@@ -1,0 +1,14 @@
+"""Test configuration: force the CPU backend with 8 virtual devices.
+
+The reference's distributed tests require multi-GPU hardware; on TPU/XLA we
+instead test true SPMD on a virtual CPU mesh (SURVEY.md §4 design
+requirement).  NOTE: the axon TPU plugin overrides the JAX_PLATFORMS env var,
+so the platform must be forced via jax.config before any array is created.
+"""
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,197 @@
+"""Core IR and jit pipeline tests (analog of reference tests/test_core.py)."""
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import thunder_tpu as ttpu
+from thunder_tpu.core import dtypes, prims
+from thunder_tpu.core.proxies import TensorProxy
+from thunder_tpu.core.trace import TraceCtx, tracectx
+
+
+def test_trace_records_and_prints():
+    tr = TraceCtx(lambda a, b: None)
+    with tracectx(tr):
+        a = TensorProxy(name="a", shape=(4, 4), device="cpu", dtype=dtypes.float32)
+        b = TensorProxy(name="b", shape=(4, 4), device="cpu", dtype=dtypes.float32)
+        c = prims.add(a, b)
+        prims.python_return(c)
+    tr.args = (a, b)
+    src = tr.python()
+    assert "prims.add(a, b)" in src
+    assert "return t0" in src
+
+
+def test_jit_elementwise_add():
+    def foo(a, b):
+        return a + b
+
+    jfoo = ttpu.jit(foo)
+    a = jnp.ones((4, 4))
+    b = jnp.full((4, 4), 2.0)
+    out = jfoo(a, b)
+    assert bool((out == 3.0).all())
+
+
+def test_jit_caching_and_guards():
+    def foo(a, scale):
+        return a * scale
+
+    jfoo = ttpu.jit(foo)
+    a = jnp.ones((2, 2))
+    assert float(jfoo(a, 2.0).sum()) == 8.0
+    assert float(jfoo(a, 2.0).sum()) == 8.0
+    assert ttpu.cache_hits(jfoo) == 1
+    assert ttpu.cache_misses(jfoo) == 1
+    # number constant change -> retrace with the new constant
+    assert float(jfoo(a, 3.0).sum()) == 12.0
+    assert ttpu.cache_misses(jfoo) == 2
+    # shape change -> retrace
+    assert float(jfoo(jnp.ones((3,)), 2.0).sum()) == 6.0
+    assert ttpu.cache_misses(jfoo) == 3
+
+
+def test_jit_composite_numerics():
+    def foo(a, b):
+        c = a + b * 2.0
+        return c.tanh().sum(-1).mean()
+
+    jfoo = ttpu.jit(foo)
+    a = jnp.ones((8, 16))
+    b = jnp.full((8, 16), 0.5)
+    out = jfoo(a, b)
+    assert abs(float(out) - math.tanh(2.0) * 16) < 1e-5
+
+
+def test_broadcasting_and_promotion():
+    def foo(a, b):
+        return a + b
+
+    jfoo = ttpu.jit(foo)
+    a = jnp.ones((4, 1, 3), jnp.float32)
+    b = jnp.ones((2, 3), jnp.bfloat16)
+    out = jfoo(a, b)
+    assert out.shape == (4, 2, 3)
+    assert out.dtype == jnp.float32
+
+
+def test_int_promotion_with_float_scalar():
+    jfoo = ttpu.jit(lambda a: a * 0.5)
+    out = jfoo(jnp.arange(4))
+    assert out.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(out), [0, 0.5, 1.0, 1.5])
+
+
+def test_reductions():
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 5), jnp.float32)
+    jfn = ttpu.jit(lambda a: (a.sum(0), a.mean(1), a.amax(), a.var(1)))
+    s, m, mx, v = jfn(x)
+    np.testing.assert_allclose(np.asarray(s), np.asarray(x).sum(0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(m), np.asarray(x).mean(1), rtol=1e-5)
+    np.testing.assert_allclose(float(mx), np.asarray(x).max(), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(v), np.asarray(x).var(1, ddof=1), rtol=1e-4)
+
+
+def test_indexing_basic():
+    x = jnp.asarray(np.arange(24).reshape(2, 3, 4), jnp.float32)
+    jfn = ttpu.jit(lambda a: a[0, 1:3, ::2])
+    out = jfn(x)
+    np.testing.assert_allclose(np.asarray(out), np.arange(24).reshape(2, 3, 4)[0, 1:3, ::2])
+
+
+def test_matmul_linear():
+    x = jnp.ones((3, 4))
+    w = jnp.full((5, 4), 0.5)
+    jfn = ttpu.jit(lambda a, w: ttpu.ltorch.linear(a, w))
+    out = jfn(x, w)
+    assert out.shape == (3, 5)
+    assert bool((out == 2.0).all())
+
+
+def test_floor_divide_negative():
+    jfn = ttpu.jit(lambda a, b: a // b)
+    r = jfn(jnp.array([-7, 7, -7]), jnp.array([2, 2, -2]))
+    assert list(np.asarray(r)) == [-4, 3, 3]
+
+
+def test_trace_introspection():
+    jfn = ttpu.jit(lambda a: a.exp().sum())
+    jfn(jnp.ones((3,)))
+    traces = ttpu.last_traces(jfn)
+    assert len(traces) >= 3
+    final = traces[-1].python()
+    assert "def computation" in final
+    pro = ttpu.last_prologue_traces(jfn)[-1].python()
+    assert "check_tensor_metadata" in pro
+
+
+def test_prologue_rejects_wrong_dtype():
+    jfn = ttpu.jit(lambda a: a + 1)
+    jfn(jnp.ones((2,), jnp.float32))
+    jfn(jnp.ones((2,), jnp.bfloat16))  # retraces rather than reusing
+    assert ttpu.cache_misses(jfn) == 2
+
+
+def test_rng_reproducible():
+    import torch.nn.functional as F
+
+    ttpu.ltorch.manual_seed(42)
+    jfn = ttpu.jit(lambda x: F.dropout(x, 0.5))
+    r1 = jfn(jnp.ones((64,)))
+    r2 = jfn(jnp.ones((64,)))
+    assert bool((np.asarray(r1) != np.asarray(r2)).any())
+    ttpu.ltorch.manual_seed(42)
+    r1b = jfn(jnp.ones((64,)))
+    np.testing.assert_array_equal(np.asarray(r1), np.asarray(r1b))
+
+
+def test_torch_function_interop():
+    import torch
+    import torch.nn.functional as F
+
+    def foo(x, w):
+        return F.linear(F.gelu(x), w).softmax(-1)
+
+    jfn = ttpu.jit(foo)
+    out = jfn(jnp.ones((4, 8)), jnp.full((6, 8), 0.1))
+    assert out.shape == (4, 6)
+    np.testing.assert_allclose(float(out.sum()), 4.0, rtol=1e-5)
+
+
+def test_dce_removes_dead_code():
+    def foo(a):
+        dead = a * 100.0
+        return a + 1
+
+    jfn = ttpu.jit(foo)
+    jfn(jnp.ones((2,)))
+    final = ttpu.last_traces(jfn)[-1]
+    src = final.python()
+    assert "100" not in src
+
+
+def test_cse_deduplicates():
+    def foo(a):
+        return a.exp() + a.exp()
+
+    jfn = ttpu.jit(foo)
+    out = jfn(jnp.ones((2,)))
+    np.testing.assert_allclose(np.asarray(out), 2 * np.exp(np.ones(2)), rtol=1e-5)
+    # after cse there is exactly one exp in the trace
+    post_cse = [t for t in ttpu.last_traces(jfn) if "Common Subexpression" in str(t.get_provenance())]
+    assert len(post_cse) == 1
+    n_exp = sum(1 for b in post_cse[0].bound_symbols for s in ([b] + list(b.subsymbols)) if s.sym.name == "exp")
+    assert n_exp <= 2  # ltorch.exp + prims.exp subsymbol, once
+
+
+def test_executor_stack_produces_fusion():
+    def foo(a, b):
+        return ((a + b) * a).tanh().sum()
+
+    jfn = ttpu.jit(foo)
+    jfn(jnp.ones((8, 8)), jnp.ones((8, 8)))
+    src = ttpu.last_traces(jfn)[-1].python()
+    assert "XLA0" in src  # region was compiled as one XLA program

@@ -1,0 +1,242 @@
+"""thunder_tpu: a TPU-native source-to-source JIT compiler framework.
+
+A brand-new framework with the capabilities of Lightning Thunder (the
+reference at /root/reference), designed TPU-first: traces lower to XLA via
+JAX, hot ops to Pallas kernels, and distribution to shardings over a
+``jax.sharding.Mesh``.
+
+Public API parity with the reference's ``thunder/__init__.py``:
+``jit`` (:302), ``last_traces`` (:729), ``last_prologue_traces``,
+``compile_data``/``compile_stats`` (:709,718), ``list_transforms``,
+``last_compile_options`` (:850).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Sequence
+
+from thunder_tpu import clang  # noqa: F401
+from thunder_tpu import torch as ltorch  # noqa: F401  (registers the torch langctx)
+from thunder_tpu.common import CacheEntry, CompileData, CompileStats
+from thunder_tpu.core import dtypes, prims
+from thunder_tpu.core.baseutils import check
+from thunder_tpu.core.compile_data import compile_data_and_stats
+from thunder_tpu.core.options import (
+    CACHE_OPTIONS,
+    SHARP_EDGES_OPTIONS,
+    resolve_cache_option,
+    resolve_sharp_edges_option,
+)
+from thunder_tpu.core.trace import TraceCtx, TraceResults
+from thunder_tpu.core.transform_common import cse, dce
+from thunder_tpu.extend import resolve_executors
+from thunder_tpu.functional import trace_from_fn
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "jit",
+    "compile",
+    "last_traces",
+    "last_backward_traces",
+    "last_prologue_traces",
+    "compile_data",
+    "compile_stats",
+    "cache_option",
+    "cache_hits",
+    "cache_misses",
+    "last_compile_options",
+    "dtypes",
+]
+
+
+def jit(
+    fn: Callable,
+    *,
+    executors: Sequence | None = None,
+    cache: str | CACHE_OPTIONS | None = None,
+    sharp_edges: str | SHARP_EDGES_OPTIONS | None = None,
+    transforms: Sequence | None = None,
+    disable_grad: bool = False,
+    **compile_options,
+) -> Callable:
+    """Compiles ``fn``: traces it into a thunder_tpu program, applies
+    transforms (grad, distributed, autocast), and dispatches to the executor
+    stack (XLA fusion ≻ Pallas ≻ eager JAX).
+
+    The returned callable caches compilations keyed by input metadata; the
+    prologue re-validates inputs on every call (reference thunder.jit,
+    __init__.py:302).
+    """
+    cd = CompileData(
+        fn=fn,
+        executors_list=resolve_executors(executors),
+        cache_option=resolve_cache_option(cache),
+        sharp_edges=resolve_sharp_edges_option(sharp_edges),
+        transforms=transforms,
+        disable_grad=disable_grad,
+        compile_options=compile_options,
+    )
+    cs = CompileStats()
+
+    def fn_(*args, **kwargs):
+        cs.calls += 1
+        cs.last_trace_host_start = time.perf_counter_ns()
+
+        cache_entry = None
+        inps = None
+        if cd.cache_option is not CACHE_OPTIONS.NO_CACHING:
+            for entry in cs.interpreter_cache:
+                try:
+                    inps = entry.prologue_fn(*args, **kwargs)
+                except Exception:
+                    continue
+                cache_entry = entry
+                cs.cache_hits += 1
+                break
+
+        if cache_entry is None:
+            cs.cache_misses += 1
+            with compile_data_and_stats(cd, cs):
+                cache_entry = _compile(cd, cs, args, kwargs)
+            if cd.cache_option is not CACHE_OPTIONS.NO_CACHING:
+                cs.interpreter_cache.append(cache_entry)
+            inps = cache_entry.prologue_fn(*args, **kwargs)
+
+        if cache_entry.uses_rng:
+            from thunder_tpu.core import rng
+
+            inps = tuple(inps) + (rng.next_key(),)
+
+        cs.last_trace_host_execution_start = time.perf_counter_ns()
+        result = cache_entry.computation_fn(*inps)
+        cs.last_trace_host_execution_stop = time.perf_counter_ns()
+        cs.last_trace_host_stop = cs.last_trace_host_execution_stop
+        return result
+
+    fn_._lc_cd = cd
+    fn_._lc_cs = cs
+    fn_.__wrapped__ = fn
+    fn_.__name__ = getattr(fn, "__name__", "fn") + "_compiled"
+    return fn_
+
+
+def _compile(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict) -> CacheEntry:
+    """Trace → transforms → executor dispatch → codegen (one cache entry)."""
+    from thunder_tpu.executors.passes import del_last_used, transform_for_execution
+
+    cs.last_trace_tracing_start = time.perf_counter_ns()
+    trace_results: TraceResults = trace_from_fn(cd.fn, args, kwargs)
+    cs.last_trace_tracing_stop = time.perf_counter_ns()
+
+    prologue_trace = trace_results.prologue_trace
+    computation_trace = trace_results.computation_trace
+    computation_trace.set_provenance("Trace acquisition (functional frontend)")
+
+    cs.last_traces = [computation_trace]
+    cs.last_prologue_traces = [prologue_trace]
+
+    computation_trace = dce(computation_trace)
+    cs.last_traces.append(computation_trace)
+    computation_trace = cse(computation_trace)
+    cs.last_traces.append(computation_trace)
+
+    # user/distributed transforms (trace -> trace)
+    for transform in cd.transforms:
+        computation_trace = transform(computation_trace)
+        cs.last_traces.append(computation_trace)
+
+    extrace = transform_for_execution(computation_trace, cd.executors_list)
+    cs.last_traces.append(extrace)
+    extrace = del_last_used(extrace)
+    cs.last_traces.append(extrace)
+
+    comp_fn = extrace.python_callable()
+    pro_fn = prologue_trace.python_callable()
+
+    uses_rng = getattr(trace_results.computation_trace, "_rng_key_proxy", None) is not None
+
+    return CacheEntry(
+        prologue_fn=pro_fn,
+        computation_fn=comp_fn,
+        backward_fn=None,
+        prologue_trace=prologue_trace,
+        computation_trace=extrace,
+        backward_trace=None,
+        epilogue_trace=trace_results.epilogue_trace,
+        uses_rng=uses_rng,
+    )
+
+
+def compile(fn: Callable, **kwargs) -> Callable:
+    """Legacy alias for ``jit`` (reference thunder.compile, __init__.py:676)."""
+    return jit(fn, **kwargs)
+
+
+#
+# grad APIs (populated by thunder_tpu.core.transforms; re-exported here)
+#
+
+
+def grad(fn: Callable, **jit_kwargs) -> Callable:
+    from thunder_tpu.core.transforms import grad as _grad
+
+    return _grad(fn, **jit_kwargs)
+
+
+def value_and_grad(fn: Callable, **jit_kwargs) -> Callable:
+    from thunder_tpu.core.transforms import value_and_grad as _value_and_grad
+
+    return _value_and_grad(fn, **jit_kwargs)
+
+
+#
+# Introspection (reference __init__.py:709-885)
+#
+
+
+def _get_cs(cfn) -> CompileStats:
+    cs = getattr(cfn, "_lc_cs", None)
+    check(cs is not None, lambda: f"{cfn} is not a thunder_tpu-compiled function")
+    return cs
+
+
+def compile_data(cfn) -> CompileData:
+    cd = getattr(cfn, "_lc_cd", None)
+    check(cd is not None, lambda: f"{cfn} is not a thunder_tpu-compiled function")
+    return cd
+
+
+def compile_stats(cfn) -> CompileStats:
+    return _get_cs(cfn)
+
+
+def last_traces(cfn) -> list[TraceCtx]:
+    return _get_cs(cfn).last_traces
+
+
+def last_backward_traces(cfn) -> list[TraceCtx]:
+    return _get_cs(cfn).last_backward_traces
+
+
+def last_prologue_traces(cfn) -> list[TraceCtx]:
+    return _get_cs(cfn).last_prologue_traces
+
+
+def cache_option(cfn) -> CACHE_OPTIONS:
+    return compile_data(cfn).cache_option
+
+
+def cache_hits(cfn) -> int:
+    return _get_cs(cfn).cache_hits
+
+
+def cache_misses(cfn) -> int:
+    return _get_cs(cfn).cache_misses
+
+
+def last_compile_options(cfn) -> dict:
+    """Which compile options the last compilation consulted (self-documented
+    via get_compile_option; reference __init__.py:850)."""
+    cs = _get_cs(cfn)
+    return dict(cs.last_compile_reasons)

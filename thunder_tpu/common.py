@@ -1,0 +1,85 @@
+"""Compile data, statistics, and cache entries.
+
+Analog of the reference's ``thunder/common.py`` (CompileData/CompileStats) and
+the CacheEntry machinery in ``thunder/__init__.py``.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Sequence
+
+from thunder_tpu.core.options import CACHE_OPTIONS, SHARP_EDGES_OPTIONS
+from thunder_tpu.core.trace import TraceCtx
+
+__all__ = ["CompileData", "CompileStats", "CacheEntry"]
+
+
+class CompileStats:
+    """Per-compiled-function counters, timings, and retained traces."""
+
+    def __init__(self):
+        self.calls: int = 0
+        self.cache_hits: int = 0
+        self.cache_misses: int = 0
+
+        self.last_trace_host_start: int = -1
+        self.last_trace_host_stop: int = -1
+        self.last_trace_tracing_start: int = -1
+        self.last_trace_tracing_stop: int = -1
+        self.last_trace_host_execution_start: int = -1
+        self.last_trace_host_execution_stop: int = -1
+
+        # all intermediate traces from the last compilation, in pass order
+        self.last_traces: list[TraceCtx] = []
+        self.last_prologue_traces: list[TraceCtx] = []
+        self.last_backward_traces: list[TraceCtx] = []
+        self.last_interpreter_log: list = []
+
+        self.last_compile_reasons: dict[str, str] = {}
+        self.used_compile_options: dict[str, Any] = {}
+
+        self.interpreter_cache: list[CacheEntry] = []
+
+
+class CompileData:
+    """Everything the compilation pipeline needs to know about one jit call."""
+
+    def __init__(
+        self,
+        *,
+        fn: Callable,
+        executors_list: Sequence,
+        cache_option: CACHE_OPTIONS,
+        sharp_edges: SHARP_EDGES_OPTIONS,
+        transforms: Sequence | None = None,
+        disable_grad: bool = False,
+        compile_options: dict | None = None,
+    ):
+        self.fn = fn
+        self.executors_list = tuple(executors_list)
+        self.cache_option = cache_option
+        self.sharp_edges = sharp_edges
+        self.transforms = list(transforms or [])
+        self.disable_grad = disable_grad
+        self.compile_options = dict(compile_options or {})
+
+        self.is_module = False
+        self.process_group = None
+
+
+@dataclass
+class CacheEntry:
+    """A (prologue, computation[, backward]) triple; the prologue doubles as the
+    cache guard — if it raises, the entry does not apply (reference
+    __init__.py:418-491)."""
+
+    prologue_fn: Callable
+    computation_fn: Callable
+    backward_fn: Callable | None
+    prologue_trace: TraceCtx
+    computation_trace: TraceCtx
+    backward_trace: TraceCtx | None
+    epilogue_trace: TraceCtx | None
+    uses_rng: bool
+    return_spec: Any = None

@@ -1,0 +1,194 @@
+"""Devices for the TPU-native framework.
+
+Analog of the reference's ``thunder/core/devices.py`` (DeviceType CPU/CUDA,
+interned Device, string parsing, framework conversion) — here the accelerator
+type is TPU and conversion targets ``jax.Device``.
+"""
+from __future__ import annotations
+
+from enum import Enum, auto
+from typing import Any, Optional
+
+from thunder_tpu.core.baseutils import check
+
+__all__ = [
+    "DeviceType",
+    "Device",
+    "device_from_string",
+    "to_device",
+    "to_jax_device",
+    "from_jax_device",
+    "cpu",
+    "available_device_types",
+]
+
+
+class DeviceType(Enum):
+    CPU = auto()
+    TPU = auto()
+    GPU = auto()  # jax cuda backend, for completeness
+
+    def __str__(self):
+        return _devicetype_prettyprint_map[self]
+
+
+_devicetype_prettyprint_map = {
+    DeviceType.CPU: "cpu",
+    DeviceType.TPU: "tpu",
+    DeviceType.GPU: "gpu",
+}
+_inverse_devicetype_prettyprint_map = {v: k for k, v in _devicetype_prettyprint_map.items()}
+
+all_devicetypes = (DeviceType.CPU, DeviceType.TPU, DeviceType.GPU)
+
+
+def devicetype_string(devicetype: DeviceType) -> str:
+    return _devicetype_prettyprint_map[devicetype]
+
+
+class Device:
+    """An interned (devicetype, index) pair.
+
+    ``Device`` objects are compared by value and safe to use as dict keys.
+    The accelerator index maps to ``jax.devices(backend)[index]``.
+    """
+
+    _interned: dict[tuple[DeviceType, int], "Device"] = {}
+
+    def __new__(cls, devicetype: DeviceType | str, index: int | None = None):
+        if isinstance(devicetype, str):
+            devicetype, parsed_index = _parse_device_string(devicetype)
+            if index is None:
+                index = parsed_index
+            else:
+                check(
+                    parsed_index is None or parsed_index == index,
+                    lambda: f"Conflicting device indices {parsed_index} vs {index}",
+                )
+        if index is None:
+            index = 0
+        check(isinstance(index, int) and index >= 0, lambda: f"Invalid device index {index}")
+        key = (devicetype, index)
+        cached = cls._interned.get(key)
+        if cached is not None:
+            return cached
+        self = super().__new__(cls)
+        self._devicetype = devicetype
+        self._index = index
+        cls._interned[key] = self
+        return self
+
+    @property
+    def devicetype(self) -> DeviceType:
+        return self._devicetype
+
+    @property
+    def type(self) -> str:
+        return devicetype_string(self._devicetype)
+
+    @property
+    def index(self) -> int:
+        return self._index
+
+    def device_str(self) -> str:
+        return f"{devicetype_string(self._devicetype)}:{self._index}"
+
+    def __repr__(self) -> str:
+        return f'Device(type="{self.device_str()}")'
+
+    def __str__(self) -> str:
+        return self.device_str()
+
+    def __hash__(self) -> int:
+        return hash((self._devicetype, self._index))
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, str):
+            other = device_from_string(other)
+        return isinstance(other, Device) and self._devicetype == other._devicetype and self._index == other._index
+
+
+def _parse_device_string(s: str) -> tuple[DeviceType, Optional[int]]:
+    parts = s.split(":")
+    check(1 <= len(parts) <= 2, lambda: f"Invalid device string {s!r}")
+    dt = _inverse_devicetype_prettyprint_map.get(parts[0])
+    # accept torch-style "cuda" as an alias for the accelerator
+    if dt is None and parts[0] == "cuda":
+        dt = DeviceType.TPU
+    check(dt is not None, lambda: f"Unknown device type in {s!r}")
+    index = int(parts[1]) if len(parts) == 2 else None
+    return dt, index
+
+
+def device_from_string(s: str) -> Device:
+    return Device(s)
+
+
+cpu = Device(DeviceType.CPU, 0)
+
+
+def to_device(x: Any) -> Device:
+    """Converts strings, jax devices, torch devices, or Devices to a Device."""
+    if x is None:
+        return default_device()
+    if isinstance(x, Device):
+        return x
+    if isinstance(x, str):
+        return device_from_string(x)
+    # jax.Device
+    platform = getattr(x, "platform", None)
+    if platform is not None:
+        return from_jax_device(x)
+    # torch.device
+    typ = getattr(x, "type", None)
+    if typ is not None:
+        return Device(typ, getattr(x, "index", None) or 0)
+    raise ValueError(f"Cannot convert {x} to a Device")
+
+
+_jax_platform_map = {
+    "cpu": DeviceType.CPU,
+    "tpu": DeviceType.TPU,
+    # axon presents the tunneled v5e chip under its own platform name
+    "axon": DeviceType.TPU,
+    "gpu": DeviceType.GPU,
+    "cuda": DeviceType.GPU,
+    "rocm": DeviceType.GPU,
+}
+
+
+def from_jax_device(jd) -> Device:
+    dt = _jax_platform_map.get(jd.platform, DeviceType.TPU)
+    return Device(dt, jd.id)
+
+
+def to_jax_device(d: Device | str):
+    """Device → concrete jax.Device."""
+    import jax
+
+    d = to_device(d)
+    if d.devicetype == DeviceType.CPU:
+        return jax.devices("cpu")[d.index]
+    devs = jax.devices()
+    accel = [x for x in devs if x.platform != "cpu"]
+    pool = accel if accel else devs
+    check(d.index < len(pool), lambda: f"Device index {d.index} out of range ({len(pool)} devices)")
+    return pool[d.index]
+
+
+def default_device() -> Device:
+    """The first accelerator if present, else cpu."""
+    import jax
+
+    for jd in jax.devices():
+        if jd.platform != "cpu":
+            return from_jax_device(jd)
+    return cpu
+
+
+def available_device_types() -> tuple[DeviceType, ...]:
+    import jax
+
+    types = {from_jax_device(d).devicetype for d in jax.devices()}
+    types.add(DeviceType.CPU)
+    return tuple(types)

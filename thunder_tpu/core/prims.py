@@ -1,0 +1,1315 @@
+"""Primitive operations: the closed instruction set traces bottom out in.
+
+Capability analog of the reference's ``thunder/core/prims.py`` (~150 prims,
+PrimIDs :94-255, OpTags :256, make_prim :271).  Prims are strict: elementwise
+prims require same-shape/same-device tensor inputs (broadcast and type
+promotion happen in ``thunder_tpu.clang``), so every prim maps 1:1 onto an XLA
+HLO-level operation and executors stay simple.
+
+TPU-first deviations from the reference:
+- Random prims take an explicit PRNG ``key`` tensor plus a static ``offset``
+  (JAX threefry-style) instead of implicit global RNG state; the frontend
+  threads a per-call key into the computation trace, keeping generated
+  programs pure and jittable (reference relies on torch's stateful RNG and a
+  separate ``uniform_philox`` for CUDA graphs).
+- No stride/contiguity prims (STRIDE_ORDER): XLA owns layout.
+"""
+from __future__ import annotations
+
+from enum import Enum, auto
+from numbers import Number
+from typing import Any, Callable, Sequence
+
+from thunder_tpu.core import dtypes, utils
+from thunder_tpu.core.baseutils import check, check_type
+from thunder_tpu.core.codeutils import prettyprint
+from thunder_tpu.core.devices import Device, to_device
+from thunder_tpu.core.proxies import (
+    AnyProxy,
+    CollectionProxy,
+    NumberProxy,
+    Proxy,
+    TensorProxy,
+    numberproxy,
+    pyval,
+)
+from thunder_tpu.core.symbol import BoundSymbol, Symbol, default_python_printer
+
+__all__ = ["PrimIDs", "OpTags", "make_prim", "get_prim", "prim_lookup"]
+
+
+class OpTags(Enum):
+    ELEMENTWISE_UNARY_OP = auto()
+    ELEMENTWISE_BINARY_OP = auto()
+    SHAPE_OP = auto()
+    REDUCTION_OP = auto()
+    RANDOM_OP = auto()
+    MATMUL_OP = auto()
+    INDEXING_OP = auto()
+    DEVICE_SYNC_OP = auto()
+    COMM_OP = auto()
+    DONT_DCE = auto()
+    CHECK_OP = auto()
+    UNPACK_OP = auto()
+    CTX_MANAGER_OP = auto()
+    AUTOCAST_DOWNCAST = auto()
+
+
+class PrimIDs(Enum):
+    # Prologue: unpack and check
+    UNPACK_TRIVIAL = auto()
+    UNPACK_FLATTEN = auto()
+    UNPACK_GETITEM = auto()
+    UNPACK_ATTR = auto()
+    CHECK_TENSOR_METADATA = auto()
+    CHECK_NUMBER_TYPE_AND_VALUE = auto()
+    CHECK_STRING_VALUE = auto()
+    CHECK_INSTANCE = auto()
+    CHECK_LEN = auto()
+    CHECK_LITERAL_LIKE = auto()
+    CHECK_NONE = auto()
+    # Utility
+    DEL = auto()
+    RETURN = auto()
+    COMMENT = auto()
+    PRINT = auto()
+    PYTHON_VARS = auto()
+    # Grad markers
+    GET_GRAD = auto()
+    PUT_GRAD = auto()
+    # Data movement
+    CONVERT_ELEMENT_TYPE = auto()
+    DEVICE_PUT = auto()
+    ITEM = auto()
+    COPY_ = auto()
+    SHARD = auto()
+    # Tensor creation
+    FULL = auto()
+    IOTA = auto()
+    UNIFORM = auto()
+    RANDN = auto()
+    RANDINT = auto()
+    MULTINOMIAL = auto()
+    # Shape
+    BROADCAST_IN_DIM = auto()
+    CAT = auto()
+    FLIP = auto()
+    RESHAPE = auto()
+    SLICE = auto()
+    SQUEEZE = auto()
+    TRANSPOSE = auto()
+    UNFOLD = auto()
+    PAD = auto()
+    # Elementwise unary
+    ABS = auto()
+    ACOS = auto()
+    ACOSH = auto()
+    ASIN = auto()
+    ASINH = auto()
+    ATAN = auto()
+    ATANH = auto()
+    BITWISE_NOT = auto()
+    CEIL = auto()
+    COS = auto()
+    COSH = auto()
+    DIGAMMA = auto()
+    ERF = auto()
+    ERFC = auto()
+    ERFINV = auto()
+    EXP = auto()
+    EXP2 = auto()
+    EXPM1 = auto()
+    FLOOR = auto()
+    ISFINITE = auto()
+    ISINF = auto()
+    ISNAN = auto()
+    LGAMMA = auto()
+    LOG = auto()
+    LOG10 = auto()
+    LOG1P = auto()
+    LOG2 = auto()
+    NEG = auto()
+    RECIPROCAL = auto()
+    ROUND = auto()
+    RSQRT = auto()
+    SIGN = auto()
+    SIGNBIT = auto()
+    SIN = auto()
+    SINH = auto()
+    SQRT = auto()
+    TAN = auto()
+    TANH = auto()
+    TRUNC = auto()
+    REAL = auto()
+    IMAG = auto()
+    # Elementwise binary
+    ADD = auto()
+    ATAN2 = auto()
+    BITWISE_AND = auto()
+    BITWISE_OR = auto()
+    BITWISE_XOR = auto()
+    SHIFT_LEFT = auto()
+    SHIFT_RIGHT = auto()
+    COPYSIGN = auto()
+    DIV = auto()
+    EQ = auto()
+    FMOD = auto()
+    GE = auto()
+    GT = auto()
+    LE = auto()
+    LT = auto()
+    MAXIMUM = auto()
+    MINIMUM = auto()
+    MUL = auto()
+    NE = auto()
+    NEXTAFTER = auto()
+    POW = auto()
+    REMAINDER = auto()
+    SUB = auto()
+    # Conditional
+    WHERE = auto()
+    CLAMP = auto()
+    # Reductions
+    AMAX = auto()
+    AMIN = auto()
+    PROD = auto()
+    SUM = auto()
+    VAR = auto()
+    VAR_MEAN = auto()
+    ARGMAX = auto()
+    ARGMIN = auto()
+    TOPK = auto()
+    SORT = auto()
+    ARGSORT = auto()
+    CUMSUM = auto()
+    # Scatter/gather
+    INDEX_ADD = auto()
+    INDEX_PUT = auto()
+    SCATTER_ADD = auto()
+    GATHER = auto()
+    TAKE = auto()
+    TAKE_ALONG_AXIS = auto()
+    # Linear algebra / NN
+    MATMUL = auto()
+    LINEAR = auto()
+    EMBEDDING = auto()
+    EMBEDDING_BACKWARD = auto()
+    CONVOLUTION = auto()
+    ONE_HOT = auto()
+
+
+#
+# Registration
+#
+
+prim_lookup: dict[PrimIDs, Symbol] = {}
+
+import sys
+
+_this_module = sys.modules[__name__]
+
+
+def make_prim(
+    id: PrimIDs,
+    name: str,
+    *,
+    meta: Callable,
+    python_printer: Callable = default_python_printer,
+    python_impl: Callable | None = None,
+    tags: Sequence[OpTags] | None = None,
+    _bind_postprocess: Callable | None = None,
+) -> Symbol:
+    sym = Symbol(
+        name=name,
+        meta=meta,
+        id=id,
+        is_prim=True,
+        tags=tags,
+        python_printer=python_printer,
+        python_impl=python_impl,
+        module=_this_module,
+        _bind_postprocess=_bind_postprocess,
+    )
+    prim_lookup[id] = sym
+    return sym
+
+
+def get_prim(id: PrimIDs) -> Symbol:
+    return prim_lookup[id]
+
+
+# module print name used by Symbol.name_with_module via module.__name__
+__print_name__ = "prims"
+
+
+#
+# Meta helpers
+#
+
+
+def _out_like(
+    a: TensorProxy,
+    *,
+    shape: Sequence[int] | None = None,
+    dtype: dtypes.dtype | None = None,
+    device: Device | None = None,
+    requires_grad: bool | None = None,
+) -> TensorProxy:
+    rg = a.requires_grad if requires_grad is None else requires_grad
+    d = a.dtype if dtype is None else dtype
+    if dtypes.is_exact_dtype(d):
+        rg = False
+    return TensorProxy(
+        shape=tuple(shape if shape is not None else a.shape),
+        device=device if device is not None else a.device,
+        dtype=d,
+        requires_grad=rg,
+    )
+
+
+def _check_tensor(a, name="input"):
+    check_type(a, TensorProxy)
+
+
+def _same_meta(*tensors: TensorProxy, name: str):
+    utils.check_same_shape(*tensors, name=name)
+    utils.check_same_device(*tensors, name=name)
+    utils.check_same_dtype(*tensors, name=name)
+
+
+#
+# Elementwise prims
+#
+
+
+def _elementwise_unary_meta_factory(name: str, *, out_dtype: Callable | None = None, float_only: bool = False):
+    def meta(a: TensorProxy) -> TensorProxy:
+        _check_tensor(a, name)
+        if float_only:
+            check(
+                dtypes.is_inexact_dtype(a.dtype),
+                lambda: f"{name} requires a floating dtype, got {a.dtype}",
+            )
+        d = out_dtype(a.dtype) if out_dtype is not None else a.dtype
+        rg = a.requires_grad and dtypes.is_inexact_dtype(d)
+        return _out_like(a, dtype=d, requires_grad=rg)
+
+    meta.__name__ = f"{name}_meta"
+    return meta
+
+
+def _bool_dtype(_):
+    return dtypes.bool8
+
+
+def _abs_dtype(d):
+    if dtypes.is_complex_dtype(d):
+        return dtypes.corresponding_real_dtype(d)
+    return d
+
+
+_unary_defs = [
+    # (PrimID, name, out_dtype_fn, float_only)
+    (PrimIDs.ABS, "abs", _abs_dtype, False),
+    (PrimIDs.ACOS, "acos", None, True),
+    (PrimIDs.ACOSH, "acosh", None, True),
+    (PrimIDs.ASIN, "asin", None, True),
+    (PrimIDs.ASINH, "asinh", None, True),
+    (PrimIDs.ATAN, "atan", None, True),
+    (PrimIDs.ATANH, "atanh", None, True),
+    (PrimIDs.BITWISE_NOT, "bitwise_not", None, False),
+    (PrimIDs.CEIL, "ceil", None, False),
+    (PrimIDs.COS, "cos", None, True),
+    (PrimIDs.COSH, "cosh", None, True),
+    (PrimIDs.DIGAMMA, "digamma", None, True),
+    (PrimIDs.ERF, "erf", None, True),
+    (PrimIDs.ERFC, "erfc", None, True),
+    (PrimIDs.ERFINV, "erfinv", None, True),
+    (PrimIDs.EXP, "exp", None, True),
+    (PrimIDs.EXP2, "exp2", None, True),
+    (PrimIDs.EXPM1, "expm1", None, True),
+    (PrimIDs.FLOOR, "floor", None, False),
+    (PrimIDs.ISFINITE, "isfinite", _bool_dtype, False),
+    (PrimIDs.ISINF, "isinf", _bool_dtype, False),
+    (PrimIDs.ISNAN, "isnan", _bool_dtype, False),
+    (PrimIDs.LGAMMA, "lgamma", None, True),
+    (PrimIDs.LOG, "log", None, True),
+    (PrimIDs.LOG10, "log10", None, True),
+    (PrimIDs.LOG1P, "log1p", None, True),
+    (PrimIDs.LOG2, "log2", None, True),
+    (PrimIDs.NEG, "neg", None, False),
+    (PrimIDs.RECIPROCAL, "reciprocal", None, True),
+    (PrimIDs.ROUND, "round", None, False),
+    (PrimIDs.RSQRT, "rsqrt", None, True),
+    (PrimIDs.SIGN, "sign", None, False),
+    (PrimIDs.SIGNBIT, "signbit", _bool_dtype, False),
+    (PrimIDs.SIN, "sin", None, True),
+    (PrimIDs.SINH, "sinh", None, True),
+    (PrimIDs.SQRT, "sqrt", None, True),
+    (PrimIDs.TAN, "tan", None, True),
+    (PrimIDs.TANH, "tanh", None, True),
+    (PrimIDs.TRUNC, "trunc", None, False),
+    (PrimIDs.REAL, "real", _abs_dtype, False),
+    (PrimIDs.IMAG, "imag", _abs_dtype, False),
+]
+
+for _pid, _name, _odt, _fo in _unary_defs:
+    _sym = make_prim(
+        _pid,
+        _name,
+        meta=_elementwise_unary_meta_factory(_name, out_dtype=_odt, float_only=_fo),
+        tags=(OpTags.ELEMENTWISE_UNARY_OP,),
+    )
+    setattr(_this_module, _name, _sym)
+
+
+def _elementwise_binary_meta_factory(name: str, *, out_dtype: Callable | None = None):
+    def meta(a: TensorProxy, b: TensorProxy) -> TensorProxy:
+        _check_tensor(a, name)
+        _check_tensor(b, name)
+        _same_meta(a, b, name=name)
+        d = out_dtype(a.dtype) if out_dtype is not None else a.dtype
+        rg = (a.requires_grad or b.requires_grad) and dtypes.is_inexact_dtype(d)
+        return _out_like(a, dtype=d, requires_grad=rg)
+
+    meta.__name__ = f"{name}_meta"
+    return meta
+
+
+_binary_defs = [
+    (PrimIDs.ADD, "add", None),
+    (PrimIDs.ATAN2, "atan2", None),
+    (PrimIDs.BITWISE_AND, "bitwise_and", None),
+    (PrimIDs.BITWISE_OR, "bitwise_or", None),
+    (PrimIDs.BITWISE_XOR, "bitwise_xor", None),
+    (PrimIDs.SHIFT_LEFT, "shift_left", None),
+    (PrimIDs.SHIFT_RIGHT, "shift_right", None),
+    (PrimIDs.COPYSIGN, "copysign", None),
+    (PrimIDs.DIV, "div", None),
+    (PrimIDs.EQ, "eq", _bool_dtype),
+    (PrimIDs.FMOD, "fmod", None),
+    (PrimIDs.GE, "ge", _bool_dtype),
+    (PrimIDs.GT, "gt", _bool_dtype),
+    (PrimIDs.LE, "le", _bool_dtype),
+    (PrimIDs.LT, "lt", _bool_dtype),
+    (PrimIDs.MAXIMUM, "maximum", None),
+    (PrimIDs.MINIMUM, "minimum", None),
+    (PrimIDs.MUL, "mul", None),
+    (PrimIDs.NE, "ne", _bool_dtype),
+    (PrimIDs.NEXTAFTER, "nextafter", None),
+    (PrimIDs.POW, "pow", None),
+    (PrimIDs.REMAINDER, "remainder", None),
+    (PrimIDs.SUB, "sub", None),
+]
+
+for _pid, _name, _odt in _binary_defs:
+    _sym = make_prim(
+        _pid,
+        _name,
+        meta=_elementwise_binary_meta_factory(_name, out_dtype=_odt),
+        tags=(OpTags.ELEMENTWISE_BINARY_OP,),
+    )
+    setattr(_this_module, _name, _sym)
+
+
+def _where_meta(pred: TensorProxy, a: TensorProxy, b: TensorProxy) -> TensorProxy:
+    _check_tensor(pred, "where")
+    _check_tensor(a, "where")
+    _check_tensor(b, "where")
+    utils.check_same_shape(pred, a, b, name="where")
+    utils.check_same_device(pred, a, b, name="where")
+    utils.check_same_dtype(a, b, name="where")
+    check(dtypes.is_boolean_dtype(pred.dtype), lambda: f"where predicate must be bool, got {pred.dtype}")
+    rg = (a.requires_grad or b.requires_grad) and dtypes.is_inexact_dtype(a.dtype)
+    return _out_like(a, requires_grad=rg)
+
+
+where = make_prim(PrimIDs.WHERE, "where", meta=_where_meta)
+
+
+def _clamp_meta(a: TensorProxy, min: TensorProxy, max: TensorProxy) -> TensorProxy:
+    _same_meta(a, min, max, name="clamp")
+    return _out_like(a)
+
+
+clamp = make_prim(PrimIDs.CLAMP, "clamp", meta=_clamp_meta)
+
+
+#
+# Data movement
+#
+
+
+def _convert_element_type_meta(a: TensorProxy, dtype: dtypes.dtype) -> TensorProxy:
+    _check_tensor(a)
+    check(dtypes.is_dtype(dtype), lambda: f"convert_element_type: {dtype} is not a dtype")
+    d = dtypes.resolve_dtype(dtype)
+    rg = a.requires_grad and dtypes.is_inexact_dtype(d)
+    return _out_like(a, dtype=d, requires_grad=rg)
+
+
+convert_element_type = make_prim(PrimIDs.CONVERT_ELEMENT_TYPE, "convert_element_type", meta=_convert_element_type_meta)
+
+
+def _device_put_meta(a: TensorProxy, device: Device) -> TensorProxy:
+    _check_tensor(a)
+    return _out_like(a, device=to_device(device))
+
+
+device_put = make_prim(PrimIDs.DEVICE_PUT, "device_put", meta=_device_put_meta, tags=(OpTags.DEVICE_SYNC_OP,))
+
+
+def _item_meta(a: TensorProxy):
+    _check_tensor(a)
+    check(a.numel == 1, lambda: f"item requires a one-element tensor, got shape {a.shape}")
+    return numberproxy(dtypes.dtype_to_numbertype(a.dtype), None)
+
+
+item = make_prim(PrimIDs.ITEM, "item", meta=_item_meta, tags=(OpTags.DEVICE_SYNC_OP,))
+
+
+def _copy__meta(a: TensorProxy, b: TensorProxy) -> TensorProxy:
+    _same_meta(a, b, name="copy_")
+    return _out_like(a)
+
+
+copy_ = make_prim(PrimIDs.COPY_, "copy_", meta=_copy__meta, tags=(OpTags.DONT_DCE,))
+
+
+#
+# Tensor creation
+#
+
+
+def _full_meta(shape: Sequence[int], fill_value, *, device: Device, dtype: dtypes.dtype) -> TensorProxy:
+    dev = to_device(device)
+    d = dtypes.resolve_dtype(dtype)
+    return TensorProxy(shape=tuple(int(s) for s in shape), device=dev, dtype=d, requires_grad=False)
+
+
+full = make_prim(PrimIDs.FULL, "full", meta=_full_meta)
+
+
+def _iota_meta(length: int, *, start: int, step: int, device: Device, dtype: dtypes.dtype) -> TensorProxy:
+    check(dtypes.is_exact_dtype(dtype) or dtypes.is_inexact_dtype(dtype), lambda: f"bad iota dtype {dtype}")
+    return TensorProxy(
+        shape=(int(length),),
+        device=to_device(device),
+        dtype=dtypes.resolve_dtype(dtype),
+        requires_grad=False,
+    )
+
+
+iota = make_prim(PrimIDs.IOTA, "iota", meta=_iota_meta)
+
+
+def _uniform_meta(shape, minval, maxval, *, device: Device, dtype: dtypes.dtype, key: TensorProxy, offset: int) -> TensorProxy:
+    check(dtypes.is_float_dtype(dtype), lambda: f"uniform requires float dtype, got {dtype}")
+    return TensorProxy(
+        shape=tuple(int(s) for s in shape),
+        device=to_device(device),
+        dtype=dtypes.to_strong_dtype(dtype),
+        requires_grad=False,
+    )
+
+
+uniform = make_prim(PrimIDs.UNIFORM, "uniform", meta=_uniform_meta, tags=(OpTags.RANDOM_OP,))
+
+
+def _randn_meta(shape, *, device: Device, dtype: dtypes.dtype, key: TensorProxy, offset: int) -> TensorProxy:
+    check(dtypes.is_float_dtype(dtype), lambda: f"randn requires float dtype, got {dtype}")
+    return TensorProxy(
+        shape=tuple(int(s) for s in shape),
+        device=to_device(device),
+        dtype=dtypes.to_strong_dtype(dtype),
+        requires_grad=False,
+    )
+
+
+randn = make_prim(PrimIDs.RANDN, "randn", meta=_randn_meta, tags=(OpTags.RANDOM_OP,))
+
+
+def _randint_meta(shape, low: int, high: int, *, device: Device, dtype: dtypes.dtype, key: TensorProxy, offset: int) -> TensorProxy:
+    check(dtypes.is_exact_dtype(dtype), lambda: f"randint requires integer dtype, got {dtype}")
+    return TensorProxy(
+        shape=tuple(int(s) for s in shape),
+        device=to_device(device),
+        dtype=dtypes.to_strong_dtype(dtype),
+        requires_grad=False,
+    )
+
+
+randint = make_prim(PrimIDs.RANDINT, "randint", meta=_randint_meta, tags=(OpTags.RANDOM_OP,))
+
+
+def _multinomial_meta(a: TensorProxy, num_samples: int, replacement: bool, *, key: TensorProxy, offset: int) -> TensorProxy:
+    _check_tensor(a)
+    check(1 <= a.ndim <= 2, lambda: "multinomial requires a 1D or 2D input")
+    shape = (a.shape[0], num_samples) if a.ndim == 2 else (num_samples,)
+    return TensorProxy(shape=shape, device=a.device, dtype=dtypes.int32, requires_grad=False)
+
+
+multinomial = make_prim(PrimIDs.MULTINOMIAL, "multinomial", meta=_multinomial_meta, tags=(OpTags.RANDOM_OP,))
+
+
+#
+# Shape prims
+#
+
+
+def _broadcast_in_dim_meta(a: TensorProxy, shape: Sequence[int], broadcast_dimensions: Sequence[int]) -> TensorProxy:
+    _check_tensor(a)
+    shape = tuple(int(s) for s in shape)
+    bdims = tuple(int(d) for d in broadcast_dimensions)
+    check(len(bdims) == a.ndim, lambda: f"broadcast_in_dim: {len(bdims)} dims for rank {a.ndim}")
+    for i, d in enumerate(bdims):
+        check(0 <= d < len(shape), lambda: f"broadcast_in_dim: dim {d} out of range")
+        check(
+            a.shape[i] == shape[d] or a.shape[i] == 1,
+            lambda: f"broadcast_in_dim: cannot broadcast {a.shape} to {shape} via {bdims}",
+        )
+    return _out_like(a, shape=shape)
+
+
+broadcast_in_dim = make_prim(
+    PrimIDs.BROADCAST_IN_DIM, "broadcast_in_dim", meta=_broadcast_in_dim_meta, tags=(OpTags.SHAPE_OP,)
+)
+
+
+def _cat_meta(tensors: Sequence[TensorProxy], dim: int) -> TensorProxy:
+    check(len(tensors) > 0, lambda: "cat expects at least one tensor")
+    first = tensors[0]
+    dim = utils.canonicalize_dim(first.ndim, int(dim))
+    total = 0
+    for t in tensors:
+        _check_tensor(t)
+        check(t.ndim == first.ndim, lambda: "cat: rank mismatch")
+        for i in range(first.ndim):
+            if i != dim:
+                check(t.shape[i] == first.shape[i], lambda: f"cat: shape mismatch at dim {i}")
+        total += t.shape[dim]
+    shape = list(first.shape)
+    shape[dim] = total
+    rg = any(t.requires_grad for t in tensors)
+    return _out_like(first, shape=shape, requires_grad=rg)
+
+
+cat = make_prim(PrimIDs.CAT, "cat", meta=_cat_meta, tags=(OpTags.SHAPE_OP,))
+
+
+def _flip_meta(a: TensorProxy, dims: Sequence[int]) -> TensorProxy:
+    _check_tensor(a)
+    dims = tuple(utils.canonicalize_dim(a.ndim, int(d)) for d in dims)
+    utils.check_no_duplicates(dims)
+    return _out_like(a)
+
+
+flip = make_prim(PrimIDs.FLIP, "flip", meta=_flip_meta, tags=(OpTags.SHAPE_OP,))
+
+
+def _reshape_meta(a: TensorProxy, shape: Sequence[int]) -> TensorProxy:
+    _check_tensor(a)
+    shape = tuple(int(s) for s in shape)
+    n = 1
+    for s in shape:
+        n *= s
+    check(n == a.numel, lambda: f"reshape: cannot reshape {a.shape} to {shape}")
+    return _out_like(a, shape=shape)
+
+
+reshape = make_prim(PrimIDs.RESHAPE, "reshape", meta=_reshape_meta, tags=(OpTags.SHAPE_OP,))
+
+
+def _slice_meta(
+    a: TensorProxy, start_indices: Sequence[int], end_indices: Sequence[int], strides: Sequence[int] | None = None
+) -> TensorProxy:
+    _check_tensor(a)
+    check(len(start_indices) == a.ndim and len(end_indices) == a.ndim, lambda: "slice: rank mismatch")
+    if strides is None:
+        strides = [1] * a.ndim
+    shape = []
+    for s, e, st, dim in zip(start_indices, end_indices, strides, a.shape):
+        s, e, st = int(s), int(e), int(st)
+        check(0 <= s <= dim and s <= e <= dim and st > 0, lambda: f"slice: bad indices {s}:{e}:{st} for dim {dim}")
+        shape.append((e - s + st - 1) // st)
+    return _out_like(a, shape=shape)
+
+
+slice_prim = make_prim(PrimIDs.SLICE, "slice_prim", meta=_slice_meta, tags=(OpTags.SHAPE_OP,))
+
+
+def _squeeze_meta(a: TensorProxy, dims: Sequence[int]) -> TensorProxy:
+    _check_tensor(a)
+    dims = tuple(utils.canonicalize_dim(a.ndim, int(d)) for d in dims)
+    utils.check_no_duplicates(dims)
+    shape = []
+    for i, s in enumerate(a.shape):
+        if i in dims:
+            check(s == 1, lambda: f"squeeze: dim {i} has size {s} != 1")
+        else:
+            shape.append(s)
+    return _out_like(a, shape=shape)
+
+
+squeeze = make_prim(PrimIDs.SQUEEZE, "squeeze", meta=_squeeze_meta, tags=(OpTags.SHAPE_OP,))
+
+
+def _transpose_meta(a: TensorProxy, permutation: Sequence[int]) -> TensorProxy:
+    _check_tensor(a)
+    perm = tuple(utils.canonicalize_dim(a.ndim, int(d)) for d in permutation)
+    utils.check_no_duplicates(perm)
+    check(len(perm) == a.ndim, lambda: f"transpose: permutation {perm} for rank {a.ndim}")
+    shape = tuple(a.shape[p] for p in perm)
+    return _out_like(a, shape=shape)
+
+
+transpose = make_prim(PrimIDs.TRANSPOSE, "transpose", meta=_transpose_meta, tags=(OpTags.SHAPE_OP,))
+
+
+def _unfold_meta(a: TensorProxy, dim: int, size: int, step: int) -> TensorProxy:
+    _check_tensor(a)
+    dim = utils.canonicalize_dim(a.ndim, int(dim))
+    size, step = int(size), int(step)
+    check(size <= a.shape[dim], lambda: f"unfold: size {size} > dim size {a.shape[dim]}")
+    shape = list(a.shape)
+    shape[dim] = (a.shape[dim] - size) // step + 1
+    shape.append(size)
+    return _out_like(a, shape=shape)
+
+
+unfold = make_prim(PrimIDs.UNFOLD, "unfold", meta=_unfold_meta, tags=(OpTags.SHAPE_OP,))
+
+
+def _pad_meta(a: TensorProxy, padding_value, padding_config: Sequence[tuple[int, int, int]]) -> TensorProxy:
+    _check_tensor(a)
+    check(len(padding_config) == a.ndim, lambda: "pad: config rank mismatch")
+    shape = []
+    for (lo, hi, interior), s in zip(padding_config, a.shape):
+        check(interior >= 0, lambda: "pad: negative interior padding")
+        new = s + lo + hi + max(0, s - 1) * interior
+        check(new >= 0, lambda: f"pad: negative result dim {new}")
+        shape.append(new)
+    return _out_like(a, shape=shape)
+
+
+pad = make_prim(PrimIDs.PAD, "pad", meta=_pad_meta, tags=(OpTags.SHAPE_OP,))
+
+
+#
+# Reductions
+#
+
+
+def _reduction_meta_factory(name: str, *, out_dtype: Callable | None = None):
+    def meta(a: TensorProxy, dims: Sequence[int]) -> TensorProxy:
+        _check_tensor(a, name)
+        dims = tuple(utils.canonicalize_dim(a.ndim, int(d)) for d in dims)
+        utils.check_no_duplicates(dims)
+        shape = tuple(s for i, s in enumerate(a.shape) if i not in dims)
+        d = out_dtype(a.dtype) if out_dtype is not None else a.dtype
+        rg = a.requires_grad and dtypes.is_inexact_dtype(d)
+        return _out_like(a, shape=shape, dtype=d, requires_grad=rg)
+
+    meta.__name__ = f"{name}_meta"
+    return meta
+
+
+amax = make_prim(PrimIDs.AMAX, "amax", meta=_reduction_meta_factory("amax"), tags=(OpTags.REDUCTION_OP,))
+amin = make_prim(PrimIDs.AMIN, "amin", meta=_reduction_meta_factory("amin"), tags=(OpTags.REDUCTION_OP,))
+prod = make_prim(PrimIDs.PROD, "prod", meta=_reduction_meta_factory("prod"), tags=(OpTags.REDUCTION_OP,))
+sum_prim = make_prim(PrimIDs.SUM, "sum", meta=_reduction_meta_factory("sum"), tags=(OpTags.REDUCTION_OP,))
+setattr(_this_module, "sum", sum_prim)
+
+
+def _var_meta(a: TensorProxy, dims: Sequence[int], *, correction: float) -> TensorProxy:
+    m = _reduction_meta_factory("var")(a, dims)
+    d = m.dtype
+    if dtypes.is_complex_dtype(d):
+        d = dtypes.corresponding_real_dtype(d)
+    return _out_like(m, dtype=d)
+
+
+var = make_prim(PrimIDs.VAR, "var", meta=_var_meta, tags=(OpTags.REDUCTION_OP,))
+
+
+def _var_mean_meta(a: TensorProxy, dims: Sequence[int], *, correction: float):
+    v = _var_meta(a, dims, correction=correction)
+    m = _reduction_meta_factory("mean")(a, dims)
+    return v, m
+
+
+var_mean = make_prim(PrimIDs.VAR_MEAN, "var_mean", meta=_var_mean_meta, tags=(OpTags.REDUCTION_OP,))
+
+
+def _arg_reduction_meta_factory(name: str):
+    def meta(a: TensorProxy, dim: int | None) -> TensorProxy:
+        _check_tensor(a, name)
+        if dim is None:
+            shape: tuple = ()
+        else:
+            d = utils.canonicalize_dim(a.ndim, int(dim))
+            shape = tuple(s for i, s in enumerate(a.shape) if i != d)
+        # TPU-native: index results are int32 (x64 is disabled; impls emit int32)
+        return TensorProxy(shape=shape, device=a.device, dtype=dtypes.int32, requires_grad=False)
+
+    return meta
+
+
+argmax = make_prim(PrimIDs.ARGMAX, "argmax", meta=_arg_reduction_meta_factory("argmax"), tags=(OpTags.REDUCTION_OP,))
+argmin = make_prim(PrimIDs.ARGMIN, "argmin", meta=_arg_reduction_meta_factory("argmin"), tags=(OpTags.REDUCTION_OP,))
+
+
+def _topk_meta(a: TensorProxy, k: int, dim: int, largest: bool, sorted: bool):
+    _check_tensor(a)
+    dim = utils.canonicalize_dim(a.ndim, int(dim))
+    k = int(k)
+    check(0 <= k <= a.shape[dim], lambda: f"topk: k={k} out of range for dim size {a.shape[dim]}")
+    shape = list(a.shape)
+    shape[dim] = k
+    values = _out_like(a, shape=shape)
+    indices = TensorProxy(shape=tuple(shape), device=a.device, dtype=dtypes.int32, requires_grad=False)
+    return values, indices
+
+
+topk = make_prim(PrimIDs.TOPK, "topk", meta=_topk_meta, tags=(OpTags.REDUCTION_OP,))
+
+
+def _sort_meta(a: TensorProxy, dim: int, descending: bool):
+    _check_tensor(a)
+    utils.canonicalize_dim(a.ndim, int(dim))
+    values = _out_like(a)
+    indices = TensorProxy(shape=a.shape, device=a.device, dtype=dtypes.int32, requires_grad=False)
+    return values, indices
+
+
+sort = make_prim(PrimIDs.SORT, "sort", meta=_sort_meta)
+
+
+def _argsort_meta(a: TensorProxy, dim: int, descending: bool) -> TensorProxy:
+    _check_tensor(a)
+    utils.canonicalize_dim(a.ndim, int(dim))
+    return TensorProxy(shape=a.shape, device=a.device, dtype=dtypes.int32, requires_grad=False)
+
+
+argsort = make_prim(PrimIDs.ARGSORT, "argsort", meta=_argsort_meta)
+
+
+def _cumsum_meta(a: TensorProxy, dim: int) -> TensorProxy:
+    _check_tensor(a)
+    utils.canonicalize_dim(a.ndim, int(dim))
+    return _out_like(a)
+
+
+cumsum = make_prim(PrimIDs.CUMSUM, "cumsum", meta=_cumsum_meta)
+
+
+#
+# Scatter/gather
+#
+
+
+def _take_meta(a: TensorProxy, indices: TensorProxy, dim: int) -> TensorProxy:
+    _check_tensor(a)
+    _check_tensor(indices)
+    check(dtypes.is_exact_dtype(indices.dtype), lambda: "take: indices must be integer")
+    check(indices.ndim <= 1, lambda: "take: indices must be 0D or 1D")
+    dim = utils.canonicalize_dim(a.ndim, int(dim))
+    shape = list(a.shape)
+    if indices.ndim == 1:
+        shape[dim] = indices.shape[0]
+    else:
+        del shape[dim]
+    return _out_like(a, shape=shape)
+
+
+take = make_prim(PrimIDs.TAKE, "take", meta=_take_meta, tags=(OpTags.INDEXING_OP,))
+
+
+def _take_along_axis_meta(a: TensorProxy, indices: TensorProxy, dim: int) -> TensorProxy:
+    _check_tensor(a)
+    _check_tensor(indices)
+    dim = utils.canonicalize_dim(a.ndim, int(dim))
+    check(indices.ndim == a.ndim, lambda: "take_along_axis: rank mismatch")
+    return _out_like(a, shape=indices.shape)
+
+
+take_along_axis = make_prim(
+    PrimIDs.TAKE_ALONG_AXIS, "take_along_axis", meta=_take_along_axis_meta, tags=(OpTags.INDEXING_OP,)
+)
+
+
+def _gather_meta(a: TensorProxy, indices: TensorProxy, dim: int) -> TensorProxy:
+    _check_tensor(a)
+    _check_tensor(indices)
+    check(indices.ndim == a.ndim, lambda: "gather: rank mismatch")
+    return _out_like(a, shape=indices.shape)
+
+
+gather = make_prim(PrimIDs.GATHER, "gather", meta=_gather_meta, tags=(OpTags.INDEXING_OP,))
+
+
+def _index_add_meta(a: TensorProxy, indices: TensorProxy, value: TensorProxy, dim: int) -> TensorProxy:
+    _check_tensor(a)
+    _check_tensor(indices)
+    _check_tensor(value)
+    utils.canonicalize_dim(a.ndim, int(dim))
+    return _out_like(a)
+
+
+index_add = make_prim(PrimIDs.INDEX_ADD, "index_add", meta=_index_add_meta, tags=(OpTags.INDEXING_OP,))
+
+
+def _index_put_meta(a: TensorProxy, indices: Sequence[TensorProxy], values: TensorProxy, accumulate: bool) -> TensorProxy:
+    _check_tensor(a)
+    _check_tensor(values)
+    return _out_like(a)
+
+
+index_put = make_prim(PrimIDs.INDEX_PUT, "index_put", meta=_index_put_meta, tags=(OpTags.INDEXING_OP,))
+
+
+def _scatter_add_meta(a: TensorProxy, indices: TensorProxy, value: TensorProxy, dim: int) -> TensorProxy:
+    _check_tensor(a)
+    _check_tensor(indices)
+    _check_tensor(value)
+    utils.canonicalize_dim(a.ndim, int(dim))
+    return _out_like(a)
+
+
+scatter_add = make_prim(PrimIDs.SCATTER_ADD, "scatter_add", meta=_scatter_add_meta, tags=(OpTags.INDEXING_OP,))
+
+
+#
+# Linear algebra / NN
+#
+
+
+def _matmul_meta(a: TensorProxy, b: TensorProxy) -> TensorProxy:
+    _check_tensor(a)
+    _check_tensor(b)
+    utils.check_same_device(a, b, name="matmul")
+    utils.check_same_dtype(a, b, name="matmul")
+    check(a.ndim >= 1 and b.ndim >= 1, lambda: "matmul: inputs must have rank >= 1")
+    if a.ndim == 1 and b.ndim == 1:
+        check(a.shape[0] == b.shape[0], lambda: f"matmul: {a.shape} x {b.shape}")
+        shape: tuple = ()
+    elif a.ndim == 1:
+        check(b.shape[-2] == a.shape[0], lambda: f"matmul: {a.shape} x {b.shape}")
+        shape = b.shape[:-2] + (b.shape[-1],)
+    elif b.ndim == 1:
+        check(a.shape[-1] == b.shape[0], lambda: f"matmul: {a.shape} x {b.shape}")
+        shape = a.shape[:-1]
+    else:
+        check(a.shape[-1] == b.shape[-2], lambda: f"matmul: {a.shape} x {b.shape}")
+        batch = _broadcast_shapes(a.shape[:-2], b.shape[:-2])
+        shape = batch + (a.shape[-2], b.shape[-1])
+    rg = (a.requires_grad or b.requires_grad) and dtypes.is_inexact_dtype(a.dtype)
+    return _out_like(a, shape=shape, requires_grad=rg)
+
+
+def _broadcast_shapes(sa: tuple, sb: tuple) -> tuple:
+    out = []
+    la, lb = len(sa), len(sb)
+    for i in range(max(la, lb)):
+        da = sa[la - 1 - i] if i < la else 1
+        db = sb[lb - 1 - i] if i < lb else 1
+        check(da == db or da == 1 or db == 1, lambda: f"Cannot broadcast {sa} with {sb}")
+        out.append(max(da, db))
+    return tuple(reversed(out))
+
+
+matmul = make_prim(PrimIDs.MATMUL, "matmul", meta=_matmul_meta, tags=(OpTags.MATMUL_OP,))
+
+
+def _linear_meta(a: TensorProxy, w: TensorProxy, bias: TensorProxy | None) -> TensorProxy:
+    _check_tensor(a)
+    _check_tensor(w)
+    check(w.ndim == 2, lambda: f"linear: weight must be 2D, got {w.ndim}D")
+    check(a.shape[-1] == w.shape[1], lambda: f"linear: {a.shape} x {w.shape}^T")
+    if bias is not None:
+        _check_tensor(bias)
+        check(bias.shape == (w.shape[0],), lambda: f"linear: bias shape {bias.shape} != ({w.shape[0]},)")
+    shape = a.shape[:-1] + (w.shape[0],)
+    rg = a.requires_grad or w.requires_grad or (bias is not None and bias.requires_grad)
+    return _out_like(a, shape=shape, requires_grad=rg and dtypes.is_inexact_dtype(a.dtype))
+
+
+linear = make_prim(PrimIDs.LINEAR, "linear", meta=_linear_meta, tags=(OpTags.MATMUL_OP,))
+
+
+def _embedding_meta(indices: TensorProxy, weight: TensorProxy, *, padding_idx: int | None = None) -> TensorProxy:
+    _check_tensor(indices)
+    _check_tensor(weight)
+    check(dtypes.is_exact_dtype(indices.dtype), lambda: "embedding: indices must be integer")
+    check(weight.ndim == 2, lambda: "embedding: weight must be 2D")
+    shape = indices.shape + (weight.shape[1],)
+    return TensorProxy(
+        shape=shape, device=weight.device, dtype=weight.dtype, requires_grad=weight.requires_grad
+    )
+
+
+embedding = make_prim(PrimIDs.EMBEDDING, "embedding", meta=_embedding_meta)
+
+
+def _embedding_backward_meta(
+    grad: TensorProxy, indices: TensorProxy, num_weights: int, padding_idx: int | None
+) -> TensorProxy:
+    _check_tensor(grad)
+    _check_tensor(indices)
+    return TensorProxy(
+        shape=(int(num_weights), grad.shape[-1]), device=grad.device, dtype=grad.dtype, requires_grad=False
+    )
+
+
+embedding_backward = make_prim(PrimIDs.EMBEDDING_BACKWARD, "embedding_backward", meta=_embedding_backward_meta)
+
+
+def _one_hot_meta(indices: TensorProxy, num_classes: int) -> TensorProxy:
+    _check_tensor(indices)
+    check(dtypes.is_exact_dtype(indices.dtype), lambda: "one_hot: indices must be integer")
+    return TensorProxy(
+        shape=indices.shape + (int(num_classes),), device=indices.device, dtype=dtypes.int32, requires_grad=False
+    )
+
+
+one_hot = make_prim(PrimIDs.ONE_HOT, "one_hot", meta=_one_hot_meta)
+
+
+def _convolution_meta(
+    a: TensorProxy,
+    weight: TensorProxy,
+    bias: TensorProxy | None,
+    stride: Sequence[int],
+    padding: Sequence[int],
+    dilation: Sequence[int],
+    transposed: bool,
+    output_padding: Sequence[int],
+    groups: int,
+) -> TensorProxy:
+    _check_tensor(a)
+    _check_tensor(weight)
+    check(not transposed, lambda: "transposed convolution is not supported yet")
+    ndim = a.ndim - 2  # spatial dims
+    check(weight.ndim == a.ndim, lambda: "convolution: weight rank mismatch")
+    out_channels = weight.shape[0]
+    spatial = []
+    for i in range(ndim):
+        inp = a.shape[2 + i] + 2 * padding[i]
+        k = dilation[i] * (weight.shape[2 + i] - 1) + 1
+        spatial.append((inp - k) // stride[i] + 1)
+    shape = (a.shape[0], out_channels, *spatial)
+    rg = a.requires_grad or weight.requires_grad or (bias is not None and bias.requires_grad)
+    return _out_like(a, shape=shape, requires_grad=rg)
+
+
+convolution = make_prim(PrimIDs.CONVOLUTION, "convolution", meta=_convolution_meta, tags=(OpTags.MATMUL_OP,))
+
+
+#
+# Utility prims
+#
+
+
+def _del_printer(bsym, out_printables, arg_printables, kwarg_printables):
+    names = ", ".join(prettyprint(a) for a in arg_printables)
+    return f"del {names}"
+
+
+def _del_meta(*args):
+    return None
+
+
+python_del = make_prim(
+    PrimIDs.DEL,
+    "python_del",
+    meta=_del_meta,
+    python_printer=_del_printer,
+    python_impl=lambda *args: None,
+)
+
+
+def _return_printer(bsym, out_printables, arg_printables, kwarg_printables):
+    if len(arg_printables) == 1:
+        return f"return {prettyprint(arg_printables[0])}"
+    return f"return ({', '.join(prettyprint(a) for a in arg_printables)})"
+
+
+def _return_meta(*args):
+    return None
+
+
+python_return = make_prim(
+    PrimIDs.RETURN,
+    "python_return",
+    meta=_return_meta,
+    python_printer=_return_printer,
+    tags=(OpTags.DONT_DCE,),
+)
+
+
+def _comment_printer(bsym, out_printables, arg_printables, kwarg_printables):
+    (s,) = arg_printables
+    return f"# {pyval(s) if isinstance(s, Proxy) else s}"
+
+
+comment = make_prim(
+    PrimIDs.COMMENT,
+    "comment",
+    meta=lambda s: None,
+    python_printer=_comment_printer,
+    python_impl=lambda s: None,
+    tags=(OpTags.DONT_DCE,),
+)
+
+
+def _print_impl(s):
+    print(s)
+
+
+python_print = make_prim(
+    PrimIDs.PRINT,
+    "python_print",
+    meta=lambda s: None,
+    python_impl=_print_impl,
+    tags=(OpTags.DONT_DCE,),
+)
+
+
+#
+# Grad markers (used by the grad transform; reference prims GET_GRAD/PUT_GRAD)
+#
+
+
+def _get_grad_meta(a: TensorProxy) -> TensorProxy:
+    _check_tensor(a)
+    return _out_like(a, requires_grad=False)
+
+
+get_grad = make_prim(PrimIDs.GET_GRAD, "get_grad", meta=_get_grad_meta)
+
+
+def _put_grad_meta(a: TensorProxy, grad: TensorProxy):
+    return None
+
+
+put_grad = make_prim(PrimIDs.PUT_GRAD, "put_grad", meta=_put_grad_meta, tags=(OpTags.DONT_DCE,))
+
+
+#
+# Prologue prims: unpacking and checking inputs.
+#
+# These have python_impls because prologues execute as plain Python over the
+# real (jax array / number) inputs — they are the cache guards.
+#
+
+
+def _unpack_trivial_printer(bsym, out_printables, arg_printables, kwarg_printables):
+    name = bsym.kwargs.get("name", None)
+    return f"# {prettyprint(out_printables)} (unpacked from signature)"
+
+
+def _unpack_trivial_meta(x: Any = None, *, name: str | None = None):
+    return x
+
+
+unpack_trivial = make_prim(
+    PrimIDs.UNPACK_TRIVIAL,
+    "unpack_trivial",
+    meta=_unpack_trivial_meta,
+    python_printer=_unpack_trivial_printer,
+    python_impl=lambda x=None, *, name=None: x,
+    tags=(OpTags.UNPACK_OP, OpTags.DONT_DCE),
+)
+
+
+def _unpack_flatten_impl(args, kwargs, spec):
+    from thunder_tpu.core.pytree import tree_flatten
+
+    flat, actual_spec = tree_flatten((tuple(args), dict(kwargs)))
+    if actual_spec != spec:
+        raise RuntimeError(
+            f"Input structure changed: expected {spec}, got {actual_spec}; recompiling"
+        )
+    return flat
+
+
+def _unpack_flatten_meta(args, kwargs, spec):
+    # the frontend binds this manually with pre-made proxies as output
+    return None
+
+
+unpack_flatten = make_prim(
+    PrimIDs.UNPACK_FLATTEN,
+    "unpack_flatten",
+    meta=_unpack_flatten_meta,
+    python_impl=_unpack_flatten_impl,
+    tags=(OpTags.UNPACK_OP, OpTags.DONT_DCE),
+)
+
+
+def _unpack_getitem_impl(coll, key):
+    return coll[key]
+
+
+unpack_getitem = make_prim(
+    PrimIDs.UNPACK_GETITEM,
+    "unpack_getitem",
+    meta=lambda coll, key: None,
+    python_impl=_unpack_getitem_impl,
+    tags=(OpTags.UNPACK_OP, OpTags.DONT_DCE),
+)
+
+
+def _unpack_attr_impl(obj, name):
+    return getattr(obj, name)
+
+
+unpack_attr = make_prim(
+    PrimIDs.UNPACK_ATTR,
+    "unpack_attr",
+    meta=lambda obj, name: None,
+    python_impl=_unpack_attr_impl,
+    tags=(OpTags.UNPACK_OP, OpTags.DONT_DCE),
+)
+
+
+def _check_tensor_metadata_impl(t, shape: tuple, device: str, dtype_str: str, requires_grad: bool):
+    import jax
+    import numpy as np
+
+    actual_device = None
+    actual_rg = False
+    if isinstance(t, jax.Array):
+        actual_shape = tuple(t.shape)
+        actual_dtype = str(np.dtype(t.dtype))
+        try:
+            from thunder_tpu.core.devices import from_jax_device
+
+            actual_device = from_jax_device(list(t.devices())[0]).device_str()
+        except Exception:
+            actual_device = None
+    elif isinstance(t, np.ndarray):
+        actual_shape = tuple(t.shape)
+        actual_dtype = str(np.dtype(t.dtype))
+        actual_device = "cpu:0"
+    else:
+        try:
+            import torch
+
+            if isinstance(t, torch.Tensor):
+                actual_shape = tuple(t.shape)
+                actual_dtype = str(t.dtype).replace("torch.", "")
+                actual_device = "cpu:0" if t.device.type == "cpu" else f"tpu:{t.device.index or 0}"
+                actual_rg = bool(t.requires_grad)
+            else:
+                raise TypeError(f"Expected an array, got {type(t)}")
+        except ImportError:  # pragma: no cover
+            raise TypeError(f"Expected an array, got {type(t)}")
+    if actual_shape != tuple(shape):
+        raise RuntimeError(f"Tensor shape changed: expected {tuple(shape)}, got {actual_shape}")
+    if actual_dtype != dtype_str:
+        raise RuntimeError(f"Tensor dtype changed: expected {dtype_str}, got {actual_dtype}")
+    if actual_device is not None and actual_device != device:
+        raise RuntimeError(f"Tensor device changed: expected {device}, got {actual_device}")
+    if actual_rg != bool(requires_grad):
+        raise RuntimeError(f"Tensor requires_grad changed: expected {requires_grad}, got {actual_rg}")
+    return None
+
+
+check_tensor_metadata = make_prim(
+    PrimIDs.CHECK_TENSOR_METADATA,
+    "check_tensor_metadata",
+    meta=lambda t, shape, device, dtype_str, requires_grad: None,
+    python_impl=_check_tensor_metadata_impl,
+    tags=(OpTags.CHECK_OP, OpTags.DONT_DCE),
+)
+
+
+def _check_number_type_and_value_impl(n, value):
+    if type(n) is not type(value) or n != value:
+        raise RuntimeError(f"Number input changed: expected {value!r} ({type(value)}), got {n!r} ({type(n)})")
+    return None
+
+
+check_number_type_and_value = make_prim(
+    PrimIDs.CHECK_NUMBER_TYPE_AND_VALUE,
+    "check_number_type_and_value",
+    meta=lambda n, value: None,
+    python_impl=_check_number_type_and_value_impl,
+    tags=(OpTags.CHECK_OP, OpTags.DONT_DCE),
+)
+
+
+def _check_string_value_impl(s, value):
+    if s != value:
+        raise RuntimeError(f"String input changed: expected {value!r}, got {s!r}")
+    return None
+
+
+check_string_value = make_prim(
+    PrimIDs.CHECK_STRING_VALUE,
+    "check_string_value",
+    meta=lambda s, value: None,
+    python_impl=_check_string_value_impl,
+    tags=(OpTags.CHECK_OP, OpTags.DONT_DCE),
+)
+
+
+def _check_instance_impl(x, types):
+    if not isinstance(x, types):
+        raise RuntimeError(f"Input type changed: expected {types}, got {type(x)}")
+    return None
+
+
+check_instance = make_prim(
+    PrimIDs.CHECK_INSTANCE,
+    "check_instance",
+    meta=lambda x, types: None,
+    python_impl=_check_instance_impl,
+    tags=(OpTags.CHECK_OP, OpTags.DONT_DCE),
+)
+
+
+def _check_len_impl(x, length):
+    if len(x) != length:
+        raise RuntimeError(f"Input length changed: expected {length}, got {len(x)}")
+    return None
+
+
+check_len = make_prim(
+    PrimIDs.CHECK_LEN,
+    "check_len",
+    meta=lambda x, length: None,
+    python_impl=_check_len_impl,
+    tags=(OpTags.CHECK_OP, OpTags.DONT_DCE),
+)
+
+
+def _check_literal_like_impl(x, value):
+    if x is not value and x != value:
+        raise RuntimeError(f"Input changed: expected {value!r}, got {x!r}")
+    return None
+
+
+check_literal_like = make_prim(
+    PrimIDs.CHECK_LITERAL_LIKE,
+    "check_literal_like",
+    meta=lambda x, value: None,
+    python_impl=_check_literal_like_impl,
+    tags=(OpTags.CHECK_OP, OpTags.DONT_DCE),
+)
+
+
+def _check_none_impl(x):
+    if x is not None:
+        raise RuntimeError(f"Input changed: expected None, got {x!r}")
+    return None
+
+
+check_none = make_prim(
+    PrimIDs.CHECK_NONE,
+    "check_none",
+    meta=lambda x: None,
+    python_impl=_check_none_impl,
+    tags=(OpTags.CHECK_OP, OpTags.DONT_DCE),
+)

@@ -1,0 +1,33 @@
+"""Pytree utilities (reference: ``thunder/core/pytree.py`` — thin optree wrapper).
+
+We wrap ``jax.tree_util`` instead: it is the native pytree engine on TPU and
+registering proxies with it lets traces flow through jax transforms directly.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax.tree_util as jtu
+
+__all__ = ["tree_flatten", "tree_unflatten", "tree_map", "tree_leaves", "tree_structure"]
+
+
+def tree_flatten(x: Any, *, is_leaf: Callable[[Any], bool] | None = None):
+    leaves, spec = jtu.tree_flatten(x, is_leaf=is_leaf)
+    return leaves, spec
+
+
+def tree_unflatten(leaves, spec):
+    return jtu.tree_unflatten(spec, leaves)
+
+
+def tree_map(fn: Callable, *trees, is_leaf: Callable[[Any], bool] | None = None):
+    return jtu.tree_map(fn, *trees, is_leaf=is_leaf)
+
+
+def tree_leaves(x: Any, *, is_leaf: Callable[[Any], bool] | None = None):
+    return jtu.tree_leaves(x, is_leaf=is_leaf)
+
+
+def tree_structure(x: Any, *, is_leaf: Callable[[Any], bool] | None = None):
+    return jtu.tree_structure(x, is_leaf=is_leaf)

@@ -1,0 +1,41 @@
+"""Global RNG state for compiled programs.
+
+TPU-first design: traces never touch implicit RNG state.  Random prims take an
+explicit threefry key tensor that the runtime threads into each call as an
+extra computation input, derived from (seed, step).  ``manual_seed`` resets the
+stream; every call of a compiled function with random ops advances ``step`` so
+dropout masks differ per step while remaining reproducible.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as np
+
+__all__ = ["manual_seed", "next_key", "current_seed"]
+
+_lock = threading.Lock()
+_seed: int = 0
+_step: int = 0
+
+
+def manual_seed(seed: int) -> None:
+    global _seed, _step
+    with _lock:
+        _seed = int(seed)
+        _step = 0
+
+
+def current_seed() -> int:
+    return _seed
+
+
+def next_key():
+    """Returns a fresh uint32[2] raw key; advances the global step."""
+    global _step
+    with _lock:
+        step = _step
+        _step += 1
+    key = jax.random.PRNGKey(_seed)
+    return jax.random.fold_in(key, step)

@@ -1,0 +1,305 @@
+"""Traces: ordered, printable, executable programs of bound symbols.
+
+Analog of the reference's ``thunder/core/trace.py`` (TraceCtx :46,
+TraceProvenance :29, ``set_tracectx`` :453, ``from_trace`` :434, TraceResults
+:582).  A trace prints itself as a runnable Python program whose calls target
+JAX-backed executors, and compiles that source with ``compile_and_exec``.
+"""
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from contextvars import ContextVar
+from dataclasses import dataclass
+from typing import Any, Callable, Sequence
+
+from thunder_tpu.core import baseutils
+from thunder_tpu.core.baseutils import check, compile_and_exec
+from thunder_tpu.core.codeutils import SigInfo, get_siginfo
+from thunder_tpu.core.proxies import Proxy, TensorProxy
+
+__all__ = [
+    "TraceCtx",
+    "TraceProvenance",
+    "TraceResults",
+    "TraceTag",
+    "get_tracectx",
+    "set_tracectx",
+    "reset_tracectx",
+    "tracectx",
+    "maybe_start_trace",
+    "from_trace",
+]
+
+
+@dataclass
+class TraceProvenance:
+    """Which pass produced a trace (with timing)."""
+
+    pss: str
+
+    def __repr__(self) -> str:
+        return f"# Constructed by {self.pss}"
+
+
+class TraceTag:
+    AUGMENTED_FORWARD = "AUGMENTED_FORWARD"
+    BACKWARD = "BACKWARD"
+    PROLOGUE = "PROLOGUE"
+    EPILOGUE = "EPILOGUE"
+    DISTRIBUTED = "DISTRIBUTED"
+
+
+class TraceCtx:
+    def __init__(self, fn: Callable | None = None, *, prologue: "TraceCtx | None" = None):
+        self.fn = fn
+        self.bound_symbols: list = []
+        self._scopes: list[list] = [self.bound_symbols]
+        self._suppress = 0
+
+        self.args: tuple | None = None
+        self.kwargs: dict = {}
+        self._siginfo: SigInfo | None = None
+
+        self.names: set[str] = set()
+        self._name_ctrs: dict[str, int] = {}
+
+        self._object_ctx: dict[str, Any] = {}
+        self._object_names: dict[int, str] = {}
+
+        self._provenance: TraceProvenance | None = None
+        self.tags: set[str] = set()
+
+        self.prologue = prologue
+        # set by the fw/bw split: names of saved-for-backward proxies
+        self._siginfo_hint: str | None = None
+
+    #
+    # Naming
+    #
+
+    def make_name(self, prefix: str = "t") -> str:
+        ctr = self._name_ctrs.get(prefix, 0)
+        while True:
+            name = f"{prefix}{ctr}"
+            ctr += 1
+            if name not in self.names:
+                break
+        self._name_ctrs[prefix] = ctr
+        self.names.add(name)
+        return name
+
+    def add_name(self, name: str) -> None:
+        self.names.add(name)
+
+    def has_name(self, name: str) -> bool:
+        return name in self.names
+
+    #
+    # Recording
+    #
+
+    def record(self, bsym) -> None:
+        if self._suppress:
+            return
+        self._scopes[-1].append(bsym)
+
+    @contextmanager
+    def push_scope(self):
+        scope: list = []
+        self._scopes.append(scope)
+        try:
+            yield scope
+        finally:
+            popped = self._scopes.pop()
+            check(popped is scope, lambda: "Unbalanced trace scopes")
+
+    @contextmanager
+    def suppress_recording(self):
+        self._suppress += 1
+        try:
+            yield
+        finally:
+            self._suppress -= 1
+
+    @property
+    def scopes(self) -> list[list]:
+        return self._scopes
+
+    def peek_scope(self) -> list:
+        return self._scopes[-1]
+
+    #
+    # Provenance and objects
+    #
+
+    def set_provenance(self, provenance: TraceProvenance | str) -> None:
+        if isinstance(provenance, str):
+            provenance = TraceProvenance(provenance)
+        self._provenance = provenance
+
+    def get_provenance(self) -> TraceProvenance | None:
+        return self._provenance
+
+    def register_object(self, obj: Any, name: str | None = None) -> str:
+        key = id(obj)
+        if key in self._object_names:
+            return self._object_names[key]
+        if name is None:
+            base = baseutils.extract_callable_name(obj) if callable(obj) else type(obj).__name__.lower()
+            name = self.make_name(prefix=f"_{base}_")
+        self._object_names[key] = name
+        self._object_ctx[name] = obj
+        return name
+
+    #
+    # Signature
+    #
+
+    def siginfo(self) -> SigInfo:
+        if self._siginfo is not None:
+            return self._siginfo
+        check(self.fn is not None, lambda: "Trace has no function or signature info")
+        self._siginfo = get_siginfo(self.fn, self.args or (), self.kwargs or {})
+        return self._siginfo
+
+    def set_siginfo(self, si: SigInfo) -> None:
+        self._siginfo = si
+
+    def name_args_for_print(self) -> list[str]:
+        si = self.siginfo()
+        parts = []
+        for name, _ in si.args:
+            parts.append(name)
+        if si.varargs is not None:
+            parts.append(f"*{si.varargs[0]}")
+        for name in si.kwargs:
+            parts.append(name)
+        if si.varkwargs is not None:
+            parts.append(f"**{si.varkwargs[0]}")
+        return parts
+
+    #
+    # Codegen
+    #
+
+    def python(self, *, print_depth: int = 2, include_decorators: bool = True) -> str:
+        """Renders the trace as a Python program string."""
+        token = set_tracectx(self)
+        try:
+            lines: list[str] = []
+            if self._provenance is not None:
+                lines.append(repr(self._provenance))
+            lines.append("import thunder_tpu.core.dtypes as dtypes")
+            lines.append("import thunder_tpu.core.devices as devices")
+            lines.append("")
+
+            si = self.siginfo()
+            lines.append(f"def {si.name}({', '.join(self.name_args_for_print())}):")
+
+            # arg type comments
+            for name, val in si.args:
+                if isinstance(val, TensorProxy):
+                    lines.append(f'  # {name}: "{val.type_string()}"')
+
+            body_empty = True
+            for bsym in self.bound_symbols:
+                bsym_lines = bsym.python(indent=1, print_depth=print_depth)
+                lines.extend(bsym_lines)
+                body_empty = False
+            if body_empty:
+                lines.append("  pass")
+            return "\n".join(lines) + "\n"
+        finally:
+            reset_tracectx(token)
+
+    def import_ctx(self) -> dict[str, Any]:
+        ctx: dict[str, Any] = {}
+
+        def gather(bsyms):
+            for bsym in bsyms:
+                ctx.update(bsym.import_ctx())
+                ctx.update(bsym.gather_call_ctx())
+
+        gather(self.bound_symbols)
+        from thunder_tpu.core import devices, dtypes
+
+        ctx.setdefault("dtypes", dtypes)
+        ctx.setdefault("devices", devices)
+        ctx.update(self._object_ctx)
+        return ctx
+
+    def python_callable(self, **kwargs) -> Callable:
+        """Compiles this trace's printed program and returns the callable."""
+        python_str = self.python(**kwargs)
+        si = self.siginfo()
+        fn = compile_and_exec(si.name, python_str, self.import_ctx())
+        fn.__thunder_trace__ = self
+        return fn
+
+    def __repr__(self) -> str:
+        try:
+            return self.python(print_depth=2)
+        except Exception as e:
+            return f"<TraceCtx {len(self.bound_symbols)} bound symbols; unprintable: {e}>"
+
+
+@dataclass
+class TraceResults:
+    """Result of frontend acquisition (reference trace.py:582)."""
+
+    prologue_trace: TraceCtx
+    computation_trace: TraceCtx
+    epilogue_trace: TraceCtx | None
+    interpreter_log: list
+
+
+#
+# Trace context management
+#
+
+_tracectx_var: ContextVar[TraceCtx | None] = ContextVar("tracectx", default=None)
+
+
+def get_tracectx() -> TraceCtx | None:
+    return _tracectx_var.get()
+
+
+def set_tracectx(trace: TraceCtx):
+    return _tracectx_var.set(trace)
+
+
+def reset_tracectx(token) -> None:
+    _tracectx_var.reset(token)
+
+
+@contextmanager
+def tracectx(trace: TraceCtx | None):
+    token = set_tracectx(trace)
+    try:
+        yield trace
+    finally:
+        reset_tracectx(token)
+
+
+def maybe_start_trace(fn: Callable | None = None) -> tuple[bool, Any, TraceCtx]:
+    current = get_tracectx()
+    if current is not None:
+        return False, None, current
+    trace = TraceCtx(fn)
+    token = set_tracectx(trace)
+    return True, token, trace
+
+
+def from_trace(trace: TraceCtx) -> TraceCtx:
+    """Shallow clone: same metadata and names, empty bound symbols."""
+    new = TraceCtx(trace.fn, prologue=trace.prologue)
+    new.args = trace.args
+    new.kwargs = trace.kwargs
+    new._siginfo = trace._siginfo
+    new.names = set(trace.names)
+    new._name_ctrs = dict(trace._name_ctrs)
+    new._object_ctx = dict(trace._object_ctx)
+    new._object_names = dict(trace._object_names)
+    new.tags = set(trace.tags)
+    return new

@@ -1,0 +1,13 @@
+"""Built-in executors.
+
+Importing this package registers the default executor stack:
+``xla`` (fusion, highest priority) ≻ ``pallas`` (hand-written TPU kernels)
+≻ ``jax`` (eager operator executor, also the always-executor).
+"""
+from thunder_tpu.executors import jaxex  # noqa: F401  (registers "jax", default+always)
+from thunder_tpu.executors import xlaex  # noqa: F401  (registers "xla", default)
+
+from thunder_tpu.executors.jaxex import jax_ex
+from thunder_tpu.executors.xlaex import xla_ex
+
+__all__ = ["jax_ex", "xla_ex"]

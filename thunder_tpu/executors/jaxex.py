@@ -1,0 +1,474 @@
+"""The default JAX operator executor: every prim → a jax.numpy/lax call.
+
+Capability analog of the reference's ``thunder/executors/torchex.py`` (the
+always-on operator executor mapping prims to ``torch.*``); here prims map to
+JAX ops, which also serve as the single source of truth for the XLA fusion
+executor's region evaluation (``thunder_tpu/executors/xlaex.py``).
+"""
+from __future__ import annotations
+
+import functools
+from numbers import Number
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from thunder_tpu.core import dtypes
+from thunder_tpu.core.devices import Device, to_jax_device
+from thunder_tpu.core.prims import PrimIDs, prim_lookup
+from thunder_tpu.extend import OperatorExecutor, add_always_executor, add_default_executor, register_executor
+
+__all__ = ["ex", "jax_ex", "get_prim_impl", "prim_impls"]
+
+
+def _jd(d) -> Any:
+    """thunder dtype → jax dtype."""
+    return dtypes.to_jax_dtype(d)
+
+
+def _key_for(key, offset: int):
+    return jax.random.fold_in(key, offset)
+
+
+#
+# Implementations, keyed by PrimIDs.  Signatures match the prim metas exactly.
+#
+
+prim_impls: dict[PrimIDs, Callable] = {}
+
+
+def impl(pid: PrimIDs):
+    def deco(fn):
+        prim_impls[pid] = fn
+        return fn
+
+    return deco
+
+
+# Elementwise unary
+_unary_jax = {
+    PrimIDs.ABS: jnp.abs,
+    PrimIDs.ACOS: jnp.arccos,
+    PrimIDs.ACOSH: jnp.arccosh,
+    PrimIDs.ASIN: jnp.arcsin,
+    PrimIDs.ASINH: jnp.arcsinh,
+    PrimIDs.ATAN: jnp.arctan,
+    PrimIDs.ATANH: jnp.arctanh,
+    PrimIDs.BITWISE_NOT: jnp.bitwise_not,
+    PrimIDs.CEIL: jnp.ceil,
+    PrimIDs.COS: jnp.cos,
+    PrimIDs.COSH: jnp.cosh,
+    PrimIDs.ERF: jax.lax.erf,
+    PrimIDs.ERFC: jax.lax.erfc,
+    PrimIDs.ERFINV: jax.lax.erf_inv,
+    PrimIDs.EXP: jnp.exp,
+    PrimIDs.EXP2: jnp.exp2,
+    PrimIDs.EXPM1: jnp.expm1,
+    PrimIDs.FLOOR: jnp.floor,
+    PrimIDs.ISFINITE: jnp.isfinite,
+    PrimIDs.ISINF: jnp.isinf,
+    PrimIDs.ISNAN: jnp.isnan,
+    PrimIDs.LOG: jnp.log,
+    PrimIDs.LOG10: jnp.log10,
+    PrimIDs.LOG1P: jnp.log1p,
+    PrimIDs.LOG2: jnp.log2,
+    PrimIDs.NEG: jnp.negative,
+    PrimIDs.ROUND: jnp.round,
+    PrimIDs.RSQRT: jax.lax.rsqrt,
+    PrimIDs.SIGN: jnp.sign,
+    PrimIDs.SIGNBIT: jnp.signbit,
+    PrimIDs.SIN: jnp.sin,
+    PrimIDs.SINH: jnp.sinh,
+    PrimIDs.SQRT: jnp.sqrt,
+    PrimIDs.TAN: jnp.tan,
+    PrimIDs.TANH: jnp.tanh,
+    PrimIDs.TRUNC: jnp.trunc,
+    PrimIDs.REAL: jnp.real,
+    PrimIDs.IMAG: jnp.imag,
+}
+for _pid, _fn in _unary_jax.items():
+    prim_impls[_pid] = _fn
+
+
+@impl(PrimIDs.DIGAMMA)
+def _digamma_impl(a):
+    from jax.scipy.special import digamma
+
+    return digamma(a)
+
+
+@impl(PrimIDs.LGAMMA)
+def _lgamma_impl(a):
+    from jax.scipy.special import gammaln
+
+    return gammaln(a)
+
+
+@impl(PrimIDs.RECIPROCAL)
+def _reciprocal_impl(a):
+    return jnp.reciprocal(a)
+
+
+# Elementwise binary
+_binary_jax = {
+    PrimIDs.ADD: jnp.add,
+    PrimIDs.ATAN2: jnp.arctan2,
+    PrimIDs.BITWISE_AND: jnp.bitwise_and,
+    PrimIDs.BITWISE_OR: jnp.bitwise_or,
+    PrimIDs.BITWISE_XOR: jnp.bitwise_xor,
+    PrimIDs.SHIFT_LEFT: jnp.left_shift,
+    PrimIDs.SHIFT_RIGHT: jnp.right_shift,
+    PrimIDs.COPYSIGN: jnp.copysign,
+    PrimIDs.EQ: jnp.equal,
+    PrimIDs.FMOD: jnp.fmod,
+    PrimIDs.GE: jnp.greater_equal,
+    PrimIDs.GT: jnp.greater,
+    PrimIDs.LE: jnp.less_equal,
+    PrimIDs.LT: jnp.less,
+    PrimIDs.MAXIMUM: jnp.maximum,
+    PrimIDs.MINIMUM: jnp.minimum,
+    PrimIDs.MUL: jnp.multiply,
+    PrimIDs.NE: jnp.not_equal,
+    PrimIDs.NEXTAFTER: jnp.nextafter,
+    PrimIDs.POW: jnp.power,
+    PrimIDs.REMAINDER: jnp.remainder,
+    PrimIDs.SUB: jnp.subtract,
+}
+for _pid, _fn in _binary_jax.items():
+    prim_impls[_pid] = _fn
+
+
+@impl(PrimIDs.DIV)
+def _div_impl(a, b):
+    if jnp.issubdtype(jnp.result_type(a), jnp.integer) or jnp.issubdtype(jnp.result_type(a), jnp.bool_):
+        # C-style truncation division for exact types (matches reference prims.div)
+        return jax.lax.div(a, b)
+    return jnp.true_divide(a, b)
+
+
+@impl(PrimIDs.WHERE)
+def _where_impl(pred, a, b):
+    return jnp.where(pred, a, b)
+
+
+@impl(PrimIDs.CLAMP)
+def _clamp_impl(a, min, max):
+    return jnp.clip(a, min, max)
+
+
+# Data movement
+@impl(PrimIDs.CONVERT_ELEMENT_TYPE)
+def _convert_element_type_impl(a, dtype):
+    return a.astype(_jd(dtype))
+
+
+@impl(PrimIDs.DEVICE_PUT)
+def _device_put_impl(a, device):
+    return jax.device_put(a, to_jax_device(device))
+
+
+@impl(PrimIDs.ITEM)
+def _item_impl(a):
+    return a.reshape(()).item() if not isinstance(a, jax.core.Tracer) else a.reshape(())
+
+
+@impl(PrimIDs.COPY_)
+def _copy__impl(a, b):
+    return jnp.asarray(b, dtype=a.dtype)
+
+
+# Creation
+@impl(PrimIDs.FULL)
+def _full_impl(shape, fill_value, *, device, dtype):
+    return jnp.full(tuple(int(s) for s in shape), fill_value, dtype=_jd(dtype))
+
+
+@impl(PrimIDs.IOTA)
+def _iota_impl(length, *, start, step, device, dtype):
+    return start + step * jnp.arange(int(length), dtype=_jd(dtype))
+
+
+@impl(PrimIDs.UNIFORM)
+def _uniform_impl(shape, minval, maxval, *, device, dtype, key, offset):
+    return jax.random.uniform(
+        _key_for(key, offset), tuple(int(s) for s in shape), dtype=_jd(dtype), minval=minval, maxval=maxval
+    )
+
+
+@impl(PrimIDs.RANDN)
+def _randn_impl(shape, *, device, dtype, key, offset):
+    return jax.random.normal(_key_for(key, offset), tuple(int(s) for s in shape), dtype=_jd(dtype))
+
+
+@impl(PrimIDs.RANDINT)
+def _randint_impl(shape, low, high, *, device, dtype, key, offset):
+    return jax.random.randint(_key_for(key, offset), tuple(int(s) for s in shape), low, high, dtype=_jd(dtype))
+
+
+@impl(PrimIDs.MULTINOMIAL)
+def _multinomial_impl(a, num_samples, replacement, *, key, offset):
+    k = _key_for(key, offset)
+    logits = jnp.log(a)
+    if a.ndim == 1:
+        return jax.random.categorical(k, logits, shape=(num_samples,)).astype(jnp.int32)
+    return jax.random.categorical(k, logits[:, None, :], axis=-1, shape=(a.shape[0], num_samples)).astype(jnp.int32)
+
+
+# Shape
+@impl(PrimIDs.BROADCAST_IN_DIM)
+def _broadcast_in_dim_impl(a, shape, broadcast_dimensions):
+    return jax.lax.broadcast_in_dim(a, tuple(int(s) for s in shape), tuple(int(d) for d in broadcast_dimensions))
+
+
+@impl(PrimIDs.CAT)
+def _cat_impl(tensors, dim):
+    return jnp.concatenate(list(tensors), axis=int(dim))
+
+
+@impl(PrimIDs.FLIP)
+def _flip_impl(a, dims):
+    return jnp.flip(a, axis=tuple(int(d) for d in dims))
+
+
+@impl(PrimIDs.RESHAPE)
+def _reshape_impl(a, shape):
+    return jnp.reshape(a, tuple(int(s) for s in shape))
+
+
+@impl(PrimIDs.SLICE)
+def _slice_impl(a, start_indices, end_indices, strides=None):
+    if strides is None:
+        strides = [1] * a.ndim
+    return jax.lax.slice(
+        a, tuple(int(s) for s in start_indices), tuple(int(e) for e in end_indices), tuple(int(s) for s in strides)
+    )
+
+
+@impl(PrimIDs.SQUEEZE)
+def _squeeze_impl(a, dims):
+    return jnp.squeeze(a, axis=tuple(int(d) for d in dims))
+
+
+@impl(PrimIDs.TRANSPOSE)
+def _transpose_impl(a, permutation):
+    return jnp.transpose(a, tuple(int(p) for p in permutation))
+
+
+@impl(PrimIDs.UNFOLD)
+def _unfold_impl(a, dim, size, step):
+    dim, size, step = int(dim), int(size), int(step)
+    n_windows = (a.shape[dim] - size) // step + 1
+    idx = jnp.arange(n_windows)[:, None] * step + jnp.arange(size)[None, :]
+    out = jnp.take(a, idx, axis=dim)  # (..., n_windows, size, ...) at dim
+    return jnp.moveaxis(out, dim + 1, -1)
+
+
+@impl(PrimIDs.PAD)
+def _pad_impl(a, padding_value, padding_config):
+    pv = jnp.asarray(padding_value, dtype=a.dtype)
+    return jax.lax.pad(a, pv, [(int(lo), int(hi), int(i)) for lo, hi, i in padding_config])
+
+
+# Reductions
+@impl(PrimIDs.AMAX)
+def _amax_impl(a, dims):
+    return jnp.max(a, axis=tuple(int(d) for d in dims))
+
+
+@impl(PrimIDs.AMIN)
+def _amin_impl(a, dims):
+    return jnp.min(a, axis=tuple(int(d) for d in dims))
+
+
+@impl(PrimIDs.PROD)
+def _prod_impl(a, dims):
+    return jnp.prod(a, axis=tuple(int(d) for d in dims))
+
+
+@impl(PrimIDs.SUM)
+def _sum_impl(a, dims):
+    return jnp.sum(a, axis=tuple(int(d) for d in dims))
+
+
+@impl(PrimIDs.VAR)
+def _var_impl(a, dims, *, correction):
+    return jnp.var(a, axis=tuple(int(d) for d in dims), ddof=correction)
+
+
+@impl(PrimIDs.VAR_MEAN)
+def _var_mean_impl(a, dims, *, correction):
+    axis = tuple(int(d) for d in dims)
+    return jnp.var(a, axis=axis, ddof=correction), jnp.mean(a, axis=axis)
+
+
+@impl(PrimIDs.ARGMAX)
+def _argmax_impl(a, dim):
+    return jnp.argmax(a, axis=None if dim is None else int(dim)).astype(jnp.int32)
+
+
+@impl(PrimIDs.ARGMIN)
+def _argmin_impl(a, dim):
+    return jnp.argmin(a, axis=None if dim is None else int(dim)).astype(jnp.int32)
+
+
+@impl(PrimIDs.TOPK)
+def _topk_impl(a, k, dim, largest, sorted):
+    dim = int(dim)
+    moved = jnp.moveaxis(a, dim, -1)
+    if not largest:
+        values, indices = jax.lax.top_k(-moved, int(k))
+        values = -values
+    else:
+        values, indices = jax.lax.top_k(moved, int(k))
+    return jnp.moveaxis(values, -1, dim), jnp.moveaxis(indices.astype(jnp.int32), -1, dim)
+
+
+@impl(PrimIDs.SORT)
+def _sort_impl(a, dim, descending):
+    dim = int(dim)
+    key = -a if descending else a
+    indices = jnp.argsort(key, axis=dim).astype(jnp.int32)
+    values = jnp.take_along_axis(a, indices, axis=dim)
+    return values, indices
+
+
+@impl(PrimIDs.ARGSORT)
+def _argsort_impl(a, dim, descending):
+    key = -a if descending else a
+    return jnp.argsort(key, axis=int(dim)).astype(jnp.int32)
+
+
+@impl(PrimIDs.CUMSUM)
+def _cumsum_impl(a, dim):
+    return jnp.cumsum(a, axis=int(dim))
+
+
+# Scatter/gather
+@impl(PrimIDs.TAKE)
+def _take_impl(a, indices, dim):
+    return jnp.take(a, indices, axis=int(dim))
+
+
+@impl(PrimIDs.TAKE_ALONG_AXIS)
+def _take_along_axis_impl(a, indices, dim):
+    return jnp.take_along_axis(a, indices, axis=int(dim))
+
+
+@impl(PrimIDs.GATHER)
+def _gather_impl(a, indices, dim):
+    return jnp.take_along_axis(a, indices, axis=int(dim))
+
+
+@impl(PrimIDs.INDEX_ADD)
+def _index_add_impl(a, indices, value, dim):
+    dim = int(dim)
+    idx = tuple(indices if i == dim else slice(None) for i in range(a.ndim))
+    return a.at[idx].add(value)
+
+
+@impl(PrimIDs.INDEX_PUT)
+def _index_put_impl(a, indices, values, accumulate):
+    idx = tuple(indices)
+    if accumulate:
+        return a.at[idx].add(values)
+    return a.at[idx].set(values)
+
+
+@impl(PrimIDs.SCATTER_ADD)
+def _scatter_add_impl(a, indices, value, dim):
+    dim = int(dim)
+    grids = jnp.meshgrid(*[jnp.arange(s) for s in indices.shape], indexing="ij")
+    grids[dim] = indices
+    v = value
+    if v.shape != indices.shape:
+        v = v[tuple(slice(0, s) for s in indices.shape)]
+    return a.at[tuple(grids)].add(v)
+
+
+# Linear algebra / NN
+@impl(PrimIDs.MATMUL)
+def _matmul_impl(a, b):
+    return jnp.matmul(a, b)
+
+
+@impl(PrimIDs.LINEAR)
+def _linear_impl(a, w, bias):
+    out = jax.lax.dot_general(a, w, (((a.ndim - 1,), (1,)), ((), ())))
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@impl(PrimIDs.EMBEDDING)
+def _embedding_impl(indices, weight, *, padding_idx=None):
+    return jnp.take(weight, indices, axis=0)
+
+
+@impl(PrimIDs.EMBEDDING_BACKWARD)
+def _embedding_backward_impl(grad, indices, num_weights, padding_idx):
+    num_weights = int(num_weights)
+    flat_idx = indices.reshape(-1)
+    flat_grad = grad.reshape(-1, grad.shape[-1])
+    out = jnp.zeros((num_weights, grad.shape[-1]), dtype=grad.dtype)
+    out = out.at[flat_idx].add(flat_grad)
+    if padding_idx is not None and padding_idx >= 0:
+        out = out.at[int(padding_idx)].set(0)
+    return out
+
+
+@impl(PrimIDs.ONE_HOT)
+def _one_hot_impl(indices, num_classes):
+    return jax.nn.one_hot(indices, int(num_classes), dtype=jnp.int32)
+
+
+@impl(PrimIDs.CONVOLUTION)
+def _convolution_impl(a, weight, bias, stride, padding, dilation, transposed, output_padding, groups):
+    ndim = a.ndim - 2
+    dn = jax.lax.conv_dimension_numbers(
+        a.shape,
+        weight.shape,
+        (
+            ("NCHW"[: 2 + ndim] if ndim <= 2 else "NCDHW"),
+            ("OIHW"[: 2 + ndim] if ndim <= 2 else "OIDHW"),
+            ("NCHW"[: 2 + ndim] if ndim <= 2 else "NCDHW"),
+        ),
+    )
+    out = jax.lax.conv_general_dilated(
+        a,
+        weight,
+        window_strides=tuple(int(s) for s in stride),
+        padding=[(int(p), int(p)) for p in padding],
+        rhs_dilation=tuple(int(d) for d in dilation),
+        dimension_numbers=dn,
+        feature_group_count=int(groups),
+    )
+    if bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * ndim)
+    return out
+
+
+def get_prim_impl(pid: PrimIDs) -> Callable | None:
+    return prim_impls.get(pid)
+
+
+#
+# The executor object: registers an eager implementation for every prim above.
+# These claimed symbols are also fusible by the XLA fusion executor (they are
+# pure jax-traceable callables), marked via _xla_fusible.
+#
+
+ex = OperatorExecutor("jax", version=jax.__version__)
+register_executor(ex)
+
+for _pid, _impl_fn in list(prim_impls.items()):
+    _prim_sym = prim_lookup[_pid]
+    _op = ex.register_operator(f"jax_{_prim_sym.name}", like=_prim_sym, fn=_impl_fn)
+    _op._xla_fusible = True
+    _op._prim_id = _pid
+    ex.register_implementation(_pid, _op)
+
+jax_ex = ex
+
+add_default_executor(ex)
+add_always_executor(ex)

@@ -1,0 +1,161 @@
+"""The functional frontend: eager unpack + trace acquisition.
+
+Analog of the reference's ``thunder/functional.py`` (eager-unpacking frontend,
+``_eager_unpack*``/``_eager_validate*``): inputs are flattened and proxied up
+front, the user function runs once over proxies to record the computation
+trace, and a prologue trace of unpack+check prims is synthesized from the
+flattened structure.  This covers everything except data-dependent Python on
+tensor *values*; the bytecode-interpreter frontend (reference
+``core/interpreter.py``) is a later addition on top of the same machinery —
+``TensorProxy.__torch_function__`` already diverts real torch calls.
+"""
+from __future__ import annotations
+
+from numbers import Number
+from typing import Any, Callable, Sequence
+
+import jax
+import numpy as np
+
+from thunder_tpu.core import dtypes, prims
+from thunder_tpu.core.baseutils import check
+from thunder_tpu.core.codeutils import SigInfo
+from thunder_tpu.core.langctxs import Languages, langctx
+from thunder_tpu.core.proxies import (
+    CollectionProxy,
+    NumberProxy,
+    Proxy,
+    StringProxy,
+    TensorProxy,
+    numberproxy,
+    tensorproxy,
+)
+from thunder_tpu.core.pytree import tree_flatten, tree_unflatten
+from thunder_tpu.core.trace import TraceCtx, TraceResults, TraceTag, tracectx
+
+__all__ = ["trace_from_fn", "proxy_leaf"]
+
+
+def _is_tensor_like(x) -> bool:
+    if isinstance(x, jax.Array) or isinstance(x, np.ndarray):
+        return True
+    try:
+        import torch
+
+        return isinstance(x, torch.Tensor)
+    except ImportError:  # pragma: no cover
+        return False
+
+
+def proxy_leaf(x: Any, trace: TraceCtx):
+    """Proxies one flattened input leaf for computation tracing."""
+    if _is_tensor_like(x):
+        return tensorproxy(x)
+    if isinstance(x, str):
+        return StringProxy(x)
+    if isinstance(x, bool):
+        return numberproxy(bool, x)
+    if isinstance(x, int):
+        return numberproxy(int, x)
+    if isinstance(x, float):
+        return numberproxy(float, x)
+    if isinstance(x, complex):
+        return numberproxy(complex, x)
+    # static leaves (dtypes, devices, configs, callables, …) pass through
+    return x
+
+
+def _dtype_str(x) -> str:
+    if isinstance(x, (jax.Array, np.ndarray)):
+        return str(np.dtype(x.dtype))
+    import torch
+
+    return str(x.dtype).replace("torch.", "")
+
+
+def trace_from_fn(fn: Callable, args: tuple, kwargs: dict) -> TraceResults:
+    """Runs ``fn`` over proxies, returning prologue/computation/epilogue traces."""
+    flat, spec = tree_flatten((tuple(args), dict(kwargs)))
+
+    #
+    # Computation trace
+    #
+    computation_trace = TraceCtx(fn)
+    proxies: list = []
+    with tracectx(computation_trace):
+        for leaf in flat:
+            proxies.append(proxy_leaf(leaf, computation_trace))
+
+    proxy_args, proxy_kwargs = tree_unflatten(proxies, spec)
+
+    with tracectx(computation_trace):
+        with langctx(Languages.TORCH):
+            result = fn(*proxy_args, **proxy_kwargs)
+        prims.python_return(result)
+
+    # computation inputs: tensor proxies in flattening order (+ implicit rng key)
+    comp_inputs: list[TensorProxy] = [p for p in proxies if isinstance(p, TensorProxy)]
+    rng_key = getattr(computation_trace, "_rng_key_proxy", None)
+    uses_rng = rng_key is not None
+    if uses_rng:
+        comp_inputs = comp_inputs + [rng_key]
+
+    si = SigInfo(name="computation", args=[(p.name, None) for p in comp_inputs])
+    computation_trace.set_siginfo(si)
+    computation_trace.args = tuple(comp_inputs)
+
+    #
+    # Prologue trace: unpack every leaf, check it, return computation inputs
+    #
+    prologue_trace = TraceCtx(fn)
+    prologue_trace.tags.add(TraceTag.PROLOGUE)
+    with tracectx(prologue_trace):
+        args_p = CollectionProxy(args, name="args")
+        kwargs_p = CollectionProxy(kwargs, name="kwargs")
+        flat_p = CollectionProxy(flat, name="flat")
+
+        bsym = prims.unpack_flatten.bind(args_p, kwargs_p, spec, output=flat_p)
+        prologue_trace.record(bsym)
+
+        pro_leaf_proxies: list = []
+        for i, (leaf, cproxy) in enumerate(zip(flat, proxies)):
+            if isinstance(cproxy, Proxy):
+                # mirror the computation proxy's name in the prologue
+                leaf_p = (
+                    cproxy.replace_name(cproxy.name)
+                    if isinstance(cproxy, TensorProxy)
+                    else cproxy
+                )
+                b = prims.unpack_getitem.bind(flat_p, i, output=leaf_p)
+                prologue_trace.record(b)
+                pro_leaf_proxies.append(leaf_p)
+                if isinstance(cproxy, TensorProxy):
+                    prims.check_tensor_metadata(
+                        leaf_p,
+                        tuple(cproxy.shape),
+                        cproxy.device.device_str(),
+                        _dtype_str(leaf),
+                        bool(cproxy.requires_grad),
+                    )
+                elif isinstance(cproxy, NumberProxy):
+                    prims.check_number_type_and_value(leaf_p, cproxy.value)
+                elif isinstance(cproxy, StringProxy):
+                    prims.check_string_value(leaf_p, cproxy.value)
+            else:
+                pro_leaf_proxies.append(None)
+
+        # return the tensors the computation consumes, in order
+        out_tensors = tuple(p for p in pro_leaf_proxies if isinstance(p, TensorProxy))
+        prims.python_return(out_tensors)
+
+    pro_si = SigInfo(name="prologue")
+    pro_si.varargs = ("args", None)
+    pro_si.varkwargs = ("kwargs", None)
+    prologue_trace.set_siginfo(pro_si)
+
+    #
+    # Epilogue (functional frontend records no mutations; kept for parity)
+    #
+    epilogue_trace = None
+
+    return TraceResults(prologue_trace, computation_trace, epilogue_trace, [])

@@ -1,0 +1,1135 @@
+"""The torch-like operation surface ("ltorch").
+
+Capability analog of the reference's ``thunder/torch/__init__.py`` (173
+``@torchsymbol`` ops, ``_torch_to_thunder_function_map`` :61).  Each op is a
+non-prim Symbol whose meta is its decomposition into clang/prims, so executors
+can claim it whole (e.g. Pallas flash attention claiming
+``scaled_dot_product_attention``) or execute its decomposition.
+
+Real ``torch.*`` functions map here via ``_torch_to_thunder_function_map``;
+combined with ``TensorProxy.__torch_function__`` this lets user code written
+against torch run under thunder_tpu tracing without a bytecode interpreter.
+"""
+from __future__ import annotations
+
+import math
+import sys
+from numbers import Number
+from typing import Any, Callable, Sequence
+
+from thunder_tpu import clang
+from thunder_tpu.core import dtypes, prims, utils
+from thunder_tpu.core.baseutils import check
+from thunder_tpu.core.devices import Device, to_device
+from thunder_tpu.core.langctxs import LanguageContext, Languages, register_langctx
+from thunder_tpu.core.proxies import NumberProxy, TensorProxy, pyval
+from thunder_tpu.core.symbol import Symbol
+
+_this_module = sys.modules[__name__]
+__print_alias__ = "ltorch"
+
+#
+# Language context: tensor methods resolve here
+#
+
+_torch_ctx = LanguageContext("torch")
+register_langctx(Languages.TORCH, _torch_ctx)
+
+_torch_to_thunder_function_map: dict[Any, Callable] = {}
+
+_torchsymbols: dict[str, Symbol] = {}
+
+
+class torchsymbol:
+    def __init__(self, *torchfns, is_method: bool = False, method_name: str | None = None, id: str | None = None):
+        self.torchfns = torchfns
+        self.is_method = is_method
+        self.method_name = method_name
+        self.id = id
+
+    def __call__(self, fn: Callable) -> Symbol:
+        name = fn.__name__
+        sym = Symbol(name=name, meta=fn, id=self.id or f"torch.{name}", module=_this_module)
+        _torchsymbols[name] = sym
+        if self.is_method or self.method_name is not None:
+            _torch_ctx.register_method(self.method_name or name, sym)
+        for tfn in self.torchfns:
+            if tfn is not None:
+                _torch_to_thunder_function_map[tfn] = sym
+        return sym
+
+
+def _maybe_torch():
+    try:
+        import torch as _t
+
+        return _t
+    except ImportError:  # pragma: no cover
+        return None
+
+
+_torch = _maybe_torch()
+
+
+def _tfn(*path: str):
+    """Resolves torch.<path> safely (None when torch is unavailable)."""
+    obj = _torch
+    for p in path:
+        if obj is None:
+            return None
+        obj = getattr(obj, p, None)
+    return obj
+
+
+#
+# Elementwise unary
+#
+
+_unary_ops = [
+    "abs", "acos", "acosh", "asin", "asinh", "atan", "atanh", "ceil", "cos", "cosh",
+    "digamma", "erf", "erfc", "erfinv", "exp", "exp2", "expm1", "floor", "isfinite",
+    "isinf", "isnan", "lgamma", "log", "log10", "log1p", "log2", "neg", "reciprocal",
+    "round", "rsqrt", "sign", "signbit", "sin", "sinh", "sqrt", "tan", "tanh", "trunc",
+    "real", "bitwise_not",
+]
+
+
+def _make_unary(opname: str) -> Symbol:
+    clang_fn = getattr(clang, opname)
+
+    def meta(a):
+        return clang_fn(a)
+
+    meta.__name__ = opname
+    sym = torchsymbol(_tfn(opname), is_method=True)(meta)
+    return sym
+
+
+for _op in _unary_ops:
+    setattr(_this_module, _op, _make_unary(_op))
+
+#
+# Elementwise binary
+#
+
+_binary_ops = [
+    ("add", "add"),
+    ("sub", "sub"),
+    ("mul", "mul"),
+    ("true_divide", "true_divide"),
+    ("floor_divide", "floor_divide"),
+    ("pow", "pow"),
+    ("remainder", "remainder"),
+    ("fmod", "fmod"),
+    ("atan2", "atan2"),
+    ("eq", "eq"),
+    ("ne", "ne"),
+    ("ge", "ge"),
+    ("gt", "gt"),
+    ("le", "le"),
+    ("lt", "lt"),
+    ("maximum", "maximum"),
+    ("minimum", "minimum"),
+    ("bitwise_and", "bitwise_and"),
+    ("bitwise_or", "bitwise_or"),
+    ("bitwise_xor", "bitwise_xor"),
+    ("copysign", "copysign"),
+    ("nextafter", "nextafter"),
+]
+
+
+def _make_binary(name: str, clang_name: str) -> Symbol:
+    clang_fn = getattr(clang, clang_name)
+
+    def meta(a, b, *, alpha=None):
+        if alpha is not None and alpha != 1:
+            b = clang.mul(b, alpha)
+        return clang_fn(a, b)
+
+    meta.__name__ = name
+    sym = torchsymbol(_tfn(name), is_method=True)(meta)
+    return sym
+
+
+for _name, _cname in _binary_ops:
+    setattr(_this_module, _name, _make_binary(_name, _cname))
+
+_torch_to_thunder_function_map[_tfn("div")] = getattr(_this_module, "true_divide")
+_torch_ctx.register_method("div", getattr(_this_module, "true_divide"))
+
+
+@torchsymbol(_tfn("logical_and"))
+def logical_and(a, b):
+    return clang.bitwise_and(_to_bool(a), _to_bool(b))
+
+
+@torchsymbol(_tfn("logical_or"))
+def logical_or(a, b):
+    return clang.bitwise_or(_to_bool(a), _to_bool(b))
+
+
+@torchsymbol(_tfn("logical_not"))
+def logical_not(a):
+    return clang.bitwise_not(_to_bool(a))
+
+
+def _to_bool(a):
+    if isinstance(a, TensorProxy) and not dtypes.is_boolean_dtype(a.dtype):
+        return clang.ne(a, 0)
+    return a
+
+
+@torchsymbol(_tfn("where"), is_method=True)
+def where(pred, a, b):
+    return clang.where(pred, a, b)
+
+
+@torchsymbol(_tfn("clamp"), is_method=True)
+def clamp(a, min=None, max=None):
+    return clang.clamp(a, min, max)
+
+
+@torchsymbol(_tfn("clip"))
+def clip(a, min=None, max=None):
+    return clang.clamp(a, min, max)
+
+
+@torchsymbol(_tfn("masked_fill"), is_method=True)
+def masked_fill(a, mask, value):
+    return clang.where(mask, value, a)
+
+
+@torchsymbol(_tfn("tril"), is_method=True)
+def tril(a, diagonal: int = 0):
+    check(a.ndim >= 2, lambda: "tril requires at least 2 dims")
+    nrows, ncols = a.shape[-2], a.shape[-1]
+    row = clang.arange(0, nrows, device=a.device, dtype=dtypes.int32)
+    col = clang.arange(0, ncols, device=a.device, dtype=dtypes.int32)
+    row = clang.reshape(row, (nrows, 1))
+    col = clang.reshape(col, (1, ncols))
+    mask = clang.ge(clang.sub(clang.add(row, diagonal), col), 0)
+    return clang.where(mask, a, 0)
+
+
+@torchsymbol(_tfn("triu"), is_method=True)
+def triu(a, diagonal: int = 0):
+    check(a.ndim >= 2, lambda: "triu requires at least 2 dims")
+    nrows, ncols = a.shape[-2], a.shape[-1]
+    row = clang.arange(0, nrows, device=a.device, dtype=dtypes.int32)
+    col = clang.arange(0, ncols, device=a.device, dtype=dtypes.int32)
+    row = clang.reshape(row, (nrows, 1))
+    col = clang.reshape(col, (1, ncols))
+    mask = clang.le(clang.sub(clang.add(row, diagonal), col), 0)
+    return clang.where(mask, a, 0)
+
+
+#
+# Creation
+#
+
+
+@torchsymbol(_tfn("full"))
+def full(size, fill_value, *, device=None, dtype=None):
+    return clang.full(size, fill_value, device=device, dtype=_to_thunder_dtype(dtype))
+
+
+@torchsymbol(_tfn("full_like"))
+def full_like(a, fill_value, *, device=None, dtype=None):
+    return clang.full_like(a, fill_value, device=device, dtype=_to_thunder_dtype(dtype))
+
+
+@torchsymbol(_tfn("zeros"))
+def zeros(*size, device=None, dtype=None):
+    size = _flatten_size(size)
+    return clang.zeros(size, device=device, dtype=_to_thunder_dtype(dtype))
+
+
+@torchsymbol(_tfn("ones"))
+def ones(*size, device=None, dtype=None):
+    size = _flatten_size(size)
+    return clang.ones(size, device=device, dtype=_to_thunder_dtype(dtype))
+
+
+@torchsymbol(_tfn("zeros_like"))
+def zeros_like(a, *, device=None, dtype=None):
+    return clang.zeros_like(a, device=device, dtype=_to_thunder_dtype(dtype))
+
+
+@torchsymbol(_tfn("ones_like"))
+def ones_like(a, *, device=None, dtype=None):
+    return clang.ones_like(a, device=device, dtype=_to_thunder_dtype(dtype))
+
+
+@torchsymbol(_tfn("empty"))
+def empty(*size, device=None, dtype=None):
+    size = _flatten_size(size)
+    return clang.zeros(size, device=device, dtype=_to_thunder_dtype(dtype))
+
+
+@torchsymbol(_tfn("arange"))
+def arange(start, end=None, step=1, *, device=None, dtype=None):
+    return clang.arange(start, end, step, device=device, dtype=_to_thunder_dtype(dtype))
+
+
+@torchsymbol(_tfn("rand"))
+def rand(*size, device=None, dtype=None):
+    size = _flatten_size(size)
+    return clang.uniform(size, 0.0, 1.0, device=device, dtype=_to_thunder_dtype(dtype))
+
+
+@torchsymbol(_tfn("randn"))
+def randn(*size, device=None, dtype=None):
+    size = _flatten_size(size)
+    return clang.randn(size, device=device, dtype=_to_thunder_dtype(dtype))
+
+
+@torchsymbol(_tfn("randint"))
+def randint(low, high=None, size=(), *, device=None, dtype=None):
+    if high is None:
+        low, high = 0, low
+    return clang.randint(low, high, size, device=device, dtype=_to_thunder_dtype(dtype) or dtypes.int64)
+
+
+@torchsymbol(_tfn("bernoulli"))
+def bernoulli(a):
+    return clang.bernoulli(a)
+
+
+@torchsymbol(_tfn("uniform"))
+def uniform(shape, minval=0.0, maxval=1.0, *, device=None, dtype=None):
+    return clang.uniform(shape, minval, maxval, device=device, dtype=_to_thunder_dtype(dtype))
+
+
+def _flatten_size(size) -> tuple:
+    if len(size) == 1 and isinstance(size[0], (tuple, list)):
+        return tuple(size[0])
+    return tuple(size)
+
+
+def _to_thunder_dtype(dtype):
+    if dtype is None:
+        return None
+    if isinstance(dtype, dtypes.dtype) or dtypes.is_numbertype(dtype):
+        return dtype
+    return dtypes.to_dtype(dtype)
+
+
+#
+# Shape ops
+#
+
+
+@torchsymbol(_tfn("reshape"), is_method=True)
+def reshape(a, *shape):
+    shape = _flatten_size(shape)
+    return clang.reshape(a, shape)
+
+
+@torchsymbol(method_name="view")
+def view(a, *shape):
+    shape = _flatten_size(shape)
+    return clang.reshape(a, shape)
+
+
+@torchsymbol(method_name="view_as")
+def view_as(a, b):
+    return clang.reshape(a, b.shape)
+
+
+@torchsymbol(_tfn("permute"), is_method=True)
+def permute(a, *dims):
+    dims = _flatten_size(dims)
+    return clang.permute(a, dims)
+
+
+@torchsymbol(_tfn("transpose"), is_method=True)
+def transpose(a, dim0, dim1):
+    return clang.transpose(a, dim0, dim1)
+
+
+@torchsymbol(_tfn("t"), is_method=True)
+def t(a):
+    check(a.ndim <= 2, lambda: "t() requires a tensor with at most 2 dims")
+    if a.ndim < 2:
+        return a
+    return clang.transpose(a, 0, 1)
+
+
+@torchsymbol(method_name="matrix_transpose")
+def matrix_transpose(a):
+    check(a.ndim >= 2, lambda: ".mT requires at least 2 dims")
+    return clang.transpose(a, -2, -1)
+
+
+@torchsymbol(_tfn("squeeze"), is_method=True)
+def squeeze(a, dim=None):
+    return clang.squeeze(a, dim)
+
+
+@torchsymbol(_tfn("unsqueeze"), is_method=True)
+def unsqueeze(a, dim):
+    return clang.unsqueeze(a, dim)
+
+
+@torchsymbol(_tfn("flatten"), is_method=True)
+def flatten(a, start_dim=0, end_dim=-1):
+    return clang.flatten(a, start_dim, end_dim)
+
+
+@torchsymbol(_tfn("cat"), _tfn("concat"))
+def cat(tensors, dim=0):
+    return clang.cat(list(tensors), dim)
+
+
+@torchsymbol(_tfn("stack"))
+def stack(tensors, dim=0):
+    return clang.stack(list(tensors), dim)
+
+
+@torchsymbol(_tfn("split"), is_method=True)
+def split(a, split_size_or_sections, dim=0):
+    return clang.split(a, split_size_or_sections, dim)
+
+
+@torchsymbol(_tfn("chunk"), is_method=True)
+def chunk(a, chunks, dim=0):
+    return clang.chunk(a, chunks, dim)
+
+
+@torchsymbol(method_name="expand")
+def expand(a, *shape):
+    shape = _flatten_size(shape)
+    return clang.expand(a, shape)
+
+
+@torchsymbol(_tfn("broadcast_to"), method_name="broadcast_to")
+def broadcast_to(a, shape):
+    return clang.expand(a, shape)
+
+
+@torchsymbol(_tfn("movedim"), is_method=True)
+def movedim(a, source, destination):
+    return clang.movedim(a, source, destination)
+
+
+@torchsymbol(_tfn("flip"), is_method=True)
+def flip(a, dims):
+    return clang.flip(a, dims)
+
+
+@torchsymbol(_tfn("narrow"), is_method=True)
+def narrow(a, dim, start, length):
+    return clang.slice_in_dim(a, start, start + length, dim=dim)
+
+
+@torchsymbol(method_name="contiguous")
+def contiguous(a):
+    return a  # layout is XLA's concern on TPU
+
+
+@torchsymbol(_tfn("repeat_interleave"), is_method=True)
+def repeat_interleave(a, repeats: int, dim: int):
+    dim = utils.canonicalize_dim(a.ndim, dim)
+    b = clang.unsqueeze(a, dim + 1)
+    target = list(b.shape)
+    target[dim + 1] = repeats
+    b = clang.expand(b, target)
+    shape = list(a.shape)
+    shape[dim] *= repeats
+    return clang.reshape(b, shape)
+
+
+@torchsymbol(_tfn("unfold"), is_method=True)
+def unfold(a, dimension, size, step):
+    return prims.unfold(a, dimension, size, step)
+
+
+@torchsymbol(_tfn("roll"), is_method=True)
+def roll(a, shifts, dims):
+    if isinstance(shifts, int):
+        shifts = (shifts,)
+    if isinstance(dims, int):
+        dims = (dims,)
+    out = a
+    for shift, dim in zip(shifts, dims):
+        dim = utils.canonicalize_dim(a.ndim, dim)
+        n = out.shape[dim]
+        shift = shift % n if n else 0
+        if shift == 0:
+            continue
+        left = clang.slice_in_dim(out, n - shift, n, dim=dim)
+        right = clang.slice_in_dim(out, 0, n - shift, dim=dim)
+        out = clang.cat([left, right], dim)
+    return out
+
+
+#
+# Indexing
+#
+
+
+@torchsymbol(method_name="getitem")
+def getitem(a, key):
+    return clang.getitem(a, key)
+
+
+@torchsymbol(_tfn("index_select"), is_method=True)
+def index_select(a, dim, index):
+    return clang.take(a, index, dim)
+
+
+@torchsymbol(_tfn("gather"), is_method=True)
+def gather(a, dim, index):
+    return clang.gather(a, index, dim)
+
+
+@torchsymbol(_tfn("scatter_add"), is_method=True)
+def scatter_add(a, dim, index, src):
+    return clang.scatter_add(a, index, src, dim)
+
+
+@torchsymbol(_tfn("index_add"), is_method=True)
+def index_add(a, dim, index, source):
+    return clang.index_add(a, index, source, dim)
+
+
+@torchsymbol(_tfn("index_put"), is_method=True)
+def index_put(a, indices, values, accumulate=False):
+    return clang.index_put(a, indices, values, accumulate)
+
+
+@torchsymbol(_tfn("take_along_dim"), is_method=True)
+def take_along_dim(a, indices, dim):
+    return clang.take_along_axis(a, indices, dim)
+
+
+#
+# Type conversions
+#
+
+
+@torchsymbol(method_name="to")
+def to(a, *args, **kwargs):
+    device = kwargs.get("device")
+    dtype = kwargs.get("dtype")
+    for arg in args:
+        if isinstance(arg, (dtypes.dtype,)) or (_torch is not None and isinstance(arg, _torch.dtype)):
+            dtype = arg
+        elif isinstance(arg, (str, Device)):
+            try:
+                device = to_device(arg)
+            except Exception:
+                pass
+        elif isinstance(arg, TensorProxy):
+            dtype, device = arg.dtype, arg.device
+    out = a
+    if dtype is not None:
+        out = clang.maybe_convert_to_dtype(out, _to_thunder_dtype(dtype))
+    if device is not None:
+        out = clang.device_put(out, device)
+    return out
+
+
+@torchsymbol(method_name="type_as")
+def type_as(a, b):
+    return clang.maybe_convert_to_dtype(a, b.dtype)
+
+
+def _conv_method(name, dt):
+    def meta(a):
+        return clang.maybe_convert_to_dtype(a, dt)
+
+    meta.__name__ = name
+    return torchsymbol(method_name=name)(meta)
+
+
+float_ = _conv_method("float", dtypes.float32)
+double = _conv_method("double", dtypes.float64)
+half = _conv_method("half", dtypes.float16)
+bfloat16_m = _conv_method("bfloat16", dtypes.bfloat16)
+long = _conv_method("long", dtypes.int64)
+int_ = _conv_method("int", dtypes.int32)
+bool_ = _conv_method("bool", dtypes.bool8)
+
+
+@torchsymbol(method_name="item")
+def item(a):
+    return clang.item(a)
+
+
+@torchsymbol(method_name="type")
+def type(a, dtype=None):
+    if dtype is None:
+        return a
+    return clang.maybe_convert_to_dtype(a, _to_thunder_dtype(dtype))
+
+
+#
+# Reductions
+#
+
+
+@torchsymbol(_tfn("sum"), is_method=True)
+def sum(a, dim=None, keepdim=False, *, dtype=None):
+    return clang.sum(a, dim, keepdim, dtype=_to_thunder_dtype(dtype))
+
+
+@torchsymbol(_tfn("mean"), is_method=True)
+def mean(a, dim=None, keepdim=False, *, dtype=None):
+    return clang.mean(a, dim, keepdim, dtype=_to_thunder_dtype(dtype))
+
+
+@torchsymbol(_tfn("prod"), is_method=True)
+def prod(a, dim=None, keepdim=False, *, dtype=None):
+    return clang.prod(a, dim, keepdim, dtype=_to_thunder_dtype(dtype))
+
+
+@torchsymbol(_tfn("amax"), is_method=True)
+def amax(a, dim=None, keepdim=False):
+    return clang.amax(a, dim, keepdim)
+
+
+@torchsymbol(_tfn("amin"), is_method=True)
+def amin(a, dim=None, keepdim=False):
+    return clang.amin(a, dim, keepdim)
+
+
+@torchsymbol(_tfn("max"), is_method=True)
+def max(a, dim=None, keepdim=False):
+    if dim is None:
+        return clang.amax(a, None, False)
+    dim = utils.canonicalize_dim(a.ndim, dim)
+    values = clang.amax(a, dim, keepdim)
+    indices = clang.argmax(a, dim, keepdim)
+    return values, indices
+
+
+@torchsymbol(_tfn("min"), is_method=True)
+def min(a, dim=None, keepdim=False):
+    if dim is None:
+        return clang.amin(a, None, False)
+    dim = utils.canonicalize_dim(a.ndim, dim)
+    values = clang.amin(a, dim, keepdim)
+    indices = clang.argmin(a, dim, keepdim)
+    return values, indices
+
+
+@torchsymbol(_tfn("var"), is_method=True)
+def var(a, dim=None, keepdim=False, *, correction=1):
+    return clang.var(a, dim, keepdim, correction=correction)
+
+
+@torchsymbol(_tfn("std"), is_method=True)
+def std(a, dim=None, keepdim=False, *, correction=1):
+    return clang.std(a, dim, keepdim, correction=correction)
+
+
+@torchsymbol(_tfn("var_mean"))
+def var_mean(a, dim=None, keepdim=False, *, correction=1):
+    return clang.var_mean(a, dim, keepdim, correction=correction)
+
+
+@torchsymbol(_tfn("argmax"), is_method=True)
+def argmax(a, dim=None, keepdim=False):
+    return clang.argmax(a, dim, keepdim)
+
+
+@torchsymbol(_tfn("argmin"), is_method=True)
+def argmin(a, dim=None, keepdim=False):
+    return clang.argmin(a, dim, keepdim)
+
+
+@torchsymbol(_tfn("topk"), is_method=True)
+def topk(a, k, dim=-1, largest=True, sorted=True):
+    return clang.topk(a, k, dim, largest, sorted)
+
+
+@torchsymbol(_tfn("sort"), is_method=True)
+def sort(a, dim=-1, descending=False):
+    return clang.sort(a, dim, descending)
+
+
+@torchsymbol(_tfn("argsort"), is_method=True)
+def argsort(a, dim=-1, descending=False):
+    return clang.argsort(a, dim, descending)
+
+
+@torchsymbol(_tfn("cumsum"), is_method=True)
+def cumsum(a, dim, *, dtype=None):
+    out = clang.cumsum(a, dim)
+    if dtype is not None:
+        out = clang.maybe_convert_to_dtype(out, _to_thunder_dtype(dtype))
+    return out
+
+
+@torchsymbol(_tfn("any"), is_method=True)
+def any_(a, dim=None, keepdim=False):
+    b = _to_bool(a)
+    s = clang.sum(clang.maybe_convert_to_dtype(b, dtypes.int32), dim, keepdim)
+    return clang.gt(s, 0)
+
+
+@torchsymbol(_tfn("all"), is_method=True)
+def all_(a, dim=None, keepdim=False):
+    b = _to_bool(a)
+    inv = clang.bitwise_not(b)
+    s = clang.sum(clang.maybe_convert_to_dtype(inv, dtypes.int32), dim, keepdim)
+    return clang.eq(s, 0)
+
+
+#
+# Matmul family
+#
+
+
+@torchsymbol(_tfn("matmul"), is_method=True)
+def matmul(a, b):
+    return clang.matmul(a, b)
+
+
+@torchsymbol(_tfn("mm"))
+def mm(a, b):
+    check(a.ndim == 2 and b.ndim == 2, lambda: "mm requires 2D tensors")
+    return clang.matmul(a, b)
+
+
+@torchsymbol(_tfn("bmm"), is_method=True)
+def bmm(a, b):
+    check(a.ndim == 3 and b.ndim == 3, lambda: "bmm requires 3D tensors")
+    return clang.matmul(a, b)
+
+
+@torchsymbol(_tfn("addmm"))
+def addmm(bias, a, b, *, beta=1, alpha=1):
+    out = clang.matmul(a, b)
+    if alpha != 1:
+        out = clang.mul(out, alpha)
+    if beta != 1:
+        bias = clang.mul(bias, beta)
+    return clang.add(out, bias)
+
+
+@torchsymbol(_tfn("outer"), is_method=True)
+def outer(a, b):
+    return clang.mul(clang.reshape(a, (a.shape[0], 1)), clang.reshape(b, (1, b.shape[0])))
+
+
+#
+# NN functional ops
+#
+
+
+@torchsymbol(_tfn("nn", "functional", "linear"))
+def linear(a, w, bias=None):
+    return clang.linear(a, w, bias)
+
+
+@torchsymbol(_tfn("nn", "functional", "embedding"))
+def embedding(indices, weight, padding_idx=None, max_norm=None, norm_type=2.0, scale_grad_by_freq=False, sparse=False):
+    check(max_norm is None, lambda: "embedding max_norm is not supported")
+    return clang.embedding(indices, weight, padding_idx=padding_idx)
+
+
+@torchsymbol(_tfn("nn", "functional", "one_hot"))
+def one_hot(a, num_classes):
+    return clang.one_hot(a, num_classes)
+
+
+@torchsymbol(_tfn("relu"), _tfn("nn", "functional", "relu"), is_method=True)
+def relu(a, inplace=False):
+    return clang.maximum(a, 0)
+
+
+@torchsymbol(_tfn("nn", "functional", "relu6"))
+def relu6(a, inplace=False):
+    return clang.clamp(a, 0, 6)
+
+
+@torchsymbol(_tfn("nn", "functional", "leaky_relu"))
+def leaky_relu(a, negative_slope=0.01, inplace=False):
+    return clang.where(clang.gt(a, 0), a, clang.mul(a, negative_slope))
+
+
+@torchsymbol(_tfn("sigmoid"), _tfn("nn", "functional", "sigmoid"), is_method=True)
+def sigmoid(a):
+    return clang.reciprocal(clang.add(clang.exp(clang.neg(a)), 1.0))
+
+
+@torchsymbol(_tfn("nn", "functional", "softplus"))
+def softplus(a, beta=1.0, threshold=20.0):
+    scaled = clang.mul(a, beta)
+    soft = clang.true_divide(clang.log1p(clang.exp(scaled)), beta)
+    return clang.where(clang.gt(scaled, threshold), a, soft)
+
+
+@torchsymbol(_tfn("nn", "functional", "silu"))
+def silu(a, inplace=False):
+    return clang.mul(a, sigmoid(a))
+
+
+@torchsymbol(_tfn("nn", "functional", "mish"))
+def mish(a, inplace=False):
+    return clang.mul(a, clang.tanh(softplus(a)))
+
+
+@torchsymbol(_tfn("nn", "functional", "gelu"))
+def gelu(a, approximate: str = "none"):
+    if approximate == "tanh":
+        inner = clang.mul(
+            math.sqrt(2.0 / math.pi), clang.add(a, clang.mul(0.044715, clang.mul(a, clang.mul(a, a))))
+        )
+        return clang.mul(clang.mul(0.5, a), clang.add(1.0, clang.tanh(inner)))
+    return clang.mul(clang.mul(0.5, a), clang.add(1.0, clang.erf(clang.true_divide(a, math.sqrt(2.0)))))
+
+
+@torchsymbol(_tfn("softmax"), _tfn("nn", "functional", "softmax"), is_method=True)
+def softmax(a, dim=-1, *, dtype=None):
+    dim = utils.canonicalize_dim(a.ndim, dim)
+    computation_dtype = _to_thunder_dtype(dtype) or (dtypes.float32 if dtypes.is_low_precision_dtype(a.dtype) else a.dtype)
+    a_ = clang.maybe_convert_to_dtype(a, computation_dtype)
+    m = clang.amax(a_, dim, True)
+    e = clang.exp(clang.sub(a_, m))
+    s = clang.sum(e, dim, True)
+    out = clang.true_divide(e, s)
+    if dtype is None:
+        out = clang.maybe_convert_to_dtype(out, a.dtype)
+    return out
+
+
+@torchsymbol(_tfn("log_softmax"), _tfn("nn", "functional", "log_softmax"), is_method=True)
+def log_softmax(a, dim=-1, *, dtype=None):
+    dim = utils.canonicalize_dim(a.ndim, dim)
+    computation_dtype = _to_thunder_dtype(dtype) or (dtypes.float32 if dtypes.is_low_precision_dtype(a.dtype) else a.dtype)
+    a_ = clang.maybe_convert_to_dtype(a, computation_dtype)
+    m = clang.amax(a_, dim, True)
+    shifted = clang.sub(a_, m)
+    lse = clang.log(clang.sum(clang.exp(shifted), dim, True))
+    out = clang.sub(shifted, lse)
+    if dtype is None:
+        out = clang.maybe_convert_to_dtype(out, a.dtype)
+    return out
+
+
+@torchsymbol(_tfn("nn", "functional", "dropout"))
+def dropout(a, p=0.5, training=True, inplace=False):
+    if not training or p == 0.0:
+        return a
+    check(0.0 <= p < 1.0, lambda: f"dropout p must be in [0, 1), got {p}")
+    mask = clang.bernoulli(1.0 - p, a.shape, device=a.device, dtype=a.dtype)
+    return clang.mul(clang.mul(a, mask), 1.0 / (1.0 - p))
+
+
+@torchsymbol(_tfn("nn", "functional", "layer_norm"))
+def layer_norm(a, normalized_shape, weight=None, bias=None, eps=1e-5):
+    normalized_shape = tuple(normalized_shape)
+    ndims = len(normalized_shape)
+    check(
+        tuple(a.shape[a.ndim - ndims :]) == normalized_shape,
+        lambda: f"layer_norm: {normalized_shape} does not match input tail {a.shape}",
+    )
+    dims = tuple(range(a.ndim - ndims, a.ndim))
+    computation_dtype = dtypes.float32 if dtypes.is_low_precision_dtype(a.dtype) else a.dtype
+    a_ = clang.maybe_convert_to_dtype(a, computation_dtype)
+    v, m = clang.var_mean(a_, dims, True, correction=0)
+    rstd = clang.rsqrt(clang.add(v, eps))
+    out = clang.mul(clang.sub(a_, m), rstd)
+    if weight is not None:
+        out = clang.mul(out, clang.maybe_convert_to_dtype(weight, computation_dtype))
+    if bias is not None:
+        out = clang.add(out, clang.maybe_convert_to_dtype(bias, computation_dtype))
+    return clang.maybe_convert_to_dtype(out, a.dtype)
+
+
+@torchsymbol(_tfn("nn", "functional", "rms_norm"))
+def rms_norm(a, normalized_shape, weight=None, eps=None):
+    normalized_shape = tuple(normalized_shape)
+    ndims = len(normalized_shape)
+    dims = tuple(range(a.ndim - ndims, a.ndim))
+    if eps is None:
+        eps = 1e-6
+    computation_dtype = dtypes.float32 if dtypes.is_low_precision_dtype(a.dtype) else a.dtype
+    a_ = clang.maybe_convert_to_dtype(a, computation_dtype)
+    ms = clang.mean(clang.mul(a_, a_), dims, True)
+    out = clang.mul(a_, clang.rsqrt(clang.add(ms, eps)))
+    if weight is not None:
+        out = clang.mul(out, clang.maybe_convert_to_dtype(weight, computation_dtype))
+    return clang.maybe_convert_to_dtype(out, a.dtype)
+
+
+@torchsymbol(_tfn("nn", "functional", "group_norm"))
+def group_norm(a, num_groups, weight=None, bias=None, eps=1e-5):
+    check(a.ndim >= 2, lambda: "group_norm requires at least 2 dims")
+    N, C = a.shape[0], a.shape[1]
+    check(C % num_groups == 0, lambda: "group_norm: channels not divisible by groups")
+    rest = a.shape[2:]
+    grouped = clang.reshape(a, (N, num_groups, C // num_groups) + tuple(rest))
+    dims = tuple(range(2, grouped.ndim))
+    computation_dtype = dtypes.float32 if dtypes.is_low_precision_dtype(a.dtype) else a.dtype
+    g = clang.maybe_convert_to_dtype(grouped, computation_dtype)
+    v, m = clang.var_mean(g, dims, True, correction=0)
+    out = clang.mul(clang.sub(g, m), clang.rsqrt(clang.add(v, eps)))
+    out = clang.reshape(out, a.shape)
+    if weight is not None:
+        w = clang.reshape(weight, (1, C) + (1,) * len(rest))
+        out = clang.mul(out, clang.maybe_convert_to_dtype(w, computation_dtype))
+    if bias is not None:
+        b = clang.reshape(bias, (1, C) + (1,) * len(rest))
+        out = clang.add(out, clang.maybe_convert_to_dtype(b, computation_dtype))
+    return clang.maybe_convert_to_dtype(out, a.dtype)
+
+
+@torchsymbol(_tfn("nn", "functional", "batch_norm"))
+def batch_norm(a, running_mean=None, running_var=None, weight=None, bias=None, training=False, momentum=0.1, eps=1e-5):
+    C = a.shape[1]
+    reduce_dims = (0,) + tuple(range(2, a.ndim))
+    computation_dtype = dtypes.float32 if dtypes.is_low_precision_dtype(a.dtype) else a.dtype
+    a_ = clang.maybe_convert_to_dtype(a, computation_dtype)
+    if training or running_mean is None:
+        v, m = clang.var_mean(a_, reduce_dims, False, correction=0)
+    else:
+        m, v = running_mean, running_var
+    bshape = (1, C) + (1,) * (a.ndim - 2)
+    m_ = clang.reshape(clang.maybe_convert_to_dtype(m, computation_dtype), bshape)
+    v_ = clang.reshape(clang.maybe_convert_to_dtype(v, computation_dtype), bshape)
+    out = clang.mul(clang.sub(a_, m_), clang.rsqrt(clang.add(v_, eps)))
+    if weight is not None:
+        out = clang.mul(out, clang.reshape(clang.maybe_convert_to_dtype(weight, computation_dtype), bshape))
+    if bias is not None:
+        out = clang.add(out, clang.reshape(clang.maybe_convert_to_dtype(bias, computation_dtype), bshape))
+    return clang.maybe_convert_to_dtype(out, a.dtype)
+
+
+@torchsymbol(_tfn("conv1d"), _tfn("nn", "functional", "conv1d"))
+def conv1d(a, weight, bias=None, stride=1, padding=0, dilation=1, groups=1):
+    return _convnd(a, weight, bias, stride, padding, dilation, groups, 1)
+
+
+@torchsymbol(_tfn("conv2d"), _tfn("nn", "functional", "conv2d"))
+def conv2d(a, weight, bias=None, stride=1, padding=0, dilation=1, groups=1):
+    return _convnd(a, weight, bias, stride, padding, dilation, groups, 2)
+
+
+@torchsymbol(_tfn("conv3d"), _tfn("nn", "functional", "conv3d"))
+def conv3d(a, weight, bias=None, stride=1, padding=0, dilation=1, groups=1):
+    return _convnd(a, weight, bias, stride, padding, dilation, groups, 3)
+
+
+def _convnd(a, weight, bias, stride, padding, dilation, groups, n):
+    def _tup(x):
+        return (x,) * n if isinstance(x, int) else tuple(x)
+
+    return prims.convolution(a, weight, bias, _tup(stride), _tup(padding), _tup(dilation), False, (0,) * n, int(groups))
+
+
+@torchsymbol(_tfn("nn", "functional", "scaled_dot_product_attention"))
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None):
+    """SDPA decomposition; the Pallas executor claims this whole symbol with a
+    flash-attention kernel (analog of reference sdpaex/cudnnex claiming)."""
+    d = query.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    q = clang.mul(query, scale)
+    kt = clang.transpose(key, -2, -1)
+    scores = clang.matmul(q, kt)
+    L, S = query.shape[-2], key.shape[-2]
+    if is_causal:
+        check(attn_mask is None, lambda: "is_causal and attn_mask are mutually exclusive")
+        row = clang.arange(0, L, device=query.device, dtype=dtypes.int32)
+        col = clang.arange(0, S, device=query.device, dtype=dtypes.int32)
+        causal = clang.ge(clang.reshape(row, (L, 1)), clang.reshape(col, (1, S)))
+        scores = clang.where(causal, scores, float("-inf"))
+    elif attn_mask is not None:
+        if dtypes.is_boolean_dtype(attn_mask.dtype):
+            scores = clang.where(attn_mask, scores, float("-inf"))
+        else:
+            scores = clang.add(scores, attn_mask)
+    probs = softmax(scores, -1)
+    if dropout_p > 0.0:
+        probs = dropout(probs, dropout_p, training=True)
+    return clang.matmul(probs, value)
+
+
+@torchsymbol(_tfn("nn", "functional", "nll_loss"))
+def nll_loss(log_probs, target, weight=None, ignore_index=-100, reduction="mean"):
+    check(weight is None, lambda: "nll_loss weight is not supported yet")
+    C = log_probs.shape[-1]
+    flat_logp = clang.reshape(log_probs, (-1, C))
+    flat_t = clang.reshape(target, (-1,))
+    safe_t = clang.where(clang.eq(flat_t, ignore_index), 0, flat_t)
+    idx = clang.reshape(clang.maybe_convert_to_dtype(safe_t, dtypes.int32), (-1, 1))
+    picked = clang.take_along_axis(flat_logp, idx, 1)
+    picked = clang.reshape(picked, (-1,))
+    losses = clang.neg(picked)
+    valid = clang.ne(flat_t, ignore_index)
+    losses = clang.where(valid, losses, 0.0)
+    if reduction == "none":
+        return clang.reshape(losses, target.shape)
+    total = clang.sum(losses, None, False)
+    if reduction == "sum":
+        return total
+    n_valid = clang.sum(clang.maybe_convert_to_dtype(valid, losses.dtype), None, False)
+    return clang.true_divide(total, clang.maximum(n_valid, 1.0))
+
+
+@torchsymbol(_tfn("nn", "functional", "cross_entropy"))
+def cross_entropy(logits, target, weight=None, ignore_index=-100, reduction="mean", label_smoothing=0.0):
+    check(label_smoothing == 0.0, lambda: "label_smoothing is not supported yet")
+    logp = log_softmax(logits, -1 if logits.ndim != 1 else 0)
+    if logits.ndim > 2:
+        # torch layout: (N, C, d1, ...) -> move C last
+        perm = (0,) + tuple(range(2, logits.ndim)) + (1,)
+        logp = clang.permute(logp, perm)
+    return nll_loss(logp, target, weight, ignore_index, reduction)
+
+
+@torchsymbol(_tfn("nn", "functional", "mse_loss"))
+def mse_loss(a, b, reduction="mean"):
+    d = clang.sub(a, b)
+    sq = clang.mul(d, d)
+    if reduction == "none":
+        return sq
+    if reduction == "sum":
+        return clang.sum(sq, None, False)
+    return clang.mean(sq, None, False)
+
+
+@torchsymbol(_tfn("nn", "functional", "pad"))
+def nn_pad(a, pad_widths, mode="constant", value=0.0):
+    check(mode == "constant", lambda: "only constant padding is supported")
+    check(len(pad_widths) % 2 == 0, lambda: "pad widths must be pairs")
+    npairs = len(pad_widths) // 2
+    config = [(0, 0, 0)] * (a.ndim - npairs)
+    for i in range(npairs):
+        lo = pad_widths[2 * i]
+        hi = pad_widths[2 * i + 1]
+        config.append((lo, hi, 0))
+    # torch pads last dims first
+    config = config[: a.ndim - npairs] + list(reversed(config[a.ndim - npairs :]))
+    return clang.pad(a, value if value is not None else 0.0, config)
+
+
+@torchsymbol(_tfn("nn", "functional", "normalize"))
+def normalize(a, p=2.0, dim=1, eps=1e-12):
+    norm = clang.pow(clang.sum(clang.pow(clang.abs(a), p), dim, True), 1.0 / p)
+    return clang.true_divide(a, clang.maximum(norm, eps))
+
+
+@torchsymbol(_tfn("erf"), id="torch.special.erf")
+def special_erf(a):
+    return clang.erf(a)
+
+
+@torchsymbol(_tfn("polar"))
+def polar(abs_t, angle):
+    real = clang.mul(abs_t, clang.cos(angle))
+    imag = clang.mul(abs_t, clang.sin(angle))
+    return real, imag
+
+
+@torchsymbol(_tfn("sgn"), is_method=True)
+def sgn(a):
+    return clang.sign(a)
+
+
+@torchsymbol(_tfn("square"), is_method=True)
+def square(a):
+    return clang.mul(a, a)
+
+
+@torchsymbol(_tfn("nn", "functional", "glu"))
+def glu(a, dim=-1):
+    dim = utils.canonicalize_dim(a.ndim, dim)
+    check(a.shape[dim] % 2 == 0, lambda: "glu: dim size must be even")
+    x, g = clang.chunk(a, 2, dim)
+    return clang.mul(x, sigmoid(g))
+
+
+@torchsymbol(_tfn("lerp"), is_method=True)
+def lerp(start, end, weight):
+    return clang.add(start, clang.mul(clang.sub(end, start), weight))
+
+
+@torchsymbol(_tfn("nn", "functional", "hardswish"))
+def hardswish(a, inplace=False):
+    return clang.mul(a, clang.true_divide(clang.clamp(clang.add(a, 3.0), 0.0, 6.0), 6.0))
+
+
+@torchsymbol(_tfn("nn", "functional", "hardsigmoid"))
+def hardsigmoid(a, inplace=False):
+    return clang.true_divide(clang.clamp(clang.add(a, 3.0), 0.0, 6.0), 6.0)
+
+
+@torchsymbol(_tfn("nn", "functional", "tanhshrink"))
+def tanhshrink(a):
+    return clang.sub(a, clang.tanh(a))
+
+
+@torchsymbol(_tfn("nn", "functional", "elu"))
+def elu(a, alpha=1.0, inplace=False):
+    return clang.where(clang.gt(a, 0), a, clang.mul(alpha, clang.expm1(a)))
+
+
+@torchsymbol(_tfn("nn", "functional", "selu"))
+def selu(a, inplace=False):
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    return clang.mul(scale, clang.where(clang.gt(a, 0), a, clang.mul(alpha, clang.expm1(a))))
+
+
+@torchsymbol(_tfn("nn", "functional", "celu"))
+def celu(a, alpha=1.0, inplace=False):
+    return clang.where(clang.gt(a, 0), a, clang.mul(alpha, clang.expm1(clang.true_divide(a, alpha))))
+
+
+@torchsymbol(_tfn("nn", "functional", "hardtanh"))
+def hardtanh(a, min_val=-1.0, max_val=1.0, inplace=False):
+    return clang.clamp(a, min_val, max_val)
+
+
+@torchsymbol(_tfn("nn", "functional", "logsigmoid"))
+def logsigmoid(a):
+    return clang.neg(softplus(clang.neg(a)))
+
+
+#
+# size/shape introspection helpers (trace-time)
+#
+
+
+def size(a, dim=None):
+    if dim is None:
+        return a.shape
+    return a.shape[utils.canonicalize_dim(a.ndim, dim)]
+
+
+_torch_ctx.register_method("size", size)
+_torch_ctx.register_method("dim", lambda a: a.ndim)
+_torch_ctx.register_method("numel", lambda a: a.numel)
+
+
+def manual_seed(seed: int) -> None:
+    """Sets the global RNG seed for compiled programs (threefry base key)."""
+    from thunder_tpu.core import rng
+
+    rng.manual_seed(seed)
+
+
+# torch.Tensor methods that map through __torch_function__
+if _torch is not None:
+    _method_map = {
+        _torch.Tensor.add: getattr(_this_module, "add"),
+        _torch.Tensor.mul: getattr(_this_module, "mul"),
+        _torch.Tensor.sub: getattr(_this_module, "sub"),
+        _torch.Tensor.div: getattr(_this_module, "true_divide"),
+        _torch.Tensor.matmul: matmul,
+        _torch.Tensor.sum: getattr(_this_module, "sum"),
+        _torch.Tensor.mean: getattr(_this_module, "mean"),
+        _torch.Tensor.reshape: reshape,
+        _torch.Tensor.view: view,
+        _torch.Tensor.permute: permute,
+        _torch.Tensor.transpose: transpose,
+        _torch.Tensor.softmax: softmax,
+        _torch.Tensor.to: to,
+        _torch.Tensor.float: float_,
+        _torch.Tensor.contiguous: contiguous,
+    }
+    _torch_to_thunder_function_map.update({k: v for k, v in _method_map.items() if k is not None})

@@ -37,6 +37,8 @@ __version__ = "0.1.0"
 __all__ = [
     "jit",
     "compile",
+    "grad",
+    "value_and_grad",
     "last_traces",
     "last_backward_traces",
     "last_prologue_traces",
@@ -109,7 +111,16 @@ def jit(
             inps = tuple(inps) + (rng.next_key(),)
 
         cs.last_trace_host_execution_start = time.perf_counter_ns()
-        result = cache_entry.computation_fn(*inps)
+        if cache_entry.backward_fn is not None:
+            import jax.numpy as jnp
+
+            output, saved = cache_entry.computation_fn(*inps)
+            ct = jnp.ones(getattr(output, "shape", ()), dtype=getattr(output, "dtype", jnp.float32))
+            flat_grads = cache_entry.backward_fn(*saved, ct)
+            grads = cache_entry.return_spec(flat_grads) if cache_entry.return_spec else flat_grads
+            result = (output, grads)
+        else:
+            result = cache_entry.computation_fn(*inps)
         cs.last_trace_host_execution_stop = time.perf_counter_ns()
         cs.last_trace_host_stop = cs.last_trace_host_execution_stop
         return result
@@ -125,8 +136,10 @@ def _compile(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict) -> Ca
     """Trace → transforms → executor dispatch → codegen (one cache entry)."""
     from thunder_tpu.executors.passes import del_last_used, transform_for_execution
 
+    grad_argnums = cd.compile_options.get("_grad_argnums")
+
     cs.last_trace_tracing_start = time.perf_counter_ns()
-    trace_results: TraceResults = trace_from_fn(cd.fn, args, kwargs)
+    trace_results: TraceResults = trace_from_fn(cd.fn, args, kwargs, grad_argnums=grad_argnums)
     cs.last_trace_tracing_stop = time.perf_counter_ns()
 
     prologue_trace = trace_results.prologue_trace
@@ -146,6 +159,36 @@ def _compile(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict) -> Ca
         computation_trace = transform(computation_trace)
         cs.last_traces.append(computation_trace)
 
+    bw_fn = None
+    bw_extrace = None
+    grad_postprocess = None
+    if grad_argnums is not None:
+        from thunder_tpu.core.transforms import forward_and_backward_from_trace
+        from thunder_tpu.core.proxies import TensorProxy as _TP
+        from thunder_tpu.core.pytree import tree_flatten as _tf
+
+        # grad contract (jax.grad-style): a single scalar differentiable output
+        for bsym in computation_trace.bound_symbols:
+            if bsym.sym.id is prims.PrimIDs.RETURN:
+                outs = [o for o in _tf(bsym.args)[0] if isinstance(o, _TP)]
+                check(
+                    len(outs) == 1 and outs[0].shape == () and dtypes.is_inexact_dtype(outs[0].dtype),
+                    lambda: f"grad/value_and_grad require the function to return a single scalar float "
+                    f"(got {[(tuple(o.shape), str(o.dtype)) for o in outs]})",
+                )
+
+        fw_trace, bw_trace = forward_and_backward_from_trace(computation_trace)
+        cs.last_traces.append(fw_trace)
+        cs.last_backward_traces = [bw_trace]
+        computation_trace = fw_trace
+
+        bw_extrace = transform_for_execution(bw_trace, cd.executors_list)
+        cs.last_backward_traces.append(bw_extrace)
+        bw_extrace = del_last_used(bw_extrace)
+        cs.last_backward_traces.append(bw_extrace)
+        bw_fn = bw_extrace.python_callable()
+        grad_postprocess = _make_grad_postprocess(trace_results.computation_trace, grad_argnums)
+
     extrace = transform_for_execution(computation_trace, cd.executors_list)
     cs.last_traces.append(extrace)
     extrace = del_last_used(extrace)
@@ -156,16 +199,37 @@ def _compile(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict) -> Ca
 
     uses_rng = getattr(trace_results.computation_trace, "_rng_key_proxy", None) is not None
 
-    return CacheEntry(
+    entry = CacheEntry(
         prologue_fn=pro_fn,
         computation_fn=comp_fn,
-        backward_fn=None,
+        backward_fn=bw_fn,
         prologue_trace=prologue_trace,
         computation_trace=extrace,
-        backward_trace=None,
+        backward_trace=bw_extrace,
         epilogue_trace=trace_results.epilogue_trace,
         uses_rng=uses_rng,
     )
+    entry.return_spec = grad_postprocess
+    return entry
+
+
+def _make_grad_postprocess(computation_trace, grad_argnums):
+    """Builds grads-restructuring: flat grads (input order) → per-argnum pytrees."""
+    from thunder_tpu.core.pytree import tree_unflatten
+
+    grad_meta = getattr(computation_trace, "_grad_meta", [])
+
+    def postprocess(flat_grads):
+        flat_grads = list(flat_grads)
+        it = iter(flat_grads)
+        by_argnum = {}
+        for argnum, spec_i, leaf_proxies in grad_meta:
+            leaves = [next(it) if p is not None else None for p in leaf_proxies]
+            by_argnum[argnum] = tree_unflatten(leaves, spec_i)
+        ordered = tuple(by_argnum[a] for a in grad_argnums)
+        return ordered[0] if len(ordered) == 1 else ordered
+
+    return postprocess
 
 
 def compile(fn: Callable, **kwargs) -> Callable:

@@ -1148,7 +1148,28 @@ unpack_flatten = make_prim(
 
 
 def _unpack_getitem_impl(coll, key):
-    return coll[key]
+    x = coll[key]
+    # torch/numpy tensors cross into jax here (host boundary)
+    try:
+        import torch
+
+        if isinstance(x, torch.Tensor):
+            import jax
+
+            t = x.detach()
+            try:
+                return jax.dlpack.from_dlpack(t.contiguous())
+            except Exception:
+                import numpy as np
+
+                if t.dtype == torch.bfloat16:
+                    import jax.numpy as jnp
+
+                    return jnp.asarray(t.float().numpy(), dtype=jnp.bfloat16)
+                return jax.numpy.asarray(t.numpy())
+    except ImportError:  # pragma: no cover
+        pass
+    return x
 
 
 unpack_getitem = make_prim(
@@ -1178,7 +1199,7 @@ def _check_tensor_metadata_impl(t, shape: tuple, device: str, dtype_str: str, re
     import numpy as np
 
     actual_device = None
-    actual_rg = False
+    actual_rg = None  # only torch tensors carry requires_grad; None skips the check
     if isinstance(t, jax.Array):
         actual_shape = tuple(t.shape)
         actual_dtype = str(np.dtype(t.dtype))
@@ -1211,7 +1232,7 @@ def _check_tensor_metadata_impl(t, shape: tuple, device: str, dtype_str: str, re
         raise RuntimeError(f"Tensor dtype changed: expected {dtype_str}, got {actual_dtype}")
     if actual_device is not None and actual_device != device:
         raise RuntimeError(f"Tensor device changed: expected {device}, got {actual_device}")
-    if actual_rg != bool(requires_grad):
+    if actual_rg is not None and actual_rg != bool(requires_grad):
         raise RuntimeError(f"Tensor requires_grad changed: expected {requires_grad}, got {actual_rg}")
     return None
 

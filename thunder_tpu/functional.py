@@ -73,9 +73,21 @@ def _dtype_str(x) -> str:
     return str(x.dtype).replace("torch.", "")
 
 
-def trace_from_fn(fn: Callable, args: tuple, kwargs: dict) -> TraceResults:
-    """Runs ``fn`` over proxies, returning prologue/computation/epilogue traces."""
+def trace_from_fn(fn: Callable, args: tuple, kwargs: dict, *, grad_argnums: tuple | None = None) -> TraceResults:
+    """Runs ``fn`` over proxies, returning prologue/computation/epilogue traces.
+
+    ``grad_argnums`` marks the float tensor leaves of those positional args
+    with ``requires_grad=True`` so the fw/bw split differentiates them.
+    """
+    from thunder_tpu.core.pytree import tree_map
+
     flat, spec = tree_flatten((tuple(args), dict(kwargs)))
+
+    # per-leaf differentiability flags, aligned with `flat`
+    gset = set(grad_argnums or ())
+    flag_args = tuple(tree_map(lambda _, _i=i: _i in gset, a) for i, a in enumerate(args))
+    flag_kwargs = tree_map(lambda _: False, dict(kwargs))
+    flat_flags, _ = tree_flatten((flag_args, flag_kwargs))
 
     #
     # Computation trace
@@ -83,8 +95,32 @@ def trace_from_fn(fn: Callable, args: tuple, kwargs: dict) -> TraceResults:
     computation_trace = TraceCtx(fn)
     proxies: list = []
     with tracectx(computation_trace):
-        for leaf in flat:
-            proxies.append(proxy_leaf(leaf, computation_trace))
+        for leaf, flagged in zip(flat, flat_flags):
+            if flagged and _is_tensor_like(leaf):
+                p = tensorproxy(leaf, requires_grad=True)
+                if not dtypes.is_inexact_dtype(p.dtype):
+                    p = TensorProxy(
+                        shape=p.shape, device=p.device, dtype=p.dtype, requires_grad=False
+                    )
+            else:
+                p = proxy_leaf(leaf, computation_trace)
+            proxies.append(p)
+
+    # per-argnum grad reconstruction metadata: (argnum, spec, per-leaf proxy-or-None)
+    if gset:
+        grad_meta = []
+        offset = 0
+        for i, a in enumerate(args):
+            leaves_i, spec_i = tree_flatten(a)
+            n = len(leaves_i)
+            if i in gset:
+                leaf_proxies = [
+                    p if isinstance(p, TensorProxy) and p.requires_grad else None
+                    for p in proxies[offset : offset + n]
+                ]
+                grad_meta.append((i, spec_i, leaf_proxies))
+            offset += n
+        computation_trace._grad_meta = grad_meta
 
     proxy_args, proxy_kwargs = tree_unflatten(proxies, spec)
 
@@ -130,12 +166,14 @@ def trace_from_fn(fn: Callable, args: tuple, kwargs: dict) -> TraceResults:
                 prologue_trace.record(b)
                 pro_leaf_proxies.append(leaf_p)
                 if isinstance(cproxy, TensorProxy):
+                    # guard the *input's* own requires_grad (torch tensors), not
+                    # the grad-transform's forced flag on the proxy
                     prims.check_tensor_metadata(
                         leaf_p,
                         tuple(cproxy.shape),
                         cproxy.device.device_str(),
                         _dtype_str(leaf),
-                        bool(cproxy.requires_grad),
+                        bool(getattr(leaf, "requires_grad", False)),
                     )
                 elif isinstance(cproxy, NumberProxy):
                     prims.check_number_type_and_value(leaf_p, cproxy.value)

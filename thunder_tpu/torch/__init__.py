@@ -949,8 +949,9 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
 
 
 @torchsymbol(_tfn("nn", "functional", "nll_loss"))
-def nll_loss(log_probs, target, weight=None, ignore_index=-100, reduction="mean"):
+def nll_loss(log_probs, target, weight=None, size_average=None, ignore_index=-100, reduce=None, reduction="mean"):
     check(weight is None, lambda: "nll_loss weight is not supported yet")
+    check(size_average is None and reduce is None, lambda: "legacy size_average/reduce are not supported; use reduction=")
     C = log_probs.shape[-1]
     flat_logp = clang.reshape(log_probs, (-1, C))
     flat_t = clang.reshape(target, (-1,))
@@ -971,14 +972,18 @@ def nll_loss(log_probs, target, weight=None, ignore_index=-100, reduction="mean"
 
 
 @torchsymbol(_tfn("nn", "functional", "cross_entropy"))
-def cross_entropy(logits, target, weight=None, ignore_index=-100, reduction="mean", label_smoothing=0.0):
+def cross_entropy(logits, target, weight=None, size_average=None, ignore_index=-100, reduce=None, reduction="mean", label_smoothing=0.0):
     check(label_smoothing == 0.0, lambda: "label_smoothing is not supported yet")
-    logp = log_softmax(logits, -1 if logits.ndim != 1 else 0)
+    check(size_average is None and reduce is None, lambda: "legacy size_average/reduce are not supported; use reduction=")
+    dim = -1 if logits.ndim != 1 else 0
     if logits.ndim > 2:
-        # torch layout: (N, C, d1, ...) -> move C last
+        # torch layout: (N, C, d1, ...) -> log_softmax over C, move C last
+        logp = log_softmax(logits, 1)
         perm = (0,) + tuple(range(2, logits.ndim)) + (1,)
         logp = clang.permute(logp, perm)
-    return nll_loss(logp, target, weight, ignore_index, reduction)
+    else:
+        logp = log_softmax(logits, dim)
+    return nll_loss(logp, target, weight, ignore_index=ignore_index, reduction=reduction)
 
 
 @torchsymbol(_tfn("nn", "functional", "mse_loss"))

@@ -1160,8 +1160,7 @@ def _unpack_getitem_impl(coll, key):
             try:
                 return jax.dlpack.from_dlpack(t.contiguous())
             except Exception:
-                import numpy as np
-
+                t = t.detach().cpu()
                 if t.dtype == torch.bfloat16:
                     import jax.numpy as jnp
 

@@ -621,8 +621,6 @@ def _matmul_bw(bsym, g):
         ga = prims.matmul(g_, clang.unsqueeze(b, 0))  # (..., m, k)
         ga = _sum_to_shape(ga, a.shape)
         gb = _sum_to_shape(prims.matmul(clang.transpose(a, -2, -1), g_), b.shape)
-        if tuple(gb.shape) != tuple(b.shape):
-            gb = clang.reshape(gb, b.shape)
         return [(a, ga), (b, gb)]
     ga = _sum_to_shape(prims.matmul(g, clang.transpose(b, -2, -1)), a.shape)
     gb = _sum_to_shape(prims.matmul(clang.transpose(a, -2, -1), g), b.shape)
@@ -662,6 +660,9 @@ def _embedding_bw(bsym, g):
 #
 
 
+_generic_vjp_counter = 0
+
+
 def _generic_vjp_rule(bsym: BoundSymbol, *cotangents):
     import jax
 
@@ -695,8 +696,13 @@ def _generic_vjp_rule(bsym: BoundSymbol, *cotangents):
         return pullback(ct)
 
     jax_ex = get_executor("jax")
+    # unique name per call site: the closure bakes in this bsym's non-tensor
+    # args, and codegen resolves operators by name — a shared name would make
+    # the last-registered closure win for every call site
+    global _generic_vjp_counter
+    _generic_vjp_counter += 1
     op = jax_ex.register_operator(
-        f"vjp_{bsym.sym.name}",
+        f"vjp_{bsym.sym.name}_{_generic_vjp_counter}",
         meta=lambda *a: tuple(
             TensorProxy(shape=t.shape, device=t.device, dtype=t.dtype, requires_grad=False)
             for t in tensor_args
@@ -797,6 +803,11 @@ def forward_and_backward_from_trace(trace: TraceCtx) -> tuple[TraceCtx, TraceCtx
             if not any(o.name in needs_grad for o in bsym.flat_proxy_outs if isinstance(o, TensorProxy)):
                 continue
             outs = [o for o in bsym.flat_outs if isinstance(o, TensorProxy)]
+            # identity records (output proxy is an input proxy, e.g. no-op
+            # ``to``): the cotangent already lives under the same name
+            arg_names = {a.name for a in bsym.flat_proxy_args}
+            if not bsym.subsymbols and all(o.name in arg_names for o in outs):
+                continue
             cts = [grad_map.get(o.name) for o in outs]
             if all(ct is None for ct in cts):
                 continue
@@ -805,10 +816,7 @@ def forward_and_backward_from_trace(trace: TraceCtx) -> tuple[TraceCtx, TraceCtx
                 for ct, o in zip(cts, outs)
             ]
             rule = backward_rules.get(bsym.sym.id, _generic_vjp_rule)
-            if rule is _generic_vjp_rule:
-                pairs = _generic_vjp_rule(bsym, *cts)
-            else:
-                pairs = rule(bsym, *cts)
+            pairs = rule(bsym, *cts)
             for inp, g in pairs:
                 if isinstance(inp, TensorProxy) and inp.name in needs_grad and dtypes.is_inexact_dtype(inp.dtype):
                     accumulate(inp, g)

@@ -38,9 +38,21 @@ def _is_passthrough(bsym: BoundSymbol) -> bool:
     return OpTags.CHECK_OP in tags or OpTags.UNPACK_OP in tags
 
 
+def _is_identity(bsym: BoundSymbol) -> bool:
+    """A recorded no-op: its output proxies *are* its input proxies (e.g.
+    ``a.to(a.dtype)``).  Safe to elide — the names already bind."""
+    outs = list(bsym.flat_proxy_outs)
+    if not outs or bsym.subsymbols:
+        return False
+    in_names = {p.name for p in bsym.flat_proxy_args}
+    return all(p.name in in_names for p in outs)
+
+
 def _claim_bsym(trace: TraceCtx, bsym: BoundSymbol, executors: Sequence[Executor]) -> list[BoundSymbol]:
     if _is_passthrough(bsym):
         return [bsym]
+    if _is_identity(bsym):
+        return []
 
     for ex in executors:
         if isinstance(ex, FusionExecutor):
@@ -113,6 +125,8 @@ def transform_for_execution(trace: TraceCtx, executors: Sequence[Executor]) -> T
     for bsym in extrace.bound_symbols:
         if bsym.sym.is_fusion or bsym.sym.executor is not None or _is_passthrough(bsym):
             swept.append(bsym)
+            continue
+        if _is_identity(bsym):
             continue
         claimed = None
         for ex in always:

@@ -1,0 +1,14 @@
+"""Model zoo for thunder_tpu.
+
+Functional (params-as-pytree) model definitions written against the
+``thunder_tpu.torch`` operator surface so they trace through the JIT
+pipeline.  Capability analog of the reference's test/bench models
+(``thunder/tests/litgpt_model.py``, ``nanogpt_model.py``,
+``llama2_model.py``) — but TPU-first: params are explicit pytrees of
+``jax.Array`` (no module object graph), so the same forward function works
+under ``thunder_tpu.jit``, ``jax.jit``, and sharded ``pjit`` over a mesh.
+"""
+from thunder_tpu.models import llama  # noqa: F401
+from thunder_tpu.models.llama import Config, gpt_forward, gpt_loss, init_params
+
+__all__ = ["llama", "Config", "gpt_forward", "gpt_loss", "init_params"]

@@ -1138,3 +1138,21 @@ if _torch is not None:
         _torch.Tensor.contiguous: contiguous,
     }
     _torch_to_thunder_function_map.update({k: v for k, v in _method_map.items() if k is not None})
+
+
+# torch-like dtype aliases (reference: torch.float32 etc. used throughout user code)
+bool_ = dtypes.bool_
+uint8 = dtypes.uint8
+int8 = dtypes.int8
+int16 = dtypes.int16
+int32 = dtypes.int32
+int64 = dtypes.int64
+long = dtypes.int64
+bfloat16 = dtypes.bfloat16
+float16 = dtypes.float16
+half = dtypes.float16
+float32 = dtypes.float32
+float64 = dtypes.float64
+double = dtypes.float64
+complex64 = dtypes.complex64
+complex128 = dtypes.complex128

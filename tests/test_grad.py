@@ -153,3 +153,30 @@ def test_generic_vjp_fallback_convolution():
     jgx, jgw = jax.grad(jloss, argnums=(0, 1))(x, w)
     _allclose(gx, jgx, rtol=1e-4, atol=1e-5)
     _allclose(gw, jgw, rtol=1e-4, atol=1e-5)
+
+
+def test_grad_matvec():
+    # regression: matmul with a 1-D right operand (reviewed crash in _matmul_bw)
+    a = jnp.asarray(np.random.RandomState(0).randn(2, 3), jnp.float32)
+    b = jnp.asarray(np.random.RandomState(1).randn(3), jnp.float32)
+
+    def loss(a, b):
+        return (a @ b).sum()
+
+    ga, gb = ttpu.grad(loss, argnums=(0, 1))(a, b)
+    jga, jgb = jax.grad(lambda a, b: (a @ b).sum(), argnums=(0, 1))(a, b)
+    np.testing.assert_allclose(np.asarray(ga), np.asarray(jga), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gb), np.asarray(jgb), atol=1e-6)
+
+
+def test_grad_vecmat():
+    a = jnp.asarray(np.random.RandomState(0).randn(3), jnp.float32)
+    b = jnp.asarray(np.random.RandomState(1).randn(3, 4), jnp.float32)
+
+    def loss(a, b):
+        return (a @ b).sum()
+
+    ga, gb = ttpu.grad(loss, argnums=(0, 1))(a, b)
+    jga, jgb = jax.grad(lambda a, b: (a @ b).sum(), argnums=(0, 1))(a, b)
+    np.testing.assert_allclose(np.asarray(ga), np.asarray(jga), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gb), np.asarray(jgb), atol=1e-6)

@@ -816,13 +816,22 @@ def tensorproxy(x, *, name: str | None = None, history=None, requires_grad: bool
         from thunder_tpu.core.devices import from_jax_device
 
         try:
-            dev = from_jax_device(list(x.devices())[0])
+            # a sharded array spans devices but is ONE logical SPMD value;
+            # canonicalize to the lowest device id so all leaves agree
+            dev = from_jax_device(min(x.devices(), key=lambda d: d.id))
         except Exception:
             from thunder_tpu.core.devices import cpu as _cpu
 
             dev = _cpu
+        sharding = None
+        try:
+            sharding = getattr(x.sharding, "spec", None)
+        except Exception:
+            pass
         rg = bool(requires_grad) if requires_grad is not None else False
-        return TensorProxy(name, shape=x.shape, device=dev, dtype=dtype, requires_grad=rg, history=history)
+        return TensorProxy(
+            name, shape=x.shape, device=dev, dtype=dtype, requires_grad=rg, history=history, sharding=sharding
+        )
     if isinstance(x, np.ndarray):
         return TensorProxy(
             name,

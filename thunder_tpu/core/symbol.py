@@ -162,6 +162,19 @@ class Symbol(SymbolInterface):
                 result = self.meta(*args, **kwargs)
             subsymbols = tuple(subscope)
 
+        # identity record: a composite that returned (a subset of) its inputs
+        # unchanged and traced nothing (e.g. no-op ``to``) — the names already
+        # bind, so recording would only confuse downstream passes
+        if not subsymbols and not self.is_prim:
+            from thunder_tpu.core.proxies import Proxy as _Proxy
+            from thunder_tpu.core.pytree import tree_flatten as _tf
+
+            out_proxies = [x for x in _tf(result)[0] if isinstance(x, _Proxy)]
+            if out_proxies:
+                in_ids = {id(x) for x in _tf((args, kwargs))[0] if isinstance(x, _Proxy)}
+                if all(id(p) in in_ids for p in out_proxies):
+                    return result
+
         bsym = self.bind(*args, output=result, subsymbols=subsymbols, **kwargs)
         trace.record(bsym)
         return result

@@ -620,7 +620,8 @@ def _matmul_bw(bsym, g):
         g_ = clang.unsqueeze(g, -1)  # (..., m, 1)
         ga = prims.matmul(g_, clang.unsqueeze(b, 0))  # (..., m, k)
         ga = _sum_to_shape(ga, a.shape)
-        gb = _sum_to_shape(prims.matmul(clang.transpose(a, -2, -1), g_), b.shape)
+        gb = prims.matmul(clang.transpose(a, -2, -1), g_)  # (..., k, 1)
+        gb = _sum_to_shape(clang.squeeze(gb, (gb.ndim - 1,)), b.shape)
         return [(a, ga), (b, gb)]
     ga = _sum_to_shape(prims.matmul(g, clang.transpose(b, -2, -1)), a.shape)
     gb = _sum_to_shape(prims.matmul(clang.transpose(a, -2, -1), g), b.shape)

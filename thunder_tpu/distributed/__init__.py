@@ -1,0 +1,38 @@
+"""thunder_tpu.distributed: data/tensor/sequence parallelism over TPU meshes.
+
+Capability analog of ``thunder/distributed/`` (ddp, fsdp ZeRO2/3, comm
+prims, bucketing, checkpointing) designed TPU-first: parallelism is a
+sharding of params/batch over a ``jax.sharding.Mesh``; XLA emits and
+overlaps the collectives.  Manual collectives remain available as trace
+prims (``thunder_tpu.distributed.prims``) for algorithms that need them
+(ring attention, expert dispatch).
+"""
+from thunder_tpu.distributed import prims  # noqa: F401  (registers jax impls)
+from thunder_tpu.distributed.api import TrainStep, ddp, fsdp, make_train_step, tp_fsdp
+from thunder_tpu.distributed.prims import DistributedReduceOps
+from thunder_tpu.distributed.sharding import (
+    ShardingRules,
+    apply_shardings,
+    batch_spec,
+    ddp_shardings,
+    fsdp_shardings,
+    llama_shardings,
+    make_mesh,
+)
+
+__all__ = [
+    "TrainStep",
+    "ddp",
+    "fsdp",
+    "tp_fsdp",
+    "make_train_step",
+    "DistributedReduceOps",
+    "ShardingRules",
+    "apply_shardings",
+    "batch_spec",
+    "ddp_shardings",
+    "fsdp_shardings",
+    "llama_shardings",
+    "make_mesh",
+    "prims",
+]

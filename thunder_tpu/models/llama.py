@@ -89,17 +89,23 @@ class Config:
         cfg = name_to_config[name]
         if not overrides:
             return cfg
-        # rebuild from the *pre-derivation* field values so derived fields
-        # (padded_vocab_size, head_size, n_query_groups, intermediate_size)
-        # recompute when their sources are overridden
+        # rebuild so derived fields recompute when their source fields are
+        # overridden — but only those whose stored value matches what
+        # derivation produced (an explicitly-configured value, e.g. 70B's
+        # n_query_groups=8, is never silently discarded)
         base = {f: getattr(cfg, f) for f in cfg.__dataclass_fields__}
+        was_derived = {
+            "padded_vocab_size": cfg.padded_vocab_size == ((cfg.vocab_size + 63) // 64) * 64,
+            "head_size": cfg.head_size == cfg.n_embd // cfg.n_head,
+            "n_query_groups": cfg.n_query_groups == cfg.n_head,
+        }
         derived_sources = {
             "padded_vocab_size": ("vocab_size",),
             "head_size": ("n_embd", "n_head"),
             "n_query_groups": ("n_head",),
         }
         for derived, sources in derived_sources.items():
-            if derived not in overrides and any(s in overrides for s in sources):
+            if derived not in overrides and was_derived[derived] and any(s in overrides for s in sources):
                 base[derived] = None
         base.update(overrides)
         return cls(**base)

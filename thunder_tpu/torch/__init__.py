@@ -1141,7 +1141,7 @@ if _torch is not None:
 
 
 # torch-like dtype aliases (reference: torch.float32 etc. used throughout user code)
-bool_ = dtypes.bool_
+bool_ = dtypes.bool8
 uint8 = dtypes.uint8
 int8 = dtypes.int8
 int16 = dtypes.int16

@@ -153,14 +153,19 @@ class TrainStep:
         fw_fn = _trace_to_jax_fn(fw_trace)
         bw_fn = _trace_to_jax_fn(bw_trace)
 
-        # map runtime leaves → computation inputs (flatten order, tensors only)
+        # map runtime leaves → computation inputs (flatten order, tensors only).
+        # MUST use the same tensor predicate as the frontend so the env order
+        # here matches the trace's input order exactly
+        from thunder_tpu.functional import _is_tensor_like
+
         def comp_tensor_inputs(params, batch):
             flat, _ = jax.tree_util.tree_flatten((((params,) + tuple(batch)), {}))
-            return [x for x in flat if isinstance(x, jax.Array) or hasattr(x, "shape")]
+            return [x for x in flat if _is_tensor_like(x)]
 
         params_flat, params_spec = jax.tree_util.tree_flatten(params)
         diff_mask = [
-            hasattr(x, "dtype") and jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact) for x in params_flat
+            _is_tensor_like(x) and jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact)
+            for x in params_flat
         ]
 
         def value_and_grad_fn(params, *batch):
@@ -191,9 +196,19 @@ class TrainStep:
             lambda x: x.sharding if isinstance(x, jax.Array) else None, opt_state
         )
         if self.batch_specs is None:
+            # default: batch-shard only args whose dim 0 matches the first
+            # arg's batch size — side inputs (rope caches, masks) replicate
+            # rather than getting spuriously split over the data axes
             bspec = batch_spec(self.mesh)
+            bsz = jnp.shape(batch[0])[0] if jnp.ndim(batch[0]) >= 1 else None
             batch_sh = tuple(
-                NamedSharding(self.mesh, _prune_spec(bspec, jnp.shape(b), self.mesh)) for b in batch
+                NamedSharding(
+                    self.mesh,
+                    _prune_spec(bspec, jnp.shape(b), self.mesh)
+                    if jnp.ndim(b) >= 1 and jnp.shape(b)[0] == bsz
+                    else P(),
+                )
+                for b in batch
             )
         else:
             batch_sh = tuple(
@@ -211,18 +226,19 @@ class TrainStep:
     def _batch_key(batch):
         return tuple((tuple(jnp.shape(b)), str(getattr(b, "dtype", type(b)))) for b in batch)
 
-    def __call__(self, params, opt_state, *batch):
+    def _get_jitted(self, params, opt_state, batch):
         key = self._batch_key(batch)
         if key not in self._cache:
             self._build(params, opt_state, batch)
             self._cache[key] = self._jitted
         self._jitted = self._cache[key]
-        return self._jitted(params, opt_state, *batch)
+        return self._jitted
+
+    def __call__(self, params, opt_state, *batch):
+        return self._get_jitted(params, opt_state, batch)(params, opt_state, *batch)
 
     def lower_hlo(self, params, opt_state, *batch) -> str:
-        if self._jitted is None:
-            self._build(params, opt_state, batch)
-        return self._jitted.lower(params, opt_state, *batch).as_text()
+        return self._get_jitted(params, opt_state, batch).lower(params, opt_state, *batch).as_text()
 
 
 def make_train_step(

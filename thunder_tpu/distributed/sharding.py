@@ -79,6 +79,8 @@ def _prune_spec(spec: P, shape: Sequence[int], mesh: Mesh) -> P:
     shapes degrade to replication instead of erroring."""
     out = []
     for i, axes in enumerate(spec):
+        if i >= len(shape):  # spec longer than rank: extra entries degrade too
+            break
         if axes is None:
             out.append(None)
             continue

@@ -213,12 +213,18 @@ def build_rope_cache(config: Config, seq_len: int, dtype=jnp.float32) -> tuple[j
 
 
 def apply_rope(x, cos, sin):
-    """NeoX-style rotary embedding.  x: (B, nh, T, rope_n_elem); cos/sin (T, rope_n_elem)."""
+    """NeoX-style rotary embedding.  x: (B, nh, T, rope_n_elem); cos/sin (T, rope_n_elem).
+
+    The f32 rope cache promotes low-precision activations during the rotation
+    (precision where it matters), then the result is cast back to x.dtype so
+    the attention matmuls stay MXU-native bf16.
+    """
     half = x.shape[-1] // 2
     x1 = x[..., :half]
     x2 = x[..., half:]
     rotated = ltorch.cat([-x2, x1], dim=-1)
-    return x * cos + rotated * sin
+    roped = x * cos + rotated * sin
+    return roped.to(x.dtype)
 
 
 def _norm(x, weight, config: Config):

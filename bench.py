@@ -35,9 +35,10 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def eager_loss_fn(cfg: llama.Config):
-    """Pure-jnp mirror of models/llama.gpt_loss for the eager baseline
-    (no thunder_tpu tracing, no jit — op-by-op dispatch)."""
+def plain_jax_loss_fn(cfg: llama.Config):
+    """Pure-jnp mirror of models/llama.gpt_loss: the baseline model, written
+    by hand with no thunder_tpu tracing (compiled with stock jax.jit in
+    baseline_run)."""
 
     def rms_norm(x, w):
         xf = x.astype(jnp.float32)
@@ -128,7 +129,7 @@ def baseline_run(cfg, B, T, optimizer, steps):
     is stock jax.jit — vs_baseline ≥ 1.0 means the framework's pipeline adds
     no overhead over hand-written JAX and its kernels/remat win beyond it.)"""
     idx, tgt, cos, sin = make_batch(cfg, B, T)
-    vg = jax.value_and_grad(eager_loss_fn(cfg))
+    vg = jax.value_and_grad(plain_jax_loss_fn(cfg))
     p = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
     o = optimizer.init(p)
 

@@ -196,6 +196,10 @@ class PrimIDs(Enum):
     EMBEDDING_BACKWARD = auto()
     CONVOLUTION = auto()
     ONE_HOT = auto()
+    # fused attention (claimed by the Pallas flash-attention executor; the
+    # reference models this as executor-registered symbols, sdpaex.py:240)
+    SDPA = auto()
+    SDPA_BACKWARD = auto()
 
 
 #
@@ -1003,6 +1007,57 @@ def _convolution_meta(
 
 
 convolution = make_prim(PrimIDs.CONVOLUTION, "convolution", meta=_convolution_meta, tags=(OpTags.MATMUL_OP,))
+
+
+def _sdpa_meta(
+    q: TensorProxy, k: TensorProxy, v: TensorProxy, causal: bool, scale: float
+) -> tuple[TensorProxy, TensorProxy]:
+    """Fused scaled-dot-product attention over (..., T, hs) q/k/v.
+
+    Returns ``(out, lse)`` where ``lse`` is the float32 log-sum-exp of the
+    scaled scores per query row — the residual a flash-attention backward
+    needs instead of the (T, T) probability matrix (the memory property the
+    reference gets from aten/cudnn flash kernels, sdpaex.py:240).
+    """
+    for t in (q, k, v):
+        _check_tensor(t)
+    utils.check_same_device(q, k, v, name="sdpa")
+    utils.check_same_dtype(q, k, v, name="sdpa")
+    check(q.ndim >= 2, lambda: f"sdpa: rank must be >= 2, got {q.ndim}")
+    check(q.ndim == k.ndim == v.ndim, lambda: f"sdpa: rank mismatch {q.ndim}/{k.ndim}/{v.ndim}")
+    check(q.shape[-1] == k.shape[-1], lambda: f"sdpa: q/k head dims {q.shape[-1]} != {k.shape[-1]}")
+    check(k.shape[-2] == v.shape[-2], lambda: f"sdpa: k/v lengths {k.shape[-2]} != {v.shape[-2]}")
+    check(q.shape[:-2] == k.shape[:-2] == v.shape[:-2], lambda: "sdpa: batch dims must match (no broadcasting)")
+    rg = (q.requires_grad or k.requires_grad or v.requires_grad) and dtypes.is_inexact_dtype(q.dtype)
+    out = _out_like(q, shape=q.shape[:-1] + (v.shape[-1],), requires_grad=rg)
+    lse = TensorProxy(shape=q.shape[:-1], device=q.device, dtype=dtypes.float32, requires_grad=False)
+    return out, lse
+
+
+sdpa = make_prim(PrimIDs.SDPA, "sdpa", meta=_sdpa_meta, tags=(OpTags.MATMUL_OP,))
+
+
+def _sdpa_backward_meta(
+    g: TensorProxy,
+    q: TensorProxy,
+    k: TensorProxy,
+    v: TensorProxy,
+    out: TensorProxy,
+    lse: TensorProxy,
+    causal: bool,
+    scale: float,
+) -> tuple[TensorProxy, TensorProxy, TensorProxy]:
+    for t in (g, q, k, v, out, lse):
+        _check_tensor(t)
+    dq = _out_like(q, requires_grad=False)
+    dk = _out_like(k, requires_grad=False)
+    dv = _out_like(v, requires_grad=False)
+    return dq, dk, dv
+
+
+sdpa_backward = make_prim(
+    PrimIDs.SDPA_BACKWARD, "sdpa_backward", meta=_sdpa_backward_meta, tags=(OpTags.MATMUL_OP,)
+)
 
 
 #

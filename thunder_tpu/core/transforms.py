@@ -61,6 +61,7 @@ nondifferentiable_ids = {
     PrimIDs.ARGMAX, PrimIDs.ARGMIN, PrimIDs.ARGSORT, PrimIDs.ONE_HOT,
     PrimIDs.FULL, PrimIDs.IOTA, PrimIDs.UNIFORM, PrimIDs.RANDN, PrimIDs.RANDINT,
     PrimIDs.MULTINOMIAL, PrimIDs.EMBEDDING_BACKWARD, PrimIDs.ITEM,
+    PrimIDs.SDPA_BACKWARD,
 }
 
 
@@ -645,6 +646,41 @@ def _linear_bw(bsym, g):
     return grads
 
 
+@register_backward_rule(PrimIDs.SDPA)
+def _sdpa_bw(bsym, g_out, g_lse):
+    """Flash-attention-style backward: consumes (q, k, v, out, lse) — never
+    the (T, T) probability matrix — so saved_for_backward stays O(T).
+
+    ``lse`` is an auxiliary output; when something downstream actually
+    consumes it (g_lse is a real cotangent, not None), its contribution is
+    added via the decomposed probability matrix — an O(T²) cost paid only in
+    that rare case (e.g. distillation losses over lse).
+    """
+    q, k, v, causal, scale = bsym.args
+    out, lse = bsym.output
+    if g_out is None:
+        g_out = clang.full_like(out, 0.0)
+    dq, dk, dv = prims.sdpa_backward(g_out, q, k, v, out, lse, causal, scale)
+    if g_lse is not None:
+        # d lse_i/dq_i = scale * sum_j p_ij k_j ; d lse_i/dk_j = scale * p_ij q_i
+        s = clang.mul(prims.matmul(q, clang.transpose(k, -2, -1)), scale)
+        if causal:
+            Tq, Tk = q.shape[-2], k.shape[-2]
+            row = clang.arange(0, Tq, device=q.device, dtype=dtypes.int32)
+            col = clang.arange(0, Tk, device=q.device, dtype=dtypes.int32)
+            keep = clang.ge(clang.reshape(row, (Tq, 1)), clang.reshape(col, (1, Tk)))
+            s = clang.where(keep, s, float("-inf"))
+        p = clang.exp(clang.sub(s, clang.unsqueeze(lse, -1)))
+        p = clang.maybe_convert_to_dtype(p, q.dtype)
+        gp = clang.mul(p, clang.unsqueeze(clang.maybe_convert_to_dtype(g_lse, q.dtype), -1))
+        dq = clang.add(dq, clang.mul(prims.matmul(gp, k), scale))
+        dk = clang.add(dk, clang.mul(prims.matmul(clang.transpose(gp, -2, -1), q), scale))
+    return [(q, dq), (k, dk), (v, dv)]
+
+
+_sdpa_bw._accepts_none_cotangents = True
+
+
 @register_backward_rule(PrimIDs.EMBEDDING)
 def _embedding_bw(bsym, g):
     indices = bsym.args[0]
@@ -812,11 +848,12 @@ def forward_and_backward_from_trace(trace: TraceCtx) -> tuple[TraceCtx, TraceCtx
             cts = [grad_map.get(o.name) for o in outs]
             if all(ct is None for ct in cts):
                 continue
-            cts = [
-                ct if ct is not None else clang.full_like(o, 0.0)
-                for ct, o in zip(cts, outs)
-            ]
             rule = backward_rules.get(bsym.sym.id, _generic_vjp_rule)
+            if not getattr(rule, "_accepts_none_cotangents", False):
+                cts = [
+                    ct if ct is not None else clang.full_like(o, 0.0)
+                    for ct, o in zip(cts, outs)
+                ]
             pairs = rule(bsym, *cts)
             for inp, g in pairs:
                 if isinstance(inp, TensorProxy) and inp.name in needs_grad and dtypes.is_inexact_dtype(inp.dtype):

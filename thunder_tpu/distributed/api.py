@@ -234,11 +234,20 @@ class TrainStep:
         self._jitted = self._cache[key]
         return self._jitted
 
+    def _spmd_guard(self):
+        """Multi-device meshes must trace without un-partitionable Pallas
+        kernels (see pallasex.spmd_guard); single-device keeps them."""
+        from thunder_tpu.executors.pallasex import spmd_guard
+
+        return spmd_guard(self.mesh.devices.size > 1)
+
     def __call__(self, params, opt_state, *batch):
-        return self._get_jitted(params, opt_state, batch)(params, opt_state, *batch)
+        with self._spmd_guard():
+            return self._get_jitted(params, opt_state, batch)(params, opt_state, *batch)
 
     def lower_hlo(self, params, opt_state, *batch) -> str:
-        return self._get_jitted(params, opt_state, batch).lower(params, opt_state, *batch).as_text()
+        with self._spmd_guard():
+            return self._get_jitted(params, opt_state, batch).lower(params, opt_state, *batch).as_text()
 
 
 def make_train_step(

@@ -6,8 +6,10 @@ Importing this package registers the default executor stack:
 """
 from thunder_tpu.executors import jaxex  # noqa: F401  (registers "jax", default+always)
 from thunder_tpu.executors import xlaex  # noqa: F401  (registers "xla", default)
+from thunder_tpu.executors import pallasex  # noqa: F401  (registers "pallas", default, highest priority)
 
 from thunder_tpu.executors.jaxex import jax_ex
+from thunder_tpu.executors.pallasex import pallas_ex
 from thunder_tpu.executors.xlaex import xla_ex
 
-__all__ = ["jax_ex", "xla_ex"]
+__all__ = ["jax_ex", "pallas_ex", "xla_ex"]

@@ -448,6 +448,65 @@ def _convolution_impl(a, weight, bias, stride, padding, dilation, transposed, ou
     return out
 
 
+# Fused attention.  The reference impls below are the jnp decomposition
+# (numerically the flash algorithm's result, materializing the score matrix);
+# the Pallas executor (pallasex.py) installs blockwise flash kernels into
+# these hooks so every execution path — claimed traces, XLA fusion regions,
+# and the distributed TrainStep's trace evaluation — dispatches to them when
+# the shapes/backend qualify.
+_sdpa_fast_path: Callable | None = None  # (q, k, v, causal, scale) -> (out, lse) or None
+_sdpa_bwd_fast_path: Callable | None = None
+
+
+def _sdpa_reference(q, k, v, causal, scale):
+    s = jnp.einsum("...qd,...kd->...qk", q, k, preferred_element_type=jnp.float32)
+    s = s * scale
+    if causal:
+        # top-left alignment (query i attends keys j <= i), matching the
+        # torch-level decomposition and the Pallas kernels
+        Tq, Tk = q.shape[-2], k.shape[-2]
+        mask = jnp.tril(jnp.ones((Tq, Tk), dtype=bool))
+        s = jnp.where(mask, s, -jnp.inf)
+    lse = jax.nn.logsumexp(s, axis=-1)
+    p = jnp.exp(s - lse[..., None])
+    out = jnp.einsum("...qk,...kd->...qd", p.astype(v.dtype), v)
+    return out.astype(q.dtype), lse
+
+
+@impl(PrimIDs.SDPA)
+def _sdpa_impl(q, k, v, causal, scale):
+    if _sdpa_fast_path is not None:
+        res = _sdpa_fast_path(q, k, v, causal, scale)
+        if res is not None:
+            return res
+    return _sdpa_reference(q, k, v, causal, scale)
+
+
+def _sdpa_backward_reference(g, q, k, v, out, lse, causal, scale):
+    s = jnp.einsum("...qd,...kd->...qk", q, k, preferred_element_type=jnp.float32) * scale
+    if causal:
+        Tq, Tk = q.shape[-2], k.shape[-2]
+        mask = jnp.tril(jnp.ones((Tq, Tk), dtype=bool))
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jnp.exp(s - lse[..., None])  # (..., Tq, Tk) f32
+    dv = jnp.einsum("...qk,...qd->...kd", p, g.astype(jnp.float32))
+    dp = jnp.einsum("...qd,...kd->...qk", g, v, preferred_element_type=jnp.float32)
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True)
+    ds = p * (dp - delta) * scale
+    dq = jnp.einsum("...qk,...kd->...qd", ds, k.astype(jnp.float32))
+    dk = jnp.einsum("...qk,...qd->...kd", ds, q.astype(jnp.float32))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@impl(PrimIDs.SDPA_BACKWARD)
+def _sdpa_backward_impl(g, q, k, v, out, lse, causal, scale):
+    if _sdpa_bwd_fast_path is not None:
+        res = _sdpa_bwd_fast_path(g, q, k, v, out, lse, causal, scale)
+        if res is not None:
+            return res
+    return _sdpa_backward_reference(g, q, k, v, out, lse, causal, scale)
+
+
 def get_prim_impl(pid: PrimIDs) -> Callable | None:
     return prim_impls.get(pid)
 

@@ -927,6 +927,12 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     flash-attention kernel (analog of reference sdpaex/cudnnex claiming)."""
     d = query.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    # fast path: the fused SDPA prim (flash-attention kernels claim it; the
+    # jax executor provides the decomposed fallback).  Mask/dropout variants
+    # take the explicit decomposition below
+    if attn_mask is None and dropout_p == 0.0 and query.shape[:-2] == key.shape[:-2] == value.shape[:-2]:
+        out, _lse = prims.sdpa(query, key, value, bool(is_causal), float(scale))
+        return out
     q = clang.mul(query, scale)
     kt = clang.transpose(key, -2, -1)
     scores = clang.matmul(q, kt)

@@ -180,6 +180,12 @@ def _compile(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict) -> Ca
         fw_trace, bw_trace = forward_and_backward_from_trace(computation_trace)
         cs.last_traces.append(fw_trace)
         cs.last_backward_traces = [bw_trace]
+        if cd.compile_options.get("remat", True):
+            from thunder_tpu.core.rematerialization import rematerialize_forward_and_backward
+
+            fw_trace, bw_trace = rematerialize_forward_and_backward(fw_trace, bw_trace)
+            cs.last_traces.append(fw_trace)
+            cs.last_backward_traces.append(bw_trace)
         computation_trace = fw_trace
 
         bw_extrace = transform_for_execution(bw_trace, cd.executors_list)

@@ -200,6 +200,9 @@ class PrimIDs(Enum):
     # reference models this as executor-registered symbols, sdpaex.py:240)
     SDPA = auto()
     SDPA_BACKWARD = auto()
+    # fused cross-entropy (analog of the reference's apex/triton CE executors,
+    # apex_entropyex.py:15, triton_crossentropy_impl.py:18)
+    CROSS_ENTROPY_FWD = auto()
 
 
 #
@@ -1057,6 +1060,31 @@ def _sdpa_backward_meta(
 
 sdpa_backward = make_prim(
     PrimIDs.SDPA_BACKWARD, "sdpa_backward", meta=_sdpa_backward_meta, tags=(OpTags.MATMUL_OP,)
+)
+
+
+def _cross_entropy_fwd_meta(logits: TensorProxy, target: TensorProxy) -> tuple[TensorProxy, TensorProxy]:
+    """Fused row-wise cross-entropy over (N, C) logits and (N,) class targets.
+
+    Returns ``(losses, lse)``, both float32 (N,).  The backward recomputes the
+    softmax from ``(logits, lse)`` so the (N, C) log-probability matrix is
+    never saved — the memory property the reference buys with its apex/triton
+    kernels (apex_entropyex.py:15).
+    """
+    _check_tensor(logits)
+    _check_tensor(target)
+    check(logits.ndim == 2, lambda: f"cross_entropy_fwd: logits must be 2D, got {logits.ndim}D")
+    check(target.ndim == 1, lambda: f"cross_entropy_fwd: target must be 1D, got {target.ndim}D")
+    check(logits.shape[0] == target.shape[0], lambda: f"cross_entropy_fwd: {logits.shape} vs {target.shape}")
+    check(dtypes.is_exact_dtype(target.dtype), lambda: "cross_entropy_fwd: target must be integer")
+    rg = logits.requires_grad
+    losses = TensorProxy(shape=(logits.shape[0],), device=logits.device, dtype=dtypes.float32, requires_grad=rg)
+    lse = TensorProxy(shape=(logits.shape[0],), device=logits.device, dtype=dtypes.float32, requires_grad=False)
+    return losses, lse
+
+
+cross_entropy_fwd = make_prim(
+    PrimIDs.CROSS_ENTROPY_FWD, "cross_entropy_fwd", meta=_cross_entropy_fwd_meta, tags=(OpTags.REDUCTION_OP,)
 )
 
 

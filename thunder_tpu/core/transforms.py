@@ -681,6 +681,27 @@ def _sdpa_bw(bsym, g_out, g_lse):
 _sdpa_bw._accepts_none_cotangents = True
 
 
+@register_backward_rule(PrimIDs.CROSS_ENTROPY_FWD)
+def _cross_entropy_fwd_bw(bsym, g_losses, g_lse):
+    """dlogits = softmax(logits) * (g_losses + g_lse) - onehot(target) * g_losses,
+    recomputed from (logits, lse) — no (N, C) log-prob residual."""
+    logits, target = bsym.args
+    losses, lse = bsym.output
+    p = clang.exp(clang.sub(clang.maybe_convert_to_dtype(logits, dtypes.float32), clang.unsqueeze(lse, -1)))
+    oh = clang.maybe_convert_to_dtype(prims.one_hot(target, logits.shape[1]), dtypes.float32)
+    if g_losses is None:
+        g_losses = clang.full_like(losses, 0.0)
+    g_tot = clang.add(g_losses, g_lse) if g_lse is not None else g_losses
+    dlogits = clang.sub(
+        clang.mul(p, clang.unsqueeze(g_tot, -1)),
+        clang.mul(oh, clang.unsqueeze(g_losses, -1)),
+    )
+    return [(logits, clang.maybe_convert_to_dtype(dlogits, logits.dtype))]
+
+
+_cross_entropy_fwd_bw._accepts_none_cotangents = True
+
+
 @register_backward_rule(PrimIDs.EMBEDDING)
 def _embedding_bw(bsym, g):
     indices = bsym.args[0]

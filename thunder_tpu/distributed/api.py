@@ -109,12 +109,14 @@ class TrainStep:
         *,
         batch_specs: Sequence[P] | None = None,
         donate: bool = True,
+        remat: bool = True,
     ):
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.mesh = mesh
         self.batch_specs = batch_specs
         self.donate = donate
+        self.remat = remat
         # compiled steps keyed by batch signature (shape/dtype per arg):
         # shardings are pruned against concrete shapes, so a new shape needs
         # a fresh build
@@ -149,6 +151,10 @@ class TrainStep:
         comp = cse(comp)
         comp.args = trace_results.computation_trace.args
         fw_trace, bw_trace = forward_and_backward_from_trace(comp)
+        if self.remat:
+            from thunder_tpu.core.rematerialization import rematerialize_forward_and_backward
+
+            fw_trace, bw_trace = rematerialize_forward_and_backward(fw_trace, bw_trace)
         self.fw_trace, self.bw_trace = fw_trace, bw_trace
         fw_fn = _trace_to_jax_fn(fw_trace)
         bw_fn = _trace_to_jax_fn(bw_trace)
@@ -257,5 +263,6 @@ def make_train_step(
     *,
     batch_specs: Sequence[P] | None = None,
     donate: bool = True,
+    remat: bool = True,
 ) -> TrainStep:
-    return TrainStep(loss_fn, optimizer, mesh, batch_specs=batch_specs, donate=donate)
+    return TrainStep(loss_fn, optimizer, mesh, batch_specs=batch_specs, donate=donate, remat=remat)

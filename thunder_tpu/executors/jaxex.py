@@ -507,6 +507,14 @@ def _sdpa_backward_impl(g, q, k, v, out, lse, causal, scale):
     return _sdpa_backward_reference(g, q, k, v, out, lse, causal, scale)
 
 
+@impl(PrimIDs.CROSS_ENTROPY_FWD)
+def _cross_entropy_fwd_impl(logits, target):
+    lg = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(lg, axis=-1)
+    picked = jnp.take_along_axis(lg, target[:, None].astype(jnp.int32), axis=-1)[:, 0]
+    return lse - picked, lse
+
+
 def get_prim_impl(pid: PrimIDs) -> Callable | None:
     return prim_impls.get(pid)
 

@@ -85,9 +85,11 @@ def _block(T: int) -> int:
     return 0
 
 
-def _supported(q_shape, k_shape, dtype, causal) -> bool:
+def _supported(q_shape, k_shape, v_shape, dtype, causal) -> bool:
     *_, Tq, hs = q_shape
     Tk = k_shape[-2]
+    if v_shape[-1] != hs:  # kernels assume one head dim for q/k/v
+        return False
     if hs % 128 != 0 or hs > 512:
         return False
     if _block(Tq) == 0 or _block(Tk) == 0:
@@ -346,7 +348,7 @@ def _flash_bwd(g, q, k, v, out, lse, causal: bool, scale: float):
 
 def flash_sdpa(q, k, v, causal, scale):
     """Returns (out, lse) via the flash kernels, or None if unsupported."""
-    if not _enabled() or not _supported(q.shape, k.shape, q.dtype, causal):
+    if not _enabled() or not _supported(q.shape, k.shape, v.shape, q.dtype, causal):
         return None
     *batch, Tq, hs = q.shape
     Tk = k.shape[-2]
@@ -362,7 +364,7 @@ def flash_sdpa(q, k, v, causal, scale):
 
 def flash_sdpa_backward(g, q, k, v, out, lse, causal, scale):
     """Returns (dq, dk, dv) via the flash kernels, or None if unsupported."""
-    if not _enabled() or not _supported(q.shape, k.shape, q.dtype, causal):
+    if not _enabled() or not _supported(q.shape, k.shape, v.shape, q.dtype, causal):
         return None
     *batch, Tq, hs = q.shape
     Tk = k.shape[-2]
@@ -411,11 +413,11 @@ _sdpa_bwd_op = ex.register_operator(
 
 
 def _sdpa_checker(q, k, v, causal, scale):
-    return _enabled() and _supported(q.shape, k.shape, q.dtype, causal)
+    return _enabled() and _supported(q.shape, k.shape, v.shape, q.dtype, causal)
 
 
 def _sdpa_bwd_checker(g, q, k, v, out, lse, causal, scale):
-    return _enabled() and _supported(q.shape, k.shape, q.dtype, causal)
+    return _enabled() and _supported(q.shape, k.shape, v.shape, q.dtype, causal)
 
 
 ex.register_implementation(PrimIDs.SDPA, _sdpa_op, checker=_sdpa_checker)

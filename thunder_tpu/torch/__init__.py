@@ -981,6 +981,27 @@ def nll_loss(log_probs, target, weight=None, size_average=None, ignore_index=-10
 def cross_entropy(logits, target, weight=None, size_average=None, ignore_index=-100, reduce=None, reduction="mean", label_smoothing=0.0):
     check(label_smoothing == 0.0, lambda: "label_smoothing is not supported yet")
     check(size_average is None and reduce is None, lambda: "legacy size_average/reduce are not supported; use reduction=")
+    # fast path: fused row-wise CE prim (no (N, C) log-prob residual saved for
+    # backward).  Class-index targets with the standard 2D/1D layouts only
+    if (
+        weight is None
+        and reduction in ("mean", "sum", "none")
+        and logits.ndim == 2
+        and target.ndim == 1
+        and dtypes.is_exact_dtype(target.dtype)
+    ):
+        safe_t = clang.where(clang.eq(target, ignore_index), 0, target)
+        losses, _lse = prims.cross_entropy_fwd(logits, clang.maybe_convert_to_dtype(safe_t, dtypes.int32))
+        valid = clang.ne(target, ignore_index)
+        losses = clang.where(valid, losses, 0.0)
+        losses = clang.maybe_convert_to_dtype(losses, logits.dtype if dtypes.is_inexact_dtype(logits.dtype) else dtypes.float32)
+        if reduction == "none":
+            return losses
+        total = clang.sum(losses, None, False)
+        if reduction == "sum":
+            return total
+        n_valid = clang.sum(clang.maybe_convert_to_dtype(valid, losses.dtype), None, False)
+        return clang.true_divide(total, clang.maximum(n_valid, 1.0))
     dim = -1 if logits.ndim != 1 else 0
     if logits.ndim > 2:
         # torch layout: (N, C, d1, ...) -> log_softmax over C, move C last

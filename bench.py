@@ -18,8 +18,10 @@ Model is the Llama-2 architecture scaled to fit one v5e chip for training
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
+import traceback
 from functools import partial
 
 import jax
@@ -153,8 +155,38 @@ def baseline_run(cfg, B, T, optimizer, steps):
     return tps
 
 
+def _resolve_backend() -> str:
+    """Return the JAX backend name, surviving flaky TPU init.
+
+    Round 1's bench died at backend init ("UNAVAILABLE: TPU backend
+    setup/compile error", BENCH_r01.json rc=1).  JAX caches a failed backend
+    for the process lifetime, so in-process retry is useless — instead
+    re-exec this script: twice to give the TPU another chance, then once
+    more with the platform forced to CPU so a (smoke-mode) number is still
+    produced.  Runs inside main()'s fail-soft wrapper, so even a forced-CPU
+    failure still emits the diagnostic JSON line.
+    """
+    if os.environ.get("THUNDER_TPU_BENCH_FORCE_CPU"):
+        from thunder_tpu._platform import force_cpu
+
+        force_cpu()  # raises on failure → caught by the __main__ wrapper
+        return jax.default_backend()
+    try:
+        return jax.default_backend()
+    except Exception as e:
+        attempt = int(os.environ.get("THUNDER_TPU_BENCH_ATTEMPT", "0"))
+        log(f"backend init failed (attempt {attempt}): {e}")
+        env = dict(os.environ)
+        if attempt < 2:
+            env["THUNDER_TPU_BENCH_ATTEMPT"] = str(attempt + 1)
+            time.sleep(15)
+        else:
+            env["THUNDER_TPU_BENCH_FORCE_CPU"] = "1"
+        os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
+
+
 def main():
-    on_tpu = jax.default_backend() == "tpu"
+    on_tpu = _resolve_backend() == "tpu"
     if on_tpu:
         # Llama-2 architecture, ~540M params: training state fits one v5e chip
         cfg = llama.Config.from_name(
@@ -184,4 +216,17 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception:
+        # Fail-soft: always emit one valid JSON line so the driver records a
+        # diagnostic artifact instead of an empty one (round-1 BENCH was rc=1
+        # with no output at all).
+        traceback.print_exc(file=sys.stderr)
+        print(json.dumps({
+            "metric": "bench_error",
+            "value": 0.0,
+            "unit": "tokens/s",
+            "vs_baseline": 0.0,
+        }))
+        sys.exit(1)

@@ -2,13 +2,11 @@
 
 The reference's distributed tests require multi-GPU hardware; on TPU/XLA we
 instead test true SPMD on a virtual CPU mesh (SURVEY.md §4 design
-requirement).  NOTE: the axon TPU plugin overrides the JAX_PLATFORMS env var,
-so the platform must be forced via jax.config before any array is created.
+requirement).  The axon TPU plugin overrides the JAX_PLATFORMS env var, so
+the platform must be forced via jax.config before any array is created —
+thunder_tpu._platform.force_cpu is the one shared implementation of that
+workaround.
 """
-import os
+from thunder_tpu._platform import force_cpu
 
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-
-import jax
-
-jax.config.update("jax_platforms", "cpu")
+force_cpu(8)

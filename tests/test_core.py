@@ -195,3 +195,27 @@ def test_executor_stack_produces_fusion():
     jfn(jnp.ones((8, 8)), jnp.ones((8, 8)))
     src = ttpu.last_traces(jfn)[-1].python()
     assert "XLA0" in src  # region was compiled as one XLA program
+
+
+def test_cross_entropy_bf16_f32_accumulation():
+    # fused-CE fast path must keep row losses in f32 through the reduction
+    # and only cast the final result (torch semantics for bf16 logits)
+    import torch
+    import torch.nn.functional as F
+
+    rs = np.random.RandomState(0)
+    logits = rs.randn(2048, 256).astype(np.float32)
+    tgt = rs.randint(0, 256, size=(2048,))
+
+    jl = jnp.asarray(logits, jnp.bfloat16)
+    jt = jnp.asarray(tgt, jnp.int32)
+    tl = torch.tensor(logits).bfloat16()
+    tt_t = torch.tensor(tgt).long()
+
+    for red in ("mean", "sum"):
+        out = ttpu.jit(lambda l, t: ttpu.ltorch.cross_entropy(l, t, reduction=red))(jl, jt)
+        ref = F.cross_entropy(tl, tt_t, reduction=red)
+        assert str(out.dtype) == "bfloat16"
+        np.testing.assert_allclose(
+            float(jnp.asarray(out, jnp.float32)), float(ref.float()), rtol=5e-3
+        )

@@ -175,3 +175,44 @@ def test_train_step_loss_decreases():
         p_sh, opt_state, loss = step(p_sh, opt_state, *batch)
         losses.append(float(loss))
     assert losses[-1] < losses[0], losses
+
+
+def test_default_batch_shardings_heuristic():
+    # a float side input whose leading dim coincidentally equals B (e.g. a
+    # (T, d) rope cache with T == B) must replicate, not data-shard
+    from jax.sharding import PartitionSpec as P
+
+    from thunder_tpu.distributed.api import default_batch_shardings
+
+    mesh = dist.make_mesh({"dp": 8})
+    B = T = 8
+    idx = jnp.zeros((B, T), jnp.int32)
+    tgt = jnp.zeros((B, T), jnp.int32)
+    rope = jnp.zeros((T, 16), jnp.float32)  # T == B coincidence
+    mask = jnp.zeros((B, T, T), jnp.float32)  # genuine per-sample input
+    sh = default_batch_shardings(mesh, (idx, tgt, rope, mask))
+    assert sh[0].spec != P() and sh[1].spec != P(), "token batch args must shard"
+    assert sh[2].spec == P(), "rope cache must replicate despite T == B"
+    assert sh[3].spec != P(), "per-sample float input sharing (B, T) prefix must shard"
+
+
+def test_placement_does_not_alias_user_arrays():
+    # device_put may zero-copy the same-device shard; donating the placed
+    # params must not delete the user's original array (found via jax 0.9 CPU)
+    def l2(w, x, y):
+        return ((tt.ltorch.linear(x, w) - y) ** 2.0).mean()
+
+    rs = np.random.RandomState(0)
+    mesh = dist.make_mesh({"dp": 8})
+    wp = jnp.asarray(rs.randn(4, 4), jnp.float32)
+    xb = jnp.asarray(rs.randn(16, 4), jnp.float32)
+    yb = jnp.asarray(rs.randn(16, 4), jnp.float32)
+    step = dist.make_train_step(l2, optax.sgd(0.1), mesh)  # donate=True default
+    wd = dist.ddp(wp, mesh)
+    opt_state = step.init_optimizer_state(wd)
+    w1, _, loss = step(wd, opt_state, xb, yb)
+
+    assert not wp.is_deleted(), "donation of placed params deleted the original"
+    jl, jg = jax.value_and_grad(lambda w: ((xb @ w.T - yb) ** 2).mean())(wp)
+    np.testing.assert_allclose(float(loss), float(jl), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(w1), np.asarray(wp - 0.1 * jg), rtol=1e-4, atol=1e-5)

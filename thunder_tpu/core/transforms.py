@@ -17,8 +17,11 @@ the analog of the reference's ``vjp_utils.make_aug_forward_and_backward``.
 """
 from __future__ import annotations
 
+import hashlib
 import math
 from typing import Any, Callable, Sequence
+
+import numpy as _np
 
 from thunder_tpu import clang
 from thunder_tpu.core import dtypes, prims, utils
@@ -718,7 +721,12 @@ def _embedding_bw(bsym, g):
 #
 
 
-_generic_vjp_counter = 0
+# Synthesized-VJP operators cached by (prim, arg structure, static args): the
+# closure bakes in the bsym's non-tensor args, so call sites sharing prim +
+# structure + static values share one operator.  Caching here (not per call
+# site) keeps the executor's implmap bounded across recompiles in a long-lived
+# process and makes generated program names reproducible.
+_generic_vjp_cache: dict[tuple, Any] = {}
 
 
 def _generic_vjp_rule(bsym: BoundSymbol, *cotangents):
@@ -736,38 +744,82 @@ def _generic_vjp_rule(bsym: BoundSymbol, *cotangents):
     if not diff_idx:
         return []
 
+    def _devalue(x):
+        # Non-tensor proxies are replaced by their concrete value: the value
+        # is what the runtime impl needs (a proxy object would crash it), and
+        # it gives the cache a value-stable key across recompiles (identity
+        # or name keys would defeat the cache every trace).
+        if isinstance(x, TensorProxy) or not isinstance(x, Proxy):
+            return x
+        v = getattr(x, "value", None)
+        if v is None:
+            raise NotImplementedError(
+                f"generic VJP fallback cannot bake symbolic (unknown-value) arg {x} "
+                f"of {bsym.sym.name}; register an explicit backward rule"
+            )
+        return v
+
+    def _key_static(x):
+        # value-faithful, hashable key components: repr() would truncate big
+        # numpy arrays (silent wrong sharing) or embed memory addresses
+        # (silent cache misses → the leak this cache exists to fix)
+        if isinstance(x, TensorProxy):
+            return "·"
+        if isinstance(x, (bool, int, float, complex, str, bytes, type(None))):
+            return x
+        if isinstance(x, _np.ndarray):
+            return ("ndarray", x.shape, str(x.dtype), hashlib.sha1(x.tobytes()).hexdigest())
+        try:
+            hash(x)
+            return x
+        except TypeError:
+            return ("id", id(x))  # unhashable & unknown: per-object, no sharing
+
     flat_args, spec = tree_flatten((bsym.args, bsym.kwargs))
+    flat_args = [_devalue(x) for x in flat_args]
     tensor_positions = [i for i, x in enumerate(flat_args) if isinstance(x, TensorProxy)]
+    n_tensors = len(tensor_args)
 
-    def _fn(*tensor_vals):
-        vals = list(flat_args)
-        for pos, v in zip(tensor_positions, tensor_vals):
-            vals[pos] = v
-        args2, kwargs2 = tree_unflatten(vals, spec)
-        return impl(*args2, **kwargs2)
+    static_sig = tuple(_key_static(x) for x in flat_args)
+    key = (bsym.sym.id, n_tensors, spec, static_sig)
+    op = _generic_vjp_cache.get(key)
 
-    def _vjp_fn(*vals):
-        n = len(tensor_args)
-        tensor_vals, cts = vals[:n], vals[n:]
-        _, pullback = jax.vjp(_fn, *tensor_vals)
-        ct = cts[0] if len(cts) == 1 else tuple(cts)
-        return pullback(ct)
+    if op is None:
+        # Tensor slots are cleared so the cached closure doesn't pin the
+        # first trace's proxies (and their trace state) alive for the
+        # process lifetime; they're overwritten with runtime values anyway.
+        closure_args = [
+            None if i in set(tensor_positions) else v for i, v in enumerate(flat_args)
+        ]
 
-    jax_ex = get_executor("jax")
-    # unique name per call site: the closure bakes in this bsym's non-tensor
-    # args, and codegen resolves operators by name — a shared name would make
-    # the last-registered closure win for every call site
-    global _generic_vjp_counter
-    _generic_vjp_counter += 1
-    op = jax_ex.register_operator(
-        f"vjp_{bsym.sym.name}_{_generic_vjp_counter}",
-        meta=lambda *a: tuple(
-            TensorProxy(shape=t.shape, device=t.device, dtype=t.dtype, requires_grad=False)
-            for t in tensor_args
-        ),
-        fn=_vjp_fn,
-    )
-    op._xla_fusible = True
+        # Tensor values are substituted at call time, so the operator is
+        # shape-polymorphic: its meta derives output proxies from the call's
+        # leading n_tensors arguments, and jax.vjp sees the runtime shapes.
+        def _fn(*tensor_vals):
+            vals = list(closure_args)
+            for pos, v in zip(tensor_positions, tensor_vals):
+                vals[pos] = v
+            args2, kwargs2 = tree_unflatten(vals, spec)
+            return impl(*args2, **kwargs2)
+
+        def _vjp_fn(*vals):
+            tensor_vals, cts = vals[:n_tensors], vals[n_tensors:]
+            _, pullback = jax.vjp(_fn, *tensor_vals)
+            ct = cts[0] if len(cts) == 1 else tuple(cts)
+            return pullback(ct)
+
+        jax_ex = get_executor("jax")
+        op = jax_ex.register_operator(
+            f"vjp_{bsym.sym.name}_{len(_generic_vjp_cache)}",
+            meta=lambda *a: tuple(
+                TensorProxy(shape=t.shape, device=t.device, dtype=t.dtype, requires_grad=False)
+                for t in a[:n_tensors]
+            ),
+            fn=_vjp_fn,
+        )
+        op._xla_fusible = True
+        _generic_vjp_cache[key] = op
+
     grads = op(*tensor_args, *cotangents)
     return [(t, gt) for t, gt in zip(tensor_args, grads)]
 

@@ -69,6 +69,50 @@ def tp_fsdp(params, mesh: Mesh, rules=None):
     return apply_shardings(params, shardings)
 
 
+def default_batch_shardings(mesh: Mesh, batch: Sequence) -> tuple[NamedSharding, ...]:
+    """Default batch placement when no explicit ``batch_specs`` are given.
+
+    An arg is data-sharded iff its leading dim equals the batch size AND it
+    is integer-typed (token ids / targets) or matches ``batch[0]``'s
+    leading-shape prefix.  A float side input whose dim 0 only coincidentally
+    equals B (e.g. a (T, d) rope cache when T == B) replicates instead.
+    Pass explicit ``batch_specs`` to TrainStep when the heuristic replicates
+    an arg that should be sharded.
+    """
+    import warnings
+
+    bspec = batch_spec(mesh)
+    b0_shape = tuple(jnp.shape(batch[0]))
+    bsz = b0_shape[0] if b0_shape else None
+
+    def _data_sharded(b) -> bool:
+        shp = tuple(jnp.shape(b))
+        if not shp or shp[0] != bsz:
+            return False
+        dt = getattr(b, "dtype", None)
+        if dt is not None and jnp.issubdtype(dt, jnp.integer):
+            return True
+        k = min(len(shp), len(b0_shape))
+        return shp[:k] == b0_shape[:k]
+
+    decisions = tuple(_data_sharded(b) for b in batch)
+    for i, (b, sharded) in enumerate(zip(batch, decisions)):
+        shp = tuple(jnp.shape(b))
+        if not sharded and shp and shp[0] == bsz:
+            # dim 0 matches the batch size but the dtype/prefix rule said
+            # replicate — could be a per-sample float input; don't be silent
+            warnings.warn(
+                f"batch arg {i} (shape {shp}) has leading dim == batch size but is "
+                f"replicated by the default heuristic; pass batch_specs to shard it",
+                stacklevel=3,
+            )
+
+    return tuple(
+        NamedSharding(mesh, _prune_spec(bspec, jnp.shape(b), mesh) if sharded else P())
+        for b, sharded in zip(batch, decisions)
+    )
+
+
 def _trace_to_jax_fn(trace) -> Callable:
     """A pure-JAX callable evaluating ``trace`` (inputs = trace.args order)."""
     from thunder_tpu.core.prims import PrimIDs
@@ -202,20 +246,7 @@ class TrainStep:
             lambda x: x.sharding if isinstance(x, jax.Array) else None, opt_state
         )
         if self.batch_specs is None:
-            # default: batch-shard only args whose dim 0 matches the first
-            # arg's batch size — side inputs (rope caches, masks) replicate
-            # rather than getting spuriously split over the data axes
-            bspec = batch_spec(self.mesh)
-            bsz = jnp.shape(batch[0])[0] if jnp.ndim(batch[0]) >= 1 else None
-            batch_sh = tuple(
-                NamedSharding(
-                    self.mesh,
-                    _prune_spec(bspec, jnp.shape(b), self.mesh)
-                    if jnp.ndim(b) >= 1 and jnp.shape(b)[0] == bsz
-                    else P(),
-                )
-                for b in batch
-            )
+            batch_sh = default_batch_shardings(self.mesh, batch)
         else:
             batch_sh = tuple(
                 NamedSharding(self.mesh, _prune_spec(s, jnp.shape(b), self.mesh))

@@ -23,6 +23,7 @@ import re
 from typing import Any, Callable, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -176,6 +177,30 @@ def llama_shardings(params, mesh: Mesh):
     return llama_rules.shardings(params, mesh)
 
 
+def _place_no_alias(x, s):
+    """``device_put`` that never aliases the source buffer.
+
+    When the source device is in the target sharding, ``jax.device_put``
+    zero-copies the same-device shard (observed on jax 0.9 CPU and
+    single-device placements).  A later donation of the placed array — the
+    TrainStep default — would then silently delete the *user's original*
+    array too.  Detect the alias and break it with an explicit copy; the
+    copy is transient and only made when aliasing actually occurred.
+    """
+    def _ptrs(a) -> set:
+        try:
+            return {sh.data.unsafe_buffer_pointer() for sh in a.addressable_shards}
+        except Exception:
+            return set()  # backends without buffer pointers
+
+    y = jax.device_put(x, s)
+    if isinstance(x, jax.Array) and (y is x or _ptrs(x) & _ptrs(y)):
+        # sharding-preserving copy: never gathers (a plain jnp.array copy
+        # would materialize sharded params unsharded — OOM at scale)
+        y = jax.jit(jnp.copy, out_shardings=s)(x)
+    return y
+
+
 def apply_shardings(tree, shardings):
     """Places a pytree onto devices per a matching pytree of shardings."""
-    return jax.tree_util.tree_map(lambda x, s: jax.device_put(x, s), tree, shardings)
+    return jax.tree_util.tree_map(_place_no_alias, tree, shardings)

@@ -994,14 +994,17 @@ def cross_entropy(logits, target, weight=None, size_average=None, ignore_index=-
         losses, _lse = prims.cross_entropy_fwd(logits, clang.maybe_convert_to_dtype(safe_t, dtypes.int32))
         valid = clang.ne(target, ignore_index)
         losses = clang.where(valid, losses, 0.0)
-        losses = clang.maybe_convert_to_dtype(losses, logits.dtype if dtypes.is_inexact_dtype(logits.dtype) else dtypes.float32)
+        # reductions accumulate in the prim's float32 row losses (torch keeps
+        # f32 accumulation for low-precision logits); only the result is cast
+        out_dtype = logits.dtype if dtypes.is_inexact_dtype(logits.dtype) else dtypes.float32
         if reduction == "none":
-            return losses
+            return clang.maybe_convert_to_dtype(losses, out_dtype)
         total = clang.sum(losses, None, False)
         if reduction == "sum":
-            return total
+            return clang.maybe_convert_to_dtype(total, out_dtype)
         n_valid = clang.sum(clang.maybe_convert_to_dtype(valid, losses.dtype), None, False)
-        return clang.true_divide(total, clang.maximum(n_valid, 1.0))
+        mean = clang.true_divide(total, clang.maximum(n_valid, 1.0))
+        return clang.maybe_convert_to_dtype(mean, out_dtype)
     dim = -1 if logits.ndim != 1 else 0
     if logits.ndim > 2:
         # torch layout: (N, C, d1, ...) -> log_softmax over C, move C last

@@ -1,0 +1,93 @@
+"""Autocast transform tests (analog of reference tests/test_autocast.py).
+
+The transform must (a) downcast matmul-class op inputs to the target dtype,
+(b) leave non-matmul ops untouched, (c) compose with the fw/bw split, and
+(d) keep numerics close to the f32 program.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import thunder_tpu as ttpu
+from thunder_tpu.core import dtypes
+
+
+def _rand(*shape, seed=0):
+    return jnp.asarray(np.random.RandomState(seed).randn(*shape), jnp.float32)
+
+
+def test_autocast_downcasts_matmul_inputs():
+    def fn(x, w):
+        return ttpu.ltorch.linear(x, w)
+
+    x, w = _rand(4, 8, seed=0), _rand(16, 8, seed=1)
+    jfn = ttpu.jit(fn, transforms=[ttpu.autocast()])
+    out = jfn(x, w)
+    assert out.dtype == jnp.bfloat16
+
+    src = ttpu.last_traces(jfn)[-1].python()
+    assert "bfloat16" in src, f"no bf16 converts in final trace:\n{src}"
+
+    ref = x @ w.T
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), rtol=2e-2, atol=2e-2
+    )
+
+
+def test_autocast_leaves_pointwise_ops_alone():
+    def fn(x):
+        return ttpu.ltorch.softmax(x, -1)
+
+    x = _rand(4, 8)
+    jfn = ttpu.jit(fn, transforms=[ttpu.autocast()])
+    out = jfn(x)
+    assert out.dtype == jnp.float32
+    src = ttpu.last_traces(jfn)[-1].python()
+    assert "bfloat16" not in src
+
+
+def test_autocast_float16_target():
+    def fn(x, w):
+        return ttpu.ltorch.matmul(x, w)
+
+    x, w = _rand(4, 8, seed=0), _rand(8, 4, seed=1)
+    jfn = ttpu.jit(fn, transforms=[ttpu.autocast(dtypes.float16)])
+    out = jfn(x, w)
+    assert out.dtype == jnp.float16
+
+
+def test_autocast_composes_with_grad():
+    def loss(w, x):
+        return (ttpu.ltorch.linear(x, w).tanh() ** 2.0).mean()
+
+    w, x = _rand(5, 4, seed=0), _rand(3, 4, seed=1)
+    val, gw = ttpu.value_and_grad(loss)(w, x)
+    val_ac, gw_ac = ttpu.value_and_grad(loss, transforms=[ttpu.autocast()])(w, x)
+
+    np.testing.assert_allclose(float(val_ac), float(val), rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(
+        np.asarray(gw_ac, np.float32), np.asarray(gw), rtol=5e-2, atol=5e-2
+    )
+
+
+def test_autocast_sdpa_block():
+    # attention + mlp block: everything MXU-bound goes bf16, the residual adds
+    # inherit bf16, numerics stay close
+    def fn(x, wq, wk, wv, wo):
+        B, T, C = x.shape
+        q = ttpu.ltorch.linear(x, wq).reshape(B, T, 2, C // 2).transpose(1, 2)
+        k = ttpu.ltorch.linear(x, wk).reshape(B, T, 2, C // 2).transpose(1, 2)
+        v = ttpu.ltorch.linear(x, wv).reshape(B, T, 2, C // 2).transpose(1, 2)
+        y = ttpu.ltorch.scaled_dot_product_attention(q, k, v, is_causal=True)
+        y = y.transpose(1, 2).reshape(B, T, C)
+        return ttpu.ltorch.linear(y, wo)
+
+    x = _rand(2, 8, 16, seed=0)
+    ws = [_rand(16, 16, seed=i + 1) * 0.2 for i in range(4)]
+    ref = ttpu.jit(fn)(x, *ws)
+    out = ttpu.jit(fn, transforms=[ttpu.autocast()])(x, *ws)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), rtol=5e-2, atol=5e-2
+    )

@@ -1,0 +1,120 @@
+"""torch.nn.Module interop: ThunderModule + autograd bridge + vjp entry point.
+
+Analog of reference tests around ThunderFunction/ThunderModule
+(thunder/executors/torch_autograd.py:20-78, thunder/__init__.py:181).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import torch.nn as nn
+
+import thunder_tpu as ttpu
+
+
+def _mlp(seed=0):
+    torch.manual_seed(seed)
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+
+def test_vjp_non_scalar_outputs():
+    def f(x, w):
+        return ttpu.ltorch.linear(x, w).tanh()
+
+    x = jnp.asarray(np.random.RandomState(0).randn(3, 4), jnp.float32)
+    w = jnp.asarray(np.random.RandomState(1).randn(5, 4), jnp.float32)
+    ct = jnp.asarray(np.random.RandomState(2).randn(3, 5), jnp.float32)
+
+    out, pullback = ttpu.vjp(f)(x, w)
+    gx, gw = pullback(ct)
+    jout, jpb = jax.vjp(lambda x, w: jnp.tanh(x @ w.T), x, w)
+    jgx, jgw = jpb(ct)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(jout), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(jgx), rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(jgw), rtol=1e-4, atol=1e-6)
+
+
+def test_vjp_multiple_outputs():
+    def f(x):
+        return ttpu.ltorch.exp(x), ttpu.ltorch.sin(x)
+
+    x = jnp.asarray(np.random.RandomState(0).randn(3, 4), jnp.float32)
+    cta, ctb = jnp.ones_like(x), 2.0 * jnp.ones_like(x)
+    out, pullback = ttpu.vjp(f)(x)
+    gx = pullback((cta, ctb))  # single argnum → bare gradient tree
+    jgx = jax.vjp(lambda x: (jnp.exp(x), jnp.sin(x)), x)[1]((cta, ctb))[0]
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(jgx), rtol=1e-5)
+
+
+def test_thunder_module_forward_matches_torch():
+    model = _mlp()
+    tmodel = ttpu.jit(model)
+    x = torch.randn(5, 8, generator=torch.Generator().manual_seed(1))
+    out = tmodel(x)
+    ref = model(x)
+    assert isinstance(out, torch.Tensor)
+    np.testing.assert_allclose(out.detach().numpy(), ref.detach().numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_thunder_module_param_grads_match_torch():
+    model = _mlp()
+    tmodel = ttpu.jit(model)
+    x = torch.randn(5, 8, generator=torch.Generator().manual_seed(2))
+
+    out = tmodel(x)
+    loss = (out**2).mean()
+    loss.backward()
+    thunder_grads = {n: p.grad.clone() for n, p in model.named_parameters()}
+
+    for p in model.parameters():
+        p.grad = None
+    ref_loss = (model(x) ** 2).mean()
+    ref_loss.backward()
+    for n, p in model.named_parameters():
+        np.testing.assert_allclose(
+            thunder_grads[n].numpy(), p.grad.numpy(), rtol=1e-4, atol=1e-6, err_msg=n
+        )
+
+
+def test_thunder_module_trains():
+    # the VERDICT done-criterion: a small torch.nn model trains through the bridge
+    model = _mlp(seed=3)
+    tmodel = ttpu.jit(model)
+    opt = torch.optim.SGD(model.parameters(), lr=0.3)
+    g = torch.Generator().manual_seed(4)
+    x = torch.randn(16, 8, generator=g)
+    y = torch.randn(16, 4, generator=g)
+
+    losses = []
+    for _ in range(30):
+        opt.zero_grad()
+        loss = ((tmodel(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        losses.append(float(loss))
+    assert losses[-1] < 0.5 * losses[0], f"did not train: {losses}"
+
+
+def test_thunder_module_state_dict_passthrough():
+    model = _mlp()
+    tmodel = ttpu.jit(model)
+    sd = tmodel.state_dict()
+    assert set(sd) == set(model.state_dict())
+    assert not any(k.startswith("_orig_mod") for k in sd)
+
+
+def test_vjp_mixed_output_with_none_cotangent():
+    # non-differentiable output leaves take None cotangents; alignment must hold
+    def f(x):
+        return 2, ttpu.ltorch.exp(x), ttpu.ltorch.sin(x)
+
+    x = jnp.asarray(np.random.RandomState(0).randn(3, 4), jnp.float32)
+    out, pullback = ttpu.vjp(f)(x)
+    cta, ctb = jnp.ones_like(x), 2.0 * jnp.ones_like(x)
+    gx = pullback((None, cta, ctb))
+    jgx = jax.vjp(lambda x: (jnp.exp(x), jnp.sin(x)), x)[1]((cta, ctb))[0]
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(jgx), rtol=1e-5)
+
+    with pytest.raises(Exception, match="cotangent"):
+        pullback((cta,))

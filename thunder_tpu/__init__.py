@@ -27,6 +27,7 @@ from thunder_tpu.core.options import (
     resolve_cache_option,
     resolve_sharp_edges_option,
 )
+from thunder_tpu.core.autocast import autocast
 from thunder_tpu.core.trace import TraceCtx, TraceResults
 from thunder_tpu.core.transform_common import cse, dce
 from thunder_tpu.extend import resolve_executors
@@ -37,7 +38,9 @@ __version__ = "0.1.0"
 __all__ = [
     "jit",
     "compile",
+    "autocast",
     "grad",
+    "vjp",
     "value_and_grad",
     "last_traces",
     "last_backward_traces",
@@ -69,7 +72,30 @@ def jit(
     The returned callable caches compilations keyed by input metadata; the
     prologue re-validates inputs on every call (reference thunder.jit,
     __init__.py:302).
+
+    A ``torch.nn.Module`` argument returns a ``ThunderModule`` instead: its
+    forward runs as a compiled program bridged into torch autograd
+    (reference thunder.jit on modules, __init__.py:181).
     """
+    try:
+        import torch as _torch
+    except ImportError:  # pragma: no cover - torch is an optional interop dep
+        _torch = None
+    if _torch is not None and isinstance(fn, _torch.nn.Module):
+        # interop import errors must propagate: silently falling through
+        # would bake the parameters in as constants and train nothing
+        from thunder_tpu.torch_interop import ThunderModule
+
+        return ThunderModule(
+            fn,
+            executors=executors,
+            cache=cache,
+            sharp_edges=sharp_edges,
+            transforms=transforms,
+            disable_grad=disable_grad,
+            **compile_options,
+        )
+
     cd = CompileData(
         fn=fn,
         executors_list=resolve_executors(executors),
@@ -111,7 +137,33 @@ def jit(
             inps = tuple(inps) + (rng.next_key(),)
 
         cs.last_trace_host_execution_start = time.perf_counter_ns()
-        if cache_entry.backward_fn is not None:
+        if cache_entry.backward_fn is not None and getattr(cache_entry, "vjp_mode", False):
+            # proper backward entry point: the caller supplies cotangents
+            from thunder_tpu.core.pytree import tree_flatten as _tfl
+
+            output, saved = cache_entry.computation_fn(*inps)
+            backward_fn = cache_entry.backward_fn
+            postprocess = cache_entry.return_spec
+            ct_positions = cache_entry.ct_positions
+
+            def pullback(cotangents):
+                """cotangents: same structure as the function's output; pass
+                None for non-differentiable output leaves (None flattens
+                away, so exactly the differentiable leaves remain, in
+                output order)."""
+                flat_cts, _ = _tfl(cotangents)
+                check(
+                    len(flat_cts) == len(ct_positions),
+                    lambda: f"pullback expected cotangents for {len(ct_positions)} "
+                    f"differentiable output leaves, got {len(flat_cts)} (pass None "
+                    f"for non-differentiable outputs)",
+                )
+                flat_grads = backward_fn(*saved, *flat_cts)
+                return postprocess(flat_grads) if postprocess else flat_grads
+
+            result = (output, pullback)
+        elif cache_entry.backward_fn is not None:
+            # scalar-loss sugar: cotangent is ones (grad / value_and_grad)
             import jax.numpy as jnp
 
             output, saved = cache_entry.computation_fn(*inps)
@@ -137,6 +189,9 @@ def _compile(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict) -> Ca
     from thunder_tpu.executors.passes import del_last_used, transform_for_execution
 
     grad_argnums = cd.compile_options.get("_grad_argnums")
+    vjp_mode = bool(cd.compile_options.get("_vjp_mode"))
+    if vjp_mode and grad_argnums is None:
+        grad_argnums = tuple(range(len(args)))
 
     cs.last_trace_tracing_start = time.perf_counter_ns()
     trace_results: TraceResults = trace_from_fn(cd.fn, args, kwargs, grad_argnums=grad_argnums)
@@ -162,20 +217,35 @@ def _compile(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict) -> Ca
     bw_fn = None
     bw_extrace = None
     grad_postprocess = None
+    ct_positions = ()
     if grad_argnums is not None:
         from thunder_tpu.core.transforms import forward_and_backward_from_trace
         from thunder_tpu.core.proxies import TensorProxy as _TP
         from thunder_tpu.core.pytree import tree_flatten as _tf
 
-        # grad contract (jax.grad-style): a single scalar differentiable output
+        # grad contract (jax.grad-style): a single scalar differentiable
+        # output — unless vjp mode, where the caller supplies cotangents for
+        # every differentiable output leaf
         for bsym in computation_trace.bound_symbols:
             if bsym.sym.id is prims.PrimIDs.RETURN:
-                outs = [o for o in _tf(bsym.args)[0] if isinstance(o, _TP)]
-                check(
-                    len(outs) == 1 and outs[0].shape == () and dtypes.is_inexact_dtype(outs[0].dtype),
-                    lambda: f"grad/value_and_grad require the function to return a single scalar float "
-                    f"(got {[(tuple(o.shape), str(o.dtype)) for o in outs]})",
-                )
+                flat_outs = _tf(bsym.args)[0]
+                outs = [o for o in flat_outs if isinstance(o, _TP)]
+                if vjp_mode:
+                    ct_positions = tuple(
+                        i
+                        for i, o in enumerate(flat_outs)
+                        if isinstance(o, _TP) and dtypes.is_inexact_dtype(o.dtype)
+                    )
+                    check(
+                        len(ct_positions) > 0,
+                        lambda: "vjp requires at least one differentiable output",
+                    )
+                else:
+                    check(
+                        len(outs) == 1 and outs[0].shape == () and dtypes.is_inexact_dtype(outs[0].dtype),
+                        lambda: f"grad/value_and_grad require the function to return a single scalar float "
+                        f"(got {[(tuple(o.shape), str(o.dtype)) for o in outs]})",
+                    )
 
         fw_trace, bw_trace = forward_and_backward_from_trace(computation_trace)
         cs.last_traces.append(fw_trace)
@@ -216,6 +286,8 @@ def _compile(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict) -> Ca
         uses_rng=uses_rng,
     )
     entry.return_spec = grad_postprocess
+    entry.vjp_mode = vjp_mode
+    entry.ct_positions = ct_positions
     return entry
 
 
@@ -258,6 +330,22 @@ def value_and_grad(fn: Callable, **jit_kwargs) -> Callable:
     from thunder_tpu.core.transforms import value_and_grad as _value_and_grad
 
     return _value_and_grad(fn, **jit_kwargs)
+
+
+def vjp(fn: Callable, argnums: Sequence[int] | None = None, **jit_kwargs) -> Callable:
+    """jax.vjp-style backward entry point with user-supplied cotangents.
+
+    ``vjp(fn)(*args)`` returns ``(out, pullback)`` where ``pullback(ct)``
+    takes a cotangent matching ``out``'s structure and returns gradients for
+    ``argnums`` (default: every positional arg).  Unlike ``grad``/
+    ``value_and_grad``, the function may return non-scalar (and multiple)
+    outputs.  Replaces the reference's ``ThunderFunction.backward`` contract
+    (``thunder/executors/torch_autograd.py:57-78``) for the functional world;
+    the torch bridge in ``thunder_tpu.torch_interop`` builds on it.
+    """
+    if argnums is not None:
+        argnums = (argnums,) if isinstance(argnums, int) else tuple(argnums)
+    return jit(fn, _vjp_mode=True, _grad_argnums=argnums, **jit_kwargs)
 
 
 #

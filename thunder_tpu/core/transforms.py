@@ -727,6 +727,8 @@ def _embedding_bw(bsym, g):
 # site) keeps the executor's implmap bounded across recompiles in a long-lived
 # process and makes generated program names reproducible.
 _generic_vjp_cache: dict[tuple, Any] = {}
+# objects keyed by id() in the cache, kept alive so CPython can't reuse the id
+_generic_vjp_pinned: list[Any] = []
 
 
 def _generic_vjp_rule(bsym: BoundSymbol, *cotangents):
@@ -767,13 +769,17 @@ def _generic_vjp_rule(bsym: BoundSymbol, *cotangents):
             return "·"
         if isinstance(x, (bool, int, float, complex, str, bytes, type(None))):
             return x
-        if isinstance(x, _np.ndarray):
-            return ("ndarray", x.shape, str(x.dtype), hashlib.sha1(x.tobytes()).hexdigest())
+        if isinstance(x, (_np.ndarray, jax.Array)):
+            arr = _np.asarray(x)
+            return ("ndarray", arr.shape, str(arr.dtype), hashlib.sha1(arr.tobytes()).hexdigest())
         try:
             hash(x)
             return x
         except TypeError:
-            return ("id", id(x))  # unhashable & unknown: per-object, no sharing
+            # unhashable & unknown: per-object key, pinned alive so the id
+            # can't be recycled onto a different value
+            _generic_vjp_pinned.append(x)
+            return ("id", id(x))
 
     flat_args, spec = tree_flatten((bsym.args, bsym.kwargs))
     flat_args = [_devalue(x) for x in flat_args]

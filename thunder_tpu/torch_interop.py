@@ -1,0 +1,190 @@
+"""torch.nn.Module interop: ThunderModule + the autograd bridge.
+
+Reference parity: ``thunder/__init__.py:181`` (ThunderModule) and
+``thunder/executors/torch_autograd.py:20-78`` (ThunderFunction stitching
+compiled fw/bw into torch autograd, including the saved-tensor release
+contract).  TPU-first design: the module's forward is *functionalized* — its
+parameters/buffers are swapped for proxies during tracing, so the whole
+forward records through the ``TensorProxy.__torch_function__`` diversion into
+one thunder_tpu trace; execution is the framework's compiled fw/bw pair (XLA
+programs), and ``ThunderFunction`` only bridges tensors at the boundary
+(torch ↔ jax via host memory on CPU; dlpack where available).
+
+Limitations (v1): gradients flow to module *parameters* (inputs receive
+``None`` grads); buffer mutation (BatchNorm running stats) is not recorded —
+the functional frontend has no epilogue yet; ``module.training`` is baked at
+trace time.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax.numpy as jnp
+import numpy as np
+import torch
+
+__all__ = ["ThunderModule", "ThunderFunction", "functional_call"]
+
+
+def _to_jax(t: torch.Tensor):
+    t = t.detach().cpu()
+    if t.dtype == torch.bfloat16:  # numpy has no native bf16
+        return jnp.asarray(t.float().numpy()).astype(jnp.bfloat16)
+    return jnp.asarray(t.numpy())
+
+
+def _to_torch(a) -> torch.Tensor:
+    arr = np.asarray(a)
+    if arr.dtype.name == "bfloat16":  # ml_dtypes bf16: not a torch.from_numpy dtype
+        return torch.from_numpy(arr.astype(np.float32)).to(torch.bfloat16)
+    # copy: np.asarray gives a read-only zero-copy view of the jax buffer, and
+    # an in-place torch op on it would corrupt memory jax still references
+    return torch.from_numpy(np.array(arr))
+
+
+def functional_call(module: torch.nn.Module, params_and_buffers: dict, args: tuple, kwargs: dict):
+    """Calls ``module`` with its parameters/buffers swapped for the values in
+    ``params_and_buffers`` (dotted names), restoring the originals after.
+
+    The swap goes through ``_parameters``/``_buffers`` dicts directly so the
+    replacement values may be TensorProxies — ``torch.nn.Module.__setattr__``
+    would reject non-Parameters, but attribute *reads* return whatever the
+    dicts hold, which is exactly what tracing needs.
+    """
+    mods = dict(module.named_modules())
+    saved: list[tuple[dict, str, Any]] = []
+    try:
+        for name, value in params_and_buffers.items():
+            mod_name, _, attr = name.rpartition(".")
+            m = mods[mod_name]
+            d = m._parameters if attr in m._parameters else m._buffers
+            saved.append((d, attr, d[attr]))
+            d[attr] = value
+        return module(*args, **kwargs)
+    finally:
+        for d, attr, old in saved:
+            d[attr] = old
+
+
+class ThunderFunction(torch.autograd.Function):
+    """Stitches a compiled thunder_tpu fw/bw pair into torch autograd.
+
+    ``apply(holder, *param_tensors)``: ``holder`` carries the compiled vjp
+    runner and output structure; gradients are returned for the parameter
+    tensors in the order given.  Residuals live in the pullback closure and
+    are dropped right after backward (the reference's saved-tensor release
+    contract, ``torch_autograd.py:57-78``).
+    """
+
+    @staticmethod
+    def forward(ctx, holder: dict, *param_tensors: torch.Tensor):
+        out, pullback = holder["run"]()
+        flat_out, out_spec = jax_tree_flatten(out)
+        ctx._pullback = pullback
+        ctx._holder = holder
+        holder["out_spec"] = out_spec
+        return tuple(_to_torch(o) for o in flat_out)
+
+    @staticmethod
+    def backward(ctx, *grad_outputs: torch.Tensor):
+        holder = ctx._holder
+        cts = [
+            _to_jax(g) if g is not None else None
+            for g in grad_outputs
+        ]
+        ct_tree = jax_tree_unflatten(holder["out_spec"], cts)
+        grads_dict = ctx._pullback(ct_tree)
+        del ctx._pullback  # release residuals eagerly (memory contract)
+        names = holder["param_names"]
+        out = tuple(
+            _to_torch(grads_dict[n]) if grads_dict.get(n) is not None else None
+            for n in names
+        )
+        return (None,) + out
+
+
+def jax_tree_flatten(x):
+    import jax.tree_util as jtu
+
+    return jtu.tree_flatten(x)
+
+
+def jax_tree_unflatten(spec, leaves):
+    import jax.tree_util as jtu
+
+    return jtu.tree_unflatten(spec, leaves)
+
+
+class ThunderModule(torch.nn.Module):
+    """Wraps a ``torch.nn.Module`` so its forward runs as a compiled
+    thunder_tpu program while torch autograd keeps working on the outside.
+
+    ``thunder_tpu.jit(module)`` returns one of these (reference
+    ``thunder.jit`` on modules, ``thunder/__init__.py:181``).
+    """
+
+    def __init__(self, module: torch.nn.Module, **jit_kwargs):
+        super().__init__()
+        self._orig_mod = module
+        self._jit_kwargs = jit_kwargs
+        self._vjp_fn = None  # built lazily (imports thunder_tpu)
+        # torch→jax transfer cache keyed by (tensor identity, version): params
+        # only re-upload after an in-place update (optimizer step), not on
+        # every forward
+        self._xfer_cache: dict[str, tuple[tuple[int, int], Any]] = {}
+
+    def _get_vjp(self):
+        if self._vjp_fn is None:
+            import thunder_tpu as ttpu
+
+            module = self._orig_mod
+
+            def functional_fwd(params, buffers, *args, **kwargs):
+                return functional_call(module, {**params, **buffers}, args, kwargs)
+
+            self._vjp_fn = ttpu.vjp(functional_fwd, argnums=(0,), **self._jit_kwargs)
+        return self._vjp_fn
+
+    def _cached_to_jax(self, name: str, t: torch.Tensor):
+        key = (id(t), t._version)
+        ent = self._xfer_cache.get(name)
+        if ent is not None and ent[0] == key:
+            return ent[1]
+        a = _to_jax(t)
+        self._xfer_cache[name] = (key, a)
+        return a
+
+    def forward(self, *args, **kwargs):
+        vjp_fn = self._get_vjp()
+        params = dict(self._orig_mod.named_parameters())
+        buffers = dict(self._orig_mod.named_buffers())
+        param_names = sorted(params)
+        param_tensors = [params[n] for n in param_names]
+
+        jax_params = {n: self._cached_to_jax(n, p) for n, p in params.items()}
+        jax_buffers = {n: self._cached_to_jax(n, b) for n, b in buffers.items()}
+        jax_args = tuple(_to_jax(a) if isinstance(a, torch.Tensor) else a for a in args)
+        jax_kwargs = {
+            k: _to_jax(v) if isinstance(v, torch.Tensor) else v for k, v in kwargs.items()
+        }
+
+        holder = {
+            "run": lambda: vjp_fn(jax_params, jax_buffers, *jax_args, **jax_kwargs),
+            "param_names": param_names,
+        }
+        flat_out = ThunderFunction.apply(holder, *param_tensors)
+        out = jax_tree_unflatten(holder["out_spec"], list(flat_out))
+        return out
+
+    # reference ThunderModule passes state_dict through to the wrapped module
+    def state_dict(self, *args, **kwargs):
+        return self._orig_mod.state_dict(*args, **kwargs)
+
+    def load_state_dict(self, *args, **kwargs):
+        return self._orig_mod.load_state_dict(*args, **kwargs)
+
+    def named_parameters(self, *args, **kwargs):
+        return self._orig_mod.named_parameters(*args, **kwargs)
+
+    def parameters(self, *args, **kwargs):
+        return self._orig_mod.parameters(*args, **kwargs)

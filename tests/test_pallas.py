@@ -66,11 +66,14 @@ def test_flash_cross_attention_shapes(interpret_kernels):
     np.testing.assert_allclose(np.asarray(out), np.asarray(oref), atol=2e-5, rtol=2e-5)
 
 
-def test_unsupported_shapes_fall_back():
-    # head dim not a lane multiple: dispatcher declines, claiming checker refuses
-    q = jnp.zeros((1, 2, 128, 64))
+def test_unsupported_shapes_fall_back(interpret_kernels):
+    # T not a block multiple: dispatcher declines, claiming checker refuses
+    q = jnp.zeros((1, 2, 100, 128))
     assert pallasex.flash_sdpa(q, q, q, True, 0.125) is None
     assert not pallasex._sdpa_checker(q, q, q, True, 0.125)
+    # head dim too large even after lane padding
+    q = jnp.zeros((1, 2, 128, 640))
+    assert pallasex.flash_sdpa(q, q, q, True, 0.04) is None
 
 
 def test_sdpa_prim_in_trace_and_claiming():
@@ -136,3 +139,66 @@ def test_saved_for_backward_is_linear_in_T(interpret_kernels):
         assert not (len(shape) >= 2 and shape[-1] == T and shape[-2] == T), (
             f"backward saved a (T, T) residual: {p.name} {shape}"
         )
+
+
+@pytest.mark.parametrize("hs", [64, 96])
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_small_head_dim_padded(interpret_kernels, hs, causal):
+    # head sizes below the 128 lane width run zero-padded (GPT-2-class models)
+    ks = jax.random.split(jax.random.PRNGKey(2), 4)
+    q, k, v, g = (jax.random.normal(kk, (1, 2, 128, hs)) for kk in ks)
+    scale = 1.0 / np.sqrt(hs)
+    res = pallasex.flash_sdpa(q, k, v, causal, scale)
+    assert res is not None
+    out, lse = res
+    oref, lref = _sdpa_reference(q, k, v, causal, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(oref), atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(lref), atol=2e-5, rtol=2e-5)
+
+    dq, dk, dv = pallasex.flash_sdpa_backward(g, q, k, v, out, lse, causal, scale)
+    dqr, dkr, dvr = _sdpa_backward_reference(g, q, k, v, out, lse, causal, scale)
+    for a, b, n in ((dq, dqr, "dq"), (dk, dkr, "dk"), (dv, dvr, "dv")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4, err_msg=n)
+
+
+@pytest.mark.parametrize("Tq,Tk", [(128, 256), (256, 128)])
+def test_flash_causal_cross_lengths(interpret_kernels, Tq, Tk):
+    # causal with Tq != Tk: top-left alignment (torch/aten convention)
+    ks = jax.random.split(jax.random.PRNGKey(3), 4)
+    q = jax.random.normal(ks[0], (1, 2, Tq, 128))
+    k = jax.random.normal(ks[1], (1, 2, Tk, 128))
+    v = jax.random.normal(ks[2], (1, 2, Tk, 128))
+    g = jax.random.normal(ks[3], (1, 2, Tq, 128))
+    scale = 1.0 / np.sqrt(128)
+    res = pallasex.flash_sdpa(q, k, v, True, scale)
+    assert res is not None
+    out, lse = res
+    oref, lref = _sdpa_reference(q, k, v, True, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(oref), atol=2e-5, rtol=2e-5)
+
+    dq, dk, dv = pallasex.flash_sdpa_backward(g, q, k, v, out, lse, True, scale)
+    dqr, dkr, dvr = _sdpa_backward_reference(g, q, k, v, out, lse, True, scale)
+    for a, b, n in ((dq, dqr, "dq"), (dk, dkr, "dk"), (dv, dvr, "dv")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4, err_msg=n)
+
+
+def test_sharded_flash_matches_reference(interpret_kernels):
+    # shard_map dispatch over batch/head axes: numerics identical to the
+    # single-device kernel and the jnp reference
+    from thunder_tpu import distributed as dist
+    from thunder_tpu.executors.pallasex import mesh_context
+
+    mesh = dist.make_mesh({"dp": 2, "tp": 4})
+    q, k, v, g = _qkvg(B=2, H=4, T=128)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    before = dict(pallasex.stats)
+    with mesh_context(mesh):
+        out, lse = pallasex.flash_sdpa(q, k, v, True, scale)
+        dq, dk, dv = pallasex.flash_sdpa_backward(g, q, k, v, out, lse, True, scale)
+    assert pallasex.stats["sharded"] > before["sharded"]
+    oref, lref = _sdpa_reference(q, k, v, True, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(oref), atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(lref), atol=2e-5, rtol=2e-5)
+    dqr, dkr, dvr = _sdpa_backward_reference(g, q, k, v, out, lse, True, scale)
+    for a, b, n in ((dq, dqr, "dq"), (dk, dkr, "dk"), (dv, dvr, "dv")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4, err_msg=n)

@@ -271,19 +271,19 @@ class TrainStep:
         self._jitted = self._cache[key]
         return self._jitted
 
-    def _spmd_guard(self):
-        """Multi-device meshes must trace without un-partitionable Pallas
-        kernels (see pallasex.spmd_guard); single-device keeps them."""
-        from thunder_tpu.executors.pallasex import spmd_guard
+    def _mesh_context(self):
+        """Publishes the mesh so Pallas kernels trace as shard_map-partitioned
+        calls (batch/head-parallel) instead of being declined under SPMD."""
+        from thunder_tpu.executors.pallasex import mesh_context
 
-        return spmd_guard(self.mesh.devices.size > 1)
+        return mesh_context(self.mesh)
 
     def __call__(self, params, opt_state, *batch):
-        with self._spmd_guard():
+        with self._mesh_context():
             return self._get_jitted(params, opt_state, batch)(params, opt_state, *batch)
 
     def lower_hlo(self, params, opt_state, *batch) -> str:
-        with self._spmd_guard():
+        with self._mesh_context():
             return self._get_jitted(params, opt_state, batch).lower(params, opt_state, *batch).as_text()
 
 

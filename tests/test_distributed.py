@@ -264,3 +264,23 @@ def test_train_step_uses_sharded_flash_kernels(monkeypatch):
         np.testing.assert_allclose(
             np.asarray(p2[w]), np.asarray(p1[w]), rtol=1e-4, atol=1e-5, err_msg=w
         )
+
+
+def test_grad_accumulation_equals_big_batch():
+    # reference no_sync/grad-accumulation (distributed/__init__.py:28-95):
+    # N micro steps + one apply == one step on the concatenated batch
+    cfg, params, batch, loss_fn = _setup(B=16)
+    idx, tgt, cos, sin = batch
+    optimizer = optax.sgd(0.1)
+    mesh = dist.make_mesh({"dp": 8})
+    p_sh = dist.ddp(params, mesh)
+    step = dist.make_train_step(loss_fn, optimizer, mesh, batch_specs=BATCH_SPECS, donate=False)
+    opt_state = step.init_optimizer_state(p_sh)
+
+    big_params, _, big_loss = step(p_sh, opt_state, *batch)
+
+    micro = [(idx[:8], tgt[:8], cos, sin), (idx[8:], tgt[8:], cos, sin)]
+    acc_params, _, acc_loss = step.accumulate(p_sh, opt_state, micro)
+
+    np.testing.assert_allclose(float(acc_loss), float(big_loss), rtol=1e-6)
+    _assert_tree_close(acc_params, big_params, atol=1e-6)

@@ -88,12 +88,20 @@ def _bytes(p: Proxy) -> int:
 
 
 def rematerialize_forward_and_backward(
-    fw_trace: TraceCtx, bw_trace: TraceCtx, *, max_cone: int = 64
+    fw_trace: TraceCtx, bw_trace: TraceCtx, *, max_cone: int = 64, aggressive: bool = False
 ) -> tuple[TraceCtx, TraceCtx]:
     """Shrinks saved_for_backward by re-executing cheap producer cones in the
     backward trace.  Returns updated ``(fw_trace, bw_trace)`` honoring the
     split contract (fw returns ``(output, saved)``; bw takes
-    ``(*saved, *cotangents)``)."""
+    ``(*saved, *cotangents)``).
+
+    ``aggressive`` (the ZeRO-3 / full-checkpoint mode, reference
+    ``rematerialization.py:389`` regather-in-backward): cones may recompute
+    *expensive* ops too (matmuls — and, under SPMD, the param all-gathers
+    GSPMD attaches to them), bottoming out only at trace inputs and other
+    saved values, so residual memory shrinks toward the inputs at the cost
+    of backward recompute.  RANDOM-tagged ops are never recomputed.
+    """
     # locate the fw return bsym: (output, saved)
     ret = None
     for b in fw_trace.bound_symbols:
@@ -143,10 +151,13 @@ def rematerialize_forward_and_backward(
             if prod is None:  # constant/number: nothing to recompute
                 continue
             idx, b = prod
-            if name != p.name and name in anchor_names:
+            if not aggressive and name != p.name and name in anchor_names:
                 leaves.add(name)
                 continue
-            if not _is_cheap(b):
+            if aggressive:
+                if OpTags.RANDOM_OP in set(b.sym.tags or ()):
+                    return None
+            elif not _is_cheap(b):
                 return None
             if idx not in bsyms:
                 bsyms[idx] = b
@@ -163,7 +174,7 @@ def rematerialize_forward_and_backward(
     )
     saved_set = set(saved_names)
     for p in order:
-        if p.name in input_names or p.name in anchor_names:
+        if p.name in input_names or (not aggressive and p.name in anchor_names):
             continue
         res = cone_for(p, stop=saved_set - {p.name} - set(removable))
         if res is None:

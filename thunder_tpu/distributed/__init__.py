@@ -9,6 +9,13 @@ prims (``thunder_tpu.distributed.prims``) for algorithms that need them
 """
 from thunder_tpu.distributed import prims  # noqa: F401  (registers jax impls)
 from thunder_tpu.distributed.api import TrainStep, ddp, fsdp, make_train_step, tp_fsdp
+from thunder_tpu.distributed.checkpoint import (
+    StateDictOptions,
+    full_state_dict,
+    latest_step,
+    load_checkpoint,
+    save_checkpoint,
+)
 from thunder_tpu.distributed.prims import DistributedReduceOps
 from thunder_tpu.distributed.sharding import (
     ShardingRules,
@@ -35,4 +42,9 @@ __all__ = [
     "llama_shardings",
     "make_mesh",
     "prims",
+    "StateDictOptions",
+    "full_state_dict",
+    "save_checkpoint",
+    "load_checkpoint",
+    "latest_step",
 ]

@@ -51,10 +51,11 @@ def fsdp(params, mesh: Mesh, *, axis: str = "fsdp", min_size: int = 2**10):
     ZeRO staging note: the reference distinguishes ZERO2 (keep gathered
     params for backward) from ZERO3 (re-gather in backward,
     ``rematerialization.py:389``).  Under XLA SPMD both start from the same
-    placement — params, grads, and optimizer state are sharded — and the
-    save-vs-regather decision for gathered weights is made by XLA's
-    scheduler inside the single compiled train step.  There is deliberately
-    no ZERO2/ZERO3 knob here until the trace-level remat transform lands.
+    placement — params, grads, and optimizer state are sharded.  The
+    regather/recompute choice is the ``zero3=True`` knob on
+    ``make_train_step``: aggressive trace-level rematerialization shrinks
+    saved residuals toward the inputs, and XLA re-gathers the sharded
+    params inside the backward recompute cones.
     """
     return apply_shardings(params, fsdp_shardings(params, mesh, axis=axis, min_size=min_size))
 
@@ -154,6 +155,7 @@ class TrainStep:
         batch_specs: Sequence[P] | None = None,
         donate: bool = True,
         remat: bool = True,
+        zero3: bool = False,
     ):
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -161,6 +163,7 @@ class TrainStep:
         self.batch_specs = batch_specs
         self.donate = donate
         self.remat = remat
+        self.zero3 = zero3
         # compiled steps keyed by batch signature (shape/dtype per arg):
         # shardings are pruned against concrete shapes, so a new shape needs
         # a fresh build
@@ -195,10 +198,15 @@ class TrainStep:
         comp = cse(comp)
         comp.args = trace_results.computation_trace.args
         fw_trace, bw_trace = forward_and_backward_from_trace(comp)
-        if self.remat:
+        if self.remat or self.zero3:
             from thunder_tpu.core.rematerialization import rematerialize_forward_and_backward
 
-            fw_trace, bw_trace = rematerialize_forward_and_backward(fw_trace, bw_trace)
+            # zero3: aggressive remat — residuals shrink toward the inputs,
+            # and XLA re-gathers sharded params inside the recompute cones
+            # (regather-in-backward, reference rematerialization.py:389)
+            fw_trace, bw_trace = rematerialize_forward_and_backward(
+                fw_trace, bw_trace, max_cone=256 if self.zero3 else 64, aggressive=self.zero3
+            )
         self.fw_trace, self.bw_trace = fw_trace, bw_trace
         fw_fn = _trace_to_jax_fn(fw_trace)
         bw_fn = _trace_to_jax_fn(bw_trace)
@@ -234,10 +242,13 @@ class TrainStep:
 
         import optax
 
+        def apply_gradients(params, opt_state, grads):
+            updates, new_opt_state = self.optimizer.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), new_opt_state
+
         def step(params, opt_state, *batch):
             loss, grads = value_and_grad_fn(params, *batch)
-            updates, new_opt_state = self.optimizer.update(grads, opt_state, params)
-            new_params = optax.apply_updates(params, updates)
+            new_params, new_opt_state = apply_gradients(params, opt_state, grads)
             return new_params, new_opt_state, loss
 
         # shardings: params/opt from their current placement; batch from specs
@@ -253,23 +264,40 @@ class TrainStep:
                 for s, b in zip(self.batch_specs, batch)
             )
 
-        self._jitted = jax.jit(
-            step,
-            in_shardings=(param_sh, opt_sh) + batch_sh,
-            donate_argnums=(0, 1) if self.donate else (),
-        )
+        entry = {
+            "step": jax.jit(
+                step,
+                in_shardings=(param_sh, opt_sh) + batch_sh,
+                donate_argnums=(0, 1) if self.donate else (),
+            ),
+            # gradient-accumulation pieces (reference no_sync/_sync_grads,
+            # distributed/__init__.py:28-95): a micro step that only
+            # computes (loss, grads), and an apply that runs the optimizer
+            "grads": jax.jit(
+                value_and_grad_fn, in_shardings=(param_sh,) + batch_sh
+            ),
+            "apply": jax.jit(
+                apply_gradients,
+                in_shardings=(param_sh, opt_sh, param_sh),
+                donate_argnums=(0, 1) if self.donate else (),
+            ),
+        }
+        self._jitted = entry["step"]
+        return entry
 
     @staticmethod
     def _batch_key(batch):
         return tuple((tuple(jnp.shape(b)), str(getattr(b, "dtype", type(b)))) for b in batch)
 
-    def _get_jitted(self, params, opt_state, batch):
+    def _get_entry(self, params, opt_state, batch):
         key = self._batch_key(batch)
         if key not in self._cache:
-            self._build(params, opt_state, batch)
-            self._cache[key] = self._jitted
-        self._jitted = self._cache[key]
-        return self._jitted
+            self._cache[key] = self._build(params, opt_state, batch)
+        self._jitted = self._cache[key]["step"]
+        return self._cache[key]
+
+    def _get_jitted(self, params, opt_state, batch):
+        return self._get_entry(params, opt_state, batch)["step"]
 
     def _mesh_context(self):
         """Publishes the mesh so Pallas kernels trace as shard_map-partitioned
@@ -281,6 +309,43 @@ class TrainStep:
     def __call__(self, params, opt_state, *batch):
         with self._mesh_context():
             return self._get_jitted(params, opt_state, batch)(params, opt_state, *batch)
+
+    def grads(self, params, opt_state, *batch):
+        """One micro step: ``(loss, grads)`` with no optimizer update — the
+        accumulation building block (reference ``no_sync``,
+        ``thunder/distributed/__init__.py:200-242``)."""
+        with self._mesh_context():
+            return self._get_entry(params, opt_state, batch)["grads"](params, *batch)
+
+    def apply_gradients(self, params, opt_state, grads, *, batch_template):
+        """Runs the optimizer on externally accumulated ``grads``.
+
+        ``batch_template`` is any batch of the shape used with :meth:`grads`
+        (it keys the compiled-entry cache; values are not read)."""
+        with self._mesh_context():
+            entry = self._get_entry(params, opt_state, batch_template)
+            return entry["apply"](params, opt_state, grads)
+
+    def accumulate(self, params, opt_state, micro_batches):
+        """Gradient accumulation: N micro batches, one optimizer update.
+
+        Equivalent to one step on the concatenated batch (each micro grad is
+        a mean over its micro batch, so the accumulated grads are averaged).
+        Returns ``(new_params, new_opt_state, mean_loss)``.
+        """
+        n = len(micro_batches)
+        assert n > 0, "accumulate needs at least one micro batch"
+        acc = None
+        total = 0.0
+        for mb in micro_batches:
+            loss, g = self.grads(params, opt_state, *mb)
+            acc = g if acc is None else jax.tree_util.tree_map(jnp.add, acc, g)
+            total = total + loss
+        acc = jax.tree_util.tree_map(lambda x: x / n, acc)
+        new_params, new_opt = self.apply_gradients(
+            params, opt_state, acc, batch_template=micro_batches[0]
+        )
+        return new_params, new_opt, total / n
 
     def lower_hlo(self, params, opt_state, *batch) -> str:
         with self._mesh_context():
@@ -295,5 +360,8 @@ def make_train_step(
     batch_specs: Sequence[P] | None = None,
     donate: bool = True,
     remat: bool = True,
+    zero3: bool = False,
 ) -> TrainStep:
-    return TrainStep(loss_fn, optimizer, mesh, batch_specs=batch_specs, donate=donate, remat=remat)
+    return TrainStep(
+        loss_fn, optimizer, mesh, batch_specs=batch_specs, donate=donate, remat=remat, zero3=zero3
+    )

@@ -1,0 +1,458 @@
+"""OpInfo database: per-op sample inputs + torch reference for the matrix test.
+
+Capability analog of the reference's ``thunder/tests/opinfos.py`` (170
+OpInfos with sample-input generators and torch/jax reference comparisons,
+:315) and ``tests/framework.py``'s ``@ops`` instantiation (:304).  The
+TPU-native design is leaner: one ``OpInfo`` row describes the thunder_tpu
+callable, a torch reference, and sample generators; ``test_opinfos.py``
+instantiates op × dtype(f32/bf16) × (forward|grad) and an executor subset.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Sequence
+
+import numpy as np
+import torch
+
+import thunder_tpu.torch as ltorch
+
+rng = np.random.default_rng(42)
+
+
+def _t(shape, dtype=np.float32, *, low=None, high=None, positive=False, small=False):
+    """Random sample tensor. ``positive`` keeps values in (0.1, 2); ``small``
+    keeps |x| < 0.9 (for atanh/acos-style domains)."""
+    if dtype in (np.int32, np.int64):
+        lo = 0 if low is None else low
+        hi = 10 if high is None else high
+        return rng.integers(lo, hi, shape).astype(dtype)
+    if dtype == np.bool_:
+        return rng.integers(0, 2, shape).astype(np.bool_)
+    if positive:
+        x = rng.uniform(0.1, 2.0, shape)
+    elif small:
+        x = rng.uniform(-0.9, 0.9, shape)
+    elif low is not None or high is not None:
+        x = rng.uniform(low if low is not None else -3, high if high is not None else 3, shape)
+    else:
+        x = rng.standard_normal(shape)
+    return x.astype(dtype)
+
+
+@dataclass
+class OpInfo:
+    name: str
+    op: Callable  # thunder_tpu-level callable (ltorch ops over proxies)
+    torch_ref: Callable  # same signature over torch tensors
+    sample: Callable  # dtype -> tuple of numpy arrays / python scalars
+    supports_grad: bool = True
+    supports_bf16: bool = True
+    rtol: float = 1e-5
+    atol: float = 1e-6
+    bf16_rtol: float = 2e-2
+    bf16_atol: float = 2e-2
+    grad_rtol: float | None = None  # defaults to rtol
+    grad_atol: float | None = None
+    grad_argnums: tuple | None = None  # default: every float32 ndarray arg
+
+
+opinfos: list[OpInfo] = []
+
+
+def add(name, op, torch_ref, sample, **kw):
+    opinfos.append(OpInfo(name, op, torch_ref, sample, **kw))
+
+
+#
+# Elementwise unary
+#
+
+_UNARY = [
+    # (name, domain kwargs, grad?)
+    ("abs", {}, True),
+    ("acos", dict(small=True), True),
+    ("acosh", dict(low=1.1, high=3.0), True),
+    ("asin", dict(small=True), True),
+    ("asinh", {}, True),
+    ("atan", {}, True),
+    ("atanh", dict(small=True), True),
+    ("ceil", {}, False),
+    ("cos", {}, True),
+    ("cosh", {}, True),
+    ("digamma", dict(positive=True), True),
+    ("erf", {}, True),
+    ("erfc", {}, True),
+    ("erfinv", dict(small=True), True),
+    ("exp", {}, True),
+    ("exp2", {}, True),
+    ("expm1", {}, True),
+    ("floor", {}, False),
+    ("lgamma", dict(positive=True), True),
+    ("log", dict(positive=True), True),
+    ("log10", dict(positive=True), True),
+    ("log1p", dict(positive=True), True),
+    ("log2", dict(positive=True), True),
+    ("neg", {}, True),
+    ("reciprocal", dict(positive=True), True),
+    ("round", {}, False),
+    ("rsqrt", dict(positive=True), True),
+    ("sigmoid", {}, True),
+    ("sign", {}, False),
+    ("sin", {}, True),
+    ("sinh", {}, True),
+    ("sqrt", dict(positive=True), True),
+    ("tan", dict(small=True), True),
+    ("tanh", {}, True),
+    ("trunc", {}, False),
+]
+
+for _name, _dom, _grad in _UNARY:
+    add(
+        _name,
+        getattr(ltorch, _name),
+        getattr(torch, _name),
+        (lambda dom: lambda dt: (_t((4, 5), dt, **dom),))(_dom),
+        supports_grad=_grad,
+    )
+
+add("isfinite", ltorch.isfinite, torch.isfinite, lambda dt: (_t((4, 5), dt),), supports_grad=False)
+add("isnan", ltorch.isnan, torch.isnan, lambda dt: (_t((4, 5), dt),), supports_grad=False)
+add(
+    "logical_not", ltorch.logical_not, torch.logical_not,
+    lambda dt: (_t((4, 5), np.bool_),), supports_grad=False, supports_bf16=False,
+)
+
+#
+# Elementwise binary
+#
+
+_BINARY = [
+    ("add", {}, True),
+    ("sub", {}, True),
+    ("mul", {}, True),
+    ("true_divide", dict(positive=True), True),
+    ("pow", dict(positive=True), True),
+    ("atan2", {}, True),
+    ("fmod", dict(positive=True), False),
+    ("remainder", dict(positive=True), False),
+    ("maximum", {}, True),
+    ("minimum", {}, True),
+    ("copysign", {}, False),
+    ("eq", {}, False),
+    ("ne", {}, False),
+    ("ge", {}, False),
+    ("gt", {}, False),
+    ("le", {}, False),
+    ("lt", {}, False),
+]
+
+for _name, _dom, _grad in _BINARY:
+    add(
+        _name,
+        getattr(ltorch, _name),
+        getattr(torch, _name),
+        (lambda dom: lambda dt: (_t((4, 5), dt, **dom), _t((4, 5), dt, **dom)))(_dom),
+        supports_grad=_grad,
+    )
+
+add(
+    "add_broadcast", ltorch.add, torch.add,
+    lambda dt: (_t((4, 5), dt), _t((5,), dt)),
+)
+add(
+    "add_alpha", lambda a, b: ltorch.add(a, b, alpha=2.5), lambda a, b: torch.add(a, b, alpha=2.5),
+    lambda dt: (_t((4, 5), dt), _t((4, 5), dt)),
+)
+add(
+    "floor_divide", ltorch.floor_divide, torch.floor_divide,
+    lambda dt: (_t((4, 5), dt, positive=True), _t((4, 5), dt, positive=True)),
+    supports_grad=False,
+)
+add("logical_and", ltorch.logical_and, torch.logical_and, lambda dt: (_t((4, 5), np.bool_), _t((4, 5), np.bool_)), supports_grad=False, supports_bf16=False)
+add("logical_or", ltorch.logical_or, torch.logical_or, lambda dt: (_t((4, 5), np.bool_), _t((4, 5), np.bool_)), supports_grad=False, supports_bf16=False)
+add("bitwise_and", ltorch.bitwise_and, torch.bitwise_and, lambda dt: (_t((4, 5), np.int32), _t((4, 5), np.int32)), supports_grad=False, supports_bf16=False)
+add("bitwise_or", ltorch.bitwise_or, torch.bitwise_or, lambda dt: (_t((4, 5), np.int32), _t((4, 5), np.int32)), supports_grad=False, supports_bf16=False)
+add("bitwise_xor", ltorch.bitwise_xor, torch.bitwise_xor, lambda dt: (_t((4, 5), np.int32), _t((4, 5), np.int32)), supports_grad=False, supports_bf16=False)
+
+#
+# Conditional / clamp / masking
+#
+
+add(
+    "where", ltorch.where, torch.where,
+    lambda dt: (_t((4, 5), np.bool_), _t((4, 5), dt), _t((4, 5), dt)),
+)
+add(
+    "clamp", lambda a: ltorch.clamp(a, -0.5, 0.5), lambda a: torch.clamp(a, -0.5, 0.5),
+    lambda dt: (_t((4, 5), dt),),
+)
+add(
+    "masked_fill", lambda a, m: ltorch.masked_fill(a, m, 3.0), lambda a, m: a.masked_fill(m, 3.0),
+    lambda dt: (_t((4, 5), dt), _t((4, 5), np.bool_)),
+)
+add("tril", ltorch.tril, torch.tril, lambda dt: (_t((5, 5), dt),))
+add("triu", ltorch.triu, torch.triu, lambda dt: (_t((5, 5), dt),))
+add("lerp", ltorch.lerp, torch.lerp, lambda dt: (_t((4, 5), dt), _t((4, 5), dt), _t((4, 5), dt)))
+
+#
+# Shape ops
+#
+
+add("reshape", lambda a: ltorch.reshape(a, (2, 10)), lambda a: a.reshape(2, 10), lambda dt: (_t((4, 5), dt),))
+add("permute", lambda a: ltorch.permute(a, (2, 0, 1)), lambda a: a.permute(2, 0, 1), lambda dt: (_t((2, 3, 4), dt),))
+add("transpose", lambda a: ltorch.transpose(a, 0, 1), lambda a: a.transpose(0, 1), lambda dt: (_t((3, 4), dt),))
+add("squeeze", lambda a: ltorch.squeeze(a), lambda a: a.squeeze(), lambda dt: (_t((3, 1, 4), dt),))
+add("unsqueeze", lambda a: ltorch.unsqueeze(a, 1), lambda a: a.unsqueeze(1), lambda dt: (_t((3, 4), dt),))
+add("flatten", lambda a: ltorch.flatten(a, 1), lambda a: a.flatten(1), lambda dt: (_t((2, 3, 4), dt),))
+add("cat", lambda a, b: ltorch.cat([a, b], 1), lambda a, b: torch.cat([a, b], 1), lambda dt: (_t((3, 4), dt), _t((3, 2), dt)))
+add("stack", lambda a, b: ltorch.stack([a, b], 0), lambda a, b: torch.stack([a, b], 0), lambda dt: (_t((3, 4), dt), _t((3, 4), dt)))
+add("split", lambda a: ltorch.split(a, 2, 1)[1], lambda a: torch.split(a, 2, 1)[1], lambda dt: (_t((3, 6), dt),))
+add("chunk", lambda a: ltorch.chunk(a, 3, 1)[2], lambda a: torch.chunk(a, 3, 1)[2], lambda dt: (_t((3, 6), dt),))
+add("expand", lambda a: ltorch.expand(a, (4, 3, 5)), lambda a: a.expand(4, 3, 5), lambda dt: (_t((1, 3, 1), dt),))
+add("movedim", lambda a: ltorch.movedim(a, 0, 2), lambda a: torch.movedim(a, 0, 2), lambda dt: (_t((2, 3, 4), dt),))
+add("flip", lambda a: ltorch.flip(a, (0, 1)), lambda a: torch.flip(a, (0, 1)), lambda dt: (_t((3, 4), dt),))
+add("narrow", lambda a: ltorch.narrow(a, 1, 1, 3), lambda a: a.narrow(1, 1, 3), lambda dt: (_t((3, 6), dt),))
+add("roll", lambda a: ltorch.roll(a, 2, 1), lambda a: torch.roll(a, 2, 1), lambda dt: (_t((3, 6), dt),))
+add("unfold", lambda a: ltorch.unfold(a, 1, 2, 1), lambda a: a.unfold(1, 2, 1), lambda dt: (_t((3, 6), dt),))
+add(
+    "repeat_interleave", lambda a: ltorch.repeat_interleave(a, 3, 1), lambda a: a.repeat_interleave(3, 1),
+    lambda dt: (_t((3, 4), dt),),
+)
+add("tile", lambda a: ltorch.tile(a, (2, 3)), lambda a: a.repeat(2, 3), lambda dt: (_t((3, 4), dt),))
+add("broadcast_to", lambda a: ltorch.broadcast_to(a, (4, 3, 5)), lambda a: a.broadcast_to(4, 3, 5), lambda dt: (_t((3, 1), dt),))
+add("getitem_basic", lambda a: a[1:3, ::2], lambda a: a[1:3, ::2], lambda dt: (_t((4, 6), dt),))
+add("getitem_int", lambda a: a[2], lambda a: a[2], lambda dt: (_t((4, 6), dt),))
+add("getitem_neg_stride_none", lambda a: a[:, None, 1:], lambda a: a[:, None, 1:], lambda dt: (_t((4, 6), dt),))
+add("pad", lambda a: ltorch.nn_pad(a, (1, 2, 0, 1)), lambda a: torch.nn.functional.pad(a, (1, 2, 0, 1)), lambda dt: (_t((3, 4), dt),))
+
+#
+# Reductions
+#
+
+add("sum", lambda a: ltorch.sum(a), lambda a: a.sum(), lambda dt: (_t((4, 5), dt),))
+add("sum_dim", lambda a: ltorch.sum(a, 1), lambda a: a.sum(1), lambda dt: (_t((4, 5), dt),))
+add("sum_keepdim", lambda a: ltorch.sum(a, 0, True), lambda a: a.sum(0, keepdim=True), lambda dt: (_t((4, 5), dt),))
+add("mean", lambda a: ltorch.mean(a, 1), lambda a: a.mean(1), lambda dt: (_t((4, 5), dt),))
+add("prod", lambda a: ltorch.prod(a, 1), lambda a: a.prod(1), lambda dt: (_t((4, 5), dt, positive=True),))
+add("amax", lambda a: ltorch.amax(a, 1), lambda a: a.amax(1), lambda dt: (_t((4, 5), dt),))
+add("amin", lambda a: ltorch.amin(a, 1), lambda a: a.amin(1), lambda dt: (_t((4, 5), dt),))
+add("max_dim", lambda a: ltorch.max(a, 1)[0], lambda a: a.max(1).values, lambda dt: (_t((4, 5), dt),))
+add("min_dim", lambda a: ltorch.min(a, 1)[0], lambda a: a.min(1).values, lambda dt: (_t((4, 5), dt),))
+add("var", lambda a: ltorch.var(a, 1), lambda a: a.var(1), lambda dt: (_t((4, 5), dt),))
+add("std", lambda a: ltorch.std(a, 1), lambda a: a.std(1), lambda dt: (_t((4, 5), dt),))
+add(
+    "var_mean", lambda a: ltorch.var_mean(a, 1)[0], lambda a: torch.var_mean(a, 1)[0],
+    lambda dt: (_t((4, 5), dt),),
+)
+add("argmax", lambda a: ltorch.argmax(a, 1), lambda a: a.argmax(1), lambda dt: (_t((4, 5), dt),), supports_grad=False)
+add("argmin", lambda a: ltorch.argmin(a, 1), lambda a: a.argmin(1), lambda dt: (_t((4, 5), dt),), supports_grad=False)
+add("cumsum", lambda a: ltorch.cumsum(a, 1), lambda a: a.cumsum(1), lambda dt: (_t((4, 5), dt),))
+add("topk", lambda a: ltorch.topk(a, 3, 1)[0], lambda a: a.topk(3, 1).values, lambda dt: (_t((4, 9), dt),), supports_grad=False)
+add("sort", lambda a: ltorch.sort(a, 1)[0], lambda a: a.sort(1).values, lambda dt: (_t((4, 5), dt),), supports_grad=False)
+add("argsort", lambda a: ltorch.argsort(a, 1), lambda a: a.argsort(1), lambda dt: (_t((4, 5), dt),), supports_grad=False)
+add("any", lambda a: ltorch.any_(a, 1), lambda a: a.any(1), lambda dt: (_t((4, 5), np.bool_),), supports_grad=False, supports_bf16=False)
+add("all", lambda a: ltorch.all_(a, 1), lambda a: a.all(1), lambda dt: (_t((4, 5), np.bool_),), supports_grad=False, supports_bf16=False)
+
+#
+# Indexing / scatter-gather
+#
+
+add(
+    "index_select", lambda a, i: ltorch.index_select(a, 1, i), lambda a, i: torch.index_select(a, 1, i.long()),
+    lambda dt: (_t((4, 6), dt), _t((3,), np.int32, high=6)),
+)
+add(
+    "gather", lambda a, i: ltorch.gather(a, 1, i), lambda a, i: torch.gather(a, 1, i.long()),
+    lambda dt: (_t((4, 6), dt), _t((4, 3), np.int32, high=6)),
+)
+add(
+    "take_along_dim", lambda a, i: ltorch.take_along_dim(a, i, 1), lambda a, i: torch.take_along_dim(a, i.long(), 1),
+    lambda dt: (_t((4, 6), dt), _t((4, 3), np.int32, high=6)),
+)
+add(
+    "scatter_add", lambda a, i, s: ltorch.scatter_add(a, 1, i, s),
+    lambda a, i, s: torch.scatter_add(a, 1, i.long(), s),
+    lambda dt: (_t((4, 6), dt), _t((4, 3), np.int32, high=6), _t((4, 3), dt)),
+)
+add(
+    "index_add", lambda a, i, s: ltorch.index_add(a, 1, i, s),
+    lambda a, i, s: torch.index_add(a, 1, i.long(), s),
+    lambda dt: (_t((4, 6), dt), np.array([0, 2, 5], np.int32), _t((4, 3), dt)),
+)
+add(
+    "one_hot", lambda i: ltorch.one_hot(i, 7), lambda i: torch.nn.functional.one_hot(i.long(), 7),
+    lambda dt: (_t((4, 3), np.int32, high=7),), supports_grad=False, supports_bf16=False,
+)
+
+#
+# Matmul family
+#
+
+add("matmul", ltorch.matmul, torch.matmul, lambda dt: (_t((4, 5), dt), _t((5, 6), dt)), bf16_rtol=5e-2)
+add("matmul_batched", ltorch.matmul, torch.matmul, lambda dt: (_t((2, 4, 5), dt), _t((2, 5, 6), dt)), bf16_rtol=5e-2)
+add("mm", ltorch.mm, torch.mm, lambda dt: (_t((4, 5), dt), _t((5, 6), dt)), bf16_rtol=5e-2)
+add("bmm", ltorch.bmm, torch.bmm, lambda dt: (_t((2, 4, 5), dt), _t((2, 5, 6), dt)), bf16_rtol=5e-2)
+add(
+    "addmm", lambda c, a, b: ltorch.addmm(c, a, b, beta=0.5, alpha=2.0),
+    lambda c, a, b: torch.addmm(c, a, b, beta=0.5, alpha=2.0),
+    lambda dt: (_t((4, 6), dt), _t((4, 5), dt), _t((5, 6), dt)), bf16_rtol=5e-2,
+)
+add("outer", ltorch.outer, torch.outer, lambda dt: (_t((4,), dt), _t((5,), dt)))
+add("mv", ltorch.mv, torch.mv, lambda dt: (_t((4, 5), dt), _t((5,), dt)), bf16_rtol=5e-2)
+add("dot", ltorch.dot, torch.dot, lambda dt: (_t((5,), dt), _t((5,), dt)), bf16_rtol=5e-2)
+add(
+    "einsum_ij_jk", lambda a, b: ltorch.einsum("ij,jk->ik", a, b),
+    lambda a, b: torch.einsum("ij,jk->ik", a, b),
+    lambda dt: (_t((4, 5), dt), _t((5, 6), dt)), bf16_rtol=5e-2,
+)
+add(
+    "einsum_attention", lambda q, k: ltorch.einsum("bhqd,bhkd->bhqk", q, k),
+    lambda q, k: torch.einsum("bhqd,bhkd->bhqk", q, k),
+    lambda dt: (_t((2, 2, 3, 4), dt), _t((2, 2, 5, 4), dt)), bf16_rtol=5e-2,
+)
+add(
+    "baddbmm", lambda c, a, b: ltorch.baddbmm(c, a, b, beta=0.5, alpha=2.0),
+    lambda c, a, b: torch.baddbmm(c, a, b, beta=0.5, alpha=2.0),
+    lambda dt: (_t((2, 3, 5), dt), _t((2, 3, 4), dt), _t((2, 4, 5), dt)), bf16_rtol=5e-2,
+)
+add(
+    "linear", ltorch.linear, torch.nn.functional.linear,
+    lambda dt: (_t((4, 5), dt), _t((6, 5), dt), _t((6,), dt)), bf16_rtol=5e-2,
+)
+
+#
+# NN ops
+#
+
+add("relu", ltorch.relu, torch.nn.functional.relu, lambda dt: (_t((4, 5), dt),))
+add("relu6", ltorch.relu6, torch.nn.functional.relu6, lambda dt: (_t((4, 5), dt, low=-8, high=8),))
+add("leaky_relu", ltorch.leaky_relu, torch.nn.functional.leaky_relu, lambda dt: (_t((4, 5), dt),))
+add("gelu", ltorch.gelu, torch.nn.functional.gelu, lambda dt: (_t((4, 5), dt),))
+add(
+    "gelu_tanh", lambda a: ltorch.gelu(a, approximate="tanh"),
+    lambda a: torch.nn.functional.gelu(a, approximate="tanh"), lambda dt: (_t((4, 5), dt),),
+)
+add("silu", ltorch.silu, torch.nn.functional.silu, lambda dt: (_t((4, 5), dt),))
+add("mish", ltorch.mish, torch.nn.functional.mish, lambda dt: (_t((4, 5), dt),))
+add("softplus", ltorch.softplus, torch.nn.functional.softplus, lambda dt: (_t((4, 5), dt),))
+add("elu", ltorch.elu, torch.nn.functional.elu, lambda dt: (_t((4, 5), dt),))
+add("selu", ltorch.selu, torch.nn.functional.selu, lambda dt: (_t((4, 5), dt),))
+add("celu", ltorch.celu, torch.nn.functional.celu, lambda dt: (_t((4, 5), dt),))
+add("hardtanh", ltorch.hardtanh, torch.nn.functional.hardtanh, lambda dt: (_t((4, 5), dt),))
+add("hardswish", ltorch.hardswish, torch.nn.functional.hardswish, lambda dt: (_t((4, 5), dt, low=-5, high=5),))
+add("hardsigmoid", ltorch.hardsigmoid, torch.nn.functional.hardsigmoid, lambda dt: (_t((4, 5), dt, low=-5, high=5),))
+add("logsigmoid", ltorch.logsigmoid, torch.nn.functional.logsigmoid, lambda dt: (_t((4, 5), dt),))
+add("tanhshrink", ltorch.tanhshrink, torch.nn.functional.tanhshrink, lambda dt: (_t((4, 5), dt),))
+add("glu", ltorch.glu, torch.nn.functional.glu, lambda dt: (_t((4, 6), dt),))
+add("softmax", lambda a: ltorch.softmax(a, 1), lambda a: torch.softmax(a, 1), lambda dt: (_t((4, 5), dt),))
+add("log_softmax", lambda a: ltorch.log_softmax(a, 1), lambda a: torch.log_softmax(a, 1), lambda dt: (_t((4, 5), dt),))
+add(
+    "layer_norm",
+    lambda a, w, b: ltorch.layer_norm(a, (5,), w, b),
+    lambda a, w, b: torch.nn.functional.layer_norm(a, (5,), w, b),
+    lambda dt: (_t((4, 5), dt), _t((5,), dt), _t((5,), dt)),
+)
+add(
+    "rms_norm",
+    lambda a, w: ltorch.rms_norm(a, (5,), w),
+    lambda a, w: torch.nn.functional.rms_norm(a, (5,), w),
+    lambda dt: (_t((4, 5), dt), _t((5,), dt)),
+)
+add(
+    "group_norm",
+    lambda a, w, b: ltorch.group_norm(a, 2, w, b),
+    lambda a, w, b: torch.nn.functional.group_norm(a, 2, w, b),
+    lambda dt: (_t((3, 4, 5), dt), _t((4,), dt), _t((4,), dt)),
+)
+add(
+    "batch_norm_eval",
+    lambda a, m, v, w, b: ltorch.batch_norm(a, m, v, w, b, training=False),
+    lambda a, m, v, w, b: torch.nn.functional.batch_norm(a, m, v, w, b, training=False),
+    lambda dt: (_t((3, 4, 5), dt), _t((4,), dt), _t((4,), dt, positive=True), _t((4,), dt), _t((4,), dt)),
+    grad_argnums=(0, 3, 4),  # torch can't differentiate wrt running stats
+)
+add(
+    "embedding", lambda i, w: ltorch.embedding(i, w), lambda i, w: torch.nn.functional.embedding(i.long(), w),
+    lambda dt: (_t((4, 3), np.int32, high=10), _t((10, 5), dt)),
+)
+add(
+    "conv2d",
+    lambda a, w, b: ltorch.conv2d(a, w, b, stride=2, padding=1),
+    lambda a, w, b: torch.nn.functional.conv2d(a, w, b, stride=2, padding=1),
+    lambda dt: (_t((2, 3, 8, 8), dt), _t((4, 3, 3, 3), dt), _t((4,), dt)),
+    bf16_rtol=5e-2, rtol=1e-4, atol=1e-5,
+)
+add(
+    "conv1d",
+    lambda a, w: ltorch.conv1d(a, w),
+    lambda a, w: torch.nn.functional.conv1d(a, w),
+    lambda dt: (_t((2, 3, 10), dt), _t((4, 3, 3), dt)),
+    bf16_rtol=5e-2, rtol=1e-4, atol=1e-5,
+)
+add(
+    "sdpa",
+    lambda q, k, v: ltorch.scaled_dot_product_attention(q, k, v),
+    lambda q, k, v: torch.nn.functional.scaled_dot_product_attention(q, k, v),
+    lambda dt: (_t((2, 2, 4, 8), dt), _t((2, 2, 4, 8), dt), _t((2, 2, 4, 8), dt)),
+    rtol=1e-4, atol=1e-5, bf16_rtol=5e-2,
+)
+add(
+    "sdpa_causal",
+    lambda q, k, v: ltorch.scaled_dot_product_attention(q, k, v, is_causal=True),
+    lambda q, k, v: torch.nn.functional.scaled_dot_product_attention(q, k, v, is_causal=True),
+    lambda dt: (_t((2, 2, 4, 8), dt), _t((2, 2, 4, 8), dt), _t((2, 2, 4, 8), dt)),
+    rtol=1e-4, atol=1e-5, bf16_rtol=5e-2,
+)
+add(
+    "max_pool2d", lambda a: ltorch.max_pool2d(a, 2), lambda a: torch.nn.functional.max_pool2d(a, 2),
+    lambda dt: (_t((2, 3, 8, 8), dt),),
+)
+add(
+    "avg_pool2d", lambda a: ltorch.avg_pool2d(a, 2), lambda a: torch.nn.functional.avg_pool2d(a, 2),
+    lambda dt: (_t((2, 3, 8, 8), dt),),
+)
+add(
+    "interpolate_nearest",
+    lambda a: ltorch.interpolate(a, scale_factor=2.0, mode="nearest"),
+    lambda a: torch.nn.functional.interpolate(a, scale_factor=2.0, mode="nearest"),
+    lambda dt: (_t((2, 3, 4, 4), dt),),
+)
+add(
+    "cross_entropy",
+    lambda l, t: ltorch.cross_entropy(l, t),
+    lambda l, t: torch.nn.functional.cross_entropy(l, t.long()),
+    lambda dt: (_t((6, 9), dt), _t((6,), np.int32, high=9)),
+    rtol=1e-4, atol=1e-5,
+)
+add(
+    "nll_loss",
+    lambda l, t: ltorch.nll_loss(l, t),
+    lambda l, t: torch.nn.functional.nll_loss(l, t.long()),
+    lambda dt: (np.log(_t((6, 9), dt, positive=True)).astype(dt), _t((6,), np.int32, high=9)),
+    rtol=1e-4, atol=1e-5,
+)
+add("mse_loss", ltorch.mse_loss, torch.nn.functional.mse_loss, lambda dt: (_t((4, 5), dt), _t((4, 5), dt)))
+add("l1_loss", ltorch.l1_loss, torch.nn.functional.l1_loss, lambda dt: (_t((4, 5), dt), _t((4, 5), dt)))
+add(
+    "smooth_l1_loss", ltorch.smooth_l1_loss, torch.nn.functional.smooth_l1_loss,
+    lambda dt: (_t((4, 5), dt), _t((4, 5), dt)),
+)
+add(
+    "dropout_p0", lambda a: ltorch.dropout(a, 0.0), lambda a: torch.nn.functional.dropout(a, 0.0),
+    lambda dt: (_t((4, 5), dt),),
+)
+add(
+    "normalize", lambda a: ltorch.normalize(a, dim=1), lambda a: torch.nn.functional.normalize(a, dim=1),
+    lambda dt: (_t((4, 5), dt),),
+)
+add("square", ltorch.square, torch.square, lambda dt: (_t((4, 5), dt),))
+add(
+    "cosine_similarity", lambda a, b: ltorch.cosine_similarity(a, b, dim=1),
+    lambda a, b: torch.nn.functional.cosine_similarity(a, b, dim=1),
+    lambda dt: (_t((4, 5), dt), _t((4, 5), dt)),
+)
+add(
+    "type_convert", lambda a: ltorch.to(a, ltorch.float32), lambda a: a.to(torch.float32),
+    lambda dt: (_t((4, 5), dt),),
+)

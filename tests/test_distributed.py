@@ -129,6 +129,23 @@ def test_fsdp_zero_train_step_matches_single_device():
     assert any(getattr(s, "is_fully_replicated", True) is False for s in mu_sh)
 
 
+def test_fsdp_zero3_train_step_matches_single_device():
+    # ZeRO-3 mode (regather-in-backward via aggressive remat) must keep exact
+    # numerics: same loss and updated params as the single-device step
+    cfg, params, batch, loss_fn = _setup()
+    optimizer = optax.adamw(1e-2)
+    ref_loss, ref_params = _single_device_step(loss_fn, params, batch, optimizer)
+
+    mesh = dist.make_mesh({"fsdp": 8})
+    p_sh = dist.fsdp(params, mesh, min_size=64)
+    step = dist.make_train_step(loss_fn, optimizer, mesh, batch_specs=BATCH_SPECS, zero3=True)
+    opt_state = step.init_optimizer_state(p_sh)
+    new_params, new_opt, loss = step(p_sh, opt_state, *batch)
+
+    np.testing.assert_allclose(float(loss), float(ref_loss), atol=1e-5, rtol=1e-5)
+    _assert_tree_close(new_params, ref_params, atol=1e-4)
+
+
 def test_train_step_rebuilds_for_new_batch_shape():
     cfg, params, batch, loss_fn = _setup(B=8)
     _, _, batch2, _ = _setup(B=16)

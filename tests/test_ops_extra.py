@@ -1,0 +1,366 @@
+"""Correctness of the round-2 op-surface additions vs torch references.
+
+Covers the VERDICT round-1 gaps: einsum, pooling, interpolate, mixed advanced
+indexing, cross_entropy weight/label_smoothing, and the extra losses
+(reference surface: ``thunder/torch/__init__.py``).
+"""
+import numpy as np
+import pytest
+import torch
+
+import thunder_tpu as tt
+import thunder_tpu.torch as ltorch
+
+rng = np.random.default_rng(7)
+
+
+def run(fn, *args):
+    return np.asarray(tt.jit(fn)(*args))
+
+
+def run_grad(fn, *args, argnums=(0,)):
+    out = tt.value_and_grad(fn, argnums=argnums)(*args)
+    return out
+
+
+class TestEinsum:
+    def test_matmul_spec(self):
+        a = rng.standard_normal((4, 5)).astype(np.float32)
+        b = rng.standard_normal((5, 6)).astype(np.float32)
+        got = run(lambda x, y: ltorch.einsum("ij,jk->ik", x, y), a, b)
+        np.testing.assert_allclose(got, a @ b, rtol=1e-5)
+
+    def test_batched_contraction(self):
+        a = rng.standard_normal((2, 3, 4)).astype(np.float32)
+        b = rng.standard_normal((2, 4, 5)).astype(np.float32)
+        got = run(lambda x, y: ltorch.einsum("bij,bjk->bik", x, y), a, b)
+        np.testing.assert_allclose(got, np.einsum("bij,bjk->bik", a, b), rtol=1e-5)
+
+    def test_trace_like_reduction(self):
+        a = rng.standard_normal((5, 5)).astype(np.float32)
+        got = run(lambda x: ltorch.einsum("ii->", x), a)
+        np.testing.assert_allclose(got, np.trace(a), rtol=1e-5)
+
+    def test_grad(self):
+        a = rng.standard_normal((4, 5)).astype(np.float32)
+        b = rng.standard_normal((5, 6)).astype(np.float32)
+        _, (ga, gb) = run_grad(
+            lambda x, y: ltorch.sum(ltorch.einsum("ij,jk->ik", x, y)), a, b, argnums=(0, 1)
+        )
+        ta = torch.tensor(a, requires_grad=True)
+        tb = torch.tensor(b, requires_grad=True)
+        torch.einsum("ij,jk->ik", ta, tb).sum().backward()
+        np.testing.assert_allclose(np.asarray(ga), ta.grad.numpy(), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(gb), tb.grad.numpy(), rtol=1e-5)
+
+
+class TestPooling:
+    x = rng.standard_normal((2, 3, 8, 8)).astype(np.float32)
+    tx = torch.from_numpy(x)
+
+    def test_max_pool2d(self):
+        got = run(lambda t: ltorch.max_pool2d(t, 2), self.x)
+        np.testing.assert_allclose(got, torch.nn.functional.max_pool2d(self.tx, 2).numpy(), rtol=1e-6)
+
+    def test_max_pool2d_stride_padding(self):
+        got = run(lambda t: ltorch.max_pool2d(t, 3, 2, 1), self.x)
+        ref = torch.nn.functional.max_pool2d(self.tx, 3, 2, 1).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+    def test_avg_pool2d_count_include_pad(self):
+        got = run(lambda t: ltorch.avg_pool2d(t, 3, 2, 1), self.x)
+        ref = torch.nn.functional.avg_pool2d(self.tx, 3, 2, 1).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    def test_avg_pool2d_no_pad_count(self):
+        got = run(lambda t: ltorch.avg_pool2d(t, 3, 2, 1, count_include_pad=False), self.x)
+        ref = torch.nn.functional.avg_pool2d(self.tx, 3, 2, 1, count_include_pad=False).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    def test_max_pool1d_3d(self):
+        x1 = rng.standard_normal((2, 3, 16)).astype(np.float32)
+        got = run(lambda t: ltorch.max_pool1d(t, 4), x1)
+        np.testing.assert_allclose(got, torch.nn.functional.max_pool1d(torch.from_numpy(x1), 4).numpy(), rtol=1e-6)
+        x3 = rng.standard_normal((1, 2, 4, 4, 4)).astype(np.float32)
+        got = run(lambda t: ltorch.max_pool3d(t, 2), x3)
+        np.testing.assert_allclose(got, torch.nn.functional.max_pool3d(torch.from_numpy(x3), 2).numpy(), rtol=1e-6)
+
+    def test_adaptive_avg_pool2d(self):
+        got = run(lambda t: ltorch.adaptive_avg_pool2d(t, 4), self.x)
+        np.testing.assert_allclose(
+            got, torch.nn.functional.adaptive_avg_pool2d(self.tx, 4).numpy(), rtol=1e-5, atol=1e-6
+        )
+
+    def test_max_pool_grad(self):
+        _, g = run_grad(lambda t: ltorch.sum(ltorch.max_pool2d(t, 2)), self.x)
+        txt = torch.tensor(self.x, requires_grad=True)
+        torch.nn.functional.max_pool2d(txt, 2).sum().backward()
+        np.testing.assert_allclose(np.asarray(g), txt.grad.numpy(), rtol=1e-5)
+
+    def test_avg_pool_grad(self):
+        _, g = run_grad(lambda t: ltorch.sum(ltorch.avg_pool2d(t, 3, 2, 1)), self.x)
+        txt = torch.tensor(self.x, requires_grad=True)
+        torch.nn.functional.avg_pool2d(txt, 3, 2, 1).sum().backward()
+        np.testing.assert_allclose(np.asarray(g), txt.grad.numpy(), rtol=1e-5, atol=1e-6)
+
+
+class TestInterpolate:
+    x = rng.standard_normal((2, 3, 8, 8)).astype(np.float32)
+    tx = torch.from_numpy(x)
+
+    def test_nearest_exact_torch_rule(self):
+        for size in (5, 7, 16):
+            got = run(lambda t, s=size: ltorch.interpolate(t, size=s, mode="nearest"), self.x)
+            ref = torch.nn.functional.interpolate(self.tx, size=size, mode="nearest").numpy()
+            np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+    def test_bilinear(self):
+        got = run(lambda t: ltorch.interpolate(t, scale_factor=2.0, mode="bilinear"), self.x)
+        ref = torch.nn.functional.interpolate(self.tx, scale_factor=2.0, mode="bilinear", align_corners=False)
+        np.testing.assert_allclose(got, ref.numpy(), rtol=1e-4, atol=1e-5)
+
+    def test_linear_1d(self):
+        x1 = rng.standard_normal((2, 3, 16)).astype(np.float32)
+        got = run(lambda t: ltorch.interpolate(t, size=24, mode="linear"), x1)
+        ref = torch.nn.functional.interpolate(torch.from_numpy(x1), size=24, mode="linear", align_corners=False)
+        np.testing.assert_allclose(got, ref.numpy(), rtol=1e-4, atol=1e-5)
+
+    def test_bilinear_grad(self):
+        _, g = run_grad(lambda t: ltorch.sum(ltorch.interpolate(t, scale_factor=2.0, mode="bilinear")), self.x)
+        txt = torch.tensor(self.x, requires_grad=True)
+        torch.nn.functional.interpolate(txt, scale_factor=2.0, mode="bilinear", align_corners=False).sum().backward()
+        np.testing.assert_allclose(np.asarray(g), txt.grad.numpy(), rtol=1e-4, atol=1e-5)
+
+
+class TestCrossEntropyExtras:
+    logits = rng.standard_normal((6, 9)).astype(np.float32)
+    tgt = np.where(rng.integers(0, 5, (6,)) == 0, -100, rng.integers(0, 9, (6,))).astype(np.int32)
+    w = rng.uniform(0.5, 2.0, (9,)).astype(np.float32)
+
+    def _refs(self):
+        return (
+            torch.from_numpy(self.logits),
+            torch.from_numpy(self.tgt).to(torch.long),
+            torch.from_numpy(self.w),
+        )
+
+    @pytest.mark.parametrize("reduction", ["mean", "sum", "none"])
+    def test_weight(self, reduction):
+        tl, tt_, tw = self._refs()
+        got = run(lambda l, t, wt: ltorch.cross_entropy(l, t, weight=wt, reduction=reduction), self.logits, self.tgt, self.w)
+        ref = torch.nn.functional.cross_entropy(tl, tt_, weight=tw, reduction=reduction).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    @pytest.mark.parametrize("reduction", ["mean", "sum"])
+    def test_label_smoothing(self, reduction):
+        tl, tt_, _ = self._refs()
+        got = run(lambda l, t: ltorch.cross_entropy(l, t, label_smoothing=0.1, reduction=reduction), self.logits, self.tgt)
+        ref = torch.nn.functional.cross_entropy(tl, tt_, label_smoothing=0.1, reduction=reduction).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    def test_weight_and_smoothing_grad(self):
+        tl, tt_, tw = self._refs()
+        tl.requires_grad_(True)
+        _, g = run_grad(
+            lambda l, t, wt: ltorch.cross_entropy(l, t, weight=wt, label_smoothing=0.2), self.logits, self.tgt, self.w
+        )
+        torch.nn.functional.cross_entropy(tl, tt_, weight=tw, label_smoothing=0.2).backward()
+        np.testing.assert_allclose(np.asarray(g), tl.grad.numpy(), rtol=1e-4, atol=1e-6)
+
+    def test_nll_loss_weight(self):
+        tl, tt_, tw = self._refs()
+        logp = torch.log_softmax(tl, -1)
+        got = run(
+            lambda l, t, wt: ltorch.nll_loss(ltorch.log_softmax(l, -1), t, weight=wt), self.logits, self.tgt, self.w
+        )
+        ref = torch.nn.functional.nll_loss(logp, tt_, weight=tw).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+class TestAdvancedIndexing:
+    x = rng.standard_normal((4, 5, 6)).astype(np.float32)
+    tx = torch.from_numpy(x)
+    i = np.array([0, 2, 1], dtype=np.int32)
+    j = np.array([1, 3, 0], dtype=np.int32)
+
+    def test_middle_dim(self):
+        got = run(lambda t, ii: t[:, ii], self.x, self.i)
+        np.testing.assert_allclose(got, self.tx[:, torch.from_numpy(self.i).long()].numpy())
+
+    def test_pairwise(self):
+        ti, tj = torch.from_numpy(self.i).long(), torch.from_numpy(self.j).long()
+        got = run(lambda t, ii, jj: t[ii, jj], self.x, self.i, self.j)
+        np.testing.assert_allclose(got, self.tx[ti, tj].numpy())
+
+    def test_pairwise_after_slice(self):
+        ti, tj = torch.from_numpy(self.i).long(), torch.from_numpy(self.j).long()
+        got = run(lambda t, ii, jj: t[:, ii, jj], self.x, self.i, self.j)
+        np.testing.assert_allclose(got, self.tx[:, ti, tj].numpy())
+
+    def test_negative_indices(self):
+        ineg = np.array([-1, 0, -2], dtype=np.int32)
+        got = run(lambda t, ii: t[:, ii], self.x, ineg)
+        np.testing.assert_allclose(got, self.tx[:, torch.from_numpy(ineg).long()].numpy())
+
+    def test_broadcast_indices(self):
+        i2 = self.i.reshape(3, 1)
+        j2 = self.j.reshape(1, 3)
+        got = run(lambda t, ii, jj: t[ii, jj], self.x, i2, j2)
+        np.testing.assert_allclose(got, self.tx[torch.from_numpy(i2).long(), torch.from_numpy(j2).long()].numpy())
+
+    def test_list_index(self):
+        got = run(lambda t: t[[2, 0, 3]], self.x)
+        np.testing.assert_allclose(got, self.tx[[2, 0, 3]].numpy())
+
+    def test_grad(self):
+        _, g = run_grad(lambda t, ii, jj: ltorch.sum(ltorch.mul(t[:, ii, jj], 2.0)), self.x, self.i, self.j)
+        txx = torch.tensor(self.x, requires_grad=True)
+        (txx[:, torch.from_numpy(self.i).long(), torch.from_numpy(self.j).long()] * 2.0).sum().backward()
+        np.testing.assert_allclose(np.asarray(g), txx.grad.numpy(), rtol=1e-5)
+
+
+class TestLosses:
+    p = rng.uniform(0.05, 0.95, (4, 7)).astype(np.float32)
+    t01 = rng.uniform(0, 1, (4, 7)).astype(np.float32)
+    lg = rng.standard_normal((4, 7)).astype(np.float32)
+
+    def test_l1_smooth_l1_huber(self):
+        tp, tt01 = torch.from_numpy(self.p), torch.from_numpy(self.t01)
+        np.testing.assert_allclose(
+            run(lambda a, b: ltorch.l1_loss(a, b), self.p, self.t01),
+            torch.nn.functional.l1_loss(tp, tt01).numpy(), rtol=1e-5)
+        np.testing.assert_allclose(
+            run(lambda a, b: ltorch.smooth_l1_loss(a, b, beta=0.5), self.p, self.t01),
+            torch.nn.functional.smooth_l1_loss(tp, tt01, beta=0.5).numpy(), rtol=1e-5)
+        np.testing.assert_allclose(
+            run(lambda a, b: ltorch.huber_loss(a, b, delta=0.7), self.p, self.t01),
+            torch.nn.functional.huber_loss(tp, tt01, delta=0.7).numpy(), rtol=1e-5)
+
+    def test_bce(self):
+        tp, tt01 = torch.from_numpy(self.p), torch.from_numpy(self.t01)
+        np.testing.assert_allclose(
+            run(lambda a, b: ltorch.binary_cross_entropy(a, b), self.p, self.t01),
+            torch.nn.functional.binary_cross_entropy(tp, tt01).numpy(), rtol=1e-5)
+
+    def test_bce_with_logits(self):
+        tlg, tt01 = torch.from_numpy(self.lg), torch.from_numpy(self.t01)
+        np.testing.assert_allclose(
+            run(lambda a, b: ltorch.binary_cross_entropy_with_logits(a, b), self.lg, self.t01),
+            torch.nn.functional.binary_cross_entropy_with_logits(tlg, tt01).numpy(), rtol=1e-5)
+        pw = rng.uniform(0.5, 2.0, (7,)).astype(np.float32)
+        np.testing.assert_allclose(
+            run(lambda a, b, c: ltorch.binary_cross_entropy_with_logits(a, b, pos_weight=c), self.lg, self.t01, pw),
+            torch.nn.functional.binary_cross_entropy_with_logits(tlg, tt01, pos_weight=torch.from_numpy(pw)).numpy(),
+            rtol=1e-4, atol=1e-6)
+
+    def test_kl_div(self):
+        logp = np.log(self.p / self.p.sum(-1, keepdims=True))
+        q = self.t01 / self.t01.sum(-1, keepdims=True)
+        np.testing.assert_allclose(
+            run(lambda a, b: ltorch.kl_div(a, b, reduction="batchmean"), logp, q),
+            torch.nn.functional.kl_div(torch.from_numpy(logp), torch.from_numpy(q), reduction="batchmean").numpy(),
+            rtol=1e-5)
+
+
+class TestMiscOps:
+    sq = rng.standard_normal((4, 6)).astype(np.float32)
+    tsq = torch.from_numpy(sq)
+    v = rng.standard_normal((5,)).astype(np.float32)
+
+    def test_mv_dot(self):
+        m = rng.standard_normal((3, 5)).astype(np.float32)
+        v2 = rng.standard_normal((5,)).astype(np.float32)
+        np.testing.assert_allclose(run(lambda a, b: ltorch.mv(a, b), m, self.v), m @ self.v, rtol=1e-5)
+        np.testing.assert_allclose(run(lambda a, b: ltorch.dot(a, b), self.v, v2), self.v @ v2, rtol=1e-5)
+
+    def test_baddbmm(self):
+        b1 = rng.standard_normal((2, 3, 4)).astype(np.float32)
+        b2 = rng.standard_normal((2, 4, 5)).astype(np.float32)
+        bi = rng.standard_normal((2, 3, 5)).astype(np.float32)
+        got = run(lambda i_, x_, y_: ltorch.baddbmm(i_, x_, y_, beta=0.5, alpha=2.0), bi, b1, b2)
+        ref = torch.baddbmm(torch.from_numpy(bi), torch.from_numpy(b1), torch.from_numpy(b2), beta=0.5, alpha=2.0)
+        np.testing.assert_allclose(got, ref.numpy(), rtol=1e-5)
+
+    @pytest.mark.parametrize("offset", [0, 2, -1])
+    def test_diagonal(self, offset):
+        np.testing.assert_allclose(
+            run(lambda t: ltorch.diagonal(t, offset), self.sq), self.tsq.diagonal(offset).numpy()
+        )
+
+    def test_diag_build(self):
+        np.testing.assert_allclose(run(lambda t: ltorch.diag(t), self.v), torch.diag(torch.from_numpy(self.v)).numpy())
+
+    def test_tile_repeat(self):
+        np.testing.assert_allclose(run(lambda t: ltorch.tile(t, (2, 3)), self.sq), self.tsq.repeat(2, 3).numpy())
+        np.testing.assert_allclose(run(lambda t: ltorch.tile(t, (2, 1, 3)), self.sq), self.tsq.repeat(2, 1, 3).numpy())
+
+    def test_unbind(self):
+        x = rng.standard_normal((4, 5, 6)).astype(np.float32)
+        got = run(lambda t: ltorch.unbind(t, 1)[2], x)
+        np.testing.assert_allclose(got, torch.from_numpy(x).unbind(1)[2].numpy())
+
+    def test_activations(self):
+        np.testing.assert_allclose(
+            run(lambda t: ltorch.softmin(t, 1), self.sq), torch.nn.functional.softmin(self.tsq, 1).numpy(), rtol=1e-5)
+        np.testing.assert_allclose(
+            run(lambda t: ltorch.softshrink(t, 0.3), self.sq), torch.nn.functional.softshrink(self.tsq, 0.3).numpy(), rtol=1e-5)
+        np.testing.assert_allclose(
+            run(lambda t: ltorch.hardshrink(t, 0.3), self.sq), torch.nn.functional.hardshrink(self.tsq, 0.3).numpy(), rtol=1e-5)
+        np.testing.assert_allclose(
+            run(lambda t: ltorch.threshold(t, 0.1, 7.0), self.sq), torch.nn.functional.threshold(self.tsq, 0.1, 7.0).numpy(), rtol=1e-5)
+
+    def test_prelu(self):
+        x = rng.standard_normal((4, 5, 6)).astype(np.float32)
+        w1 = np.array([0.25], dtype=np.float32)
+        wc = rng.uniform(0.1, 0.5, (5,)).astype(np.float32)
+        np.testing.assert_allclose(
+            run(lambda t, w_: ltorch.prelu(t, w_), x, w1),
+            torch.nn.functional.prelu(torch.from_numpy(x), torch.from_numpy(w1)).numpy(), rtol=1e-5)
+        np.testing.assert_allclose(
+            run(lambda t, w_: ltorch.prelu(t, w_), x, wc),
+            torch.nn.functional.prelu(torch.from_numpy(x), torch.from_numpy(wc)).numpy(), rtol=1e-5)
+
+    def test_cosine_similarity(self):
+        m = rng.standard_normal((3, 5)).astype(np.float32)
+        np.testing.assert_allclose(
+            run(lambda a, b: ltorch.cosine_similarity(a, b, dim=1), m, m + 0.5),
+            torch.nn.functional.cosine_similarity(torch.from_numpy(m), torch.from_numpy(m) + 0.5, dim=1).numpy(),
+            rtol=1e-5)
+
+
+class TestReviewRegressions:
+    """Round-2 code-review findings."""
+
+    x = rng.standard_normal((3, 4)).astype(np.float32)
+    tx = torch.from_numpy(x)
+
+    def test_tile_pads_short_reps(self):
+        # torch.tile left-pads reps with 1s; Tensor.repeat does not
+        got = run(lambda t: ltorch.tile(t, (2,)), self.x)
+        np.testing.assert_allclose(got, torch.tile(self.tx, (2,)).numpy())
+
+    def test_repeat_rejects_short_reps(self):
+        with pytest.raises(Exception, match="repeat"):
+            run(lambda t: ltorch.repeat(t, (2,)), self.x)
+
+    def test_diag_keyword_form(self):
+        got = run(lambda t: ltorch.diag(t, diagonal=1), self.x)
+        np.testing.assert_allclose(got, torch.diag(self.tx, diagonal=1).numpy())
+
+    def test_bool_list_index_rejected(self):
+        with pytest.raises(Exception, match="boolean mask"):
+            run(lambda t: t[[True, False, True]], self.x)
+
+
+class TestInt64Canonicalization:
+    def test_torch_int64_input(self):
+        # torch int64 crosses the host boundary as jax int32 (x64 off); the
+        # prologue guard must describe the canonical dtype, not the container's
+        t = torch.arange(6)
+        assert t.dtype == torch.int64
+        got = run(lambda x: ltorch.add(x, 1), t)
+        np.testing.assert_array_equal(got, np.arange(1, 7, dtype=np.int32))
+
+    def test_numpy_int64_input(self):
+        got = run(lambda x: ltorch.add(x, 1), np.arange(6, dtype=np.int64))
+        np.testing.assert_array_equal(got, np.arange(1, 7))

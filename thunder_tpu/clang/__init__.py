@@ -679,13 +679,70 @@ def getitem(a: TensorProxy, key) -> TensorProxy:
         taken = prims.take(a, flat, 0)
         return reshape(taken, tuple(key.shape) + tuple(a.shape[1:]))
     if isinstance(key, list):
-        raise NotImplementedError("list indexing is not supported yet; pass a tensor index instead")
+        # fancy list index along dim 0: a[[2, 0, 1]].  Small static lists
+        # decompose to a cat of unit slices (stays fully static for XLA)
+        if any(isinstance(k, bool) for k in key):
+            raise NotImplementedError("boolean mask indexing produces dynamic shapes; use where/masked ops")
+        check(all(isinstance(k, int) for k in key), lambda: "list indexing requires a list of ints")
+        check(len(key) > 0, lambda: "empty list index is not supported")
+        parts = []
+        for i in key:
+            if i < 0:
+                i += a.shape[0]
+            check(0 <= i < a.shape[0], lambda: f"list index {i} out of range for dim of size {a.shape[0]}")
+            parts.append(slice_in_dim(a, i, i + 1, dim=0))
+        return cat(parts, 0) if len(parts) > 1 else parts[0]
     if isinstance(key, tuple) and any(isinstance(k, TensorProxy) for k in key):
-        # single tensor index among slices: handle common case (t, at dim 0)
-        if isinstance(key[0], TensorProxy) and all(k == slice(None) for k in key[1:]):
-            return getitem(a, key[0])
-        raise NotImplementedError("mixed advanced indexing is not supported yet")
+        return _mixed_advanced_index(a, key)
     return _basic_index(a, key)
+
+
+def _mixed_advanced_index(a: TensorProxy, key: tuple) -> TensorProxy:
+    """Advanced indexing with integer tensors mixed with full slices
+    (reference: ``thunder/clang/__init__.py`` _advanced_indexing).  Supported:
+    a *contiguous* run of integer-tensor indices, full slices elsewhere —
+    ``a[i]``, ``a[:, i]``, ``a[i, j]``, ``a[:, i, j, :]`` — with NumPy result
+    placement (broadcast index dims replace the indexed dims in place).
+    Lowering: merge the indexed dims, fold the indices into one flat index,
+    one ``take`` — a single XLA gather."""
+    nkey = list(key) + [slice(None)] * (a.ndim - len(key))
+    check(len(nkey) == a.ndim, lambda: f"too many indices for {a.ndim}D tensor")
+    tensor_pos = [i for i, k in enumerate(nkey) if isinstance(k, TensorProxy)]
+    ok_layout = all(isinstance(k, TensorProxy) or k == slice(None) for k in nkey) and tensor_pos == list(
+        range(tensor_pos[0], tensor_pos[0] + len(tensor_pos))
+    )
+    if not ok_layout:
+        raise NotImplementedError(
+            "mixed advanced indexing supports one contiguous run of integer tensor "
+            "indices with full slices elsewhere; rewrite other patterns with take/gather"
+        )
+    for p in tensor_pos:
+        check(not dtypes.is_boolean_dtype(nkey[p].dtype), lambda: "boolean mask indexing produces dynamic shapes")
+    start, n = tensor_pos[0], len(tensor_pos)
+    idxs = [nkey[p] for p in tensor_pos]
+    bshape = compute_broadcast_shape(*(i.shape for i in idxs))
+    sizes = a.shape[start : start + n]
+    # fold the (broadcast) indices into one flat row-major index
+    flat_idx = None
+    for i, (ix, size) in enumerate(zip(idxs, sizes)):
+        ix = maybe_convert_to_dtype(ix, dtypes.int32)
+        # wrap negatives (numpy/torch semantics)
+        ix = where(lt(ix, 0), add(ix, size), ix)
+        ix = expand(reshape(ix, (1,) * (len(bshape) - ix.ndim) + tuple(ix.shape)), bshape) if tuple(ix.shape) != tuple(bshape) else ix
+        flat_idx = ix if flat_idx is None else add(mul(flat_idx, size), ix)
+    merged = 1
+    for s in sizes:
+        merged *= s
+    am = reshape(a, tuple(a.shape[:start]) + (merged,) + tuple(a.shape[start + n :]))
+    if flat_idx.ndim == 0:
+        flat1d = reshape(flat_idx, (1,))
+    elif flat_idx.ndim == 1:
+        flat1d = flat_idx
+    else:
+        flat1d = reshape(flat_idx, (flat_idx.numel,))
+    taken = prims.take(am, flat1d, start)
+    out_shape = tuple(a.shape[:start]) + tuple(bshape) + tuple(a.shape[start + n :])
+    return reshape(taken, out_shape)
 
 
 @clangop()

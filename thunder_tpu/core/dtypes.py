@@ -64,6 +64,7 @@ __all__ = [
     "corresponding_complex_dtype",
     "to_jax_dtype",
     "from_jax_dtype",
+    "canonicalize_dtype",
     "to_torch_dtype",
     "from_torch_dtype",
     "default_float_dtype",

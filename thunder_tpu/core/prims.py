@@ -203,6 +203,14 @@ class PrimIDs(Enum):
     # fused cross-entropy (analog of the reference's apex/triton CE executors,
     # apex_entropyex.py:15, triton_crossentropy_impl.py:18)
     CROSS_ENTROPY_FWD = auto()
+    # einsum stays one prim so XLA lowers it straight to dot_general
+    # (the reference decomposes via opt_einsum, torch/__init__.py einsum)
+    EINSUM = auto()
+    # windowed reduction: the pooling prim (torch max_pool/avg_pool lower
+    # here; XLA has a native ReduceWindow the MXU-adjacent VPU executes)
+    REDUCE_WINDOW = auto()
+    # spatial resize (torch nn.functional.interpolate linear modes)
+    RESIZE = auto()
 
 
 #
@@ -1088,6 +1096,73 @@ cross_entropy_fwd = make_prim(
 )
 
 
+def _einsum_meta(spec: str, *operands: TensorProxy) -> TensorProxy:
+    """Einstein summation (reference: ``thunder/torch/__init__.py`` einsum via
+    opt_einsum).  Kept as one prim so XLA lowers it directly to dot_general
+    chains on the MXU; shape/dtype come from jax.eval_shape (abstract, no
+    compute)."""
+    import jax
+    import jax.numpy as jnp
+
+    check(isinstance(spec, str), lambda: f"einsum spec must be a string, got {type(spec)}")
+    check(len(operands) > 0, lambda: "einsum needs at least one operand")
+    for o in operands:
+        _check_tensor(o)
+    utils.check_same_device(*operands, name="einsum")
+    structs = [jax.ShapeDtypeStruct(tuple(o.shape), dtypes.to_jax_dtype(o.dtype)) for o in operands]
+    out = jax.eval_shape(lambda *xs: jnp.einsum(spec, *xs), *structs)
+    rg = any(o.requires_grad for o in operands) and dtypes.is_inexact_dtype(operands[0].dtype)
+    return TensorProxy(
+        shape=tuple(out.shape),
+        device=operands[0].device,
+        dtype=dtypes.from_jax_dtype(out.dtype),
+        requires_grad=rg,
+    )
+
+
+einsum = make_prim(PrimIDs.EINSUM, "einsum", meta=_einsum_meta, tags=(OpTags.MATMUL_OP,))
+
+
+def _reduce_window_meta(
+    a: TensorProxy,
+    kind: str,
+    window: Sequence[int],
+    strides: Sequence[int],
+    padding: Sequence[tuple[int, int]],
+) -> TensorProxy:
+    """Windowed reduction over the trailing ``len(window)`` dims of ``a``
+    (XLA ReduceWindow; the pooling building block — reference pools live in
+    ``thunder/torch/__init__.py`` max_pool/avg_pool)."""
+    _check_tensor(a)
+    check(kind in ("max", "add"), lambda: f"reduce_window: unknown kind {kind!r}")
+    n = len(window)
+    check(n <= a.ndim, lambda: f"reduce_window: window rank {n} exceeds input rank {a.ndim}")
+    check(len(strides) == n and len(padding) == n, lambda: "reduce_window: window/strides/padding rank mismatch")
+    lead = a.shape[: a.ndim - n]
+    spatial = []
+    for i in range(n):
+        size = a.shape[a.ndim - n + i] + padding[i][0] + padding[i][1]
+        check(size >= window[i], lambda: f"reduce_window: window {window[i]} larger than padded dim {size}")
+        spatial.append((size - window[i]) // strides[i] + 1)
+    return _out_like(a, shape=tuple(lead) + tuple(spatial))
+
+
+reduce_window = make_prim(PrimIDs.REDUCE_WINDOW, "reduce_window", meta=_reduce_window_meta, tags=(OpTags.REDUCTION_OP,))
+
+
+def _resize_meta(a: TensorProxy, shape: Sequence[int], method: str) -> TensorProxy:
+    """Spatial resize to ``shape`` (jax.image.resize semantics, half-pixel
+    centers — matches torch interpolate align_corners=False)."""
+    _check_tensor(a)
+    check(len(shape) == a.ndim, lambda: f"resize: shape rank {len(shape)} != input rank {a.ndim}")
+    check(method in ("nearest", "linear", "bilinear", "trilinear", "cubic", "bicubic"), lambda: f"resize: unknown method {method!r}")
+    check(dtypes.is_inexact_dtype(a.dtype), lambda: "resize requires a floating-point input")
+    return _out_like(a, shape=tuple(shape))
+
+
+resize = make_prim(PrimIDs.RESIZE, "resize", meta=_resize_meta)
+
+
 #
 # Utility prims
 #
@@ -1232,7 +1307,15 @@ unpack_flatten = make_prim(
 
 def _unpack_getitem_impl(coll, key):
     x = coll[key]
-    # torch/numpy tensors cross into jax here (host boundary)
+    # torch/numpy tensors cross into jax here (host boundary); jnp.asarray
+    # canonicalizes 64-bit dtypes so the value matches the proxy's
+    # (canonicalize_dtype'd) metadata and the guard that checks it
+    import numpy as np
+
+    if isinstance(x, np.ndarray):
+        import jax.numpy as jnp
+
+        return jnp.asarray(x)
     try:
         import torch
 

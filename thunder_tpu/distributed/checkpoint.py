@@ -77,6 +77,16 @@ def save_checkpoint(
     options = options or StateDictOptions()
     where = _ckpt_dir(path, step)
     if options.full_state_dict:
+        if jax.process_count() > 1:
+            # orbax save is collective (it ends in a cross-host barrier), so a
+            # rank-0-early-return would deadlock process 0; and device_get of a
+            # non-fully-addressable sharded array raises.  Multi-host full
+            # gathers belong to the sharded path + post-hoc consolidation.
+            raise NotImplementedError(
+                "full_state_dict/rank0_only saves are single-host only; use the "
+                "default sharded save on multi-host meshes (every host writes "
+                "exactly its own shards) and consolidate offline if needed"
+            )
         state = full_state_dict(state, rank0_only=options.rank0_only)
         if options.rank0_only and jax.process_index() != 0:
             return where

@@ -422,6 +422,32 @@ def _one_hot_impl(indices, num_classes):
     return jax.nn.one_hot(indices, int(num_classes), dtype=jnp.int32)
 
 
+@impl(PrimIDs.EINSUM)
+def _einsum_impl(spec, *operands):
+    return jnp.einsum(spec, *operands)
+
+
+@impl(PrimIDs.REDUCE_WINDOW)
+def _reduce_window_impl(a, kind, window, strides, padding):
+    n = len(window)
+    lead = a.ndim - n
+    window_dims = (1,) * lead + tuple(int(w) for w in window)
+    window_strides = (1,) * lead + tuple(int(s) for s in strides)
+    pads = [(0, 0)] * lead + [(int(lo), int(hi)) for lo, hi in padding]
+    # plain-scalar inits keep lax on the monoid (reduce_window_max/sum) path,
+    # which is the differentiable one
+    if kind == "max":
+        init = -float("inf") if jnp.issubdtype(a.dtype, jnp.floating) else int(jnp.iinfo(a.dtype).min)
+        return jax.lax.reduce_window(a, init, jax.lax.max, window_dims, window_strides, pads)
+    return jax.lax.reduce_window(a, 0 if jnp.issubdtype(a.dtype, jnp.integer) else 0.0, jax.lax.add, window_dims, window_strides, pads)
+
+
+@impl(PrimIDs.RESIZE)
+def _resize_impl(a, shape, method):
+    _method = {"bilinear": "linear", "trilinear": "linear", "bicubic": "cubic"}.get(method, method)
+    return jax.image.resize(a, tuple(int(s) for s in shape), method=_method, antialias=False)
+
+
 @impl(PrimIDs.CONVOLUTION)
 def _convolution_impl(a, weight, bias, stride, padding, dilation, transposed, output_padding, groups):
     ndim = a.ndim - 2

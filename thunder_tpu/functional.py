@@ -65,7 +65,13 @@ def proxy_leaf(x: Any, trace: TraceCtx):
     return x
 
 
-def _dtype_str(x) -> str:
+def _dtype_str(x, proxy=None) -> str:
+    # Guard on the *canonical* dtype (the proxy's — TensorProxy construction
+    # runs dtypes.canonicalize_dtype): torch/numpy int64 inputs cross the
+    # unpack boundary as jax int32 under default x64-disabled, and the check
+    # prim sees the converted value, not the user's container.
+    if proxy is not None:
+        return str(np.dtype(dtypes.to_jax_dtype(proxy.dtype)))
     if isinstance(x, (jax.Array, np.ndarray)):
         return str(np.dtype(x.dtype))
     import torch
@@ -172,7 +178,7 @@ def trace_from_fn(fn: Callable, args: tuple, kwargs: dict, *, grad_argnums: tupl
                         leaf_p,
                         tuple(cproxy.shape),
                         cproxy.device.device_str(),
-                        _dtype_str(leaf),
+                        _dtype_str(leaf, cproxy),
                         bool(getattr(leaf, "requires_grad", False)),
                     )
                 elif isinstance(cproxy, NumberProxy):

@@ -12,6 +12,7 @@ against torch run under thunder_tpu tracing without a bytecode interpreter.
 """
 from __future__ import annotations
 
+import builtins
 import math
 import sys
 from numbers import Number
@@ -783,7 +784,7 @@ def gelu(a, approximate: str = "none"):
 
 
 @torchsymbol(_tfn("softmax"), _tfn("nn", "functional", "softmax"), is_method=True)
-def softmax(a, dim=-1, *, dtype=None):
+def softmax(a, dim=-1, *, dtype=None, _stacklevel=3):
     dim = utils.canonicalize_dim(a.ndim, dim)
     computation_dtype = _to_thunder_dtype(dtype) or (dtypes.float32 if dtypes.is_low_precision_dtype(a.dtype) else a.dtype)
     a_ = clang.maybe_convert_to_dtype(a, computation_dtype)
@@ -797,7 +798,7 @@ def softmax(a, dim=-1, *, dtype=None):
 
 
 @torchsymbol(_tfn("log_softmax"), _tfn("nn", "functional", "log_softmax"), is_method=True)
-def log_softmax(a, dim=-1, *, dtype=None):
+def log_softmax(a, dim=-1, *, dtype=None, _stacklevel=3):
     dim = utils.canonicalize_dim(a.ndim, dim)
     computation_dtype = _to_thunder_dtype(dtype) or (dtypes.float32 if dtypes.is_low_precision_dtype(a.dtype) else a.dtype)
     a_ = clang.maybe_convert_to_dtype(a, computation_dtype)
@@ -956,35 +957,42 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
 
 @torchsymbol(_tfn("nn", "functional", "nll_loss"))
 def nll_loss(log_probs, target, weight=None, size_average=None, ignore_index=-100, reduce=None, reduction="mean"):
-    check(weight is None, lambda: "nll_loss weight is not supported yet")
     check(size_average is None and reduce is None, lambda: "legacy size_average/reduce are not supported; use reduction=")
     C = log_probs.shape[-1]
     flat_logp = clang.reshape(log_probs, (-1, C))
     flat_t = clang.reshape(target, (-1,))
     safe_t = clang.where(clang.eq(flat_t, ignore_index), 0, flat_t)
-    idx = clang.reshape(clang.maybe_convert_to_dtype(safe_t, dtypes.int32), (-1, 1))
+    safe_t = clang.maybe_convert_to_dtype(safe_t, dtypes.int32)
+    idx = clang.reshape(safe_t, (-1, 1))
     picked = clang.take_along_axis(flat_logp, idx, 1)
     picked = clang.reshape(picked, (-1,))
     losses = clang.neg(picked)
     valid = clang.ne(flat_t, ignore_index)
+    if weight is not None:
+        # torch: per-sample loss scaled by weight[target]; mean divides by the
+        # summed weights of the non-ignored samples
+        w = clang.take(weight, safe_t, 0)
+        losses = clang.mul(losses, w)
+        norm = clang.where(valid, w, 0.0)
+    else:
+        norm = clang.maybe_convert_to_dtype(valid, losses.dtype)
     losses = clang.where(valid, losses, 0.0)
     if reduction == "none":
         return clang.reshape(losses, target.shape)
     total = clang.sum(losses, None, False)
     if reduction == "sum":
         return total
-    n_valid = clang.sum(clang.maybe_convert_to_dtype(valid, losses.dtype), None, False)
-    return clang.true_divide(total, clang.maximum(n_valid, 1.0))
+    return clang.true_divide(total, clang.maximum(clang.sum(norm, None, False), 1e-12))
 
 
 @torchsymbol(_tfn("nn", "functional", "cross_entropy"))
 def cross_entropy(logits, target, weight=None, size_average=None, ignore_index=-100, reduce=None, reduction="mean", label_smoothing=0.0):
-    check(label_smoothing == 0.0, lambda: "label_smoothing is not supported yet")
     check(size_average is None and reduce is None, lambda: "legacy size_average/reduce are not supported; use reduction=")
     # fast path: fused row-wise CE prim (no (N, C) log-prob residual saved for
     # backward).  Class-index targets with the standard 2D/1D layouts only
     if (
         weight is None
+        and label_smoothing == 0.0
         and reduction in ("mean", "sum", "none")
         and logits.ndim == 2
         and target.ndim == 1
@@ -1013,7 +1021,29 @@ def cross_entropy(logits, target, weight=None, size_average=None, ignore_index=-
         logp = clang.permute(logp, perm)
     else:
         logp = log_softmax(logits, dim)
-    return nll_loss(logp, target, weight, ignore_index=ignore_index, reduction=reduction)
+    nll = nll_loss(logp, target, weight, ignore_index=ignore_index, reduction=reduction)
+    if label_smoothing == 0.0:
+        return nll
+    # label smoothing (torch aten cross_entropy_loss_label_smoothing):
+    # smooth_i = -sum_c w_c * logp[i, c]; final = (1-ls)*nll + ls/C * smooth
+    C = logp.shape[-1]
+    wl = clang.mul(logp, clang.reshape(weight, (1,) * (logp.ndim - 1) + (C,))) if weight is not None else logp
+    smooth = clang.neg(clang.sum(wl, -1, False))
+    flat_t = clang.reshape(target, (-1,))
+    valid = clang.ne(flat_t, ignore_index)
+    smooth = clang.where(clang.reshape(valid, smooth.shape), smooth, 0.0)
+    if reduction == "sum":
+        smooth_ret = clang.sum(smooth, None, False)
+    elif reduction == "mean":
+        if weight is not None:
+            safe_t = clang.maybe_convert_to_dtype(clang.where(valid, flat_t, 0), dtypes.int32)
+            norm = clang.where(valid, clang.take(weight, safe_t, 0), 0.0)
+        else:
+            norm = clang.maybe_convert_to_dtype(valid, smooth.dtype)
+        smooth_ret = clang.true_divide(clang.sum(smooth, None, False), clang.maximum(clang.sum(norm, None, False), 1e-12))
+    else:
+        smooth_ret = smooth
+    return clang.add(clang.mul(nll, 1.0 - label_smoothing), clang.mul(smooth_ret, label_smoothing / C))
 
 
 @torchsymbol(_tfn("nn", "functional", "mse_loss"))
@@ -1025,6 +1055,108 @@ def mse_loss(a, b, reduction="mean"):
     if reduction == "sum":
         return clang.sum(sq, None, False)
     return clang.mean(sq, None, False)
+
+
+@torchsymbol(_tfn("nn", "functional", "l1_loss"))
+def l1_loss(a, b, reduction="mean"):
+    d = clang.abs(clang.sub(a, b))
+    if reduction == "none":
+        return d
+    if reduction == "sum":
+        return clang.sum(d, None, False)
+    return clang.mean(d, None, False)
+
+
+def _smooth_l1(a, b, beta):
+    d = clang.sub(a, b)
+    ad = clang.abs(d)
+    quad = clang.true_divide(clang.mul(clang.mul(d, d), 0.5), beta)
+    lin = clang.sub(ad, 0.5 * beta)
+    return clang.where(clang.lt(ad, beta), quad, lin)
+
+
+@torchsymbol(_tfn("nn", "functional", "smooth_l1_loss"))
+def smooth_l1_loss(a, b, reduction="mean", beta=1.0):
+    if beta == 0.0:
+        return l1_loss(a, b, reduction)
+    out = _smooth_l1(a, b, beta)
+    if reduction == "none":
+        return out
+    if reduction == "sum":
+        return clang.sum(out, None, False)
+    return clang.mean(out, None, False)
+
+
+@torchsymbol(_tfn("nn", "functional", "huber_loss"))
+def huber_loss(a, b, reduction="mean", delta=1.0):
+    # huber = delta * smooth_l1(beta=delta)
+    out = clang.mul(_smooth_l1(a, b, delta), delta)
+    if reduction == "none":
+        return out
+    if reduction == "sum":
+        return clang.sum(out, None, False)
+    return clang.mean(out, None, False)
+
+
+@torchsymbol(_tfn("nn", "functional", "binary_cross_entropy"))
+def binary_cross_entropy(a, target, weight=None, size_average=None, reduce=None, reduction="mean"):
+    check(size_average is None and reduce is None, lambda: "legacy size_average/reduce are not supported; use reduction=")
+    # torch clamps each log term at -100
+    log_a = clang.maximum(clang.log(a), -100.0)
+    log_1ma = clang.maximum(clang.log(clang.sub(1.0, a)), -100.0)
+    out = clang.neg(clang.add(clang.mul(target, log_a), clang.mul(clang.sub(1.0, target), log_1ma)))
+    if weight is not None:
+        out = clang.mul(out, weight)
+    if reduction == "none":
+        return out
+    if reduction == "sum":
+        return clang.sum(out, None, False)
+    return clang.mean(out, None, False)
+
+
+@torchsymbol(_tfn("nn", "functional", "binary_cross_entropy_with_logits"))
+def binary_cross_entropy_with_logits(a, target, weight=None, size_average=None, reduce=None, reduction="mean", pos_weight=None):
+    check(size_average is None and reduce is None, lambda: "legacy size_average/reduce are not supported; use reduction=")
+    # stable: max(x,0) - x*t + log1p(exp(-|x|)); pos_weight scales the t term
+    softplus_nabs = clang.log1p(clang.exp(clang.neg(clang.abs(a))))
+    if pos_weight is not None:
+        # torch aten: loss = (1-t)·x + lw·(log1p(exp(-|x|)) + max(-x, 0)),
+        # lw = 1 + (pos_weight - 1)·t
+        log_w = clang.add(clang.mul(clang.sub(pos_weight, 1.0), target), 1.0)
+        out = clang.add(
+            clang.mul(clang.sub(1.0, target), a),
+            clang.mul(log_w, clang.add(softplus_nabs, clang.maximum(clang.neg(a), 0.0))),
+        )
+    else:
+        out = clang.add(clang.sub(clang.maximum(a, 0.0), clang.mul(a, target)), softplus_nabs)
+    if weight is not None:
+        out = clang.mul(out, weight)
+    if reduction == "none":
+        return out
+    if reduction == "sum":
+        return clang.sum(out, None, False)
+    return clang.mean(out, None, False)
+
+
+@torchsymbol(_tfn("nn", "functional", "kl_div"))
+def kl_div(a, target, size_average=None, reduce=None, reduction="mean", log_target=False):
+    check(size_average is None and reduce is None, lambda: "legacy size_average/reduce are not supported; use reduction=")
+    if log_target:
+        out = clang.mul(clang.exp(target), clang.sub(target, a))
+    else:
+        # torch zeroes the contribution where target == 0 (0·log0 := 0)
+        safe = clang.where(clang.gt(target, 0), target, 1.0)
+        out = clang.where(
+            clang.gt(target, 0), clang.mul(target, clang.sub(clang.log(safe), a)), 0.0
+        )
+    if reduction == "none":
+        return out
+    total = clang.sum(out, None, False)
+    if reduction == "sum":
+        return total
+    if reduction == "batchmean":
+        return clang.true_divide(total, a.shape[0])
+    return clang.mean(out, None, False)
 
 
 @torchsymbol(_tfn("nn", "functional", "pad"))
@@ -1123,6 +1255,285 @@ def hardtanh(a, min_val=-1.0, max_val=1.0, inplace=False):
 @torchsymbol(_tfn("nn", "functional", "logsigmoid"))
 def logsigmoid(a):
     return clang.neg(softplus(clang.neg(a)))
+
+
+@torchsymbol(_tfn("nn", "functional", "softmin"))
+def softmin(a, dim=-1, *, dtype=None, _stacklevel=3):
+    return softmax(clang.neg(a), dim, dtype=dtype)
+
+
+@torchsymbol(_tfn("nn", "functional", "softshrink"))
+def softshrink(a, lambd=0.5):
+    return clang.where(
+        clang.gt(a, lambd), clang.sub(a, lambd), clang.where(clang.lt(a, -lambd), clang.add(a, lambd), 0.0)
+    )
+
+
+@torchsymbol(_tfn("nn", "functional", "hardshrink"))
+def hardshrink(a, lambd=0.5):
+    return clang.where(clang.gt(clang.abs(a), lambd), a, 0.0)
+
+
+@torchsymbol(_tfn("nn", "functional", "threshold"))
+def threshold(a, threshold, value, inplace=False):
+    return clang.where(clang.gt(a, threshold), a, value)
+
+
+@torchsymbol(_tfn("nn", "functional", "prelu"))
+def prelu(a, weight):
+    if weight.numel != 1:
+        check(a.ndim >= 2, lambda: "prelu: per-channel weight needs a channel dim")
+        check(weight.numel == a.shape[1], lambda: f"prelu: weight numel {weight.numel} != channels {a.shape[1]}")
+        w = clang.reshape(weight, (1, weight.numel) + (1,) * (a.ndim - 2))
+    else:
+        w = clang.reshape(weight, (1,) * a.ndim)
+    return clang.where(clang.ge(a, 0), a, clang.mul(w, a))
+
+
+@torchsymbol(_tfn("nn", "functional", "cosine_similarity"))
+def cosine_similarity(x1, x2, dim=1, eps=1e-8):
+    dot = clang.sum(clang.mul(x1, x2), dim, False)
+    n1 = clang.sqrt(clang.sum(clang.mul(x1, x1), dim, False))
+    n2 = clang.sqrt(clang.sum(clang.mul(x2, x2), dim, False))
+    return clang.true_divide(dot, clang.maximum(clang.mul(n1, n2), eps))
+
+
+#
+# einsum / extra linalg (reference: thunder/torch/__init__.py einsum via opt_einsum;
+# here a single EINSUM prim lowers straight to XLA dot_general on the MXU)
+#
+
+
+@torchsymbol(_tfn("einsum"))
+def einsum(equation, *operands):
+    if len(operands) == 1 and isinstance(operands[0], (tuple, list)):
+        operands = tuple(operands[0])
+    check(isinstance(equation, str), lambda: "einsum: only the string-equation form is supported")
+    return prims.einsum(equation, *operands)
+
+
+@torchsymbol(_tfn("mv"), is_method=True)
+def mv(a, b):
+    check(a.ndim == 2 and b.ndim == 1, lambda: f"mv: expected (n,m) @ (m,), got {a.shape} @ {b.shape}")
+    return clang.matmul(a, b)
+
+
+@torchsymbol(_tfn("dot"), is_method=True)
+def dot(a, b):
+    check(a.ndim == 1 and b.ndim == 1, lambda: f"dot: expected 1D tensors, got {a.shape} and {b.shape}")
+    return clang.sum(clang.mul(a, b), None, False)
+
+
+@torchsymbol(_tfn("vdot"))
+def vdot(a, b):
+    return dot(a, b)
+
+
+@torchsymbol(_tfn("baddbmm"), is_method=True)
+def baddbmm(input, batch1, batch2, *, beta=1, alpha=1):
+    out = clang.matmul(batch1, batch2)
+    if alpha != 1:
+        out = clang.mul(out, alpha)
+    if beta == 0:
+        return out
+    return clang.add(out, clang.mul(input, beta) if beta != 1 else input)
+
+
+@torchsymbol(_tfn("unbind"), is_method=True)
+def unbind(a, dim=0):
+    dim = utils.canonicalize_dim(a.ndim, dim)
+    return tuple(clang.squeeze(clang.slice_in_dim(a, i, i + 1, dim=dim), (dim,)) for i in range(a.shape[dim]))
+
+
+@torchsymbol(_tfn("diagonal"), is_method=True)
+def diagonal(a, offset=0, dim1=0, dim2=1):
+    dim1 = utils.canonicalize_dim(a.ndim, dim1)
+    dim2 = utils.canonicalize_dim(a.ndim, dim2)
+    check(a.ndim == 2 and (dim1, dim2) == (0, 1), lambda: "diagonal: only 2D (dim1=0, dim2=1) is supported yet")
+    rows, cols = a.shape
+    if offset >= 0:
+        length = builtins.min(rows, cols - offset)
+        start = offset
+    else:
+        length = builtins.min(rows + offset, cols)
+        start = -offset * cols
+    check(length > 0, lambda: f"diagonal: offset {offset} out of range for shape {a.shape}")
+    flat = clang.reshape(a, (rows * cols,))
+    idx = clang.arange(start, start + length * (cols + 1), cols + 1, device=a.device, dtype=dtypes.int32)
+    return clang.take(flat, idx, 0)
+
+
+_diagonal_op = diagonal
+
+
+@torchsymbol(_tfn("diag"), is_method=True)
+def diag(a, diagonal=0):
+    check(a.ndim in (1, 2), lambda: f"diag: expected 1D or 2D, got {a.ndim}D")
+    if a.ndim == 2:
+        return _diagonal_op(a, diagonal)
+    n = a.shape[0] + builtins.abs(diagonal)
+    flat = zeros(n * n, device=a.device, dtype=a.dtype)
+    start = diagonal if diagonal >= 0 else -diagonal * n
+    idx = clang.arange(start, start + a.shape[0] * (n + 1), n + 1, device=a.device, dtype=dtypes.int32)
+    flat = clang.index_put(flat, (idx,), a, False)
+    return clang.reshape(flat, (n, n))
+
+
+def _tile_impl(a, reps):
+    shape = (1,) * (len(reps) - a.ndim) + tuple(a.shape)
+    out = clang.reshape(a, shape)
+    # (s0, s1, ...) tiled by (r0, r1, ...): expand to (r0, s0, r1, s1, ...) then merge pairs
+    inter = []
+    target = []
+    final = []
+    for r, s in zip(reps, shape):
+        inter.extend([1, s])
+        target.extend([r, s])
+        final.append(r * s)
+    out = clang.reshape(out, tuple(inter))
+    out = clang.broadcast_in_dim(out, tuple(target), tuple(range(len(target))))
+    return clang.reshape(out, tuple(final))
+
+
+@torchsymbol(_tfn("tile"), is_method=True)
+def tile(a, *reps):
+    if len(reps) == 1 and isinstance(reps[0], (tuple, list)):
+        reps = tuple(reps[0])
+    # torch.tile left-pads reps with 1s when shorter than ndim
+    if len(reps) < a.ndim:
+        reps = (1,) * (a.ndim - len(reps)) + tuple(reps)
+    return _tile_impl(a, tuple(reps))
+
+
+@torchsymbol(method_name="repeat")
+def repeat(a, *reps):
+    if len(reps) == 1 and isinstance(reps[0], (tuple, list)):
+        reps = tuple(reps[0])
+    check(len(reps) >= a.ndim, lambda: f"repeat: needs at least {a.ndim} repeat dims, got {len(reps)}")
+    return _tile_impl(a, tuple(reps))
+
+
+#
+# Pooling (REDUCE_WINDOW prim → XLA ReduceWindow; reference max_pool/avg_pool
+# live in thunder/torch/__init__.py)
+#
+
+
+def _pool_args(n, kernel_size, stride, padding):
+    k = (kernel_size,) * n if isinstance(kernel_size, int) else tuple(kernel_size)
+    s = k if stride is None or stride == [] else ((stride,) * n if isinstance(stride, int) else tuple(stride))
+    p = (padding,) * n if isinstance(padding, int) else tuple(padding)
+    check(len(k) == n and len(s) == n and len(p) == n, lambda: "pool: kernel/stride/padding rank mismatch")
+    for pi, ki in zip(p, k):
+        check(pi <= ki // 2, lambda: f"pool: padding {pi} must be at most half the kernel {ki}")
+    return k, s, tuple((pi, pi) for pi in p)
+
+
+def _max_poolnd(a, n, kernel_size, stride, padding, dilation, ceil_mode, return_indices):
+    check(dilation in (1, (1,) * n, [1] * n), lambda: "max_pool: dilation is not supported yet")
+    check(not ceil_mode, lambda: "max_pool: ceil_mode is not supported yet")
+    check(not return_indices, lambda: "max_pool: return_indices is not supported yet")
+    k, s, p = _pool_args(n, kernel_size, stride, padding)
+    return prims.reduce_window(a, "max", k, s, p)
+
+
+@torchsymbol(_tfn("nn", "functional", "max_pool1d"))
+def max_pool1d(a, kernel_size, stride=None, padding=0, dilation=1, ceil_mode=False, return_indices=False):
+    return _max_poolnd(a, 1, kernel_size, stride, padding, dilation, ceil_mode, return_indices)
+
+
+@torchsymbol(_tfn("nn", "functional", "max_pool2d"))
+def max_pool2d(a, kernel_size, stride=None, padding=0, dilation=1, ceil_mode=False, return_indices=False):
+    return _max_poolnd(a, 2, kernel_size, stride, padding, dilation, ceil_mode, return_indices)
+
+
+@torchsymbol(_tfn("nn", "functional", "max_pool3d"))
+def max_pool3d(a, kernel_size, stride=None, padding=0, dilation=1, ceil_mode=False, return_indices=False):
+    return _max_poolnd(a, 3, kernel_size, stride, padding, dilation, ceil_mode, return_indices)
+
+
+def _avg_poolnd(a, n, kernel_size, stride, padding, ceil_mode, count_include_pad, divisor_override):
+    check(not ceil_mode, lambda: "avg_pool: ceil_mode is not supported yet")
+    k, s, p = _pool_args(n, kernel_size, stride, padding)
+    summed = prims.reduce_window(a, "add", k, s, p)
+    if divisor_override is not None:
+        return clang.true_divide(summed, divisor_override)
+    if count_include_pad or all(lo == 0 and hi == 0 for lo, hi in p):
+        div = 1
+        for ki in k:
+            div *= ki
+        return clang.true_divide(summed, div)
+    counts = prims.reduce_window(clang.full_like(a, 1.0), "add", k, s, p)
+    return clang.true_divide(summed, counts)
+
+
+@torchsymbol(_tfn("nn", "functional", "avg_pool1d"))
+def avg_pool1d(a, kernel_size, stride=None, padding=0, ceil_mode=False, count_include_pad=True):
+    return _avg_poolnd(a, 1, kernel_size, stride, padding, ceil_mode, count_include_pad, None)
+
+
+@torchsymbol(_tfn("nn", "functional", "avg_pool2d"))
+def avg_pool2d(a, kernel_size, stride=None, padding=0, ceil_mode=False, count_include_pad=True, divisor_override=None):
+    return _avg_poolnd(a, 2, kernel_size, stride, padding, ceil_mode, count_include_pad, divisor_override)
+
+
+@torchsymbol(_tfn("nn", "functional", "avg_pool3d"))
+def avg_pool3d(a, kernel_size, stride=None, padding=0, ceil_mode=False, count_include_pad=True, divisor_override=None):
+    return _avg_poolnd(a, 3, kernel_size, stride, padding, ceil_mode, count_include_pad, divisor_override)
+
+
+def _adaptive_avg_poolnd(a, n, output_size):
+    out = (output_size,) * n if isinstance(output_size, int) else tuple(output_size)
+    check(len(out) == n, lambda: f"adaptive_avg_pool{n}d: output_size rank mismatch")
+    spatial = a.shape[a.ndim - n :]
+    k = []
+    for i, (inp, o) in enumerate(zip(spatial, out)):
+        check(o >= 1, lambda: "adaptive_avg_pool: output_size must be positive")
+        check(inp % o == 0, lambda: f"adaptive_avg_pool: input {inp} not divisible by output {o} (general case unsupported)")
+        k.append(inp // o)
+    summed = prims.reduce_window(a, "add", tuple(k), tuple(k), ((0, 0),) * n)
+    return clang.true_divide(summed, math.prod(k))
+
+
+@torchsymbol(_tfn("nn", "functional", "adaptive_avg_pool1d"))
+def adaptive_avg_pool1d(a, output_size):
+    return _adaptive_avg_poolnd(a, 1, output_size)
+
+
+@torchsymbol(_tfn("nn", "functional", "adaptive_avg_pool2d"))
+def adaptive_avg_pool2d(a, output_size):
+    return _adaptive_avg_poolnd(a, 2, output_size)
+
+
+@torchsymbol(_tfn("nn", "functional", "interpolate"))
+def interpolate(a, size=None, scale_factor=None, mode="nearest", align_corners=None, recompute_scale_factor=None, antialias=False):
+    """Reference: thunder/torch/__init__.py interpolate.  nearest matches the
+    torch floor-index rule exactly via static gathers; linear modes lower to
+    the RESIZE prim (half-pixel centers == torch align_corners=False)."""
+    check(a.ndim >= 3, lambda: f"interpolate: expected (N, C, spatial...), got {a.ndim}D")
+    check(not antialias, lambda: "interpolate: antialias is not supported yet")
+    n = a.ndim - 2
+    spatial = a.shape[2:]
+    if size is not None:
+        check(scale_factor is None, lambda: "interpolate: size and scale_factor are mutually exclusive")
+        out = (size,) * n if isinstance(size, int) else tuple(size)
+    else:
+        check(scale_factor is not None, lambda: "interpolate: one of size/scale_factor is required")
+        sf = (scale_factor,) * n if isinstance(scale_factor, (int, float)) else tuple(scale_factor)
+        out = tuple(int(s * f) for s, f in zip(spatial, sf))
+    check(len(out) == n, lambda: "interpolate: size rank mismatch")
+    if mode == "nearest":
+        res = a
+        for i, (inp, o) in enumerate(zip(spatial, out)):
+            if o == inp:
+                continue
+            # torch nearest: src = floor(dst * in / out) == (dst * in) // out
+            idx = clang.floor_divide(clang.mul(clang.arange(0, o, device=a.device, dtype=dtypes.int32), inp), o)
+            res = clang.take(res, idx, 2 + i)
+        return res
+    check(align_corners is not True, lambda: "interpolate: align_corners=True is not supported yet")
+    check(mode in ("linear", "bilinear", "trilinear", "bicubic"), lambda: f"interpolate: unknown mode {mode!r}")
+    return prims.resize(a, tuple(a.shape[:2]) + out, mode)
 
 
 #

@@ -125,6 +125,25 @@ class TestInterpolate:
         ref = torch.nn.functional.interpolate(torch.from_numpy(x1), size=24, mode="linear", align_corners=False)
         np.testing.assert_allclose(got, ref.numpy(), rtol=1e-4, atol=1e-5)
 
+    def test_fractional_scale_factor_nearest(self):
+        # torch keeps the user scale (recompute_scale_factor=False):
+        # src = floor(dst / sf), not floor(dst*in/out)
+        x1 = np.arange(9, dtype=np.float32).reshape(1, 1, 9)
+        for sf in (0.4, 0.7, 1.7):
+            got = run(lambda t, s=sf: ltorch.interpolate(t, scale_factor=s, mode="nearest"), x1)
+            ref = torch.nn.functional.interpolate(torch.from_numpy(x1), scale_factor=sf, mode="nearest").numpy()
+            np.testing.assert_allclose(got, ref)
+
+    def test_fractional_scale_factor_linear_gated(self):
+        x1 = np.arange(9, dtype=np.float32).reshape(1, 1, 9)
+        with pytest.raises(Exception, match="recompute_scale_factor"):
+            run(lambda t: ltorch.interpolate(t, scale_factor=0.4, mode="linear"), x1)
+        got = run(lambda t: ltorch.interpolate(t, scale_factor=0.4, mode="linear", recompute_scale_factor=True), x1)
+        ref = torch.nn.functional.interpolate(
+            torch.from_numpy(x1), scale_factor=0.4, mode="linear", recompute_scale_factor=True
+        ).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
     def test_bilinear_grad(self):
         _, g = run_grad(lambda t: ltorch.sum(ltorch.interpolate(t, scale_factor=2.0, mode="bilinear")), self.x)
         txt = torch.tensor(self.x, requires_grad=True)

@@ -1,0 +1,132 @@
+"""Expert-parallel MoE: capacity-based all_to_all dispatch over an ``ep`` axis.
+
+The reference ships MoE models but runs them unsharded (SURVEY §2.6: "MoE
+models run unsharded through the tracer; no expert dispatch/All2All").  This
+module goes beyond that parity point with the TPU-native design the VERDICT
+asked for: GShard/Switch-style expert parallelism under ``jax.shard_map`` —
+
+- tokens live sharded over ``ep`` (the data axis of the dispatch);
+- expert weights are stacked on a leading E dim and sharded over ``ep``
+  (``models/llama.py`` init_params already stacks them);
+- each device routes its local tokens, builds a capacity-limited dispatch
+  tensor, and a **tiled all_to_all over ICI** exchanges token slices so every
+  device computes only its own experts;
+- a second all_to_all returns expert outputs; a combine einsum applies the
+  router weights.
+
+Shapes are fully static (capacity-based, tokens over capacity are dropped —
+the standard TPU MoE contract); routing matches the dense
+``models.llama.moe_mlp`` exactly when nothing drops, which is what the tests
+pin down.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["ep_moe_mlp", "expert_capacity"]
+
+
+def expert_capacity(tokens_per_device: int, n_expert: int, k: int, capacity_factor: float) -> int:
+    """Per-expert, per-source-device slot count (static)."""
+    return max(1, int(math.ceil(tokens_per_device * k / n_expert * capacity_factor)))
+
+
+def _local_moe_dispatch(x, gate_w, fc1, fc2, proj, *, n_expert, k, cap, axis, act_dtype):
+    """Per-device body (runs under shard_map).
+
+    x: (S, C) local tokens; gate_w: (E, C) replicated router;
+    fc1/fc2: (E_loc, I, C), proj: (E_loc, C, I) local expert slices.
+    """
+    S, C = x.shape
+    E = n_expert
+    xf = x.astype(jnp.float32)
+
+    # --- routing (litgpt LLaMAMoE semantics: top-k on raw logits, softmax
+    # over the selected k in f32).  The logits are computed in the activation
+    # dtype so expert *selection* bit-matches the dense models.llama.moe_mlp
+    # path (bf16 logit ties must resolve identically on both paths) ---
+    router = x @ gate_w.T.astype(x.dtype)  # (S, E) in activation dtype
+    top_logits, top_idx = jax.lax.top_k(router, k)  # (S, k)
+    gates = jax.nn.softmax(top_logits.astype(jnp.float32), axis=-1)  # (S, k) f32
+
+    # --- capacity assignment: slot-major priority (slot 0 of every token
+    # beats slot 1), then token order ---
+    dispatch = jnp.zeros((S, E, cap), dtype=jnp.float32)
+    combine = jnp.zeros((S, E, cap), dtype=jnp.float32)
+    counts = jnp.zeros((E,), dtype=jnp.int32)
+    for j in range(k):
+        oh = jax.nn.one_hot(top_idx[:, j], E, dtype=jnp.int32)  # (S, E)
+        pos = counts[None, :] + jnp.cumsum(oh, axis=0) - oh  # (S, E) position if assigned
+        keep = (pos < cap) & (oh > 0)
+        slot = jax.nn.one_hot(jnp.where(keep, pos, cap), cap, dtype=jnp.float32)  # overflow → all-zero row
+        sel = slot * keep[..., None]
+        dispatch = dispatch + sel
+        combine = combine + gates[:, j][:, None, None] * sel
+        counts = counts + jnp.sum(oh * keep, axis=0)
+
+    # --- dispatch: gather token vectors into (E, cap, C) then exchange so
+    # each device holds (E_loc, ep*cap, C) — its experts, everyone's tokens ---
+    d = jnp.einsum("sec,sh->ech", dispatch, xf)  # (E, cap, C)
+    d = jax.lax.all_to_all(d, axis, split_axis=0, concat_axis=1, tiled=True)  # (E_loc, ep*cap, C)
+
+    # --- expert compute: SwiGLU per local expert (static unrolled loop) ---
+    d = d.astype(act_dtype)
+    e_loc = fc1.shape[0]
+    outs = []
+    for e in range(e_loc):
+        h = jax.nn.silu(d[e] @ fc1[e].T) * (d[e] @ fc2[e].T)  # (ep*cap, I)
+        outs.append(h @ proj[e].T)  # (ep*cap, C)
+    o = jnp.stack(outs, axis=0)  # (E_loc, ep*cap, C)
+
+    # --- return + combine ---
+    o = jax.lax.all_to_all(o, axis, split_axis=1, concat_axis=0, tiled=True)  # (E, cap, C)
+    y = jnp.einsum("sec,ech->sh", combine, o.astype(jnp.float32))  # (S, C)
+    return y.astype(x.dtype)
+
+
+def ep_moe_mlp(
+    mp,
+    x,
+    *,
+    mesh: Mesh,
+    n_expert: int,
+    n_expert_per_token: int = 2,
+    axis: str = "ep",
+    capacity_factor: float = 2.0,
+):
+    """Expert-parallel MoE MLP over ``mesh[axis]``.
+
+    ``mp``: the stacked MoE params from ``models.llama.init_params`` —
+    ``gate`` (E, C) replicated, ``fc_1``/``fc_2`` (E, I, C) and ``proj``
+    (E, C, I) sharded on dim 0.  ``x``: (B, T, C) tokens, sharded on dim 0.
+    Returns (B, T, C) with the same sharding as ``x``.
+    """
+    ep = mesh.shape[axis]
+    assert n_expert % ep == 0, f"n_expert {n_expert} must divide over {axis}={ep}"
+    B, T, C = x.shape
+    assert B % ep == 0, f"batch {B} must divide over {axis}={ep}"
+    S_loc = (B // ep) * T
+    cap = expert_capacity(S_loc, n_expert, n_expert_per_token, capacity_factor)
+
+    def body(xb, gate_w, fc1, fc2, proj):
+        S = xb.shape[0] * xb.shape[1]
+        y = _local_moe_dispatch(
+            xb.reshape(S, C), gate_w, fc1, fc2, proj,
+            n_expert=n_expert, k=n_expert_per_token, cap=cap,
+            axis=axis, act_dtype=xb.dtype,
+        )
+        return y.reshape(xb.shape)
+
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis), P(), P(axis), P(axis), P(axis)),
+        out_specs=P(axis),
+        check_vma=False,
+    )
+    return fn(x, mp["gate"], mp["fc_1"], mp["fc_2"], mp["proj"])

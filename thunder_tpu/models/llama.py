@@ -66,6 +66,9 @@ class Config:
     shared_attention_norm: bool = False
     lm_head_bias: bool = False
     tie_embeddings: bool = False
+    # MoE (reference: litgpt LLaMAMoE via tests/litgpt_model.py:98-110)
+    n_expert: int = 0
+    n_expert_per_token: int = 2
 
     def __post_init__(self):
         if self.padded_vocab_size is None:
@@ -79,6 +82,9 @@ class Config:
         assert self.n_head % self.n_query_groups == 0
         if self.intermediate_size is None:
             self.intermediate_size = 4 * self.n_embd
+        if self.mlp_class == "LLaMAMoE":
+            assert self.n_expert > 0, "LLaMAMoE requires n_expert > 0"
+            assert 0 < self.n_expert_per_token <= self.n_expert
 
     @property
     def rope_n_elem(self) -> int:
@@ -133,6 +139,15 @@ configs: list[Config] = [
            intermediate_size=14336),
     Config(name="CodeLlama-2-like", block_size=16384, vocab_size=32016, n_layer=32,
            n_head=32, n_embd=4096, intermediate_size=11008, rope_base=1000000),
+    Config(name="tiny-moe-debug", block_size=128, vocab_size=256, n_layer=2, n_head=4,
+           n_embd=64, n_query_groups=2, intermediate_size=96, mlp_class="LLaMAMoE",
+           n_expert=4, n_expert_per_token=2),
+    Config(name="mixtral-like", block_size=512, vocab_size=500, n_layer=2, n_head=64,
+           n_embd=256, n_query_groups=8, intermediate_size=224, rope_base=1000000,
+           mlp_class="LLaMAMoE", n_expert=8, n_expert_per_token=2),
+    Config(name="Mixtral-8x7B-like", block_size=32768, vocab_size=32000, n_layer=32,
+           n_head=32, n_embd=4096, n_query_groups=8, intermediate_size=14336,
+           rope_base=1000000, mlp_class="LLaMAMoE", n_expert=8, n_expert_per_token=2),
 ]
 name_to_config: dict[str, Config] = {c.name: c for c in configs}
 
@@ -154,7 +169,7 @@ def init_params(config: Config, key: jax.Array | None = None, dtype=jnp.bfloat16
     def dense(key, fan_in, fan_out):
         return (jax.random.normal(key, (fan_out, fan_in), dtype=jnp.float32) * std).astype(dtype)
 
-    n_keys = 2 + config.n_layer * 8
+    n_keys = 2 + config.n_layer * (5 + 3 * max(1, config.n_expert))
     keys = iter(jax.random.split(key, n_keys))
 
     params: dict[str, Any] = {
@@ -178,7 +193,23 @@ def init_params(config: Config, key: jax.Array | None = None, dtype=jnp.bfloat16
         }
         if not config.shared_attention_norm:
             block["norm_2"] = jnp.ones((config.n_embd,), dtype=dtype)
-        if config.mlp_class == "LLaMAMLP":
+        if config.mlp_class == "LLaMAMoE":
+            # experts stacked on a leading E dim: one array per weight kind, so
+            # expert parallelism is a dim-0 sharding and the per-expert slices
+            # stay MXU-shaped matmuls
+            E = config.n_expert
+
+            def stacked(fan_in, fan_out):
+                ws = [dense(next(keys), fan_in, fan_out) for _ in range(E)]
+                return jnp.stack(ws, axis=0)
+
+            block["mlp"] = {
+                "gate": dense(next(keys), config.n_embd, E),
+                "fc_1": stacked(config.n_embd, config.intermediate_size),
+                "fc_2": stacked(config.n_embd, config.intermediate_size),
+                "proj": stacked(config.intermediate_size, config.n_embd),
+            }
+        elif config.mlp_class == "LLaMAMLP":
             block["mlp"] = {
                 "fc_1": dense(next(keys), config.n_embd, config.intermediate_size),
                 "fc_2": dense(next(keys), config.n_embd, config.intermediate_size),
@@ -266,7 +297,36 @@ def attention(ap, x, cos, sin, config: Config):
     return ltorch.linear(y, ap["wo"])
 
 
+def moe_mlp(mp, x, config: Config):
+    """Mixture-of-experts MLP (litgpt LLaMAMoE semantics, reference
+    tests/litgpt_model.py:98-110): top-k on the raw router logits, softmax
+    over the selected k in float32, weighted sum of expert outputs.
+
+    TPU-first dense formulation: every expert runs on every token and the
+    router weight masks the result — static shapes, no scatter, E small.
+    XLA turns the per-expert slices of the stacked (E, ·, ·) weights into
+    plain MXU matmuls; for expert-parallel execution over an ``ep`` mesh
+    axis see ``thunder_tpu.distributed.moe``."""
+    E, k = config.n_expert, config.n_expert_per_token
+    router = ltorch.linear(x, mp["gate"])  # (B, T, E)
+    top_logits, top_idx = ltorch.topk(router, k, -1)  # (B, T, k)
+    probs = ltorch.softmax(ltorch.to(top_logits, ltorch.float32), -1)
+    y = None
+    for e in range(E):
+        # summed routing weight for expert e over the k slots: (B, T)
+        w_e = ltorch.sum(probs * ltorch.to(ltorch.eq(top_idx, e), ltorch.float32), -1)
+        xe = ltorch.linear(
+            ltorch.silu(ltorch.linear(x, mp["fc_1"][e])) * ltorch.linear(x, mp["fc_2"][e]),
+            mp["proj"][e],
+        )
+        contrib = xe * ltorch.to(ltorch.unsqueeze(w_e, -1), x.dtype)
+        y = contrib if y is None else y + contrib
+    return y
+
+
 def mlp(mp, x, config: Config):
+    if config.mlp_class == "LLaMAMoE":
+        return moe_mlp(mp, x, config)
     if config.mlp_class == "LLaMAMLP":
         return ltorch.linear(ltorch.silu(ltorch.linear(x, mp["fc_1"])) * ltorch.linear(x, mp["fc_2"]), mp["proj"])
     return ltorch.linear(ltorch.gelu(ltorch.linear(x, mp["fc"])), mp["proj"])

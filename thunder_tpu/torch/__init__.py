@@ -1514,6 +1514,7 @@ def interpolate(a, size=None, scale_factor=None, mode="nearest", align_corners=N
     check(not antialias, lambda: "interpolate: antialias is not supported yet")
     n = a.ndim - 2
     spatial = a.shape[2:]
+    sf = None
     if size is not None:
         check(scale_factor is None, lambda: "interpolate: size and scale_factor are mutually exclusive")
         out = (size,) * n if isinstance(size, int) else tuple(size)
@@ -1521,18 +1522,39 @@ def interpolate(a, size=None, scale_factor=None, mode="nearest", align_corners=N
         check(scale_factor is not None, lambda: "interpolate: one of size/scale_factor is required")
         sf = (scale_factor,) * n if isinstance(scale_factor, (int, float)) else tuple(scale_factor)
         out = tuple(int(s * f) for s, f in zip(spatial, sf))
+        if recompute_scale_factor:
+            sf = None  # torch recomputes the scale from the integer sizes
     check(len(out) == n, lambda: "interpolate: size rank mismatch")
     if mode == "nearest":
         res = a
         for i, (inp, o) in enumerate(zip(spatial, out)):
             if o == inp:
                 continue
-            # torch nearest: src = floor(dst * in / out) == (dst * in) // out
-            idx = clang.floor_divide(clang.mul(clang.arange(0, o, device=a.device, dtype=dtypes.int32), inp), o)
+            if sf is not None:
+                # torch keeps the user scale (recompute_scale_factor=False
+                # semantics): src = floor(dst / scale_factor)
+                frac = clang.true_divide(
+                    clang.arange(0, o, device=a.device, dtype=dtypes.float32), float(sf[i])
+                )
+                idx = clang.maybe_convert_to_dtype(clang.floor(frac), dtypes.int32)
+                idx = clang.minimum(idx, inp - 1)
+            else:
+                # size= path: src = floor(dst * in / out)
+                idx = clang.floor_divide(clang.mul(clang.arange(0, o, device=a.device, dtype=dtypes.int32), inp), o)
             res = clang.take(res, idx, 2 + i)
         return res
     check(align_corners is not True, lambda: "interpolate: align_corners=True is not supported yet")
     check(mode in ("linear", "bilinear", "trilinear", "bicubic"), lambda: f"interpolate: unknown mode {mode!r}")
+    if sf is not None:
+        # the RESIZE prim derives its scale from the shapes; that only equals
+        # the torch coordinate map when out == in·sf exactly
+        for s, o, f in zip(spatial, out, sf):
+            check(
+                builtins.abs(s * f - o) < 1e-9,
+                lambda: "interpolate: fractional scale_factor with linear modes needs "
+                "recompute_scale_factor=True (or pass size=) — shape-derived and "
+                "user scales diverge otherwise",
+            )
     return prims.resize(a, tuple(a.shape[:2]) + out, mode)
 
 

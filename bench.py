@@ -182,11 +182,118 @@ def _resolve_backend() -> str:
             time.sleep(15)
         else:
             env["THUNDER_TPU_BENCH_FORCE_CPU"] = "1"
-        os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
+        os.execve(sys.executable, [sys.executable, os.path.abspath(__file__), *sys.argv[1:]], env)
+
+
+#
+# MFU: model FLOPs per token (PaLM-appendix accounting: 6N for the dense
+# params + 12·L·T·d_attn for attention scores/values) against peak chip FLOPs
+#
+
+_PEAK_BF16_FLOPS = {
+    "tpu": 197e12,  # v5e chip, bf16
+    "cpu": 1e12,    # nominal; CPU smoke MFU is meaningless but well-defined
+}
+
+
+def model_flops_per_token(cfg: llama.Config, T: int) -> float:
+    n_params = (
+        cfg.padded_vocab_size * cfg.n_embd * 2  # wte + lm_head
+        + cfg.n_layer
+        * (
+            cfg.n_embd * (cfg.n_head + 2 * cfg.n_query_groups) * cfg.head_size  # qkv
+            + cfg.n_head * cfg.head_size * cfg.n_embd  # wo
+            + 3 * cfg.n_embd * cfg.intermediate_size  # swiglu
+        )
+    )
+    attn = 12 * cfg.n_layer * T * cfg.n_head * cfg.head_size / 2  # causal halves the scores
+    return 6 * n_params + attn
+
+
+def mfu(tokens_per_sec: float, cfg: llama.Config, T: int, backend: str) -> float:
+    peak = _PEAK_BF16_FLOPS.get(backend, 1e12)
+    return tokens_per_sec * model_flops_per_token(cfg, T) / peak
+
+
+#
+# Microbenchmarks (reference benchmarks/targets.py:402-700 — GELU→block ops).
+# Run with `python bench.py micro`; results go to stderr (the driver's stdout
+# contract stays one JSON line from the headline run).
+#
+
+
+def _time_fn(fn, *args, iters=20):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def micro_benchmarks(on_tpu: bool):
+    import numpy as np
+
+    import thunder_tpu as tt
+    import thunder_tpu.torch as ltorch
+
+    B, H, T, hs = (4, 16, 2048, 128) if on_tpu else (2, 2, 256, 64)
+    V, C = (32000, 2048) if on_tpu else (1024, 256)
+    key = jax.random.PRNGKey(0)
+    dt = jnp.bfloat16 if on_tpu else jnp.float32
+
+    results = {}
+
+    # SDPA: kernels on vs off (flash Pallas vs jnp decomposition)
+    q = jax.random.normal(key, (B, H, T, hs), dtype=dt)
+    k = jax.random.normal(jax.random.fold_in(key, 1), (B, H, T, hs), dtype=dt)
+    v = jax.random.normal(jax.random.fold_in(key, 2), (B, H, T, hs), dtype=dt)
+
+    def sdpa(q, k, v):
+        return ltorch.scaled_dot_product_attention(q, k, v, is_causal=True)
+
+    results["sdpa_ms"] = _time_fn(tt.jit(sdpa), q, k, v) * 1e3
+    os.environ["THUNDER_TPU_DISABLE_PALLAS"] = "1"
+    try:
+        results["sdpa_nokernel_ms"] = _time_fn(tt.jit(sdpa), q, k, v) * 1e3
+    finally:
+        del os.environ["THUNDER_TPU_DISABLE_PALLAS"]
+
+    # fused cross entropy
+    logits = jax.random.normal(key, (B * T, V), dtype=jnp.float32)
+    tgt = jax.random.randint(jax.random.fold_in(key, 3), (B * T,), 0, V)
+    results["cross_entropy_ms"] = _time_fn(tt.jit(lambda l, t: ltorch.cross_entropy(l, t)), logits, tgt) * 1e3
+
+    # rmsnorm
+    x = jax.random.normal(key, (B, T, C), dtype=dt)
+    w = jnp.ones((C,), dtype=dt)
+    results["rms_norm_ms"] = _time_fn(tt.jit(lambda a, ww: ltorch.rms_norm(a, (C,), ww)), x, w) * 1e3
+
+    # one transformer block fwd
+    cfg = llama.Config.from_name("tiny-llama-debug") if not on_tpu else llama.Config.from_name(
+        "Llama-2-7b-hf", n_layer=1, n_embd=2048, n_head=16, intermediate_size=5504
+    )
+    params = llama.init_params(cfg, key, dtype=dt)
+    Tb = min(T, cfg.block_size)
+    idx, _, cos, sin = make_batch(cfg, B, Tb)
+    results["block_fwd_ms"] = _time_fn(
+        tt.jit(lambda p, i, c, s: llama.gpt_forward(p, i, c, s, cfg)), params, idx, cos, sin
+    ) * 1e3
+
+    for name, ms in results.items():
+        log(f"micro {name}: {ms:.3f} ms")
+    if "sdpa_nokernel_ms" in results and results["sdpa_ms"] > 0:
+        log(f"micro sdpa kernel speedup: {results['sdpa_nokernel_ms']/results['sdpa_ms']:.2f}x")
+    return results
 
 
 def main():
     on_tpu = _resolve_backend() == "tpu"
+    if len(sys.argv) > 1 and sys.argv[1] == "micro":
+        micro_benchmarks(on_tpu)
+        print(json.dumps({"metric": "micro", "value": 1.0, "unit": "ok", "vs_baseline": 1.0}))
+        return
     if on_tpu:
         # Llama-2 architecture, ~540M params: training state fits one v5e chip
         cfg = llama.Config.from_name(
@@ -206,12 +313,15 @@ def main():
     jax.clear_caches()  # free the compiled program + donated buffers before the next phase
     baseline_tps = baseline_run(cfg, B, T, optimizer, baseline_steps)
 
+    backend = jax.default_backend()
     print(json.dumps({
         "metric": "llama2_arch_540m_pretrain_tokens_per_sec_single_chip" if on_tpu
                   else "llama_tiny_pretrain_tokens_per_sec_cpu_smoke",
         "value": round(compiled_tps, 1),
         "unit": "tokens/s",
         "vs_baseline": round(compiled_tps / baseline_tps, 3),
+        "mfu_pct": round(100 * mfu(compiled_tps, cfg, T, backend), 2),
+        "baseline_mfu_pct": round(100 * mfu(baseline_tps, cfg, T, backend), 2),
     }))
 
 

@@ -1,0 +1,119 @@
+"""Epilogue traces: input-container mutation write-back.
+
+Reference parity: epilogue traces recording setattr-style state updates
+(``thunder/core/jit_ext.py:1336-1365``) — here the observable state is the
+argument pytree (BN running stats, KV caches).
+"""
+import numpy as np
+import pytest
+
+import thunder_tpu as tt
+import thunder_tpu.torch as ltorch
+
+rng = np.random.default_rng(17)
+
+
+def test_running_stat_update():
+    def f(x, state):
+        new_mean = ltorch.mean(x, 0)
+        state["running_mean"] = ltorch.add(
+            ltorch.mul(state["running_mean"], 0.9), ltorch.mul(new_mean, 0.1)
+        )
+        return ltorch.relu(x)
+
+    x = rng.standard_normal((4, 5)).astype(np.float32)
+    state = {"running_mean": np.zeros(5, dtype=np.float32)}
+    jfn = tt.jit(f)
+    out = jfn(x, state)
+    np.testing.assert_allclose(np.asarray(out), np.maximum(x, 0), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(state["running_mean"]), 0.1 * x.mean(0), atol=1e-6)
+
+    # cached second call keeps accumulating
+    prev = np.asarray(state["running_mean"]).copy()
+    jfn(x, state)
+    np.testing.assert_allclose(
+        np.asarray(state["running_mean"]), 0.9 * prev + 0.1 * x.mean(0), atol=1e-6
+    )
+    assert tt.cache_hits(jfn) >= 1
+
+
+def test_kv_cache_style_update():
+    def step(tok, cache):
+        cache["k"] = ltorch.cat([cache["k"], ltorch.unsqueeze(tok, 0)], 0)
+        return ltorch.sum(cache["k"], 0)
+
+    tok = rng.standard_normal((8,)).astype(np.float32)
+    cache = {"k": np.zeros((1, 8), dtype=np.float32)}
+    out = tt.jit(step)(tok, cache)
+    assert np.asarray(cache["k"]).shape == (2, 8)
+    np.testing.assert_allclose(np.asarray(out), tok, atol=1e-6)
+
+
+def test_epilogue_trace_printable():
+    def f(x, state):
+        state["v"] = ltorch.mul(state["v"], 2.0)
+        return x
+
+    x = rng.standard_normal((3,)).astype(np.float32)
+    state = {"v": np.ones(3, dtype=np.float32)}
+    jfn = tt.jit(f)
+    jfn(x, state)
+    epi = jfn._lc_cs.interpreter_cache[0].epilogue_trace
+    assert epi is not None
+    src = epi.python()
+    assert "write_path" in src and "'v'" in src
+
+
+def test_structure_mutation_rejected():
+    def f(x, state):
+        state["new_key"] = ltorch.mul(x, 2.0)
+        return x
+
+    x = rng.standard_normal((3,)).astype(np.float32)
+    with pytest.raises(Exception, match="structure"):
+        tt.jit(f)(x, {"old": x})
+
+
+def test_mutation_with_grad_rejected():
+    def f(x, state):
+        state["v"] = ltorch.mul(state["v"], 2.0)
+        return ltorch.sum(x)
+
+    x = rng.standard_normal((3,)).astype(np.float32)
+    with pytest.raises(Exception, match="epilogue"):
+        tt.value_and_grad(f)(x, {"v": x})
+
+
+def test_same_tensor_written_to_two_slots():
+    # one distinct proxy → one epilogue parameter, reused for both paths
+    def f(x, state):
+        t = ltorch.mul(state["a"], 2.0)
+        state["a"] = t
+        state["b"] = t
+        return x
+
+    x = rng.standard_normal((3,)).astype(np.float32)
+    state = {"a": np.ones(3, dtype=np.float32), "b": np.zeros(3, dtype=np.float32)}
+    tt.jit(f)(x, state)
+    np.testing.assert_allclose(np.asarray(state["a"]), 2.0 * np.ones(3), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(state["b"]), 2.0 * np.ones(3), atol=1e-6)
+
+
+def test_vmap_rejects_mutation():
+    def f(x, state):
+        state["v"] = ltorch.mul(state["v"], 2.0)
+        return x
+
+    x = rng.standard_normal((2, 3)).astype(np.float32)
+    with pytest.raises(Exception, match="mutate"):
+        tt.vmap(f, in_axes=(0, None))(x, {"v": np.ones(3, dtype=np.float32)})
+
+
+def test_no_mutation_no_epilogue():
+    def f(x):
+        return ltorch.mul(x, 2.0)
+
+    x = rng.standard_normal((3,)).astype(np.float32)
+    jfn = tt.jit(f)
+    jfn(x)
+    assert jfn._lc_cs.interpreter_cache[0].epilogue_trace is None

@@ -1,0 +1,65 @@
+"""NumPy language context (reference thunder/numpy/__init__.py).
+
+Real np.* calls on proxies divert through __array_ufunc__/__array_function__
+into the numpy langctx, tracing into the same clang/prims programs.
+"""
+import numpy as np
+
+import thunder_tpu as tt
+import thunder_tpu.numpy as lnp
+
+rng = np.random.default_rng(13)
+
+
+def test_ufunc_diversion():
+    a = rng.standard_normal((4, 5)).astype(np.float32)
+    b = rng.standard_normal((4, 5)).astype(np.float32)
+
+    def f(x, y):
+        return np.add(np.multiply(x, y), np.exp(x))
+
+    got = np.asarray(tt.jit(f)(a, b))
+    np.testing.assert_allclose(got, a * b + np.exp(a), rtol=1e-5)
+
+
+def test_array_function_diversion():
+    a = rng.standard_normal((4, 6)).astype(np.float32)
+
+    def f(x):
+        return np.sum(np.reshape(x, (2, 12)), axis=1)
+
+    got = np.asarray(tt.jit(f)(a))
+    np.testing.assert_allclose(got, a.reshape(2, 12).sum(1), rtol=1e-5)
+
+
+def test_matmul_and_where():
+    a = rng.standard_normal((4, 5)).astype(np.float32)
+    b = rng.standard_normal((5, 3)).astype(np.float32)
+
+    def f(x, y):
+        h = np.matmul(x, y)
+        return np.where(np.greater(h, 0), h, 0.1 * h)
+
+    got = np.asarray(tt.jit(f)(a, b))
+    h = a @ b
+    np.testing.assert_allclose(got, np.where(h > 0, h, 0.1 * h), rtol=1e-5)
+
+
+def test_lnp_surface_direct():
+    a = rng.standard_normal((3, 4)).astype(np.float32)
+
+    def f(x):
+        return lnp.mean(lnp.multiply(x, x), axis=1)
+
+    got = np.asarray(tt.jit(f)(a))
+    np.testing.assert_allclose(got, (a * a).mean(1), rtol=1e-5)
+
+
+def test_grad_through_numpy_surface():
+    a = rng.standard_normal((3, 4)).astype(np.float32)
+
+    def loss(x):
+        return lnp.sum(lnp.multiply(lnp.sin(x), x))
+
+    v, g = tt.value_and_grad(loss)(a)
+    np.testing.assert_allclose(np.asarray(g), np.cos(a) * a + np.sin(a), rtol=1e-5)

@@ -1,0 +1,253 @@
+"""Debug tooling: examine(), sharp edges, patterns, profile markers.
+
+Reference parity: ``thunder/examine/__init__.py:49``, sharp-edges policy
+(``core/options.py:146`` + ``jit_ext.py:472``), ``core/patterns.py:99``,
+``core/profile.py:7``.
+"""
+import numpy as np
+import pytest
+import torch
+
+import thunder_tpu as tt
+import thunder_tpu.torch as ltorch
+
+rng = np.random.default_rng(21)
+
+
+class TestExamine:
+    def test_supported_function(self, capsys):
+        from thunder_tpu.examine import examine
+
+        def f(a, b):
+            return torch.nn.functional.relu(a) + torch.matmul(a, b)
+
+        a = torch.randn(4, 4)
+        b = torch.randn(4, 4)
+        ok = examine(f, a, b)
+        out = capsys.readouterr().out
+        assert ok
+        assert "supported by the tracer" in out
+        assert "compiled and ran" in out
+
+    def test_unsupported_function_reported(self, capsys):
+        from thunder_tpu.examine import examine
+
+        def f(a):
+            # svd isn't on the ltorch surface
+            u, s, v = torch.linalg.svd(a)
+            return s
+
+        ok = examine(f, torch.randn(4, 4))
+        out = capsys.readouterr().out
+        assert not ok
+        assert "not supported" in out
+        assert "svd" in out
+
+    def test_broken_function_reported(self, capsys):
+        from thunder_tpu.examine import examine
+
+        def f(a):
+            raise ValueError("boom")
+
+        ok = examine(f, torch.randn(2))
+        out = capsys.readouterr().out
+        assert not ok
+        assert "failed outside thunder_tpu" in out
+
+    def test_get_fusions_and_memory(self):
+        from thunder_tpu.examine import get_fusions, memory_estimate
+
+        def f(a):
+            return ltorch.sin(a) * ltorch.cos(a) + 1.0
+
+        a = rng.standard_normal((16, 16)).astype(np.float32)
+        jfn = tt.jit(f)
+        jfn(a)
+        trc = tt.last_traces(jfn)[-1]
+        fusions = get_fusions(trc)
+        assert len(fusions) == 1 and fusions[0][0] == "XLA0"
+        mem = memory_estimate(trc)
+        assert mem["input_bytes"] == 16 * 16 * 4
+        assert mem["output_bytes"] == 16 * 16 * 4
+        assert mem["peak_bytes_estimate"] >= mem["input_bytes"]
+
+
+class TestSharpEdges:
+    def test_time_error_policy(self):
+        import time
+
+        def f(a):
+            return a * time.time()
+
+        a = rng.standard_normal((4,)).astype(np.float32)
+        with pytest.raises(Exception, match="sharp edge"):
+            tt.jit(f, sharp_edges="error")(a)
+
+    def test_random_warn_policy(self):
+        import random
+
+        def f(a):
+            return a + random.random()
+
+        a = rng.standard_normal((4,)).astype(np.float32)
+        with pytest.warns(UserWarning, match="sharp edge"):
+            tt.jit(f, sharp_edges="warn")(a)
+
+    def test_allow_is_silent_default(self):
+        import random
+
+        def f(a):
+            return a + random.random()
+
+        a = rng.standard_normal((4,)).astype(np.float32)
+        out = tt.jit(f)(a)  # default allow: no warning, runs
+        assert np.all(np.isfinite(np.asarray(out)))
+
+    def test_numpy_random_detected(self):
+        def f(a):
+            return a + float(np.random.rand())
+
+        a = rng.standard_normal((4,)).astype(np.float32)
+        with pytest.raises(Exception, match="sharp edge"):
+            tt.jit(f, sharp_edges="error")(a)
+
+    def test_guard_restores_patches(self):
+        import random
+
+        r0 = random.random
+        try:
+            tt.jit(lambda a: a * random.random(), sharp_edges="error")(
+                rng.standard_normal((2,)).astype(np.float32)
+            )
+        except Exception:
+            pass
+        assert random.random is r0
+
+
+class TestPatterns:
+    def test_match_mul_add_chain(self):
+        from thunder_tpu.core.patterns import Pattern
+        from thunder_tpu.core.prims import PrimIDs
+        from thunder_tpu.functional import trace_from_fn
+
+        def f(a, b, c):
+            return a * b + c
+
+        a = rng.standard_normal((4,)).astype(np.float32)
+        tr = trace_from_fn(f, (a, a, a), {}).computation_trace
+        from thunder_tpu.core.transforms import flatten_to_prims
+
+        flat = tr.shallow_copy() if hasattr(tr, "shallow_copy") else tr
+        import thunder_tpu.core.transforms as T
+
+        flat_trace = tr
+        flat_trace.bound_symbols = T.flatten_to_prims(tr.bound_symbols)
+
+        p = Pattern()
+        p.match(lambda bsym, ctx: (bsym.sym.id == PrimIDs.MUL, {"mul": bsym}))
+        p.match(
+            lambda bsym, ctx: (
+                bsym.sym.id == PrimIDs.ADD
+                and any(a.name in {o.name for o in ctx["mul"].flat_proxy_outs} for a in bsym.flat_proxy_args),
+                {},
+            )
+        )
+        matches = p(flat_trace)
+        assert len(matches) == 1
+        bsyms, ctx = matches[0]
+        assert [b.sym.id for b in bsyms] == [PrimIDs.MUL, PrimIDs.ADD]
+        assert "mul" in ctx
+
+    def test_no_match_across_dependency(self):
+        from thunder_tpu.core.patterns import Pattern
+        from thunder_tpu.core.prims import PrimIDs
+        from thunder_tpu.functional import trace_from_fn
+        import thunder_tpu.core.transforms as T
+
+        # mul → (sum barrier uses mul's out) → add(uses sum): the add depends
+        # on the mul THROUGH the unmatched sum, so [mul, add] must not match
+        def f(a):
+            m = a * a
+            s = ltorch.sum(m)
+            return s + 1.0
+
+        a = rng.standard_normal((4,)).astype(np.float32)
+        tr = trace_from_fn(f, (a,), {}).computation_trace
+        tr.bound_symbols = T.flatten_to_prims(tr.bound_symbols)
+
+        p = Pattern()
+        p.match(lambda bsym, ctx: (bsym.sym.id == PrimIDs.MUL, {"mul": bsym}))
+        p.match(lambda bsym, ctx: (bsym.sym.id == PrimIDs.ADD, {}))
+        matches = p(tr)
+        assert matches == [] or all(
+            len(bsyms) < 2 or True for bsyms, _ in matches
+        )
+        # specifically: no match may pair the mul with the add
+        for bsyms, _ in matches:
+            ids = [b.sym.id for b in bsyms]
+            assert not (PrimIDs.MUL in ids and PrimIDs.ADD in ids)
+
+    def test_match_replace_rewrites(self):
+        from thunder_tpu.core.patterns import Pattern, match_replace
+        from thunder_tpu.core.prims import PrimIDs
+        from thunder_tpu.functional import trace_from_fn
+        import thunder_tpu.core.transforms as T
+        from thunder_tpu import clang
+
+        def f(a, b, c):
+            return a * b + c
+
+        a = rng.standard_normal((4,)).astype(np.float32)
+        tr = trace_from_fn(f, (a, a, a), {}).computation_trace
+        tr.bound_symbols = T.flatten_to_prims(tr.bound_symbols)
+
+        p = Pattern()
+        p.match(lambda bsym, ctx: (bsym.sym.id == PrimIDs.MUL, {"mul": bsym}))
+        p.match(
+            lambda bsym, ctx: (
+                bsym.sym.id == PrimIDs.ADD
+                and any(x.name in {o.name for o in ctx["mul"].flat_proxy_outs} for x in bsym.flat_proxy_args),
+                {"add": bsym},
+            )
+        )
+
+        def fma_builder(ctx, mul_bsym, add_bsym):
+            x, y = mul_bsym.args[0], mul_bsym.args[1]
+            mul_out = {o.name for o in mul_bsym.flat_proxy_outs}
+            other = next(x2 for x2 in add_bsym.flat_proxy_args if x2.name not in mul_out)
+            # rewrite as (x + 0) * y + other via different ops to make the
+            # rewrite observable in the trace while staying numerically equal
+            return clang.add(clang.mul(clang.add(x, 0.0), y), other)
+
+        new_tr = match_replace(tr, p, fma_builder)
+        src = new_tr.python()
+        assert "Pattern rewrite" in src
+        # evaluate both traces and compare
+        from thunder_tpu.executors.utils import eval_bsyms
+
+        import jax.numpy as jnp
+
+        env1 = {pr.name: jnp.asarray(a) for pr in tr.args}
+        env2 = {pr.name: jnp.asarray(a) for pr in new_tr.args}
+        eval_bsyms([b for b in tr.bound_symbols if b.sym.id != PrimIDs.RETURN], env1)
+        eval_bsyms([b for b in new_tr.bound_symbols if b.sym.id != PrimIDs.RETURN], env2)
+        out1 = [v for k, v in env1.items()][-1]
+        out_name = tr.bound_symbols[-1].flat_proxy_args[0].name
+        np.testing.assert_allclose(np.asarray(env1[out_name]), np.asarray(env2[out_name]), rtol=1e-6)
+
+
+class TestProfileMarkers:
+    def test_disabled_by_default(self):
+        from thunder_tpu.core.profile import add_markers, profiling_enabled
+
+        assert not profiling_enabled()
+        with add_markers("test-region"):
+            pass  # no-op without the env var
+
+    def test_enabled_wraps_jax_annotation(self, monkeypatch):
+        import thunder_tpu.core.profile as prof
+
+        monkeypatch.setattr(prof, "_ENABLED", True)
+        with prof.add_markers("region-x"):
+            x = np.ones(3).sum()
+        assert x == 3.0

@@ -28,6 +28,7 @@ from thunder_tpu.core.options import (
     resolve_sharp_edges_option,
 )
 from thunder_tpu.core.autocast import autocast
+from thunder_tpu.core.batching import jvp, vmap
 from thunder_tpu.core.trace import TraceCtx, TraceResults
 from thunder_tpu.core.transform_common import cse, dce
 from thunder_tpu.extend import resolve_executors
@@ -41,6 +42,8 @@ __all__ = [
     "autocast",
     "grad",
     "vjp",
+    "jvp",
+    "vmap",
     "value_and_grad",
     "last_traces",
     "last_backward_traces",
@@ -173,6 +176,12 @@ def jit(
             result = (output, grads)
         else:
             result = cache_entry.computation_fn(*inps)
+            if cache_entry.epilogue_fn is not None:
+                # the computation returns (user_result, mutated_leaves); the
+                # epilogue writes the mutated leaves back into the caller's
+                # containers (reference epilogue execution, __init__.py:651)
+                result, mutated = result
+                cache_entry.epilogue_fn(args, kwargs, *mutated)
         cs.last_trace_host_execution_stop = time.perf_counter_ns()
         cs.last_trace_host_stop = cs.last_trace_host_execution_stop
         return result
@@ -194,7 +203,10 @@ def _compile(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict) -> Ca
         grad_argnums = tuple(range(len(args)))
 
     cs.last_trace_tracing_start = time.perf_counter_ns()
-    trace_results: TraceResults = trace_from_fn(cd.fn, args, kwargs, grad_argnums=grad_argnums)
+    from thunder_tpu.core.sharp_edges import sharp_edges_guard
+
+    with sharp_edges_guard(cd.sharp_edges):
+        trace_results: TraceResults = trace_from_fn(cd.fn, args, kwargs, grad_argnums=grad_argnums)
     cs.last_trace_tracing_stop = time.perf_counter_ns()
 
     prologue_trace = trace_results.prologue_trace
@@ -284,6 +296,11 @@ def _compile(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict) -> Ca
         backward_trace=bw_extrace,
         epilogue_trace=trace_results.epilogue_trace,
         uses_rng=uses_rng,
+        epilogue_fn=(
+            trace_results.epilogue_trace.python_callable()
+            if trace_results.epilogue_trace is not None
+            else None
+        ),
     )
     entry.return_spec = grad_postprocess
     entry.vjp_mode = vjp_mode

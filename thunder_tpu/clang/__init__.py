@@ -135,9 +135,18 @@ def _tensor_args(*args) -> list[TensorProxy]:
     return [a for a in args if isinstance(a, TensorProxy)]
 
 
+def _unwrap_known_number(value):
+    """NumberProxy → python value when known; unknown numbers (item()
+    results) stay symbolic so the bsym records the proxy and codegen passes
+    the runtime scalar through."""
+    if isinstance(value, NumberProxy):
+        pv = pyval(value)
+        return value if pv is None else pv
+    return value
+
+
 def _scalar_to_tensor(value, dtype: dtypes.dtype, device: Device) -> TensorProxy:
-    v = pyval(value) if isinstance(value, NumberProxy) else value
-    return prims.full((), v, device=device, dtype=dtype)
+    return prims.full((), _unwrap_known_number(value), device=device, dtype=dtype)
 
 
 #
@@ -369,8 +378,7 @@ def full(shape, fill_value, *, device=None, dtype=None) -> TensorProxy:
         else:
             dtype = dtypes.float32
     dev, dt = _resolve_device_dtype(device, dtype)
-    v = pyval(fill_value) if isinstance(fill_value, NumberProxy) else fill_value
-    return prims.full(tuple(int(s) for s in shape), v, device=dev, dtype=dt)
+    return prims.full(tuple(int(s) for s in shape), _unwrap_known_number(fill_value), device=dev, dtype=dt)
 
 
 @clangop()

@@ -83,3 +83,4 @@ class CacheEntry:
     epilogue_trace: TraceCtx | None
     uses_rng: bool
     return_spec: Any = None
+    epilogue_fn: Callable | None = None

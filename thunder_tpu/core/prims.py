@@ -211,6 +211,9 @@ class PrimIDs(Enum):
     REDUCE_WINDOW = auto()
     # spatial resize (torch nn.functional.interpolate linear modes)
     RESIZE = auto()
+    # epilogue write-back of mutated input containers (reference epilogue
+    # traces, jit_ext.py:1336)
+    WRITE_PATH = auto()
 
 
 #
@@ -1356,6 +1359,32 @@ unpack_attr = make_prim(
     meta=lambda obj, name: None,
     python_impl=_unpack_attr_impl,
     tags=(OpTags.UNPACK_OP, OpTags.DONT_DCE),
+)
+
+
+def _write_path_impl(root_args, root_kwargs, path, value):
+    """Epilogue write-back: navigates the caller's real argument containers by
+    ``path`` and assigns ``value`` (reference jit_ext.py:1336 — recorded
+    setattr/setitem mutations execute in the epilogue trace)."""
+    obj = (root_args, root_kwargs)
+    for k in path[:-1]:
+        obj = obj[k]
+    last = path[-1]
+    try:
+        obj[last] = value
+    except TypeError as e:
+        raise RuntimeError(
+            f"epilogue cannot write back through an immutable container at {path!r}: {e}"
+        ) from None
+    return None
+
+
+write_path = make_prim(
+    PrimIDs.WRITE_PATH,
+    "write_path",
+    meta=lambda root_args, root_kwargs, path, value: None,
+    python_impl=_write_path_impl,
+    tags=(OpTags.DONT_DCE,),
 )
 
 

@@ -1,0 +1,32 @@
+"""Trace annotation markers for profiling.
+
+Capability analog of the reference's ``thunder/core/profile.py`` (NVTX +
+torch.profiler ranges gated by ``THUNDER_ANNOTATE_TRACES``).  On TPU the
+profiler is jax's: markers become ``jax.profiler.TraceAnnotation`` ranges,
+visible in XLA/TensorBoard profiles, gated by ``THUNDER_TPU_ANNOTATE_TRACES``.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+
+__all__ = ["profiling_enabled", "add_markers"]
+
+_ENABLED = os.getenv("THUNDER_TPU_ANNOTATE_TRACES") in ("1", "y", "Y")
+
+
+def profiling_enabled() -> bool:
+    return _ENABLED
+
+
+@contextlib.contextmanager
+def add_markers(msg: str):
+    """Annotates the enclosed device work with ``msg`` in jax profiles."""
+    if not profiling_enabled():
+        yield
+        return
+    assert "\n" not in msg, msg
+    import jax
+
+    with jax.profiler.TraceAnnotation(msg):
+        yield

@@ -610,6 +610,29 @@ class TensorProxy(Proxy, TensorProxyInterface):
             )
         return mapped(*args, **kwargs)
 
+    # numpy interop: real np.* calls on proxies divert into the numpy langctx
+    # (the numpy analog of __torch_function__; reference thunder/numpy)
+    def __array_ufunc__(self, ufunc, method, *inputs, **kwargs):
+        if method != "__call__" or kwargs.get("out") is not None:
+            return NotImplemented
+        from thunder_tpu.numpy import _numpy_to_thunder_function_map
+
+        mapped = _numpy_to_thunder_function_map.get(ufunc)
+        if mapped is None:
+            return NotImplemented
+        return mapped(*inputs, **kwargs)
+
+    def __array_function__(self, func, types, args, kwargs):
+        from thunder_tpu.numpy import _numpy_to_thunder_function_map
+
+        mapped = _numpy_to_thunder_function_map.get(func)
+        if mapped is None:
+            raise NotImplementedError(
+                f"numpy function {func.__name__} is not yet mapped into thunder_tpu; "
+                f"register it in thunder_tpu/numpy/__init__.py"
+            )
+        return mapped(*args, **(kwargs or {}))
+
     #
     # jax interop: jnp.* calls on proxies divert similarly (jax dispatches via
     # __jax_array__ only for conversion, so we cover the operator protocol and

@@ -1,0 +1,168 @@
+"""examine(): support reporting and debug tooling.
+
+Capability analog of the reference's ``thunder/examine/__init__.py:49`` —
+runs a function under a collection mode, reports which torch operations are
+(un)supported by the tracer, tries the jit, and prints a repro template.
+Plus ``get_fusions`` (``:190``) and a trace memory calculator
+(``examine/memory_caculation.py``).
+"""
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable
+
+__all__ = ["examine", "get_fusions", "get_fusion_symbols", "memory_estimate"]
+
+
+def _collect_torch_functions(fn, args, kwargs):
+    """Runs ``fn`` on real torch tensors under TorchFunctionMode, collecting
+    every torch callable used (reference CollectFunctionsUsed)."""
+    import torch
+
+    calls: dict[str, Any] = {}
+
+    class Collect(torch.overrides.TorchFunctionMode):
+        def __torch_function__(self, func, types, f_args=(), f_kwargs=None):
+            f_kwargs = f_kwargs or {}
+            qn = getattr(func, "__qualname__", None) or str(func)
+            mod = getattr(func, "__module__", "") or ""
+            calls.setdefault(f"{mod}.{qn}" if mod else qn, func)
+            return func(*f_args, **f_kwargs)
+
+    with Collect():
+        result = fn(*args, **kwargs)
+    return calls, result
+
+
+def examine(fn: Callable, *args, **kwargs) -> bool:
+    """Reports whether ``fn`` can run through thunder_tpu.jit and why not.
+
+    Returns True when everything checked out.  Never raises — the reference's
+    contract is "doesn't crash the user program".
+    """
+    try:
+        import torch
+    except ImportError:  # pragma: no cover
+        print("examine() requires torch for operation collection")
+        return False
+
+    from thunder_tpu.torch import _torch_to_thunder_function_map
+
+    if not callable(fn):
+        print(f"examine(): expected a callable, got {type(fn)}")
+        return False
+
+    # Step 1: run eagerly, collect the torch surface used
+    try:
+        calls, torch_result = _collect_torch_functions(fn, args, kwargs)
+    except Exception as e:
+        print(f"examine(): the function failed outside thunder_tpu ({type(e).__name__}: {e}); fix that first")
+        return False
+
+    known = set(_torch_to_thunder_function_map)
+    unsupported = {name: f for name, f in calls.items() if isinstance(f, Callable) and f not in known and not _is_benign(f)}
+
+    if unsupported:
+        print(f"Found {len(unsupported)} distinct operation(s) not supported by the tracer:")
+        for name in sorted(unsupported):
+            print(f"  {name}")
+        print(
+            "\nRepro template for an operator request:\n"
+            "  import thunder_tpu as tt\n"
+            "  import thunder_tpu.torch as ltorch\n"
+            "  def repro(...):  # minimal fn using the op above\n"
+            "      ...\n"
+            "  tt.jit(repro)(...)\n"
+        )
+    else:
+        print(f"All {len(calls)} collected operations are supported by the tracer")
+
+    # Step 2: try the jit and compare
+    try:
+        import numpy as np
+
+        import thunder_tpu as tt
+
+        jfn = tt.jit(fn)
+        jit_result = jfn(*args, **kwargs)
+        try:
+            a = np.asarray(jit_result)
+            b = torch_result.detach().to(torch.float32).numpy() if isinstance(torch_result, torch.Tensor) else np.asarray(torch_result)
+            if a.shape == getattr(b, "shape", None):
+                ok = np.allclose(np.asarray(a, dtype=np.float32), np.asarray(b, dtype=np.float32), rtol=1e-3, atol=1e-4)
+                print("jit result matches eager torch" if ok else "WARNING: jit result DIVERGES from eager torch")
+        except Exception:
+            pass
+        print("thunder_tpu.jit compiled and ran the function successfully")
+        return not unsupported
+    except Exception as e:
+        print(f"thunder_tpu.jit failed: {type(e).__name__}: {e}")
+        return False
+
+
+def _is_benign(func) -> bool:
+    """Attribute accesses and dunder plumbing that need no tracer support."""
+    qn = getattr(func, "__qualname__", "") or ""
+    return qn.startswith(("Tensor.__", "Tensor.shape", "Tensor.dtype", "Tensor.device", "_has_torch_function"))
+
+
+def get_fusion_symbols(trace) -> list:
+    """All fusion bound symbols (XLA regions) in ``trace``
+    (reference examine/__init__.py:190 get_fusions)."""
+    out = []
+    for bsym in trace.bound_symbols:
+        if getattr(bsym.sym, "is_fusion", False):
+            out.append(bsym)
+    return out
+
+
+def get_fusions(trace) -> list[tuple[str, Callable]]:
+    """(name, callable) for each fusion region in ``trace``."""
+    out = []
+    for bsym in get_fusion_symbols(trace):
+        ctx = bsym._call_ctx or {}
+        for name, fusion in ctx.items():
+            out.append((name, fusion))
+    return out
+
+
+def memory_estimate(trace) -> dict[str, int]:
+    """Bytes of inputs / outputs / peak-intermediate estimate for a trace
+    (reference examine/memory_caculation.py).  The intermediate estimate
+    walks the trace with del-aware liveness: it is the ceiling XLA's own
+    buffer reuse then improves on."""
+    from thunder_tpu.core.prims import PrimIDs
+    from thunder_tpu.core.proxies import TensorProxy
+
+    def nbytes(p) -> int:
+        if not isinstance(p, TensorProxy):
+            return 0
+        n = 1
+        for s in p.shape:
+            n *= int(s)
+        return n * p.dtype.bytes
+
+    inputs = sum(nbytes(p) for p in trace.args if isinstance(p, TensorProxy))
+    outputs = 0
+    live: dict[str, int] = {}
+    peak = 0
+    for p in trace.args:
+        if isinstance(p, TensorProxy):
+            live[p.name] = nbytes(p)
+    cur = sum(live.values())
+    peak = cur
+    for bsym in trace.bound_symbols:
+        if bsym.sym.id == PrimIDs.RETURN:
+            outputs = sum(nbytes(p) for p in bsym.flat_proxy_args)
+            continue
+        if bsym.sym.id == PrimIDs.DEL:
+            for p in bsym.flat_proxy_args:
+                cur -= live.pop(p.name, 0)
+            continue
+        for o in bsym.flat_proxy_outs:
+            if o.name not in live:
+                b = nbytes(o)
+                live[o.name] = b
+                cur += b
+        peak = max(peak, cur)
+    return {"input_bytes": inputs, "output_bytes": outputs, "peak_bytes_estimate": peak}

@@ -67,7 +67,12 @@ def resolve_args(env: dict[str, Any], args, kwargs):
 
     def sub(x):
         if isinstance(x, (NumberProxy, StringProxy, AnyProxy)):
-            return x.value
+            if x.value is not None:
+                return x.value
+            # unknown at trace time (e.g. an item() result): runtime value
+            if x.name in env:
+                return env[x.name]
+            raise RuntimeError(f"Number proxy {x.name} has no static or runtime value")
         if isinstance(x, Proxy):
             if x.name not in env:
                 raise RuntimeError(f"Proxy {x.name} has no value during evaluation")

@@ -91,9 +91,16 @@ class XLAFusionExecutor(FusionExecutor):
 
     def fuse(self, region_bsyms: list[BoundSymbol], fusion_counter: int, producers_map, consumers_map, return_proxies) -> BoundSymbol:
         region = Region(producers_map, consumers_map, region_bsyms)
-        # only tensors have runtime identity; known numbers/strings resolve
-        # statically inside the region evaluation
-        inputs = [p for p in (unvariableify(v) for v in region.inputs) if isinstance(p, TensorProxy)]
+        # tensors have runtime identity; numbers resolve statically UNLESS
+        # their value is unknown at trace time (item() results) — those are
+        # runtime scalars and must enter the region as inputs
+        from thunder_tpu.core.proxies import NumberProxy
+
+        inputs = [
+            p
+            for p in (unvariableify(v) for v in region.inputs)
+            if isinstance(p, TensorProxy) or (isinstance(p, NumberProxy) and p.value is None)
+        ]
         outputs = [unvariableify(v) for v in region.outputs]
         # proxies returned from the trace must also escape the fusion
         out_names = {p.name for p in outputs}
@@ -133,28 +140,21 @@ class XLAFusionExecutor(FusionExecutor):
             if bsym.sym.id == _P.RETURN:
                 return_proxies.extend(bsym.flat_proxy_args)
 
+        # dataflow-aware partitioning (reference data_dependent_partition.py):
+        # fusible islands regroup around non-fusible bsyms instead of being
+        # split by them
+        from thunder_tpu.executors.data_dependent_partition import fuse_bound_symbols
+
+        groups = fuse_bound_symbols(trace.bound_symbols, self._is_fusible)
+
         new_bsyms: list[BoundSymbol] = []
-        pending: list[BoundSymbol] = []
         fusion_counter = 0
-
-        def flush():
-            nonlocal fusion_counter, pending
-            if not pending:
-                return
-            if len(pending) < int(min_size) or not self.get_fuel():
-                new_bsyms.extend(pending)
+        for g in groups:
+            if not g.fusible or len(g.bsyms) < int(min_size) or not self.get_fuel():
+                new_bsyms.extend(g.bsyms)
             else:
-                new_bsyms.append(self.fuse(pending, fusion_counter, producers_map, consumers_map, return_proxies))
+                new_bsyms.append(self.fuse(g.bsyms, fusion_counter, producers_map, consumers_map, return_proxies))
                 fusion_counter += 1
-            pending = []
-
-        for bsym in trace.bound_symbols:
-            if self._is_fusible(bsym):
-                pending.append(bsym)
-            else:
-                flush()
-                new_bsyms.append(bsym)
-        flush()
 
         ntrace = from_trace(trace)
         ntrace.bound_symbols = new_bsyms

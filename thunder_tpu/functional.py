@@ -133,7 +133,25 @@ def trace_from_fn(fn: Callable, args: tuple, kwargs: dict, *, grad_argnums: tupl
     with tracectx(computation_trace):
         with langctx(Languages.TORCH):
             result = fn(*proxy_args, **proxy_kwargs)
-        prims.python_return(result)
+        # epilogue: record mutations of the input containers (the reference
+        # records setattrs into an epilogue trace, jit_ext.py:1336; here the
+        # observable state is the argument pytree — d[key] = new_tensor in
+        # the traced fn writes back into the caller's container after the
+        # computation runs)
+        mutations = _detect_mutations(proxies, spec, proxy_args, proxy_kwargs)
+        # one value per DISTINCT proxy (a tensor written to two slots appears
+        # once in the return and the epilogue signature)
+        mutated_values = list({p.name: p for _, p in mutations}.values())
+        if mutations:
+            check(
+                grad_argnums is None,
+                lambda: "input-container mutation (epilogue) is not supported together "
+                "with grad yet; return the updated state instead",
+            )
+            prims.python_return((result, tuple(mutated_values)))
+        else:
+            prims.python_return(result)
+    computation_trace._mutations = mutations
 
     # computation inputs: tensor proxies in flattening order (+ implicit rng key)
     comp_inputs: list[TensorProxy] = [p for p in proxies if isinstance(p, TensorProxy)]
@@ -198,8 +216,62 @@ def trace_from_fn(fn: Callable, args: tuple, kwargs: dict, *, grad_argnums: tupl
     prologue_trace.set_siginfo(pro_si)
 
     #
-    # Epilogue (functional frontend records no mutations; kept for parity)
+    # Epilogue: write mutated container leaves back into the caller's objects
+    # (reference TraceResults epilogue, jit_ext.py:1336-1365)
     #
     epilogue_trace = None
+    if mutations:
+        epilogue_trace = TraceCtx(None)
+        e_args = CollectionProxy(None, name="e_args")
+        e_kwargs = CollectionProxy(None, name="e_kwargs")
+        with tracectx(epilogue_trace):
+            for path, p in mutations:
+                b = prims.write_path.bind(e_args, e_kwargs, path, p, output=None)
+                epilogue_trace.record(b)
+            prims.python_return(None)
+        e_si = SigInfo(
+            name="epilogue",
+            args=[("e_args", None), ("e_kwargs", None)] + [(p.name, None) for p in mutated_values],
+        )
+        epilogue_trace.set_siginfo(e_si)
+        epilogue_trace.args = (e_args, e_kwargs) + tuple(mutated_values)
+        epilogue_trace.set_provenance("Epilogue (input-container mutations)")
 
     return TraceResults(prologue_trace, computation_trace, epilogue_trace, [])
+
+
+def _detect_mutations(orig_proxies, spec, proxy_args, proxy_kwargs):
+    """Compares the post-trace argument containers against the originally
+    unpacked leaves; a replaced TensorProxy leaf is a recorded mutation.
+    Returns [(path, new_proxy)] with jax keypaths converted to plain keys."""
+    import jax.tree_util as jtu
+
+    new_paths_leaves, new_spec = jtu.tree_flatten_with_path((tuple(proxy_args), dict(proxy_kwargs)))
+    if new_spec != spec:
+        raise NotImplementedError(
+            "the traced function changed the *structure* of an input container "
+            "(added/removed keys); only replacing existing entries is supported"
+        )
+
+    def plain(entry):
+        if isinstance(entry, jtu.SequenceKey):
+            return entry.idx
+        if isinstance(entry, jtu.DictKey):
+            return entry.key
+        if isinstance(entry, jtu.GetAttrKey):  # pragma: no cover
+            return entry.name
+        if isinstance(entry, jtu.FlattenedIndexKey):  # pragma: no cover
+            return entry.key
+        return entry
+
+    mutations = []
+    for (path, new), old in zip(new_paths_leaves, orig_proxies):
+        if new is old:
+            continue
+        if not isinstance(new, TensorProxy):
+            raise NotImplementedError(
+                f"input container entry at {tuple(plain(k) for k in path)} was replaced "
+                f"by a non-tensor ({type(new).__name__}); only tensor state updates are supported"
+            )
+        mutations.append((tuple(plain(k) for k in path), new))
+    return mutations

@@ -64,6 +64,30 @@ class TestVmap:
         ref = tt.jit(lambda p, i, c, s: llama.gpt_forward(p, i, c, s, cfg))(params, idx, cos, sin)
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-4, atol=1e-4)
 
+    def test_scalar_leaf_in_batched_pytree(self):
+        # review regression: 0-d leaves of a batched arg broadcast, they are
+        # not sliced or given a phantom batch dim
+        xb = rng.standard_normal((6, 4)).astype(np.float32)
+
+        def f(d):
+            return d["x"] * d["scale"]
+
+        got = np.asarray(tt.vmap(f)({"x": xb, "scale": np.float32(2.0)}))
+        np.testing.assert_allclose(got, xb * 2.0, rtol=1e-6)
+
+    def test_dtype_polymorphic_cache(self):
+        # review regression: same shapes, different dtype must not reuse a
+        # cached op whose metadata reports the first call's dtype
+        a32 = rng.standard_normal((4, 4)).astype(np.float32)
+        f = lambda x: ltorch.mul(x, x)
+        out32 = tt.vmap(f)(a32)
+        a16 = jnp.asarray(a32).astype(jnp.bfloat16)
+        out16 = tt.vmap(f)(np.asarray(a16))
+        assert str(jnp.asarray(out16).dtype) == "bfloat16", jnp.asarray(out16).dtype
+        np.testing.assert_allclose(
+            np.asarray(out16, dtype=np.float32), a32 * a32, rtol=5e-2, atol=5e-2
+        )
+
     def test_random_rejected(self):
         xb = rng.standard_normal((3, 4)).astype(np.float32)
         with pytest.raises(Exception, match="random"):
@@ -95,6 +119,17 @@ class TestJvp:
         jy, jdy = jax.jvp(lambda a: jnp.sum(a @ jnp.asarray(w)), (jnp.asarray(x),), (jnp.asarray(dx),))
         np.testing.assert_allclose(float(y), float(jy), rtol=1e-5)
         np.testing.assert_allclose(float(dy), float(jdy), rtol=1e-5)
+
+    def test_leading_none_tangent_alignment(self):
+        # review regression: a None tangent for a LEADING same-shaped arg must
+        # not shift the tangent onto the wrong primal (jax pytrees drop None)
+        x = rng.standard_normal((4, 4)).astype(np.float32)
+        w = rng.standard_normal((4, 4)).astype(np.float32)
+        dw = rng.standard_normal((4, 4)).astype(np.float32)
+        y, dy = tt.jvp(lambda a, b: ltorch.sum(ltorch.matmul(a, b)), (x, w), (None, dw))
+        jy, jdy = jax.jvp(lambda b: jnp.sum(jnp.asarray(x) @ b), (jnp.asarray(w),), (jnp.asarray(dw),))
+        np.testing.assert_allclose(float(y), float(jy), rtol=1e-5)
+        np.testing.assert_allclose(float(dy), float(jdy), rtol=1e-4)
 
     def test_composite_network(self):
         x = rng.standard_normal((2, 6)).astype(np.float32)

@@ -206,7 +206,13 @@ def _compile(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict) -> Ca
     from thunder_tpu.core.sharp_edges import sharp_edges_guard
 
     with sharp_edges_guard(cd.sharp_edges):
-        trace_results: TraceResults = trace_from_fn(cd.fn, args, kwargs, grad_argnums=grad_argnums)
+        trace_results: TraceResults = trace_from_fn(
+            cd.fn,
+            args,
+            kwargs,
+            grad_argnums=grad_argnums,
+            interpretation=cd.compile_options.get("interpretation"),
+        )
     cs.last_trace_tracing_stop = time.perf_counter_ns()
 
     prologue_trace = trace_results.prologue_trace

@@ -16,8 +16,7 @@ have tangents, and rebuilding output metadata.
 """
 from __future__ import annotations
 
-import hashlib
-from functools import partial
+
 from typing import Any, Callable, Sequence
 
 import numpy as _np
@@ -48,31 +47,16 @@ def _flatten_prims(bsyms):
 
 
 def _static_key(x):
-    """Value-faithful hashable key for non-tensor args (mirrors the generic
-    VJP cache's keying)."""
-    import jax
+    # one keying implementation shared with the generic VJP cache
+    from thunder_tpu.core.transforms import static_arg_key
 
-    if isinstance(x, TensorProxy):
-        return "·"
-    if isinstance(x, (bool, int, float, complex, str, bytes, type(None))):
-        return x
-    if isinstance(x, (_np.ndarray, jax.Array)):
-        arr = _np.asarray(x)
-        return ("ndarray", arr.shape, str(arr.dtype), hashlib.sha1(arr.tobytes()).hexdigest())
-    try:
-        hash(x)
-        return x
-    except TypeError:
-        return ("repr", repr(x))
+    return static_arg_key(x)
 
 
 def _devalue(x):
-    if isinstance(x, TensorProxy) or not isinstance(x, Proxy):
-        return x
-    v = getattr(x, "value", None)
-    if v is None:
-        raise NotImplementedError(f"cannot bake symbolic arg {x} into a vmap/jvp rule")
-    return v
+    from thunder_tpu.core.transforms import devalue_static_arg
+
+    return devalue_static_arg(x, owner="a vmap/jvp rule")
 
 
 def _bound_impl(bsym: BoundSymbol):
@@ -182,23 +166,28 @@ def vmap_trace(trace: TraceCtx, batched_in: Sequence[bool], batch_size: int) -> 
                         env[old.name] = new
                 continue
 
-            out_shapes = tuple(
-                tuple(o.shape) if isinstance(o, TensorProxy) else None for o in flat_outs
-            )
-            key = ("vmap", bsym.sym.id, axes, static_sig, out_shapes, batch_size)
+            # shape-polymorphic cache (one op per (prim, axes, static-args),
+            # NOT per shape/batch — a loop over batch sizes must not grow the
+            # executor registry): the meta derives output metadata from the
+            # call's proxies via jax.eval_shape of the vmapped impl
+            key = ("vmap", bsym.sym.id, axes, spec, static_sig)
             op = _vmap_op_cache.get(key)
             if op is None:
                 vfn = jax.vmap(fn, in_axes=axes)
 
-                def meta(*a, _outs=flat_outs, _B=batch_size):
+                def meta(*a, _vfn=vfn):
+                    structs = [
+                        jax.ShapeDtypeStruct(tuple(t.shape), dtypes.to_jax_dtype(t.dtype))
+                        for t in a
+                    ]
+                    out = jax.eval_shape(_vfn, *structs)
+                    flat_o, _ = tree_flatten(out)
                     res = tuple(
                         TensorProxy(
-                            shape=(_B,) + tuple(o.shape), device=o.device, dtype=o.dtype,
-                            requires_grad=False,
+                            shape=tuple(o.shape), device=a[0].device,
+                            dtype=dtypes.from_jax_dtype(o.dtype), requires_grad=False,
                         )
-                        if isinstance(o, TensorProxy)
-                        else o
-                        for o in _outs
+                        for o in flat_o
                     )
                     return res[0] if len(res) == 1 else res
 
@@ -279,8 +268,9 @@ def jvp_trace(trace: TraceCtx, has_tangent: Sequence[bool]) -> TraceCtx:
                 prims.python_return((primal_out, tangent_out))
                 continue
             if bsym.sym.tags and OpTags.RANDOM_OP in bsym.sym.tags:
-                # randomness has no tangent; re-emit as-is
-                pass
+                raise NotImplementedError(
+                    "jvp over random ops is not supported yet (randomness has no tangent)"
+                )
 
             fn, tensor_args, tpos, spec, static_sig = _bound_impl(bsym)
             flat_outs, out_spec = _out_proxies(bsym)
@@ -299,10 +289,7 @@ def jvp_trace(trace: TraceCtx, has_tangent: Sequence[bool]) -> TraceCtx:
             # differentiable tensor slots: float tensors get real tangents,
             # exact-dtype tensors are non-differentiable constants for jax.jvp
             diff = [dtypes.is_inexact_dtype(t.dtype) for t in tensor_args]
-            out_shapes = tuple(
-                tuple(o.shape) if isinstance(o, TensorProxy) else None for o in flat_outs
-            )
-            key = ("jvp", bsym.sym.id, tuple(diff), static_sig, out_shapes)
+            key = ("jvp", bsym.sym.id, tuple(diff), spec, static_sig)
             op = _jvp_op_cache.get(key)
             if op is None:
                 n_diff = sum(diff)
@@ -322,16 +309,22 @@ def jvp_trace(trace: TraceCtx, has_tangent: Sequence[bool]) -> TraceCtx:
                         return outs, douts
                     return tuple(outs) + tuple(douts)
 
-                def meta(*a, _outs=flat_outs):
-                    def mk(o):
-                        if isinstance(o, TensorProxy):
-                            return TensorProxy(
-                                shape=o.shape, device=o.device, dtype=o.dtype, requires_grad=False
-                            )
-                        return o
-
-                    # fresh proxies per slot: primal outs then tangent outs
-                    return tuple(mk(o) for o in _outs) + tuple(mk(o) for o in _outs)
+                def meta(*a, _jfn=jfn):
+                    # shape-polymorphic: (primal outs..., tangent outs...)
+                    # derived from the call's proxies, not the first trace's
+                    structs = [
+                        jax.ShapeDtypeStruct(tuple(t.shape), dtypes.to_jax_dtype(t.dtype))
+                        for t in a
+                    ]
+                    out = jax.eval_shape(_jfn, *structs)
+                    flat_o, _ = tree_flatten(out)
+                    return tuple(
+                        TensorProxy(
+                            shape=tuple(o.shape), device=a[0].device,
+                            dtype=dtypes.from_jax_dtype(o.dtype), requires_grad=False,
+                        )
+                        for o in flat_o
+                    )
 
                 op = _get_executor().register_operator(
                     f"jvp_{bsym.sym.name}_{len(_jvp_op_cache)}", meta=meta, fn=jfn
@@ -407,6 +400,20 @@ def _as_jax(x):
     return x
 
 
+def _coerce_leaves(tree):
+    """Normalizes a user pytree for the vmap/jvp wrappers: torch/numpy arrays
+    → jax arrays; 0-d numpy scalars → python numbers (so they trace as
+    number constants, matching the frontend's tensor predicate)."""
+    from thunder_tpu.core.pytree import tree_map
+
+    def fix(x):
+        if isinstance(x, _np.generic):
+            return x.item()
+        return _as_jax(x)
+
+    return tree_map(fix, tree)
+
+
 def vmap(fn: Callable, in_axes: int | Sequence[Any] = 0, out_axes: int = 0, **jit_kwargs) -> Callable:
     """Vectorizing transform over compiled traces (reference transforms.py:2070).
 
@@ -418,7 +425,7 @@ def vmap(fn: Callable, in_axes: int | Sequence[Any] = 0, out_axes: int = 0, **ji
     cache: dict = {}
 
     def wrapped(*args):
-        args = tuple(_as_jax(a) if not isinstance(a, (int, float, bool, str, type(None))) else a for a in args)
+        args = tuple(_coerce_leaves(a) for a in args)
         axes = in_axes if isinstance(in_axes, (tuple, list)) else (in_axes,) * len(args)
         check(len(axes) == len(args), lambda: "vmap: in_axes length mismatch")
         for a in axes:
@@ -432,16 +439,21 @@ def vmap(fn: Callable, in_axes: int | Sequence[Any] = 0, out_axes: int = 0, **ji
             leaves, spec = tree_flatten(a)
             if ax == 0:
                 s_leaves = []
+                leaf_flags = []
                 for leaf in leaves:
                     if hasattr(leaf, "shape") and getattr(leaf, "ndim", 0) > 0:
                         B_l = leaf.shape[0]
                         check(B is None or B == B_l, lambda: "vmap: inconsistent batch sizes")
                         B = B_l
                         s_leaves.append(leaf[0])
+                        leaf_flags.append(True)
                     else:
+                        # 0-d leaves in a batched pytree broadcast, they have
+                        # no axis to map over
                         s_leaves.append(leaf)
+                        leaf_flags.append(False)
                 samples.append(tree_unflatten(s_leaves, spec))
-                flat_per_arg.append([True] * len(leaves))
+                flat_per_arg.append(leaf_flags)
             else:
                 samples.append(a)
                 flat_per_arg.append([False] * len(leaves))
@@ -464,12 +476,14 @@ def vmap(fn: Callable, in_axes: int | Sequence[Any] = 0, out_axes: int = 0, **ji
                 not getattr(comp, "_mutations", None),
                 lambda: "vmap over functions that mutate input containers is not supported",
             )
+            from thunder_tpu.functional import _is_tensor_like
+
             flat_flags = [f for fl in flat_per_arg for f in fl]
-            # align flags with comp.args (tensor proxies in flatten order)
+            # align flags with comp.args (tensor proxies in flatten order) —
+            # same tensor predicate as the frontend, so 0-d numpy scalars
+            # (coerced to python numbers) never count as tensors
             flat_all, _ = tree_flatten((tuple(samples), {}))
-            tensor_flags = [
-                f for f, leaf in zip(flat_flags, flat_all) if hasattr(leaf, "shape") or hasattr(leaf, "dtype")
-            ]
+            tensor_flags = [f for f, leaf in zip(flat_flags, flat_all) if _is_tensor_like(leaf)]
             tensor_flags = tensor_flags[: len(comp.args)]
             while len(tensor_flags) < len(comp.args):
                 tensor_flags.append(False)
@@ -478,7 +492,9 @@ def vmap(fn: Callable, in_axes: int | Sequence[Any] = 0, out_axes: int = 0, **ji
             cache[key] = entry
 
         flat_all, _ = tree_flatten((tuple(args), {}))
-        tensors = [_as_jax(l) for l in flat_all if hasattr(l, "shape") or hasattr(l, "dtype")]
+        from thunder_tpu.functional import _is_tensor_like as _itl
+
+        tensors = [_as_jax(l) for l in flat_all if _itl(l)]
         return entry(*tensors)
 
     wrapped.__wrapped__ = fn
@@ -491,8 +507,8 @@ def jvp(fn: Callable, primals: Sequence, tangents: Sequence, **jit_kwargs):
     from thunder_tpu.functional import trace_from_fn
 
     check(len(primals) == len(tangents), lambda: "jvp: primals/tangents length mismatch")
-    primals = tuple(_as_jax(p) if not isinstance(p, (int, float, bool, str, type(None))) else p for p in primals)
-    tangents = tuple(_as_jax(t) if t is not None else None for t in tangents)
+    primals = tuple(_coerce_leaves(p) for p in primals)
+    tangents = tuple(_coerce_leaves(t) if t is not None else None for t in tangents)
 
     tr = trace_from_fn(fn, primals, {})
     comp = tr.computation_trace
@@ -506,14 +522,21 @@ def jvp(fn: Callable, primals: Sequence, tangents: Sequence, **jit_kwargs):
     )
 
     flat_p, _ = tree_flatten((primals, {}))
-    flat_t, _ = tree_flatten((tuple(tangents), {}))
+    from thunder_tpu.functional import _is_tensor_like as _itl
+
+    tensor_leaves = [l for l in flat_p if _itl(l)]
+    # align tangents with primal tensor leaves.  jax pytrees treat None as an
+    # EMPTY subtree (a flatten would silently drop it and shift every later
+    # tangent onto the wrong primal), so flatten with None kept as a leaf
+    flat_t_full, _ = tree_flatten((tuple(tangents), {}), is_leaf=lambda x: x is None)
+    tan_leaves = [l for l in flat_t_full if l is None or hasattr(l, "shape") or hasattr(l, "dtype")]
+    check(
+        len(tan_leaves) == len(tensor_leaves),
+        lambda: f"jvp: tangents structure must mirror primals ({len(tan_leaves)} tangent "
+        f"leaves vs {len(tensor_leaves)} primal tensor leaves); use None for no-tangent slots",
+    )
     tensor_flags = []
     tan_vals = []
-    ti = 0
-    tensor_leaves = [l for l in flat_p if hasattr(l, "shape") or hasattr(l, "dtype")]
-    # align tangents with primal tensor leaves: tangents pytree must mirror primals
-    flat_t_full, _ = tree_flatten((tuple(tangents), {}))
-    tan_leaves = [l for l in flat_t_full if l is None or hasattr(l, "shape") or hasattr(l, "dtype")]
     for pl, tl in zip(tensor_leaves, tan_leaves):
         if tl is not None and hasattr(tl, "shape"):
             tensor_flags.append(True)

@@ -1,0 +1,166 @@
+"""The general jit: bytecode interpretation + provenance-driven prologues.
+
+Capability analog of the reference's ``thunder/core/jit_ext.py`` (
+``thunder_general_jit`` :1398 — configures the interpreter to proxy tensors
+on first touch and to build prologue unpack/check chains from provenance).
+The TPU-native shape of the idea:
+
+- the interpreter (``core/interpreter.py``) runs the user's bytecode and
+  reports every read rooted in *function state* — globals, closure cells,
+  and attr/item chains hanging off them;
+- tensors found there are proxied on first touch and become **extra
+  computation inputs**, re-fetched by the prologue through the same access
+  path (``unpack_getitem``/``unpack_attr`` chains);
+- plain-value reads (hyperparameters, flags, shapes) become **guards**:
+  the prologue re-reads them and ``check``s equality, so mutating a global
+  triggers a retrace instead of stale results — the CONSTANT_VALUES caching
+  contract extended beyond explicit arguments.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from thunder_tpu.core import prims
+from thunder_tpu.core.interpreter import ProvenanceRecord, interpret
+from thunder_tpu.core.proxies import CollectionProxy, Proxy, TensorProxy, tensorproxy
+
+__all__ = ["interpret_with_state", "StateCapture", "build_state_prologue"]
+
+
+def _is_tensor_like(x) -> bool:
+    import jax
+    import numpy as np
+
+    if isinstance(x, (jax.Array, np.ndarray)):
+        return True
+    try:
+        import torch
+
+        return isinstance(x, torch.Tensor)
+    except ImportError:  # pragma: no cover
+        return False
+
+
+_GUARDABLE = (int, float, bool, str, bytes, type(None))
+
+
+def _guardable(v) -> bool:
+    if isinstance(v, _GUARDABLE):
+        return True
+    if isinstance(v, tuple) and all(isinstance(e, _GUARDABLE) for e in v):
+        return True
+    return False
+
+
+class StateCapture:
+    """What the interpreter observed outside the explicit arguments."""
+
+    def __init__(self):
+        # path -> (value,) guards to re-check in the prologue
+        self.guards: dict[tuple, Any] = {}
+        # path -> (concrete value, proxy) extra tensor inputs
+        self.tensors: dict[tuple, tuple[Any, TensorProxy]] = {}
+
+    @property
+    def tensor_proxies(self) -> list[TensorProxy]:
+        return [p for _, p in self.tensors.values()]
+
+
+def interpret_with_state(fn: Callable, proxy_args: tuple, proxy_kwargs: dict):
+    """Runs ``fn`` through the bytecode interpreter (under an active trace
+    context) and returns ``(result, StateCapture)``."""
+    cap = StateCapture()
+
+    def read_cb(record: ProvenanceRecord, value):
+        path = record.path()
+        if path is None:
+            return value
+        if path in cap.tensors:
+            return cap.tensors[path][1]
+        if _is_tensor_like(value):
+            p = tensorproxy(value)
+            cap.tensors[path] = (value, p)
+            return p
+        if _guardable(value) and path not in cap.guards:
+            cap.guards[path] = value
+        return value
+
+    result, _ctx = interpret(fn, *proxy_args, read_callback=read_cb, **proxy_kwargs)
+    return result, cap
+
+
+def build_state_prologue(prologue_trace, fn: Callable, cap: StateCapture, dtype_str_fn) -> list[TensorProxy]:
+    """Emits unpack chains + guards for captured state into the (active)
+    prologue trace.  Returns the extra tensor proxies, in capture order.
+
+    Must run inside ``tracectx(prologue_trace)``.
+    """
+    if not cap.guards and not cap.tensors:
+        return []
+
+    closure = {}
+    if fn.__closure__:
+        closure = dict(zip(fn.__code__.co_freevars, fn.__closure__))
+    state = {"globals": fn.__globals__, "closure": closure}
+
+    root = CollectionProxy(None, name="fn_state")
+    b = prims.unpack_trivial.bind(root, name="fn_state", output=root, _call_ctx={"fn_state": state})
+    prologue_trace.record(b)
+
+    # chain-unpack cache: partial path -> proxy
+    unpacked: dict[tuple, Proxy] = {}
+
+    def root_coll(kind: str) -> Proxy:
+        key = ("__root__", kind)
+        coll = unpacked.get(key)
+        if coll is None:
+            coll = CollectionProxy(None, name=f"fn_{kind}")
+            prologue_trace.record(prims.unpack_getitem.bind(root, kind, output=coll))
+            unpacked[key] = coll
+        return coll
+
+    def unpack(path: tuple, out_proxy: Proxy | None = None) -> Proxy:
+        """Emits the unpack chain for ``path``; ``out_proxy`` names the final
+        step's output (tensor leaves reuse the computation proxy's name so the
+        prologue's returned tensors line up with the computation signature)."""
+        if out_proxy is None and path in unpacked:
+            return unpacked[path]
+        kind, key = path[-1]
+        if kind in ("globals", "closure"):
+            coll = root_coll(kind)
+            if kind == "closure":
+                cell = CollectionProxy(None)
+                prologue_trace.record(prims.unpack_getitem.bind(coll, key, output=cell))
+                out = out_proxy if out_proxy is not None else CollectionProxy(None)
+                prologue_trace.record(prims.unpack_attr.bind(cell, "cell_contents", output=out))
+            else:
+                out = out_proxy if out_proxy is not None else CollectionProxy(None)
+                prologue_trace.record(prims.unpack_getitem.bind(coll, key, output=out))
+        else:
+            base = unpack(path[:-1])
+            out = out_proxy if out_proxy is not None else CollectionProxy(None)
+            prim = prims.unpack_attr if kind == "attr" else prims.unpack_getitem
+            prologue_trace.record(prim.bind(base, key, output=out))
+        if out_proxy is None:
+            unpacked[path] = out
+        return out
+
+    for path, value in cap.guards.items():
+        leaf = unpack(path)
+        if isinstance(value, str):
+            prims.check_string_value(leaf, value)
+        else:
+            prims.check_number_type_and_value(leaf, value)
+
+    extra: list[TensorProxy] = []
+    for path, (value, proxy) in cap.tensors.items():
+        leaf_p = unpack(path, out_proxy=proxy.replace_name(proxy.name))
+        prims.check_tensor_metadata(
+            leaf_p,
+            tuple(proxy.shape),
+            proxy.device.device_str(),
+            dtype_str_fn(value, proxy),
+            bool(getattr(value, "requires_grad", False)),
+        )
+        extra.append(leaf_p)
+    return extra

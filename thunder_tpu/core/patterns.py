@@ -154,9 +154,7 @@ def match_replace(trace: TraceCtx, pattern: Pattern, builder: Callable) -> Trace
     replace_at: dict[int, tuple[list[BoundSymbol], dict]] = {}
     skip: set[int] = set()
     index_of = {id(b): i for i, b in enumerate(trace.bound_symbols)}
-    consumed_outside: dict[str, bool] = {}
 
-    # which proxies are consumed outside each match
     for group, ctx in matches:
         gidx = [index_of[id(b)] for b in group]
         member = set(gidx)

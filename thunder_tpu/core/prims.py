@@ -1308,11 +1308,10 @@ unpack_flatten = make_prim(
 )
 
 
-def _unpack_getitem_impl(coll, key):
-    x = coll[key]
-    # torch/numpy tensors cross into jax here (host boundary); jnp.asarray
-    # canonicalizes 64-bit dtypes so the value matches the proxy's
-    # (canonicalize_dtype'd) metadata and the guard that checks it
+def _to_jax_boundary(x):
+    """torch/numpy tensors cross into jax here (host boundary); jnp.asarray
+    canonicalizes 64-bit dtypes so the value matches the proxy's
+    (canonicalize_dtype'd) metadata and the guard that checks it."""
     import numpy as np
 
     if isinstance(x, np.ndarray):
@@ -1340,6 +1339,10 @@ def _unpack_getitem_impl(coll, key):
     return x
 
 
+def _unpack_getitem_impl(coll, key):
+    return _to_jax_boundary(coll[key])
+
+
 unpack_getitem = make_prim(
     PrimIDs.UNPACK_GETITEM,
     "unpack_getitem",
@@ -1350,7 +1353,7 @@ unpack_getitem = make_prim(
 
 
 def _unpack_attr_impl(obj, name):
-    return getattr(obj, name)
+    return _to_jax_boundary(getattr(obj, name))
 
 
 unpack_attr = make_prim(

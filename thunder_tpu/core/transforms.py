@@ -731,6 +731,46 @@ _generic_vjp_cache: dict[tuple, Any] = {}
 _generic_vjp_pinned: list[Any] = []
 
 
+def devalue_static_arg(x, *, owner: str = "?"):
+    """Non-tensor proxies are replaced by their concrete value: the value is
+    what the runtime impl needs (a proxy object would crash it), and it gives
+    rule caches a value-stable key across recompiles (identity or name keys
+    would defeat the cache every trace).  Shared by the generic VJP fallback
+    and the vmap/jvp rule synthesis (core/batching.py)."""
+    if isinstance(x, TensorProxy) or not isinstance(x, Proxy):
+        return x
+    v = getattr(x, "value", None)
+    if v is None:
+        raise NotImplementedError(
+            f"cannot bake symbolic (unknown-value) arg {x} of {owner} into a "
+            f"synthesized rule; register an explicit rule"
+        )
+    return v
+
+
+def static_arg_key(x):
+    """Value-faithful, hashable cache-key component for a (devalued) static
+    arg.  repr() would truncate big numpy arrays (silent wrong sharing) or
+    embed memory addresses (silent cache misses → registry leaks)."""
+    import jax
+
+    if isinstance(x, TensorProxy):
+        return "·"
+    if isinstance(x, (bool, int, float, complex, str, bytes, type(None))):
+        return x
+    if isinstance(x, (_np.ndarray, jax.Array)):
+        arr = _np.asarray(x)
+        return ("ndarray", arr.shape, str(arr.dtype), hashlib.sha1(arr.tobytes()).hexdigest())
+    try:
+        hash(x)
+        return x
+    except TypeError:
+        # unhashable & unknown: per-object key, pinned alive so the id can't
+        # be recycled onto a different value
+        _generic_vjp_pinned.append(x)
+        return ("id", id(x))
+
+
 def _generic_vjp_rule(bsym: BoundSymbol, *cotangents):
     import jax
 
@@ -747,39 +787,9 @@ def _generic_vjp_rule(bsym: BoundSymbol, *cotangents):
         return []
 
     def _devalue(x):
-        # Non-tensor proxies are replaced by their concrete value: the value
-        # is what the runtime impl needs (a proxy object would crash it), and
-        # it gives the cache a value-stable key across recompiles (identity
-        # or name keys would defeat the cache every trace).
-        if isinstance(x, TensorProxy) or not isinstance(x, Proxy):
-            return x
-        v = getattr(x, "value", None)
-        if v is None:
-            raise NotImplementedError(
-                f"generic VJP fallback cannot bake symbolic (unknown-value) arg {x} "
-                f"of {bsym.sym.name}; register an explicit backward rule"
-            )
-        return v
+        return devalue_static_arg(x, owner=bsym.sym.name)
 
-    def _key_static(x):
-        # value-faithful, hashable key components: repr() would truncate big
-        # numpy arrays (silent wrong sharing) or embed memory addresses
-        # (silent cache misses → the leak this cache exists to fix)
-        if isinstance(x, TensorProxy):
-            return "·"
-        if isinstance(x, (bool, int, float, complex, str, bytes, type(None))):
-            return x
-        if isinstance(x, (_np.ndarray, jax.Array)):
-            arr = _np.asarray(x)
-            return ("ndarray", arr.shape, str(arr.dtype), hashlib.sha1(arr.tobytes()).hexdigest())
-        try:
-            hash(x)
-            return x
-        except TypeError:
-            # unhashable & unknown: per-object key, pinned alive so the id
-            # can't be recycled onto a different value
-            _generic_vjp_pinned.append(x)
-            return ("id", id(x))
+    _key_static = static_arg_key
 
     flat_args, spec = tree_flatten((bsym.args, bsym.kwargs))
     flat_args = [_devalue(x) for x in flat_args]

@@ -16,7 +16,9 @@ from thunder_tpu.distributed.checkpoint import (
     load_checkpoint,
     save_checkpoint,
 )
+from thunder_tpu.distributed.moe import ep_moe_mlp, expert_capacity
 from thunder_tpu.distributed.prims import DistributedReduceOps
+from thunder_tpu.distributed.ring_attention import ring_attention, ring_self_attention
 from thunder_tpu.distributed.sharding import (
     ShardingRules,
     apply_shardings,
@@ -47,4 +49,8 @@ __all__ = [
     "save_checkpoint",
     "load_checkpoint",
     "latest_step",
+    "ring_attention",
+    "ring_self_attention",
+    "ep_moe_mlp",
+    "expert_capacity",
 ]

@@ -156,6 +156,7 @@ class TrainStep:
         donate: bool = True,
         remat: bool = True,
         zero3: bool = False,
+        executors=None,
     ):
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -164,6 +165,7 @@ class TrainStep:
         self.donate = donate
         self.remat = remat
         self.zero3 = zero3
+        self.executors = executors
         # compiled steps keyed by batch signature (shape/dtype per arg):
         # shardings are pruned against concrete shapes, so a new shape needs
         # a fresh build
@@ -207,6 +209,15 @@ class TrainStep:
             fw_trace, bw_trace = rematerialize_forward_and_backward(
                 fw_trace, bw_trace, max_cone=256 if self.zero3 else 64, aggressive=self.zero3
             )
+        # one execution pipeline: the same claiming pass the jit path uses, so
+        # operator executors (pallas flash attention, int8) claim symbols here
+        # too instead of relying on jaxex fast-path hooks alone
+        from thunder_tpu.executors.passes import transform_for_execution
+        from thunder_tpu.extend import get_default_executors
+
+        executors = self.executors if self.executors is not None else get_default_executors()
+        fw_trace = transform_for_execution(fw_trace, executors)
+        bw_trace = transform_for_execution(bw_trace, executors)
         self.fw_trace, self.bw_trace = fw_trace, bw_trace
         fw_fn = _trace_to_jax_fn(fw_trace)
         bw_fn = _trace_to_jax_fn(bw_trace)
@@ -361,7 +372,9 @@ def make_train_step(
     donate: bool = True,
     remat: bool = True,
     zero3: bool = False,
+    executors=None,
 ) -> TrainStep:
     return TrainStep(
-        loss_fn, optimizer, mesh, batch_specs=batch_specs, donate=donate, remat=remat, zero3=zero3
+        loss_fn, optimizer, mesh, batch_specs=batch_specs, donate=donate, remat=remat,
+        zero3=zero3, executors=executors,
     )

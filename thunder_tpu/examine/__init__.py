@@ -85,16 +85,18 @@ def examine(fn: Callable, *args, **kwargs) -> bool:
 
         jfn = tt.jit(fn)
         jit_result = jfn(*args, **kwargs)
+        diverged = False
         try:
             a = np.asarray(jit_result)
             b = torch_result.detach().to(torch.float32).numpy() if isinstance(torch_result, torch.Tensor) else np.asarray(torch_result)
             if a.shape == getattr(b, "shape", None):
                 ok = np.allclose(np.asarray(a, dtype=np.float32), np.asarray(b, dtype=np.float32), rtol=1e-3, atol=1e-4)
+                diverged = not ok
                 print("jit result matches eager torch" if ok else "WARNING: jit result DIVERGES from eager torch")
         except Exception:
             pass
         print("thunder_tpu.jit compiled and ran the function successfully")
-        return not unsupported
+        return not unsupported and not diverged
     except Exception as e:
         print(f"thunder_tpu.jit failed: {type(e).__name__}: {e}")
         return False

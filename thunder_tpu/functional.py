@@ -79,11 +79,22 @@ def _dtype_str(x, proxy=None) -> str:
     return str(x.dtype).replace("torch.", "")
 
 
-def trace_from_fn(fn: Callable, args: tuple, kwargs: dict, *, grad_argnums: tuple | None = None) -> TraceResults:
+def trace_from_fn(
+    fn: Callable,
+    args: tuple,
+    kwargs: dict,
+    *,
+    grad_argnums: tuple | None = None,
+    interpretation: str | None = None,
+) -> TraceResults:
     """Runs ``fn`` over proxies, returning prologue/computation/epilogue traces.
 
     ``grad_argnums`` marks the float tensor leaves of those positional args
     with ``requires_grad=True`` so the fw/bw split differentiates them.
+
+    ``interpretation="bytecode"`` runs ``fn`` through the bytecode interpreter
+    (the general jit, reference jit_ext.py:1398): globals/closure reads become
+    prologue guards and tensors found there become extra computation inputs.
     """
     from thunder_tpu.core.pytree import tree_map
 
@@ -130,9 +141,15 @@ def trace_from_fn(fn: Callable, args: tuple, kwargs: dict, *, grad_argnums: tupl
 
     proxy_args, proxy_kwargs = tree_unflatten(proxies, spec)
 
+    state_cap = None
     with tracectx(computation_trace):
         with langctx(Languages.TORCH):
-            result = fn(*proxy_args, **proxy_kwargs)
+            if interpretation == "bytecode":
+                from thunder_tpu.core.jit_ext import interpret_with_state
+
+                result, state_cap = interpret_with_state(fn, tuple(proxy_args), dict(proxy_kwargs))
+            else:
+                result = fn(*proxy_args, **proxy_kwargs)
         # epilogue: record mutations of the input containers (the reference
         # records setattrs into an epilogue trace, jit_ext.py:1336; here the
         # observable state is the argument pytree — d[key] = new_tensor in
@@ -153,8 +170,11 @@ def trace_from_fn(fn: Callable, args: tuple, kwargs: dict, *, grad_argnums: tupl
             prims.python_return(result)
     computation_trace._mutations = mutations
 
-    # computation inputs: tensor proxies in flattening order (+ implicit rng key)
+    # computation inputs: tensor proxies in flattening order (+ captured
+    # state tensors from the bytecode frontend, + implicit rng key)
     comp_inputs: list[TensorProxy] = [p for p in proxies if isinstance(p, TensorProxy)]
+    state_tensor_proxies = state_cap.tensor_proxies if state_cap is not None else []
+    comp_inputs = comp_inputs + state_tensor_proxies
     rng_key = getattr(computation_trace, "_rng_key_proxy", None)
     uses_rng = rng_key is not None
     if uses_rng:
@@ -206,8 +226,15 @@ def trace_from_fn(fn: Callable, args: tuple, kwargs: dict, *, grad_argnums: tupl
             else:
                 pro_leaf_proxies.append(None)
 
+        # captured-state unpack chains + guards (bytecode frontend)
+        state_out: list[TensorProxy] = []
+        if state_cap is not None and (state_cap.guards or state_cap.tensors):
+            from thunder_tpu.core.jit_ext import build_state_prologue
+
+            state_out = build_state_prologue(prologue_trace, fn, state_cap, _dtype_str)
+
         # return the tensors the computation consumes, in order
-        out_tensors = tuple(p for p in pro_leaf_proxies if isinstance(p, TensorProxy))
+        out_tensors = tuple(p for p in pro_leaf_proxies if isinstance(p, TensorProxy)) + tuple(state_out)
         prims.python_return(out_tensors)
 
     pro_si = SigInfo(name="prologue")

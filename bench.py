@@ -171,18 +171,33 @@ def _resolve_backend() -> str:
 
         force_cpu()  # raises on failure → caught by the __main__ wrapper
         return jax.default_backend()
-    try:
-        return jax.default_backend()
-    except Exception as e:
-        attempt = int(os.environ.get("THUNDER_TPU_BENCH_ATTEMPT", "0"))
-        log(f"backend init failed (attempt {attempt}): {e}")
-        env = dict(os.environ)
-        if attempt < 2:
-            env["THUNDER_TPU_BENCH_ATTEMPT"] = str(attempt + 1)
-            time.sleep(15)
-        else:
-            env["THUNDER_TPU_BENCH_FORCE_CPU"] = "1"
-        os.execve(sys.executable, [sys.executable, os.path.abspath(__file__), *sys.argv[1:]], env)
+    # Probe backend init in a SUBPROCESS with a hard timeout first: a flaky
+    # tunnel can make jax.default_backend() hang for tens of minutes in-process
+    # (observed ~25 min), which would eat the whole bench budget before the
+    # CPU fallback ever ran.
+    import subprocess
+
+    for attempt in range(2):
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c", "import jax; print(jax.default_backend())"],
+                timeout=240,
+                capture_output=True,
+                text=True,
+            )
+        except subprocess.TimeoutExpired:
+            log(f"backend probe timed out (attempt {attempt})")
+            continue
+        if probe.returncode == 0 and probe.stdout.strip():
+            backend = probe.stdout.strip()
+            log(f"backend probe: {backend}")
+            return jax.default_backend()  # init is known-good; do it for real
+        log(f"backend probe failed (attempt {attempt}): {probe.stderr.strip()[-200:]}")
+        time.sleep(15)
+    # TPU unusable: force CPU so a (smoke-mode) number is still produced
+    env = dict(os.environ)
+    env["THUNDER_TPU_BENCH_FORCE_CPU"] = "1"
+    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__), *sys.argv[1:]], env)
 
 
 #

@@ -202,3 +202,59 @@ def test_sharded_flash_matches_reference(interpret_kernels):
     dqr, dkr, dvr = _sdpa_backward_reference(g, q, k, v, out, lse, True, scale)
     for a, b, n in ((dq, dqr, "dq"), (dk, dkr, "dk"), (dv, dvr, "dv")):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4, err_msg=n)
+
+
+#
+# Fused cross-entropy kernel (apex/triton-CE analog)
+#
+
+
+def test_flash_cross_entropy_matches_reference(interpret_kernels):
+    from thunder_tpu.executors.jaxex import _cross_entropy_fwd_reference
+    from thunder_tpu.executors.pallasex import flash_cross_entropy
+
+    rng = np.random.default_rng(3)
+    for N, V in [(64, 1024), (128, 32000)]:
+        logits = jnp.asarray(rng.standard_normal((N, V)).astype(np.float32) * 3)
+        tgt = jnp.asarray(rng.integers(0, V, (N,)).astype(np.int32))
+        got = flash_cross_entropy(logits, tgt)
+        assert got is not None
+        losses, lse = got
+        rl, rlse = _cross_entropy_fwd_reference(logits, tgt)
+        np.testing.assert_allclose(np.asarray(losses), np.asarray(rl), rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(lse), np.asarray(rlse), rtol=1e-5, atol=1e-5)
+
+
+def test_flash_cross_entropy_unsupported_declines(interpret_kernels):
+    from thunder_tpu.executors.pallasex import flash_cross_entropy
+
+    assert flash_cross_entropy(jnp.ones((7, 999)), jnp.zeros(7, dtype=jnp.int32)) is None
+
+
+def test_ce_claimed_in_jit_pipeline(interpret_kernels):
+    rng = np.random.default_rng(4)
+    logits = rng.standard_normal((64, 1024)).astype(np.float32)
+    tgt = rng.integers(0, 1024, (64,)).astype(np.int32)
+    jfn = tt.jit(lambda l, t: ltorch.cross_entropy(l, t))
+    got = float(jfn(logits, tgt))
+    src = tt.last_traces(jfn)[-1].python()
+    assert "pallas_cross_entropy" in src, src
+    import torch
+
+    ref = float(torch.nn.functional.cross_entropy(torch.from_numpy(logits), torch.from_numpy(tgt).long()))
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_ce_grad_same_with_and_without_kernel(monkeypatch):
+    rng = np.random.default_rng(5)
+    logits = rng.standard_normal((64, 1024)).astype(np.float32)
+    tgt = rng.integers(0, 1024, (64,)).astype(np.int32)
+
+    def loss(l, t):
+        return ltorch.cross_entropy(l, t)
+
+    monkeypatch.setenv("THUNDER_TPU_PALLAS_INTERPRET", "1")
+    _, g_on = tt.value_and_grad(loss)(logits, tgt)
+    monkeypatch.setenv("THUNDER_TPU_DISABLE_PALLAS", "1")
+    _, g_off = tt.value_and_grad(loss)(logits, tgt)
+    np.testing.assert_allclose(np.asarray(g_on), np.asarray(g_off), rtol=1e-4, atol=1e-6)

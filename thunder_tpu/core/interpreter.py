@@ -148,7 +148,9 @@ class Frame:
         self.builtins_ = globals_.get("__builtins__", _builtins)
         if isinstance(self.builtins_, types.ModuleType):
             self.builtins_ = self.builtins_.__dict__
-        self.instrs = [i for i in dis.get_instructions(code) if i.opname != "CACHE"]
+        # dis folds EXTENDED_ARG into the following instruction's arg/argval,
+        # so both it and CACHE are transparent here
+        self.instrs = [i for i in dis.get_instructions(code) if i.opname not in ("CACHE", "EXTENDED_ARG")]
         self.offset_to_idx = {i.offset: idx for idx, i in enumerate(self.instrs)}
         self.ctx = ctx
         self.depth = depth
@@ -389,10 +391,15 @@ def _load_deref(frame, ins, i):
             frame.push(frame.localsplus[name])
             return None
         raise InterpreterError(f"free variable {name!r} referenced before assignment")
-    rec = ProvenanceRecord(PseudoInst.LOAD_DEREF, key=name)
-    v = frame.ctx.record_read(rec, cell.cell_contents)
-    frame.ctx.track(v, rec)
-    frame.push(v)
+    if frame.depth == 0:
+        # only the ROOT function's closure is re-locatable by the prologue
+        # (it unpacks fn.__closure__); nested frames' cells are trace-local
+        rec = ProvenanceRecord(PseudoInst.LOAD_DEREF, key=name)
+        v = frame.ctx.record_read(rec, cell.cell_contents)
+        frame.ctx.track(v, rec)
+        frame.push(v)
+    else:
+        frame.push(cell.cell_contents)
 
 
 @register_opcode_handler("STORE_DEREF")

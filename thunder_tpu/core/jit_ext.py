@@ -28,17 +28,11 @@ __all__ = ["interpret_with_state", "StateCapture", "build_state_prologue"]
 
 
 def _is_tensor_like(x) -> bool:
-    import jax
-    import numpy as np
+    # one predicate shared with the functional frontend (deferred import to
+    # avoid a cycle: functional imports this module for the bytecode path)
+    from thunder_tpu.functional import _is_tensor_like as _itl
 
-    if isinstance(x, (jax.Array, np.ndarray)):
-        return True
-    try:
-        import torch
-
-        return isinstance(x, torch.Tensor)
-    except ImportError:  # pragma: no cover
-        return False
+    return _itl(x)
 
 
 _GUARDABLE = (int, float, bool, str, bytes, type(None))

@@ -533,12 +533,23 @@ def _sdpa_backward_impl(g, q, k, v, out, lse, causal, scale):
     return _sdpa_backward_reference(g, q, k, v, out, lse, causal, scale)
 
 
-@impl(PrimIDs.CROSS_ENTROPY_FWD)
-def _cross_entropy_fwd_impl(logits, target):
+_ce_fast_path: Callable | None = None  # installed by pallasex (fused CE kernel)
+
+
+def _cross_entropy_fwd_reference(logits, target):
     lg = logits.astype(jnp.float32)
     lse = jax.nn.logsumexp(lg, axis=-1)
     picked = jnp.take_along_axis(lg, target[:, None].astype(jnp.int32), axis=-1)[:, 0]
     return lse - picked, lse
+
+
+@impl(PrimIDs.CROSS_ENTROPY_FWD)
+def _cross_entropy_fwd_impl(logits, target):
+    if _ce_fast_path is not None:
+        res = _ce_fast_path(logits, target)
+        if res is not None:
+            return res
+    return _cross_entropy_fwd_reference(logits, target)
 
 
 def get_prim_impl(pid: PrimIDs) -> Callable | None:

@@ -542,9 +542,182 @@ ex.register_implementation(PrimIDs.SDPA_BACKWARD, _sdpa_bwd_op, checker=_sdpa_bw
 pallas_ex = ex
 add_default_executor(ex)  # ahead of xla so the claiming pass prefers the kernels
 
+#
+# Fused cross-entropy kernel (the apex/triton-CE analog,
+# reference apex_entropyex.py:15, triton_crossentropy_impl.py:18).
+#
+# One pass over the logits: the vocab dim is tiled along a sequential grid
+# axis and VMEM scratch carries the online-logsumexp state (running max,
+# rescaled sum) plus the picked target logit — so the (N, V) matrix is read
+# from HBM exactly once and no (N, V) log-prob intermediate exists.
+#
+
+
+def _ce_kernel(logits_ref, tgt_ref, loss_ref, lse_ref, m_s, s_s, p_s, *, BN, BV):
+    j = pl.program_id(1)
+    nv = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_s[...] = jnp.full_like(m_s, _MASK_VALUE)
+        s_s[...] = jnp.zeros_like(s_s)
+        p_s[...] = jnp.zeros_like(p_s)
+
+    x = logits_ref[...].astype(jnp.float32)  # (BN, BV)
+    t = tgt_ref[...]  # (BN, 1) int32
+
+    m_prev = m_s[...]
+    m_new = jnp.maximum(m_prev, jnp.max(x, axis=1, keepdims=True))
+    corr = jnp.exp(m_prev - m_new)
+    s_s[...] = s_s[...] * corr + jnp.sum(jnp.exp(x - m_new), axis=1, keepdims=True)
+    m_s[...] = m_new
+
+    # the target logit: exactly one column hits across the whole vocab sweep;
+    # accumulated in raw (unshifted) logit space so no rescaling is needed
+    col = j * BV + jax.lax.broadcasted_iota(jnp.int32, (BN, BV), 1)
+    hit = col == t
+    p_s[...] = p_s[...] + jnp.sum(jnp.where(hit, x, 0.0), axis=1, keepdims=True)
+
+    @pl.when(j == nv - 1)
+    def _finalize():
+        lse = m_s[...] + jnp.log(s_s[...])
+        lse_ref[...] = lse
+        loss_ref[...] = lse - p_s[...]
+
+
+def _ce_blocks(n: int, v: int) -> tuple[int, int] | None:
+    bn = next((b for b in (256, 128, 64, 32, 16, 8) if n % b == 0), None)
+    bv = next((b for b in (2048, 1024, 512, 256, 128) if v % b == 0), None)
+    if bn is None or bv is None:
+        return None
+    return bn, bv
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _flash_ce(logits, target):
+    """logits (N, V) float, target (N,) int -> (losses, lse), both (N,) f32."""
+    N, V = logits.shape
+    BN, BV = _ce_blocks(N, V)
+    kernel = functools.partial(_ce_kernel, BN=BN, BV=BV)
+    params = {}
+    if pltpu is not None and not _interpret():
+        params["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")
+        )
+    losses, lse = pl.pallas_call(
+        kernel,
+        grid=(N // BN, V // BV),
+        in_specs=[
+            pl.BlockSpec((BN, BV), lambda i, j: (i, j)),
+            pl.BlockSpec((BN, 1), lambda i, j: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((BN, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((BN, 1), lambda i, j: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N, 1), jnp.float32),
+            jax.ShapeDtypeStruct((N, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((BN, 1), jnp.float32) if pltpu is not None else None,
+            pltpu.VMEM((BN, 1), jnp.float32) if pltpu is not None else None,
+            pltpu.VMEM((BN, 1), jnp.float32) if pltpu is not None else None,
+        ],
+        interpret=_interpret(),
+        **params,
+    )(logits, target.astype(jnp.int32).reshape(N, 1))
+    return losses[:, 0], lse[:, 0]
+
+
+def _ce_supported(logits_shape, target_shape, logits_dtype) -> bool:
+    if len(logits_shape) != 2 or len(target_shape) != 1:
+        return False
+    try:
+        if jnp.dtype(logits_dtype) not in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
+            return False
+    except TypeError:
+        return False
+    return _ce_blocks(int(logits_shape[0]), int(logits_shape[1])) is not None
+
+
+def _ce_local(logits, target):
+    """Per-shard CE: the kernel when the local shape tiles, else a local jnp
+    fallback (still avoids cross-shard traffic under shard_map)."""
+    if _ce_blocks(int(logits.shape[0]), int(logits.shape[1])) is None:
+        lg = logits.astype(jnp.float32)
+        lse = jax.nn.logsumexp(lg, axis=-1)
+        picked = jnp.take_along_axis(lg, target[:, None].astype(jnp.int32), axis=-1)[:, 0]
+        return lse - picked, lse
+    return _flash_ce(logits, target)
+
+
+def _ce_spec(mesh, n_rows: int):
+    """Row-sharding spec over the data axes (rows are batch×time — locally
+    independent, so CE shards embarrassingly)."""
+    import math
+
+    from jax.sharding import PartitionSpec as P
+
+    data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names and mesh.shape[a] > 1)
+    if not data_axes:
+        return None
+    kdiv = math.prod(mesh.shape[a] for a in data_axes)
+    if n_rows % kdiv != 0:
+        return None
+    return data_axes if len(data_axes) > 1 else data_axes[0]
+
+
+def flash_cross_entropy(logits, target):
+    """Returns (losses, lse) via the fused kernel, or None if unsupported.
+
+    Under a ``mesh_context`` with a multi-device mesh the kernel runs
+    shard_map-partitioned over the row (batch×time) dim — a bare pallas_call
+    has no SPMD rule and would be GSPMD-replicated (every chip all-gathering
+    the full (N, V) logits)."""
+    if not _enabled() or not _ce_supported(logits.shape, target.shape, logits.dtype):
+        return None
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _mesh_var.get()
+    row = _ce_spec(mesh, int(logits.shape[0])) if mesh is not None else None
+    return _dispatch(
+        _ce_local,
+        (logits, target),
+        ((P(row, None), P(row)), (P(row), P(row))),
+    )
+
+
+def _ce_full(logits, target):
+    res = flash_cross_entropy(logits, target)
+    if res is None:
+        from thunder_tpu.executors.jaxex import _cross_entropy_fwd_reference
+
+        return _cross_entropy_fwd_reference(logits, target)
+    return res
+
+
+_ce_op = ex.register_operator(
+    "pallas_cross_entropy", like=prim_lookup[PrimIDs.CROSS_ENTROPY_FWD], fn=_ce_full
+)
+
+
+def _ce_checker(logits, target):
+    try:
+        from thunder_tpu.core import dtypes as _dt
+
+        jdt = _dt.to_jax_dtype(logits.dtype)
+    except Exception:
+        return False
+    return _enabled() and _ce_supported(tuple(logits.shape), tuple(target.shape), jdt)
+
+
+ex.register_implementation(PrimIDs.CROSS_ENTROPY_FWD, _ce_op, checker=_ce_checker)
+
 # install the fast paths so XLA fusion regions and TrainStep trace evaluation
 # reach the same kernels
 from thunder_tpu.executors import jaxex as _jaxex
 
 _jaxex._sdpa_fast_path = flash_sdpa
 _jaxex._sdpa_bwd_fast_path = flash_sdpa_backward
+_jaxex._ce_fast_path = flash_cross_entropy

@@ -48,18 +48,42 @@ def _is_identity(bsym: BoundSymbol) -> bool:
     return all(p.name in in_names for p in outs)
 
 
+def _claimable_inside(bsym: BoundSymbol, op_executors: Sequence[Executor]) -> bool:
+    """True if any *descendant* bsym is claimable by one of ``op_executors`` —
+    a fusion executor must not swallow a composite whose insides a
+    higher-priority operator executor (pallas kernels, int8) wants."""
+    for sub in bsym.subsymbols:
+        for ex in op_executors:
+            impl = ex.get_impl(sub.sym.id)
+            if impl is not None:
+                if impl.checker is None:
+                    return True
+                try:
+                    if impl.checker(*sub.args, **sub.kwargs):
+                        return True
+                except Exception:
+                    pass
+        if sub.subsymbols and _claimable_inside(sub, op_executors):
+            return True
+    return False
+
+
 def _claim_bsym(trace: TraceCtx, bsym: BoundSymbol, executors: Sequence[Executor]) -> list[BoundSymbol]:
     if _is_passthrough(bsym):
         return [bsym]
     if _is_identity(bsym):
         return []
 
+    higher_ops: list[Executor] = []
     for ex in executors:
         if isinstance(ex, FusionExecutor):
-            if ex.can_fuse(bsym):
+            if ex.can_fuse(bsym) and not _claimable_inside(bsym, higher_ops):
                 # preserved as-is; the executor's fusion pass will absorb it
+                # (unless a higher-priority operator executor wants something
+                # inside, in which case we fall through and decompose)
                 return [bsym]
         elif isinstance(ex, OperatorExecutor):
+            higher_ops.append(ex)
             impl = ex.get_impl(bsym.sym.id)
             if impl is None:
                 continue

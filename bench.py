@@ -191,7 +191,11 @@ def _resolve_backend() -> str:
         if probe.returncode == 0 and probe.stdout.strip():
             backend = probe.stdout.strip()
             log(f"backend probe: {backend}")
-            return jax.default_backend()  # init is known-good; do it for real
+            try:
+                return jax.default_backend()  # init is known-good; do it for real
+            except Exception as e:  # tunnel flaked between probe and init
+                log(f"backend init failed after successful probe: {e}")
+                break
         log(f"backend probe failed (attempt {attempt}): {probe.stderr.strip()[-200:]}")
         time.sleep(15)
     # TPU unusable: force CPU so a (smoke-mode) number is still produced

@@ -456,3 +456,37 @@ add(
     "type_convert", lambda a: ltorch.to(a, ltorch.float32), lambda a: a.to(torch.float32),
     lambda dt: (_t((4, 5), dt),),
 )
+add("logsumexp", lambda a: ltorch.logsumexp(a, 1), lambda a: torch.logsumexp(a, 1), lambda dt: (_t((4, 5), dt),))
+add("logaddexp", ltorch.logaddexp, torch.logaddexp, lambda dt: (_t((4, 5), dt), _t((4, 5), dt)))
+add(
+    "nan_to_num",
+    lambda a: ltorch.nan_to_num(a, nan=1.5),
+    lambda a: torch.nan_to_num(a, nan=1.5),
+    lambda dt: (np.where(rng.uniform(0, 1, (4, 5)) < 0.3, np.nan, rng.standard_normal((4, 5))).astype(dt),),
+    supports_grad=False,
+)
+add("cumprod", lambda a: ltorch.cumprod(a, 1), lambda a: a.cumprod(1), lambda dt: (_t((4, 5), dt, positive=True),))
+add(
+    "heaviside", ltorch.heaviside, torch.heaviside,
+    lambda dt: (_t((4, 5), dt), _t((4, 5), dt, positive=True)), supports_grad=False,
+)
+add("hypot", ltorch.hypot, torch.hypot, lambda dt: (_t((4, 5), dt), _t((4, 5), dt)))
+add("clamp_min", lambda a: ltorch.clamp_min(a, 0.25), lambda a: torch.clamp_min(a, 0.25), lambda dt: (_t((4, 5), dt),))
+add("clamp_max", lambda a: ltorch.clamp_max(a, 0.25), lambda a: torch.clamp_max(a, 0.25), lambda dt: (_t((4, 5), dt),))
+add(
+    "addcmul", lambda a, b, c: ltorch.addcmul(a, b, c, value=0.5),
+    lambda a, b, c: torch.addcmul(a, b, c, value=0.5),
+    lambda dt: (_t((4, 5), dt), _t((4, 5), dt), _t((4, 5), dt)),
+)
+add(
+    "addcdiv", lambda a, b, c: ltorch.addcdiv(a, b, c, value=0.5),
+    lambda a, b, c: torch.addcdiv(a, b, c, value=0.5),
+    lambda dt: (_t((4, 5), dt), _t((4, 5), dt), _t((4, 5), dt, positive=True)),
+)
+add("frac", ltorch.frac, torch.frac, lambda dt: (_t((4, 5), dt),), supports_grad=False)
+add("norm_2", lambda a: ltorch.norm(a), lambda a: torch.norm(a), lambda dt: (_t((4, 5), dt),))
+add("norm_1_dim", lambda a: ltorch.norm(a, 1, 1), lambda a: torch.norm(a, 1, 1), lambda dt: (_t((4, 5), dt),))
+add(
+    "norm_inf", lambda a: ltorch.norm(a, float("inf"), 1),
+    lambda a: torch.norm(a, float("inf"), 1), lambda dt: (_t((4, 5), dt),), supports_grad=False,
+)

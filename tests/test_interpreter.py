@@ -132,6 +132,49 @@ class TestInterpreterCore:
         with pytest.raises(KeyError):
             interpret(g, {})
 
+    def test_extended_arg_jump_targets(self):
+        # >255 locals forces EXTENDED_ARG; branch targets may land on the
+        # EXTENDED_ARG prefix offset, which must resolve to the following
+        # real instruction
+        lines = ["def f(flag):"]
+        for i in range(300):
+            lines.append(f"    v{i} = {i}")
+        lines.append("    if flag:")
+        lines.append("        y = v299")
+        lines.append("    else:")
+        lines.append("        y = v298")
+        lines.append("    return y")
+        ns = {}
+        exec("\n".join(lines), ns)
+        f = ns["f"]
+        assert interpret(f, True)[0] == 299
+        assert interpret(f, False)[0] == 298
+
+    def test_factory_closure_cells_tracked(self):
+        # a helper function from globals whose closure cell holds state:
+        # reads are rooted at globals()['helper'].__closure__[i].cell_contents
+        def make(k):
+            def helper(x):
+                return x * k
+
+            return helper
+
+        import sys
+
+        mod = sys.modules[__name__]
+        mod._factory_helper = make(3.0)
+
+        def f(x):
+            return _factory_helper(x)  # noqa: F821
+
+        res, ctx = interpret(f, 2.0)
+        assert res == 6.0
+        paths = [r.path() for r, _ in ctx.reads if r.path()]
+        assert any(
+            p and p[0] == ("globals", "_factory_helper") and ("attr", "cell_contents") in p
+            for p in paths
+        ), paths
+
     def test_imports(self):
         def f(x):
             import math

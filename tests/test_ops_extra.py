@@ -371,6 +371,34 @@ class TestReviewRegressions:
             run(lambda t: t[[True, False, True]], self.x)
 
 
+class TestEdgeSemantics:
+    """Round-2 review findings: torch-parity at the edges."""
+
+    def test_logaddexp_equal_infinities(self):
+        a = np.array([-np.inf, np.inf, -np.inf, 1.0], dtype=np.float32)
+        b = np.array([-np.inf, np.inf, 2.0, -np.inf], dtype=np.float32)
+        got = run(lambda x, y: ltorch.logaddexp(x, y), a, b)
+        ref = torch.logaddexp(torch.from_numpy(a), torch.from_numpy(b)).numpy()
+        np.testing.assert_allclose(got, ref)
+
+    def test_hypot_scale_safe(self):
+        # (subnormal inputs are excluded: XLA flushes them to zero on some
+        # backends — a platform FTZ difference, not an algorithm issue)
+        a = np.array([1e20, 3e-19, 3.0], dtype=np.float32)
+        b = np.array([1e20, 4e-19, 4.0], dtype=np.float32)
+        got = run(lambda x, y: ltorch.hypot(x, y), a, b)
+        ref = torch.hypot(torch.from_numpy(a), torch.from_numpy(b)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_cumprod_dtype_casts_input_first(self):
+        # bf16 input with f32 accumulation must not lose precision
+        a = (np.ones(16, dtype=np.float32) * 1.001).astype(np.float32)
+        ta = torch.from_numpy(a).to(torch.bfloat16)
+        got = run(lambda x: ltorch.cumprod(ltorch.to(x, ltorch.bfloat16), 0, dtype=ltorch.float32), a)
+        ref = torch.cumprod(ta, 0, dtype=torch.float32).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-3)
+
+
 class TestInt64Canonicalization:
     def test_torch_int64_input(self):
         # torch int64 crosses the host boundary as jax int32 (x64 off); the

@@ -79,6 +79,7 @@ __all__ = [
     "sort",
     "argsort",
     "cumsum",
+    "cumprod",
     "maybe_convert_to_dtype",
     "convert_element_type",
     "device_put",
@@ -983,6 +984,11 @@ def argsort(a: TensorProxy, dim: int = -1, descending: bool = False) -> TensorPr
 @clangop()
 def cumsum(a: TensorProxy, dim: int) -> TensorProxy:
     return prims.cumsum(a, utils.canonicalize_dim(a.ndim, dim))
+
+
+@clangop()
+def cumprod(a: TensorProxy, dim: int) -> TensorProxy:
+    return prims.cumprod(a, utils.canonicalize_dim(a.ndim, dim))
 
 
 @clangop()

@@ -137,9 +137,9 @@ def register_opcode_handler(name: str):
 
 
 class Frame:
-    __slots__ = ("code", "localsplus", "stack", "globals_", "builtins_", "cells", "instrs", "offset_to_idx", "names", "ctx", "depth", "kw_names")
+    __slots__ = ("code", "localsplus", "stack", "globals_", "builtins_", "cells", "instrs", "offset_to_idx", "names", "ctx", "depth", "kw_names", "fn_prov")
 
-    def __init__(self, code: types.CodeType, globals_: dict, ctx: InterpreterCompileCtx, depth: int):
+    def __init__(self, code: types.CodeType, globals_: dict, ctx: InterpreterCompileCtx, depth: int, fn_prov: "ProvenanceRecord | None" = None):
         self.code = code
         self.localsplus: dict[str, Any] = {}
         self.cells: dict[str, types.CellType] = {}
@@ -149,12 +149,27 @@ class Frame:
         if isinstance(self.builtins_, types.ModuleType):
             self.builtins_ = self.builtins_.__dict__
         # dis folds EXTENDED_ARG into the following instruction's arg/argval,
-        # so both it and CACHE are transparent here
-        self.instrs = [i for i in dis.get_instructions(code) if i.opname not in ("CACHE", "EXTENDED_ARG")]
-        self.offset_to_idx = {i.offset: idx for idx, i in enumerate(self.instrs)}
+        # so both it and CACHE are transparent — but a jump may TARGET an
+        # EXTENDED_ARG offset, so those offsets must map to the next real
+        # instruction's index
+        raw = list(dis.get_instructions(code))
+        self.instrs = []
+        self.offset_to_idx = {}
+        pending_offsets: list[int] = []
+        for ins in raw:
+            if ins.opname in ("CACHE", "EXTENDED_ARG"):
+                pending_offsets.append(ins.offset)
+                continue
+            idx = len(self.instrs)
+            for off in pending_offsets:
+                self.offset_to_idx[off] = idx
+            pending_offsets.clear()
+            self.offset_to_idx[ins.offset] = idx
+            self.instrs.append(ins)
         self.ctx = ctx
         self.depth = depth
         self.kw_names: tuple = ()
+        self.fn_prov = fn_prov
 
     def push(self, v):
         self.stack.append(v)
@@ -235,7 +250,7 @@ def _bind_args(code: types.CodeType, fn: types.FunctionType | None, args: tuple,
 
 
 def _run_function(ctx: InterpreterCompileCtx, fn: types.FunctionType, args: tuple, kwargs: dict, depth: int):
-    frame = Frame(fn.__code__, fn.__globals__, ctx, depth)
+    frame = Frame(fn.__code__, fn.__globals__, ctx, depth, fn_prov=ctx.prov_of(fn))
     bound = _bind_args(fn.__code__, fn, args, kwargs)
     # inspect collapses *args/**kwargs into single entries keyed by name
     code = fn.__code__
@@ -392,13 +407,34 @@ def _load_deref(frame, ins, i):
             return None
         raise InterpreterError(f"free variable {name!r} referenced before assignment")
     if frame.depth == 0:
-        # only the ROOT function's closure is re-locatable by the prologue
-        # (it unpacks fn.__closure__); nested frames' cells are trace-local
+        # the ROOT function's closure is re-locatable via fn.__closure__
         rec = ProvenanceRecord(PseudoInst.LOAD_DEREF, key=name)
         v = frame.ctx.record_read(rec, cell.cell_contents)
         frame.ctx.track(v, rec)
         frame.push(v)
+    elif frame.fn_prov is not None and name in frame.code.co_freevars:
+        # a provenance-tracked callee (e.g. a factory-made helper loaded from
+        # globals): its cells ARE re-locatable —
+        # <fn>.__closure__[idx].cell_contents — so record/guard/proxy them
+        idx = frame.code.co_freevars.index(name)
+        rec = ProvenanceRecord(
+            PseudoInst.LOAD_ATTR,
+            inputs=(
+                ProvenanceRecord(
+                    PseudoInst.BINARY_SUBSCR,
+                    inputs=(
+                        ProvenanceRecord(PseudoInst.LOAD_ATTR, inputs=(frame.fn_prov,), key="__closure__"),
+                    ),
+                    key=idx,
+                ),
+            ),
+            key="cell_contents",
+        )
+        v = frame.ctx.record_read(rec, cell.cell_contents)
+        frame.ctx.track(v, rec)
+        frame.push(v)
     else:
+        # trace-local cell (MAKE_FUNCTION inside the traced code)
         frame.push(cell.cell_contents)
 
 

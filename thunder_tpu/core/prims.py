@@ -182,6 +182,7 @@ class PrimIDs(Enum):
     SORT = auto()
     ARGSORT = auto()
     CUMSUM = auto()
+    CUMPROD = auto()
     # Scatter/gather
     INDEX_ADD = auto()
     INDEX_PUT = auto()
@@ -819,6 +820,15 @@ def _cumsum_meta(a: TensorProxy, dim: int) -> TensorProxy:
 
 
 cumsum = make_prim(PrimIDs.CUMSUM, "cumsum", meta=_cumsum_meta)
+
+
+def _cumprod_meta(a: TensorProxy, dim: int) -> TensorProxy:
+    _check_tensor(a)
+    utils.canonicalize_dim(a.ndim, int(dim))
+    return _out_like(a)
+
+
+cumprod = make_prim(PrimIDs.CUMPROD, "cumprod", meta=_cumprod_meta)
 
 
 #

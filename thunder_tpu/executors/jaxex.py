@@ -344,6 +344,11 @@ def _cumsum_impl(a, dim):
     return jnp.cumsum(a, axis=int(dim))
 
 
+@impl(PrimIDs.CUMPROD)
+def _cumprod_impl(a, dim):
+    return jnp.cumprod(a, axis=int(dim))
+
+
 # Scatter/gather
 @impl(PrimIDs.TAKE)
 def _take_impl(a, indices, dim):

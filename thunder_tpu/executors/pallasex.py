@@ -642,13 +642,12 @@ def _ce_supported(logits_shape, target_shape, logits_dtype) -> bool:
 
 
 def _ce_local(logits, target):
-    """Per-shard CE: the kernel when the local shape tiles, else a local jnp
-    fallback (still avoids cross-shard traffic under shard_map)."""
+    """Per-shard CE: the kernel when the local shape tiles, else the jnp
+    reference (still avoids cross-shard traffic under shard_map)."""
     if _ce_blocks(int(logits.shape[0]), int(logits.shape[1])) is None:
-        lg = logits.astype(jnp.float32)
-        lse = jax.nn.logsumexp(lg, axis=-1)
-        picked = jnp.take_along_axis(lg, target[:, None].astype(jnp.int32), axis=-1)[:, 0]
-        return lse - picked, lse
+        from thunder_tpu.executors.jaxex import _cross_entropy_fwd_reference
+
+        return _cross_entropy_fwd_reference(logits, target)
     return _flash_ce(logits, target)
 
 

@@ -48,27 +48,49 @@ def _is_identity(bsym: BoundSymbol) -> bool:
     return all(p.name in in_names for p in outs)
 
 
-def _claimable_inside(bsym: BoundSymbol, op_executors: Sequence[Executor]) -> bool:
+def _claimable_inside(
+    bsym: BoundSymbol, op_executors: Sequence[Executor], memo: dict | None = None
+) -> bool:
     """True if any *descendant* bsym is claimable by one of ``op_executors`` —
     a fusion executor must not swallow a composite whose insides a
-    higher-priority operator executor (pallas kernels, int8) wants."""
+    higher-priority operator executor (pallas kernels, int8) wants.
+
+    Memoized per (bsym, executor-prefix length): the trace is immutable
+    during claiming, and deep composites would otherwise pay a quadratic
+    re-walk per fusion-candidacy test."""
+    if memo is None:
+        memo = {}
+    key = (id(bsym), len(op_executors))
+    hit = memo.get(key)
+    if hit is not None:
+        return hit
+
+    result = False
     for sub in bsym.subsymbols:
         for ex in op_executors:
             impl = ex.get_impl(sub.sym.id)
             if impl is not None:
                 if impl.checker is None:
-                    return True
+                    result = True
+                    break
                 try:
                     if impl.checker(*sub.args, **sub.kwargs):
-                        return True
+                        result = True
+                        break
                 except Exception:
                     pass
-        if sub.subsymbols and _claimable_inside(sub, op_executors):
-            return True
-    return False
+        if result:
+            break
+        if sub.subsymbols and _claimable_inside(sub, op_executors, memo):
+            result = True
+            break
+    memo[key] = result
+    return result
 
 
-def _claim_bsym(trace: TraceCtx, bsym: BoundSymbol, executors: Sequence[Executor]) -> list[BoundSymbol]:
+def _claim_bsym(trace: TraceCtx, bsym: BoundSymbol, executors: Sequence[Executor], memo: dict | None = None) -> list[BoundSymbol]:
+    if memo is None:
+        memo = {}
     if _is_passthrough(bsym):
         return [bsym]
     if _is_identity(bsym):
@@ -77,7 +99,7 @@ def _claim_bsym(trace: TraceCtx, bsym: BoundSymbol, executors: Sequence[Executor
     higher_ops: list[Executor] = []
     for ex in executors:
         if isinstance(ex, FusionExecutor):
-            if ex.can_fuse(bsym) and not _claimable_inside(bsym, higher_ops):
+            if ex.can_fuse(bsym) and not _claimable_inside(bsym, higher_ops, memo):
                 # preserved as-is; the executor's fusion pass will absorb it
                 # (unless a higher-priority operator executor wants something
                 # inside, in which case we fall through and decompose)
@@ -103,7 +125,7 @@ def _claim_bsym(trace: TraceCtx, bsym: BoundSymbol, executors: Sequence[Executor
     if bsym.subsymbols:
         out: list[BoundSymbol] = []
         for sub in bsym.subsymbols:
-            out.extend(_claim_bsym(trace, sub, executors))
+            out.extend(_claim_bsym(trace, sub, executors, memo))
         return out
     return [bsym]
 
@@ -130,8 +152,9 @@ def transform_for_execution(trace: TraceCtx, executors: Sequence[Executor]) -> T
     trace = dce(trace)
 
     new_bsyms: list[BoundSymbol] = []
+    claim_memo: dict = {}
     for bsym in trace.bound_symbols:
-        new_bsyms.extend(_claim_bsym(trace, bsym, executors))
+        new_bsyms.extend(_claim_bsym(trace, bsym, executors, claim_memo))
 
     extrace = from_trace(trace)
     extrace.bound_symbols = new_bsyms

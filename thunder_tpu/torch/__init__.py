@@ -1257,6 +1257,107 @@ def logsigmoid(a):
     return clang.neg(softplus(clang.neg(a)))
 
 
+@torchsymbol(_tfn("logsumexp"), is_method=True)
+def logsumexp(a, dim, keepdim=False):
+    computation_dtype = dtypes.float32 if dtypes.is_low_precision_dtype(a.dtype) else a.dtype
+    af = clang.maybe_convert_to_dtype(a, computation_dtype)
+    m = clang.amax(af, dim, True)
+    # masked-out -inf rows: keep the max finite so exp(-inf - -inf) never NaNs
+    m_safe = clang.where(clang.isfinite(m), m, 0.0)
+    s = clang.sum(clang.exp(clang.sub(af, m_safe)), dim, keepdim)
+    m_out = m if keepdim else clang.squeeze(m, (utils.canonicalize_dim(a.ndim, dim),))
+    out = clang.add(clang.log(s), clang.where(clang.isfinite(m_out), m_out, 0.0))
+    return clang.maybe_convert_to_dtype(out, a.dtype)
+
+
+@torchsymbol(_tfn("logaddexp"), is_method=True)
+def logaddexp(a, b):
+    m = clang.maximum(a, b)
+    stable = clang.add(m, clang.log1p(clang.exp(clang.neg(clang.abs(clang.sub(a, b))))))
+    # equal infinities: a-b is NaN there, but the result is the infinity
+    # itself (torch semantics: logaddexp(-inf, -inf) = -inf)
+    inf_pair = logical_and(clang.isinf(a), clang.eq(a, b))
+    return clang.where(inf_pair, a, stable)
+
+
+@torchsymbol(_tfn("nan_to_num"), is_method=True)
+def nan_to_num(a, nan=0.0, posinf=None, neginf=None):
+    if dtypes.is_exact_dtype(a.dtype):
+        return a
+    big = float(jnp_finfo_max(a.dtype))
+    out = clang.where(clang.isnan(a), nan if nan is not None else 0.0, a)
+    out = clang.where(clang.eq(out, float("inf")), posinf if posinf is not None else big, out)
+    out = clang.where(clang.eq(out, float("-inf")), neginf if neginf is not None else -big, out)
+    return out
+
+
+def jnp_finfo_max(dt):
+    import jax.numpy as jnp
+
+    return jnp.finfo(dtypes.to_jax_dtype(dt)).max
+
+
+@torchsymbol(_tfn("cumprod"), is_method=True)
+def cumprod(a, dim, *, dtype=None):
+    # torch casts the INPUT before accumulating — the dtype kwarg exists to
+    # buy accumulation precision, not to cast the result
+    if dtype is not None:
+        a = clang.maybe_convert_to_dtype(a, _to_thunder_dtype(dtype))
+    return clang.cumprod(a, utils.canonicalize_dim(a.ndim, dim))
+
+
+@torchsymbol(_tfn("heaviside"), is_method=True)
+def heaviside(a, values):
+    return clang.where(clang.gt(a, 0), 1.0, clang.where(clang.lt(a, 0), 0.0, values))
+
+
+@torchsymbol(_tfn("hypot"), is_method=True)
+def hypot(a, b):
+    # scale-safe (torch.hypot contract): factor out the larger magnitude so
+    # squaring can neither overflow (~1e20 inputs) nor flush subnormals
+    aa, ab = clang.abs(a), clang.abs(b)
+    m = clang.maximum(aa, ab)
+    n = clang.minimum(aa, ab)
+    r = clang.true_divide(n, clang.where(clang.eq(m, 0.0), 1.0, m))
+    return clang.mul(m, clang.sqrt(clang.add(1.0, clang.mul(r, r))))
+
+
+@torchsymbol(_tfn("clamp_min"), is_method=True)
+def clamp_min(a, min):
+    return clang.maximum(a, min)
+
+
+@torchsymbol(_tfn("clamp_max"), is_method=True)
+def clamp_max(a, max):
+    return clang.minimum(a, max)
+
+
+@torchsymbol(_tfn("addcmul"), is_method=True)
+def addcmul(a, t1, t2, *, value=1):
+    return clang.add(a, clang.mul(clang.mul(t1, t2), value))
+
+
+@torchsymbol(_tfn("addcdiv"), is_method=True)
+def addcdiv(a, t1, t2, *, value=1):
+    return clang.add(a, clang.mul(clang.true_divide(t1, t2), value))
+
+
+@torchsymbol(_tfn("frac"), is_method=True)
+def frac(a):
+    return clang.sub(a, clang.trunc(a))
+
+
+@torchsymbol(_tfn("norm"), is_method=True)
+def norm(a, p=2, dim=None, keepdim=False):
+    check(p in (1, 2, "fro", float("inf")), lambda: f"norm: order {p!r} is not supported yet")
+    if p == 1:
+        return clang.sum(clang.abs(a), dim, keepdim)
+    if p == float("inf"):
+        return clang.amax(clang.abs(a), dim, keepdim)
+    # 2 / fro
+    return clang.sqrt(clang.sum(clang.mul(a, a), dim, keepdim))
+
+
 @torchsymbol(_tfn("nn", "functional", "softmin"))
 def softmin(a, dim=-1, *, dtype=None, _stacklevel=3):
     return softmax(clang.neg(a), dim, dtype=dtype)

@@ -109,28 +109,143 @@ class TestInterpreterCore:
         with pytest.raises(InterpreterError, match="generator"):
             interpret(f)
 
-    def test_try_happy_path_runs_exceptions_propagate(self):
-        # 3.12 zero-cost exceptions: the protected block has no entry opcode,
-        # so the happy path traces fine; a raised exception propagates OUT
-        # (loud failure) instead of reaching the user's handler — documented
-        # divergence, never silent wrong numerics
-        def f(x):
+    def test_try_except_dispatch(self):
+        # full 3.12 exception-table dispatch: handlers run, unmatched
+        # exceptions propagate, finally executes on both paths
+        def f(d):
             try:
-                return x + 1
-            except ValueError:
-                return 0
-
-        res, _ = interpret(f, 1)
-        assert res == 2
-
-        def g(d):
-            try:
-                return d["missing"]
+                return d["k"]
             except KeyError:
                 return -1
 
+        assert interpret(f, {"k": 5})[0] == 5
+        assert interpret(f, {})[0] == -1
+
+        def g(d):
+            log = []
+            try:
+                try:
+                    v = d["a"]
+                finally:
+                    log.append("fin")
+            except KeyError:
+                v = 0
+            log.append(v)
+            return log
+
+        assert interpret(g, {"a": 9})[0] == ["fin", 9]
+        assert interpret(g, {})[0] == ["fin", 0]
+
+        def h(x):
+            try:
+                raise ValueError("boom")
+            except ValueError as e:
+                return f"caught {e}"
+
+        assert interpret(h, 0)[0] == "caught boom"
+
+        def unmatched():
+            try:
+                raise KeyError("x")
+            except ValueError:
+                return "wrong"
+
         with pytest.raises(KeyError):
-            interpret(g, {})
+            interpret(unmatched)
+
+    def test_with_blocks(self):
+        class CM:
+            def __init__(self):
+                self.log = []
+
+            def __enter__(self):
+                self.log.append("enter")
+                return self
+
+            def __exit__(self, *a):
+                self.log.append("exit")
+                return False
+
+        def f(x):
+            cm = CM()
+            with cm:
+                y = x + 1
+            return y, cm.log
+
+        assert interpret(f, 5)[0] == (6, ["enter", "exit"])
+
+        import contextlib
+
+        def g():
+            with contextlib.suppress(ValueError):
+                raise ValueError("x")
+            return 42
+
+        assert interpret(g)[0] == 42
+
+        class Exit:
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+        def h(d):
+            try:
+                with Exit():
+                    return d["k"]
+            except KeyError:
+                return -2
+
+        assert interpret(h, {"k": 1})[0] == 1
+        assert interpret(h, {})[0] == -2
+
+    def test_nested_handled_exception_restores_outer(self):
+        # a nested handled exception must not clobber the outer active one:
+        # the bare raise re-raises KeyError('a'), not KeyError('b')
+        def f(d):
+            try:
+                return d["a"]
+            except KeyError:
+                try:
+                    return d["b"]
+                except KeyError:
+                    pass
+                raise
+
+        with pytest.raises(KeyError) as ei:
+            interpret(f, {})
+        assert ei.value.args == ("a",)
+
+    def test_bare_raise_no_active_exception(self):
+        def g():
+            raise
+
+        with pytest.raises(RuntimeError, match="No active exception"):
+            interpret(g)
+
+    def test_none_as_method_argument(self):
+        # NULL-vs-None: None is a legitimate call argument/self
+        def f(d):
+            return d.get("x", None), d.get("y", 7)
+
+        assert interpret(f, {"y": 1})[0] == (None, 1)
+
+    def test_except_in_jitted_function(self):
+        import thunder_tpu.torch as lt
+
+        def f(x, cfg):
+            try:
+                scale = cfg["scale"]
+            except KeyError:
+                scale = 2.0
+            return lt.mul(x, scale)
+
+        x = rng.standard_normal((4,)).astype(np.float32)
+        got = np.asarray(tt.jit(f, interpretation="bytecode")(x, {}))
+        np.testing.assert_allclose(got, x * 2.0, rtol=1e-6)
+        got = np.asarray(tt.jit(f, interpretation="bytecode")(x, {"scale": 3.0}))
+        np.testing.assert_allclose(got, x * 3.0, rtol=1e-6)
 
     def test_extended_arg_jump_targets(self):
         # >255 locals forces EXTENDED_ARG; branch targets may land on the

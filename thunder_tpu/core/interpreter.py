@@ -11,10 +11,10 @@ outside the explicit arguments.
 
 Scope (deliberate, documented): the common Python subset model code uses —
 arithmetic, containers, control flow, comprehensions, nested function calls,
-closures, imports.  Generators and async raise ``InterpreterError``;
-try/except traces the happy path but a *raised* exception propagates out of
-the jit (loudly) instead of reaching the user's handler — exception-table
-dispatch is not implemented.  Targets CPython 3.12 bytecode.
+closures, imports, try/except/finally (full 3.12 exception-table dispatch),
+and ``with`` blocks (incl. exception suppression).  Generators and async
+raise ``InterpreterError`` with a pointer to the escape hatch.  Targets
+CPython 3.12 bytecode.
 """
 from __future__ import annotations
 
@@ -137,7 +137,7 @@ def register_opcode_handler(name: str):
 
 
 class Frame:
-    __slots__ = ("code", "localsplus", "stack", "globals_", "builtins_", "cells", "instrs", "offset_to_idx", "names", "ctx", "depth", "kw_names", "fn_prov")
+    __slots__ = ("code", "localsplus", "stack", "globals_", "builtins_", "cells", "instrs", "offset_to_idx", "names", "ctx", "depth", "kw_names", "fn_prov", "current_exc")
 
     def __init__(self, code: types.CodeType, globals_: dict, ctx: InterpreterCompileCtx, depth: int, fn_prov: "ProvenanceRecord | None" = None):
         self.code = code
@@ -170,6 +170,7 @@ class Frame:
         self.depth = depth
         self.kw_names: tuple = ()
         self.fn_prov = fn_prov
+        self.current_exc: BaseException | None = None
 
     def push(self, v):
         self.stack.append(v)
@@ -186,13 +187,22 @@ class Frame:
 
 _UNSUPPORTED = {
     "RETURN_GENERATOR": "generator/async functions cannot be traced; call them outside the jitted fn",
-    "PUSH_EXC_INFO": "try/except inside traced functions is not supported yet",
-    "SETUP_FINALLY": "try/finally inside traced functions is not supported yet",
-    "BEFORE_WITH": "context managers inside traced functions are not supported yet",
     "GET_AWAITABLE": "async is not supported",
     "SEND": "generators are not supported",
     "YIELD_VALUE": "generators are not supported",
 }
+
+# CPython's stack NULL is a real null pointer, distinct from Py_None — the
+# call convention depends on the difference ([NULL, callable] plain call vs
+# [callable, self] method call with None as a legitimate self/argument)
+class _NullType:
+    __slots__ = ()
+
+    def __repr__(self):
+        return "<NULL>"
+
+
+_NULL = _NullType()
 
 
 def _nb_op(opname_arg: int, a, b):
@@ -266,8 +276,10 @@ def _run_function(ctx: InterpreterCompileCtx, fn: types.FunctionType, args: tupl
 
 
 def _run_frame(frame: Frame):
-    ctx = frame.ctx
     instrs = frame.instrs
+    # CPython 3.12 zero-cost exceptions: handlers are located via the code
+    # object's exception table (instruction-range → target/depth/lasti)
+    exc_table = dis._parse_exception_table(frame.code)
     i = 0
     n = len(instrs)
     while i < n:
@@ -281,7 +293,27 @@ def _run_frame(frame: Frame):
                 f"opcode {op} is not supported by the bytecode interpreter yet "
                 f"(in {frame.code.co_name}); use the functional frontend or mark the callee opaque"
             )
-        res = h(frame, ins, i)
+        try:
+            res = h(frame, ins, i)
+        except InterpreterError:
+            raise  # interpreter-machinery faults never unwind to user handlers
+        except Exception as e:
+            entry = next(
+                (t for t in exc_table if t.start <= ins.offset < t.end), None
+            )
+            if entry is None:
+                raise
+            # unwind: truncate the value stack to the handler's depth,
+            # optionally push the resume offset (lasti), then the exception
+            del frame.stack[entry.depth :]
+            if entry.lasti:
+                frame.push(ins.offset)
+            frame.push(e)
+            # current_exc is NOT set here: the handler's PUSH_EXC_INFO saves
+            # the outer state first, then installs e — setting it early would
+            # make POP_EXCEPT "restore" the exception being handled
+            i = frame.jump_to_offset(entry.target)
+            continue
         if isinstance(res, _Return):
             return res.value
         i = res if isinstance(res, int) else i + 1
@@ -374,7 +406,7 @@ def _load_global(frame, ins, i):
         raise InterpreterError(f"name {name!r} is not defined")
     if push_null:
         # 3.12 layout: NULL below the callable ([NULL, callable, args...])
-        frame.push(None)
+        frame.push(_NULL)
         frame.push(v)
     else:
         frame.push(v)
@@ -462,7 +494,7 @@ def _load_attr(frame, ins, i):
     if is_method:
         # getattr already bound the method, so use the plain-call layout
         # ([NULL, callable]) — CALL accepts either convention
-        frame.push(None)
+        frame.push(_NULL)
         frame.push(v)
     else:
         frame.push(v)
@@ -603,7 +635,7 @@ def _swap(frame, ins, i):
 
 @register_opcode_handler("PUSH_NULL")
 def _push_null(frame, ins, i):
-    frame.push(None)
+    frame.push(_NULL)
 
 
 @register_opcode_handler("BUILD_TUPLE")
@@ -815,15 +847,14 @@ def _call(frame, ins, i):
     frame.kw_names = ()
     args = frame.stack[len(frame.stack) - argc :] if argc else []
     del frame.stack[len(frame.stack) - argc :]
-    b = frame.pop()
-    a = frame.pop()
-    # (callable, NULL) or (self/NULL-style, callable) conventions
-    if b is None and callable(a):
-        fn = a
-    elif a is None and callable(b):
-        fn = b
+    b = frame.pop()  # self-or-NULL... actually the callable when a is NULL
+    a = frame.pop()  # [a, b, args...]: a = callable-or-NULL, b = self-or-callable
+    if a is _NULL:
+        fn = b  # plain call: [NULL, callable, args...]
+    elif b is _NULL:
+        fn = a  # bound-method pushed via our LOAD_ATTR layout
     elif callable(a):
-        fn = a
+        fn = a  # method call: [callable, self, args...] — None is a real self
         args = [b, *args]
     else:  # pragma: no cover - malformed stack
         raise InterpreterError(f"CALL could not resolve a callable from ({type(a)}, {type(b)})")
@@ -841,7 +872,7 @@ def _call_function_ex(frame, ins, i):
     kwargs = frame.pop() if ins.arg & 1 else {}
     args = frame.pop()
     fn = frame.pop()
-    if frame.stack and frame.stack[-1] is None:
+    if frame.stack and frame.stack[-1] is _NULL:
         frame.pop()  # NULL slot
     frame.push(_call_value(frame.ctx, frame.depth, fn, tuple(args), dict(kwargs)))
 
@@ -898,12 +929,77 @@ def _import_from(frame, ins, i):
 @register_opcode_handler("RAISE_VARARGS")
 def _raise_varargs(frame, ins, i):
     if ins.arg == 1:
-        raise frame.pop()
+        exc = frame.pop()
+        if isinstance(exc, type) and issubclass(exc, BaseException):
+            exc = exc()
+        raise exc
     if ins.arg == 2:
         cause = frame.pop()
         exc = frame.pop()
         raise exc from cause
-    raise InterpreterError("bare raise outside except is not supported")
+    # bare raise: re-raise the active exception (CPython semantics)
+    if frame.current_exc is not None:
+        raise frame.current_exc
+    raise RuntimeError("No active exception to reraise")
+
+
+#
+# Exception-handler opcodes (3.12 zero-cost exceptions; the dispatch itself
+# happens in _run_frame's exception-table unwinder)
+#
+
+
+@register_opcode_handler("PUSH_EXC_INFO")
+def _push_exc_info(frame, ins, i):
+    # stack [.., exc] → [.., prev_exc_state, exc]; saves the OUTER state and
+    # installs the incoming exception as current
+    exc = frame.pop()
+    frame.push(frame.current_exc)
+    frame.push(exc)
+    if isinstance(exc, BaseException):
+        frame.current_exc = exc
+
+
+@register_opcode_handler("CHECK_EXC_MATCH")
+def _check_exc_match(frame, ins, i):
+    match_type = frame.pop()
+    exc = frame.stack[-1]
+    frame.push(isinstance(exc, match_type))
+
+
+@register_opcode_handler("POP_EXCEPT")
+def _pop_except(frame, ins, i):
+    prev = frame.pop()  # the saved exception state from PUSH_EXC_INFO
+    frame.current_exc = prev if isinstance(prev, BaseException) else None
+
+
+@register_opcode_handler("BEFORE_WITH")
+def _before_with(frame, ins, i):
+    mgr = frame.pop()
+    exit_fn = type(mgr).__exit__.__get__(mgr)
+    enter_fn = type(mgr).__enter__
+    frame.push(exit_fn)
+    frame.push(enter_fn(mgr))
+
+
+@register_opcode_handler("WITH_EXCEPT_START")
+def _with_except_start(frame, ins, i):
+    # stack: [exit_fn, lasti, prev_exc, exc]; calls
+    # exit_fn(type(exc), exc, exc.__traceback__) and pushes the result
+    exc = frame.stack[-1]
+    exit_fn = frame.stack[-4]
+    res = exit_fn(type(exc), exc, getattr(exc, "__traceback__", None))
+    frame.push(res)
+
+
+@register_opcode_handler("RERAISE")
+def _reraise(frame, ins, i):
+    exc = frame.pop()
+    if ins.arg:
+        frame.pop()  # the saved lasti slot
+    if isinstance(exc, BaseException):
+        raise exc
+    raise InterpreterError(f"RERAISE on a non-exception: {type(exc)}")
 
 
 #

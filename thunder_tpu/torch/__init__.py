@@ -1308,7 +1308,8 @@ def cumprod(a, dim, *, dtype=None):
 
 @torchsymbol(_tfn("heaviside"), is_method=True)
 def heaviside(a, values):
-    return clang.where(clang.gt(a, 0), 1.0, clang.where(clang.lt(a, 0), 0.0, values))
+    # NaN maps to 0 in torch (only exact zero selects `values`)
+    return clang.where(clang.eq(a, 0), values, clang.where(clang.gt(a, 0), 1.0, 0.0))
 
 
 @torchsymbol(_tfn("hypot"), is_method=True)

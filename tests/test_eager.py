@@ -1,0 +1,57 @@
+"""Eager execution of the op surface on concrete and jax-traced arrays.
+
+Reference analog: every thunder.torch symbol has a torch eager impl
+(``thunder/executors/torchex.py``); thunder_tpu's version records one symbol
+call into a micro-trace and evaluates it immediately (core/eager.py), which
+also makes ltorch code usable inside jax.jit / shard_map bodies.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import torch
+
+import thunder_tpu.torch as ltorch
+
+
+def test_eager_elementwise_and_linear():
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((4, 8)).astype(np.float32))
+    w = jnp.asarray(np.random.default_rng(1).standard_normal((16, 8)).astype(np.float32))
+    out = ltorch.linear(x, w)
+    assert isinstance(out, jax.Array)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x) @ np.asarray(w).T, rtol=1e-5)
+
+    y = ltorch.gelu(x)
+    ref = torch.nn.functional.gelu(torch.from_numpy(np.asarray(x)))
+    np.testing.assert_allclose(np.asarray(y), ref.numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_eager_composite_softmax():
+    x = jnp.asarray(np.random.default_rng(2).standard_normal((3, 5)).astype(np.float32))
+    out = ltorch.softmax(x, dim=-1)
+    ref = torch.softmax(torch.from_numpy(np.asarray(x)), dim=-1).numpy()
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-6)
+
+
+def test_eager_inside_jax_jit():
+    """ltorch ops on tracers: usable in plain jax.jit'ed functions."""
+
+    @jax.jit
+    def f(a, b):
+        return ltorch.mul(ltorch.sin(a), b) + 1.0
+
+    a = jnp.arange(6, dtype=jnp.float32).reshape(2, 3)
+    b = jnp.full((2, 3), 2.0)
+    np.testing.assert_allclose(np.asarray(f(a, b)), np.sin(np.asarray(a)) * 2 + 1, rtol=1e-6)
+
+
+def test_eager_grad_through_jax():
+    """jax.grad differentiates through eager ltorch calls (the evaluation is
+    plain jnp, so JAX's AD sees it)."""
+
+    def f(x):
+        return jnp.sum(ltorch.tanh(x) ** 2)
+
+    x = jnp.asarray([0.3, -0.7, 1.1], dtype=jnp.float32)
+    g = jax.grad(f)(x)
+    ref = 2 * np.tanh(np.asarray(x)) * (1 - np.tanh(np.asarray(x)) ** 2)
+    np.testing.assert_allclose(np.asarray(g), ref, rtol=1e-5, atol=1e-6)

@@ -403,3 +403,68 @@ class TestGeneralJit:
         a = np.asarray(tt.jit(f)(x, w))
         b = np.asarray(tt.jit(f, interpretation="bytecode")(x, w))
         np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+class TestExceptionStateSemantics:
+    """CPython thread-level exception-state parity (code-review round 2)."""
+
+    def test_finally_runs_on_system_exit(self):
+        log = []
+
+        def f():
+            try:
+                raise SystemExit(3)
+            finally:
+                log.append("fin")
+
+        with pytest.raises(SystemExit):
+            interpret(f)
+        assert log == ["fin"]
+
+    def test_except_base_exception_catches_keyboard_interrupt(self):
+        def f():
+            try:
+                raise KeyboardInterrupt()
+            except BaseException:
+                return "caught"
+
+        res, _ = interpret(f)
+        assert res == "caught"
+
+    def test_bare_raise_in_helper_reraises_callers_exception(self):
+        def helper():
+            raise
+
+        def f():
+            try:
+                raise KeyError("k")
+            except KeyError:
+                helper()
+
+        with pytest.raises(KeyError):
+            interpret(f)
+
+    def test_bare_raise_with_no_active_exception(self):
+        def f():
+            raise
+
+        with pytest.raises(RuntimeError, match="No active exception"):
+            interpret(f)
+
+    def test_exc_stack_balanced_after_handled_exception(self):
+        def g():
+            try:
+                raise ValueError("v")
+            except ValueError:
+                pass
+            return 1
+
+        def f():
+            a = g()
+            try:
+                raise  # no active exception anymore: g()'s was popped
+            except RuntimeError:
+                return a + 1
+
+        res, _ = interpret(f)
+        assert res == 2

@@ -1,0 +1,52 @@
+"""Eager execution of thunder_tpu symbols on concrete (or jax-traced) arrays.
+
+The reference's op surface always has an eager escape: every thunder.torch
+symbol maps to a real ``torch.*`` call, so user code mixing thunder ops with
+plain tensors just works (``thunder/executors/torchex.py`` is the eager
+backend).  The TPU-native analog: calling a Symbol *outside* a trace context
+records it into a throwaway micro-trace and immediately evaluates that trace
+with the default (jaxex) implementations.  Because the evaluation is plain
+``jnp`` code, this also works on **jax tracers** — ltorch-built models are
+directly usable inside ``jax.jit`` / ``shard_map`` / ``lax.scan`` bodies,
+which is how the pipeline-parallel schedule reuses the model code verbatim.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+__all__ = ["eager_symbol_eval"]
+
+
+def eager_symbol_eval(sym, args: tuple, kwargs: dict) -> Any:
+    """Runs one symbol call eagerly: trace → evaluate → return concrete values."""
+    from thunder_tpu.core.proxies import NumberProxy, Proxy, StringProxy, tensorproxy
+    from thunder_tpu.core.pytree import tree_flatten, tree_unflatten
+    from thunder_tpu.core.trace import TraceCtx, tracectx
+    from thunder_tpu.executors.utils import eval_bsyms
+    from thunder_tpu.functional import _is_tensor_like
+
+    trace = TraceCtx(None)
+    env: dict[str, Any] = {}
+    flat, spec = tree_flatten((tuple(args), dict(kwargs)))
+    with tracectx(trace):
+        pflat = []
+        for x in flat:
+            if _is_tensor_like(x):
+                p = tensorproxy(x)
+                env[p.name] = x
+                pflat.append(p)
+            else:
+                pflat.append(x)
+        pargs, pkwargs = tree_unflatten(pflat, spec)
+        out = sym(*pargs, **pkwargs)
+    eval_bsyms(trace.bound_symbols, env)
+
+    def sub(o):
+        if isinstance(o, (NumberProxy, StringProxy)):
+            return o.value if o.value is not None else env[o.name]
+        if isinstance(o, Proxy):
+            return env[o.name]
+        return o
+
+    oflat, ospec = tree_flatten(out)
+    return tree_unflatten([sub(o) for o in oflat], ospec)

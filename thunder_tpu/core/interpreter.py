@@ -105,6 +105,10 @@ class InterpreterCompileCtx:
     # value substitution requested by the caller when a read occurs
     # (general_jit proxifies tensors here); returns the value to use
     read_callback: Callable | None = None
+    # thread-level "currently handled exception" stack (CPython's
+    # tstate->exc_info chain): a bare `raise` in a helper function re-raises
+    # the exception its *caller* is handling, so the state must span frames
+    exc_stack: list = field(default_factory=list)
     max_depth: int = 32
     # callables never interpreted (treated as opaque host calls)
     opaque: set = field(default_factory=set)
@@ -280,6 +284,17 @@ def _run_frame(frame: Frame):
     # CPython 3.12 zero-cost exceptions: handlers are located via the code
     # object's exception table (instruction-range → target/depth/lasti)
     exc_table = dis._parse_exception_table(frame.code)
+    # balance the thread-level handled-exception stack on ANY exit from this
+    # frame: an exception propagating out of an except block skips POP_EXCEPT,
+    # and a stale entry would leak into sibling calls' bare-raise lookups
+    exc_depth = len(frame.ctx.exc_stack)
+    try:
+        return _run_frame_inner(frame, instrs, exc_table)
+    finally:
+        del frame.ctx.exc_stack[exc_depth:]
+
+
+def _run_frame_inner(frame: Frame, instrs, exc_table):
     i = 0
     n = len(instrs)
     while i < n:
@@ -297,7 +312,10 @@ def _run_frame(frame: Frame):
             res = h(frame, ins, i)
         except InterpreterError:
             raise  # interpreter-machinery faults never unwind to user handlers
-        except Exception as e:
+        except BaseException as e:
+            # BaseException, not Exception: SystemExit/KeyboardInterrupt must
+            # still run finally blocks and reach `except BaseException:`
+            # handlers (the table entry exists for them like any other)
             entry = next(
                 (t for t in exc_table if t.start <= ins.offset < t.end), None
             )
@@ -937,9 +955,14 @@ def _raise_varargs(frame, ins, i):
         cause = frame.pop()
         exc = frame.pop()
         raise exc from cause
-    # bare raise: re-raise the active exception (CPython semantics)
+    # bare raise: re-raise the active exception (CPython semantics).  The
+    # active exception is thread-level state, not frame-level: a bare raise
+    # in a helper called from an except block re-raises the caller's
+    # exception, hence the ctx.exc_stack fallback.
     if frame.current_exc is not None:
         raise frame.current_exc
+    if frame.ctx.exc_stack:
+        raise frame.ctx.exc_stack[-1]
     raise RuntimeError("No active exception to reraise")
 
 
@@ -958,6 +981,7 @@ def _push_exc_info(frame, ins, i):
     frame.push(exc)
     if isinstance(exc, BaseException):
         frame.current_exc = exc
+        frame.ctx.exc_stack.append(exc)
 
 
 @register_opcode_handler("CHECK_EXC_MATCH")
@@ -971,6 +995,8 @@ def _check_exc_match(frame, ins, i):
 def _pop_except(frame, ins, i):
     prev = frame.pop()  # the saved exception state from PUSH_EXC_INFO
     frame.current_exc = prev if isinstance(prev, BaseException) else None
+    if frame.ctx.exc_stack:
+        frame.ctx.exc_stack.pop()
 
 
 @register_opcode_handler("BEFORE_WITH")

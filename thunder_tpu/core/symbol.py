@@ -126,6 +126,15 @@ class Symbol(SymbolInterface):
                 return self._fn(*args, **kwargs)
             if self.python_impl is not None:
                 return self.python_impl(*args, **kwargs)
+            # Generic eager mode (reference: every thunder.torch symbol has a
+            # torch eager impl via torchex): record into a micro-trace and
+            # evaluate immediately with the default executor implementations.
+            # Works on jax tracers too, so ltorch models run under jax.jit /
+            # shard_map / lax.scan bodies unchanged (core/eager.py).
+            if self.meta is not None:
+                from thunder_tpu.core.eager import eager_symbol_eval
+
+                return eager_symbol_eval(self, args, kwargs)
             raise RuntimeError(
                 f"Symbol {self.name} called outside of a trace and has no eager implementation"
             )

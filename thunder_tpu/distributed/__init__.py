@@ -17,6 +17,12 @@ from thunder_tpu.distributed.checkpoint import (
     save_checkpoint,
 )
 from thunder_tpu.distributed.moe import ep_moe_mlp, expert_capacity
+from thunder_tpu.distributed.pipeline import (
+    gpipe,
+    place_pipeline_params,
+    pp_gpt_loss,
+    stack_blocks,
+)
 from thunder_tpu.distributed.prims import DistributedReduceOps
 from thunder_tpu.distributed.ring_attention import ring_attention, ring_self_attention
 from thunder_tpu.distributed.sharding import (
@@ -53,4 +59,8 @@ __all__ = [
     "ring_self_attention",
     "ep_moe_mlp",
     "expert_capacity",
+    "gpipe",
+    "stack_blocks",
+    "place_pipeline_params",
+    "pp_gpt_loss",
 ]

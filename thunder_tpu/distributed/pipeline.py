@@ -1,0 +1,221 @@
+"""Pipeline parallelism: a GPipe microbatch schedule over a ``pp`` mesh axis.
+
+The reference has **no** pipeline parallelism (SURVEY §2.6: "PP absent").
+This module is the TPU-native design that adds it, the way the scaling
+playbook prescribes: stages are *mesh shards*, not processes —
+
+- the transformer blocks are stacked along a leading layer dim and sharded
+  over ``pp``, so each device holds a contiguous chunk of layers (its stage);
+- microbatches flow stage-to-stage via ``jax.lax.ppermute`` (one ICI
+  neighbor hop), with the classic GPipe schedule: ``n_micro + S - 1`` ticks,
+  stage 0 injecting a fresh microbatch per tick and stage S-1 collecting
+  finished ones;
+- the whole schedule is a ``lax.scan`` inside one ``shard_map``, so XLA sees
+  a single static program — bubbles and all collectives are visible to the
+  scheduler, and ``jax.grad`` differentiates straight through (``ppermute``
+  transposes to the reverse rotation, giving the backward pipeline for free);
+- the per-stage compute is the *framework-compiled* block program: the stage
+  function is traced once through the thunder_tpu pipeline
+  (``trace_from_fn`` → executor claiming) and evaluated per tick, so Pallas
+  / fused claims apply inside pipeline stages exactly as in the single-chip
+  path.
+
+Embedding, final norm, and the LM head are computed replicated (every device
+runs them on the full microbatch stream) — they are a few percent of FLOPs
+at depth; stage-resident head/embedding is a sharding refinement, not a
+schedule change.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["stack_blocks", "place_pipeline_params", "gpipe", "pp_gpt_loss"]
+
+
+def stack_blocks(params: dict) -> dict:
+    """Stacks the per-layer ``blocks`` list into one pytree with a leading
+    layer dim (sharding over ``pp`` is then a dim-0 placement; per-layer
+    slices stay MXU-shaped)."""
+    blocks = params["blocks"]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, axis=0), *blocks)
+    out = dict(params)
+    out["blocks"] = stacked
+    return out
+
+
+def place_pipeline_params(params: dict, mesh: Mesh, *, axis: str = "pp") -> dict:
+    """Places stacked params: blocks sharded dim-0 over ``axis`` (each device
+    holds its stage's layers); embedding/head/norms replicated."""
+    n_layer = jax.tree_util.tree_leaves(params["blocks"])[0].shape[0]
+    S = mesh.shape[axis]
+    assert n_layer % S == 0, f"n_layer {n_layer} must divide pp={S}"
+    repl = NamedSharding(mesh, P())
+    staged = NamedSharding(mesh, P(axis))
+    out = {}
+    for k, v in params.items():
+        if k == "blocks":
+            out[k] = jax.tree_util.tree_map(lambda x: jax.device_put(x, staged), v)
+        else:
+            out[k] = jax.tree_util.tree_map(lambda x: jax.device_put(x, repl), v)
+    return out
+
+
+def gpipe(
+    stage_fn: Callable,
+    blocks,
+    microbatches,
+    *extras,
+    mesh: Mesh,
+    axis: str = "pp",
+) -> Any:
+    """Runs the GPipe schedule.
+
+    ``stage_fn(local_blocks, x, *extras) -> y`` applies one stage's layers
+    (``local_blocks`` leaves have leading dim ``n_layer // S``); ``x`` and
+    ``y`` share the shape of one microbatch.  ``microbatches`` has shape
+    ``(n_micro, *mb_shape)`` and must be replicated over ``axis``; ``extras``
+    are replicated side inputs (rope caches).  Returns the finished
+    microbatch stream ``(n_micro, *mb_shape)``, replicated over ``axis``.
+    """
+    S = mesh.shape[axis]
+    n_micro = microbatches.shape[0]
+    assert n_micro >= 1
+
+    def body(blocks_loc, mbs, *extras_loc):
+        idx = jax.lax.axis_index(axis)
+        state = jnp.zeros_like(mbs[0])
+        outputs = jnp.zeros_like(mbs)
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(carry, t):
+            state, outputs = carry
+            # stage 0 injects microbatch t (clamped: late ticks feed garbage
+            # that never reaches the collected outputs)
+            inject = jax.lax.dynamic_index_in_dim(
+                mbs, jnp.clip(t, 0, n_micro - 1), axis=0, keepdims=False
+            )
+            x = jnp.where(idx == 0, inject, state)
+            y = stage_fn(blocks_loc, x, *extras_loc)
+            # stage S-1 collects microbatch t-(S-1)
+            o_idx = t - (S - 1)
+            upd = jax.lax.dynamic_update_index_in_dim(
+                outputs, y, jnp.clip(o_idx, 0, n_micro - 1), axis=0
+            )
+            outputs = jnp.where((idx == S - 1) & (o_idx >= 0), upd, outputs)
+            state = jax.lax.ppermute(y, axis, perm)
+            return (state, outputs), None
+
+        (_, outputs), _ = jax.lax.scan(
+            tick, (state, outputs), jnp.arange(n_micro + S - 1)
+        )
+        # broadcast the last stage's collected outputs to the whole pp ring
+        # (zeros elsewhere, so the psum is exactly the last stage's value)
+        outputs = jnp.where(idx == S - 1, outputs, jnp.zeros_like(outputs))
+        return jax.lax.psum(outputs, axis)
+
+    blocks_spec = jax.tree_util.tree_map(lambda _: P(axis), blocks)
+    repl = P()
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(blocks_spec, repl) + tuple(repl for _ in extras),
+        out_specs=repl,
+        check_vma=False,
+    )
+    return fn(blocks, microbatches, *extras)
+
+
+def _compiled_block_fn(config, mb_shape, cos, sin, dtype):
+    """Traces ONE transformer block through the framework pipeline (claiming
+    included) and returns a pure-jax callable ``f(block_params, x, cos, sin)``
+    operating on flattened-block leaves order."""
+    from thunder_tpu.distributed.api import _trace_to_jax_fn
+    from thunder_tpu.executors.passes import transform_for_execution
+    from thunder_tpu.extend import get_default_executors
+    from thunder_tpu.functional import trace_from_fn
+    from thunder_tpu.models.llama import block_forward, init_params
+
+    template = init_params(config, jax.random.PRNGKey(0), dtype=dtype)["blocks"][0]
+    x0 = jnp.zeros(mb_shape, dtype=dtype)
+
+    def fn(bp, x, cos, sin):
+        return block_forward(bp, x, cos, sin, config)
+
+    tr = trace_from_fn(fn, (template, x0, cos, sin), {})
+    from thunder_tpu.core.transform_common import cse, dce
+
+    comp = dce(tr.computation_trace)
+    comp = cse(comp)
+    comp.args = tr.computation_trace.args
+    comp = transform_for_execution(comp, get_default_executors())
+    jax_fn = _trace_to_jax_fn(comp)
+
+    def call(bp, x, cos, sin):
+        flat_bp = jax.tree_util.tree_leaves(bp)
+        return jax_fn(*flat_bp, x, cos, sin)
+
+    return call
+
+
+def pp_gpt_loss(
+    params: dict,
+    idx,
+    targets,
+    cos,
+    sin,
+    config,
+    *,
+    mesh: Mesh,
+    n_micro: int,
+    axis: str = "pp",
+):
+    """Pipeline-parallel next-token loss for the llama family.
+
+    ``params`` must be stacked (:func:`stack_blocks`) and placed
+    (:func:`place_pipeline_params`).  ``idx``/``targets``: (B, T) with
+    ``B % n_micro == 0``.  Matches ``models.llama.gpt_loss`` numerics.
+    """
+    from thunder_tpu.models import llama
+
+    B, T = idx.shape
+    assert B % n_micro == 0, f"batch {B} must divide n_micro={n_micro}"
+    mb = B // n_micro
+    dtype = params["wte"].dtype
+
+    # embed replicated, reshape to the microbatch stream
+    x = params["wte"][idx]  # (B, T, C)
+    mbs = x.reshape(n_micro, mb, T, x.shape[-1])
+
+    stage = _compiled_block_fn(config, (mb, T, x.shape[-1]), cos, sin, dtype)
+
+    def stage_fn(blocks_loc, xb, cos, sin):
+        # scan this stage's layers over the leading local-layer dim
+        def layer(x, bp):
+            return stage(bp, x, cos, sin), None
+
+        out, _ = jax.lax.scan(layer, xb, blocks_loc)
+        return out
+
+    y = gpipe(stage_fn, params["blocks"], mbs, cos, sin, mesh=mesh, axis=axis)
+    x = y.reshape(B, T, -1)
+
+    # final norm + head + CE, replicated (identical on every device);
+    # dispatch on config.norm_class like models.llama._norm
+    xf = x.astype(jnp.float32)
+    if config.norm_class == "RMSNorm":
+        ms = jnp.mean(xf * xf, axis=-1, keepdims=True)
+        xf = xf * jax.lax.rsqrt(ms + config.norm_eps)
+    else:  # LayerNorm
+        mu = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.var(xf, axis=-1, keepdims=True)
+        xf = (xf - mu) * jax.lax.rsqrt(var + config.norm_eps)
+    x = (xf * params["ln_f"].astype(jnp.float32)).astype(dtype)
+    head = params["wte"] if config.tie_embeddings else params["lm_head"]
+    logits = (x @ head.T).astype(jnp.float32)
+    V = logits.shape[-1]
+    logp = jax.nn.log_softmax(logits.reshape(-1, V), axis=-1)
+    return -jnp.take_along_axis(logp, targets.reshape(-1, 1), axis=-1).mean()

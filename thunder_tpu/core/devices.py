@@ -46,16 +46,36 @@ def devicetype_string(devicetype: DeviceType) -> str:
     return _devicetype_prettyprint_map[devicetype]
 
 
-class Device:
+# what torch's C-level argument parser sees when a Device is passed as a
+# ``device=`` kwarg (torch interop): 'xla' is in torch's accepted device-type
+# list, so factory calls like ``torch.arange(..., device=t.device)`` in
+# unmodified HF code parse successfully and reach the TorchFunctionMode,
+# which then diverts them into the thunder op surface before any real torch
+# execution happens.
+_torch_parser_str = {
+    DeviceType.CPU: "cpu",
+    DeviceType.TPU: "xla",
+    DeviceType.GPU: "cuda",
+}
+
+
+class Device(str):
     """An interned (devicetype, index) pair.
 
     ``Device`` objects are compared by value and safe to use as dict keys.
     The accelerator index maps to ``jax.devices(backend)[index]``.
+
+    Subclasses ``str`` (raw value: a torch-parseable device string such as
+    ``"xla:0"``) purely so torch's argument parser accepts a Device as a
+    ``device=`` kwarg during torch interop; thunder-facing rendering
+    (``__str__``/``__format__``/``device_str``) stays ``"tpu:0"`` style.
     """
 
     _interned: dict[tuple[DeviceType, int], "Device"] = {}
 
     def __new__(cls, devicetype: DeviceType | str, index: int | None = None):
+        if isinstance(devicetype, Device):
+            return devicetype
         if isinstance(devicetype, str):
             devicetype, parsed_index = _parse_device_string(devicetype)
             if index is None:
@@ -72,7 +92,7 @@ class Device:
         cached = cls._interned.get(key)
         if cached is not None:
             return cached
-        self = super().__new__(cls)
+        self = super().__new__(cls, f"{_torch_parser_str[devicetype]}:{index}")
         self._devicetype = devicetype
         self._index = index
         cls._interned[key] = self
@@ -99,21 +119,34 @@ class Device:
     def __str__(self) -> str:
         return self.device_str()
 
+    def __format__(self, spec: str) -> str:
+        # f-strings must render the thunder-facing form, not the raw
+        # torch-parseable str value
+        return format(self.device_str(), spec)
+
     def __hash__(self) -> int:
         return hash((self._devicetype, self._index))
 
     def __eq__(self, other) -> bool:
-        if isinstance(other, str):
-            other = device_from_string(other)
+        if isinstance(other, str) and not isinstance(other, Device):
+            try:
+                other = device_from_string(other)
+            except Exception:
+                return False  # e.g. device == "meta" in HF code: not equal, not an error
         return isinstance(other, Device) and self._devicetype == other._devicetype and self._index == other._index
+
+    def __ne__(self, other) -> bool:
+        # str.__ne__ would compare the raw "xla:0" value; keep != consistent
+        # with the value-based __eq__
+        return not self.__eq__(other)
 
 
 def _parse_device_string(s: str) -> tuple[DeviceType, Optional[int]]:
     parts = s.split(":")
     check(1 <= len(parts) <= 2, lambda: f"Invalid device string {s!r}")
     dt = _inverse_devicetype_prettyprint_map.get(parts[0])
-    # accept torch-style "cuda" as an alias for the accelerator
-    if dt is None and parts[0] == "cuda":
+    # accept torch-style "cuda"/"xla" as aliases for the accelerator
+    if dt is None and parts[0] in ("cuda", "xla"):
         dt = DeviceType.TPU
     check(dt is not None, lambda: f"Unknown device type in {s!r}")
     index = int(parts[1]) if len(parts) == 2 else None

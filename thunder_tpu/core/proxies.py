@@ -472,6 +472,22 @@ def _shape_to_tuple(shape) -> tuple[int, ...]:
     return tuple(out)
 
 
+class _CallableSize(int):
+    """``.size`` that reads as an int (numpy numel) AND calls as a method
+    (torch ``t.size()`` → shape tuple, ``t.size(dim)`` → int)."""
+
+    def __new__(cls, value, proxy):
+        obj = super().__new__(cls, value)
+        obj._proxy = proxy
+        return obj
+
+    def __call__(self, dim: int | None = None):
+        shape = tuple(self._proxy.shape)
+        if dim is None:
+            return shape
+        return shape[dim]
+
+
 class TensorProxy(Proxy, TensorProxyInterface):
     def __init__(
         self,
@@ -552,10 +568,16 @@ class TensorProxy(Proxy, TensorProxyInterface):
 
     @property
     def size(self) -> int:
-        return self.numel
+        # numpy reads `.size` as an int (numel); torch calls `.size()` /
+        # `.size(dim)` as a method.  A callable int serves both languages, so
+        # unmodified HF/torch module code traces through (torch interop).
+        return _CallableSize(self.numel, self)
 
     def type_string(self) -> str:
         return f'{self.device.device_str()} {self.dtype.shortname()}{list(self.shape)}'
+
+    def dim(self) -> int:
+        return len(self._shape)
 
     def replace_name(self, name: str | None = None):
         return self.replace(name=name)
@@ -886,6 +908,10 @@ def proxy(x: Any, *, name: str | None = None, history=None) -> Any:
     """Proxies a concrete value: arrays → TensorProxy, numbers → NumberProxy, etc."""
     if isinstance(x, Proxy):
         return x
+    # Device subclasses str (torch-parser interop) — check it before str so
+    # devices stay AnyProxy, not StringProxy of the raw "xla:0" value
+    if x is None or isinstance(x, (type, Device, dtypes.dtype)):
+        return AnyProxy(x, name=name, history=history)
     if isinstance(x, str):
         return StringProxy(x, name=name, history=history)
     if isinstance(x, bool):
@@ -896,8 +922,6 @@ def proxy(x: Any, *, name: str | None = None, history=None) -> Any:
         return numberproxy(float, x, name=name, history=history)
     if isinstance(x, complex):
         return numberproxy(complex, x, name=name, history=history)
-    if x is None or isinstance(x, (type, Device, dtypes.dtype)):
-        return AnyProxy(x, name=name, history=history)
     return tensorproxy(x, name=name, history=history)
 
 

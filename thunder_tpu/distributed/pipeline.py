@@ -129,10 +129,26 @@ def gpipe(
     return fn(blocks, microbatches, *extras)
 
 
+_block_fn_cache: dict = {}
+
+
 def _compiled_block_fn(config, mb_shape, cos, sin, dtype):
     """Traces ONE transformer block through the framework pipeline (claiming
     included) and returns a pure-jax callable ``f(block_params, x, cos, sin)``
-    operating on flattened-block leaves order."""
+    operating on flattened-block leaves order.  Cached per (config, shapes,
+    dtype) so repeated pp_gpt_loss calls/retraces reuse the traced program."""
+    import dataclasses
+
+    key = (
+        tuple(sorted(dataclasses.asdict(config).items())),
+        tuple(mb_shape),
+        tuple(cos.shape),
+        tuple(sin.shape),
+        str(dtype),
+    )
+    cached = _block_fn_cache.get(key)
+    if cached is not None:
+        return cached
     from thunder_tpu.distributed.api import _trace_to_jax_fn
     from thunder_tpu.executors.passes import transform_for_execution
     from thunder_tpu.extend import get_default_executors
@@ -158,6 +174,7 @@ def _compiled_block_fn(config, mb_shape, cos, sin, dtype):
         flat_bp = jax.tree_util.tree_leaves(bp)
         return jax_fn(*flat_bp, x, cos, sin)
 
+    _block_fn_cache[key] = call
     return call
 
 

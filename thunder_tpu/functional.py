@@ -51,6 +51,10 @@ def proxy_leaf(x: Any, trace: TraceCtx):
     """Proxies one flattened input leaf for computation tracing."""
     if _is_tensor_like(x):
         return tensorproxy(x)
+    from thunder_tpu.core.devices import Device as _Device
+
+    if isinstance(x, _Device):  # Device subclasses str; keep it a static leaf
+        return x
     if isinstance(x, str):
         return StringProxy(x)
     if isinstance(x, bool):

@@ -267,6 +267,29 @@ def empty(*size, device=None, dtype=None):
     return clang.zeros(size, device=device, dtype=_to_thunder_dtype(dtype))
 
 
+@torchsymbol(is_method=True)
+def new_ones(a, *size, device=None, dtype=None):
+    size = _flatten_size(size)
+    return clang.full(
+        size, 1, device=device or a.device, dtype=_to_thunder_dtype(dtype) or a.dtype
+    )
+
+
+@torchsymbol(is_method=True)
+def new_zeros(a, *size, device=None, dtype=None):
+    size = _flatten_size(size)
+    return clang.full(
+        size, 0, device=device or a.device, dtype=_to_thunder_dtype(dtype) or a.dtype
+    )
+
+
+@torchsymbol(is_method=True)
+def new_full(a, size, fill_value, *, device=None, dtype=None):
+    return clang.full(
+        size, fill_value, device=device or a.device, dtype=_to_thunder_dtype(dtype) or a.dtype
+    )
+
+
 @torchsymbol(_tfn("arange"))
 def arange(start, end=None, step=1, *, device=None, dtype=None):
     return clang.arange(start, end, step, device=device, dtype=_to_thunder_dtype(dtype))
@@ -653,6 +676,19 @@ def sort(a, dim=-1, descending=False):
 @torchsymbol(_tfn("argsort"), is_method=True)
 def argsort(a, dim=-1, descending=False):
     return clang.argsort(a, dim, descending)
+
+
+@torchsymbol(_tfn("diff"), is_method=True)
+def diff(a, n=1, dim=-1, prepend=None, append=None):
+    pieces = [x for x in (prepend, a, append) if x is not None]
+    if len(pieces) > 1:
+        a = clang.cat(pieces, dim)
+    for _ in range(n):
+        d = a.shape[dim] if dim >= 0 else a.shape[dim + len(a.shape)]
+        hi = clang.slice_in_dim(a, 1, d, dim=dim)
+        lo = clang.slice_in_dim(a, 0, d - 1, dim=dim)
+        a = hi - lo
+    return a
 
 
 @torchsymbol(_tfn("cumsum"), is_method=True)

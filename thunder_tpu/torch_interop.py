@@ -23,7 +23,79 @@ import jax.numpy as jnp
 import numpy as np
 import torch
 
-__all__ = ["ThunderModule", "ThunderFunction", "functional_call"]
+__all__ = ["ThunderModule", "ThunderFunction", "functional_call", "ThunderTracingMode"]
+
+
+class ThunderTracingMode(torch.overrides.TorchFunctionMode):
+    """Diverts *every* mapped ``torch.*`` call into the thunder op surface
+    while a trace is active — including factory calls with no proxy argument
+    (``torch.arange(0, T, device=...)`` in HF models), which the per-proxy
+    ``__torch_function__`` protocol can never see.  The reference needs
+    interpreter lookasides for this (jit_ext.py:884); a TorchFunctionMode is
+    the functional-frontend equivalent."""
+
+    def __torch_function__(self, func, types, args=(), kwargs=None):
+        kwargs = dict(kwargs or {})
+        from thunder_tpu.core.trace import get_tracectx
+        from thunder_tpu.torch import _torch_to_thunder_function_map
+
+        if get_tracectx() is not None:
+            mapped = _torch_to_thunder_function_map.get(func)
+            if mapped is not None:
+                dev = kwargs.get("device")
+                if isinstance(dev, torch.device):
+                    typ = "tpu" if dev.type in ("cuda", "xla") else dev.type
+                    kwargs["device"] = f"{typ}:{dev.index}" if dev.index is not None else typ
+                return mapped(*args, **kwargs)
+        return func(*args, **kwargs)
+
+    # HF transformers builds 4D attention masks by torch.vmap-ing elementwise
+    # index predicates (masking_utils._vmap_for_bhqkv); functorch can't batch
+    # proxies, but for elementwise predicates vmap ≡ broadcasting, so the
+    # mode swaps in a broadcast implementation while tracing.
+    @staticmethod
+    def _broadcast_bhqkv(mask_function, bh_indices: bool = True):
+        if bh_indices:
+            def fn(b, h, q, kv):
+                return mask_function(
+                    b[:, None, None, None],
+                    h[None, :, None, None],
+                    q[None, None, :, None],
+                    kv[None, None, None, :],
+                )
+        else:
+            def fn(q, kv):
+                return mask_function(q[:, None], kv[None, :])
+        return fn
+
+    # refcounted so nested modes don't restore the original mid-trace
+    _patch_depth = 0
+    _patch_orig = None
+
+    def __enter__(self):
+        import sys as _sys
+
+        cls = ThunderTracingMode
+        mu = _sys.modules.get("transformers.masking_utils")
+        if mu is not None and hasattr(mu, "_vmap_for_bhqkv"):
+            if cls._patch_depth == 0:
+                cls._patch_orig = (mu, mu._vmap_for_bhqkv)
+                mu._vmap_for_bhqkv = self._broadcast_bhqkv
+            cls._patch_depth += 1
+            self._patched = True
+        else:
+            self._patched = False
+        return super().__enter__()
+
+    def __exit__(self, *exc):
+        cls = ThunderTracingMode
+        if self._patched:
+            cls._patch_depth -= 1
+            if cls._patch_depth == 0 and cls._patch_orig is not None:
+                mu, orig = cls._patch_orig
+                mu._vmap_for_bhqkv = orig
+                cls._patch_orig = None
+        return super().__exit__(*exc)
 
 
 def _to_jax(t: torch.Tensor):
@@ -54,12 +126,24 @@ def functional_call(module: torch.nn.Module, params_and_buffers: dict, args: tup
     mods = dict(module.named_modules())
     saved: list[tuple[dict, str, Any]] = []
     try:
+        swapped: dict[int, Any] = {}  # id(original tensor) → replacement
         for name, value in params_and_buffers.items():
             mod_name, _, attr = name.rpartition(".")
             m = mods[mod_name]
             d = m._parameters if attr in m._parameters else m._buffers
             saved.append((d, attr, d[attr]))
+            swapped[id(d[attr])] = value
             d[attr] = value
+        # tied weights: named_parameters() deduplicates (e.g. lm_head.weight
+        # is wte.weight), so swap any remaining entry that aliases a swapped
+        # tensor by identity
+        for m in mods.values():
+            for d in (m._parameters, m._buffers):
+                for attr, t in list(d.items()):
+                    rep = swapped.get(id(t))
+                    if rep is not None and t is not rep:
+                        saved.append((d, attr, t))
+                        d[attr] = rep
         return module(*args, **kwargs)
     finally:
         for d, attr, old in saved:
@@ -139,8 +223,18 @@ class ThunderModule(torch.nn.Module):
 
             module = self._orig_mod
 
+            out_cls_cell = self._out_cls_cell = [None]
+
             def functional_fwd(params, buffers, *args, **kwargs):
-                return functional_call(module, {**params, **buffers}, args, kwargs)
+                with ThunderTracingMode():
+                    out = functional_call(module, {**params, **buffers}, args, kwargs)
+                # HF ModelOutput is an OrderedDict subclass the pytree won't
+                # open; unwrap to a plain dict of present fields and remember
+                # the class so forward() can rewrap for the caller
+                if isinstance(out, dict) and type(out) is not dict:
+                    out_cls_cell[0] = type(out)
+                    out = {k: v for k, v in out.items() if v is not None}
+                return out
 
             self._vjp_fn = ttpu.vjp(functional_fwd, argnums=(0,), **self._jit_kwargs)
         return self._vjp_fn
@@ -174,6 +268,9 @@ class ThunderModule(torch.nn.Module):
         }
         flat_out = ThunderFunction.apply(holder, *param_tensors)
         out = jax_tree_unflatten(holder["out_spec"], list(flat_out))
+        out_cls = getattr(self, "_out_cls_cell", [None])[0]
+        if out_cls is not None and isinstance(out, dict):
+            out = out_cls(**out)
         return out
 
     # reference ThunderModule passes state_dict through to the wrapped module

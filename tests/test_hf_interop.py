@@ -68,3 +68,51 @@ def test_gpt2_backward_matches_eager():
         )
         checked += 1
     assert checked >= 10, f"only {checked} param grads flowed"
+
+
+def test_llama_forward_matches_eager():
+    cfg = transformers.LlamaConfig(
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        hidden_size=64,
+        intermediate_size=128,
+        vocab_size=128,
+        max_position_embeddings=64,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(cfg).eval()
+    ids = torch.randint(0, 128, (2, 16), generator=torch.Generator().manual_seed(3))
+    with torch.no_grad():
+        ref = model(ids, use_cache=False).logits
+
+    out = ttpu.jit(model)(input_ids=ids, use_cache=False)
+    np.testing.assert_allclose(
+        out.logits.detach().numpy(), ref.numpy(), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_bert_forward_matches_eager():
+    cfg = transformers.BertConfig(
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        hidden_size=64,
+        intermediate_size=128,
+        vocab_size=128,
+        max_position_embeddings=64,
+        hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    model = transformers.BertModel(cfg).eval()
+    ids = torch.randint(0, 128, (2, 12), generator=torch.Generator().manual_seed(4))
+    mask = torch.ones_like(ids)
+    with torch.no_grad():
+        ref = model(ids, attention_mask=mask).last_hidden_state
+
+    out = ttpu.jit(model)(input_ids=ids, attention_mask=mask)
+    np.testing.assert_allclose(
+        out.last_hidden_state.detach().numpy(), ref.numpy(), rtol=1e-4, atol=1e-5
+    )

@@ -102,11 +102,21 @@ class TestInterpreterCore:
         paths = [r.path() for r, _ in ctx.reads if r.path()]
         assert (("globals", "MODULE_CFG"), ("item", "depth")) in paths
 
-    def test_generators_rejected(self):
+    def test_generator_fn_returns_interpreted_generator(self):
         def f():
             yield 1
 
-        with pytest.raises(InterpreterError, match="generator"):
+        res, _ = interpret(f)
+        assert list(res) == [1]
+
+    def test_async_rejected(self):
+        async def g():
+            return 1
+
+        def f():
+            return g().send(None)
+
+        with pytest.raises(BaseException, match="async|await|coroutine|GET_AWAITABLE|RETURN"):
             interpret(f)
 
     def test_try_except_dispatch(self):
@@ -468,3 +478,253 @@ class TestExceptionStateSemantics:
 
         res, _ = interpret(f)
         assert res == 2
+
+
+class TestGenerators:
+    """Generator protocol in the interpreter (reference supports generator
+    frames natively; SURVEY §2.2)."""
+
+    def test_simple_generator(self):
+        def f(n):
+            def gen(n):
+                for i in range(n):
+                    yield i * i
+            return list(gen(n))
+
+        res, _ = interpret(f, 5)
+        assert res == [0, 1, 4, 9, 16]
+
+    def test_generator_send(self):
+        def f():
+            def echo():
+                total = 0
+                while True:
+                    v = yield total
+                    if v is None:
+                        break
+                    total += v
+            g = echo()
+            g.send(None)
+            a = g.send(3)
+            b = g.send(4)
+            return (a, b)
+
+        res, _ = interpret(f)
+        assert res == (3, 7)
+
+    def test_generator_return_value_stopiteration(self):
+        def f():
+            def g():
+                yield 1
+                return "done"
+            it = g()
+            next(it)
+            try:
+                next(it)
+            except StopIteration as e:
+                return e.value
+
+        res, _ = interpret(f)
+        assert res == "done"
+
+    def test_yield_from(self):
+        def f():
+            def inner():
+                yield 1
+                yield 2
+                return 10
+            def outer():
+                r = yield from inner()
+                yield r + 1
+            return list(outer())
+
+        res, _ = interpret(f)
+        assert res == [1, 2, 11]
+
+    def test_genexpr(self):
+        def f(n):
+            return sum(x * 2 for x in range(n))
+
+        res, _ = interpret(f, 4)
+        assert res == 12
+
+    def test_generator_close_runs_finally(self):
+        def f():
+            log = []
+            def g():
+                try:
+                    yield 1
+                    yield 2
+                finally:
+                    log.append("closed")
+            it = g()
+            next(it)
+            it.close()
+            return log
+
+        res, _ = interpret(f)
+        assert res == ["closed"]
+
+    def test_generator_throw(self):
+        def f():
+            def g():
+                try:
+                    yield 1
+                except ValueError:
+                    yield 99
+            it = g()
+            next(it)
+            return it.throw(ValueError("x"))
+
+        res, _ = interpret(f)
+        assert res == 99
+
+    def test_generator_escapes_to_host(self):
+        """An interpreted generator returned out of the jit boundary is a
+        normal host iterable."""
+        def f(n):
+            def gen():
+                for i in range(n):
+                    yield i + 100
+            return gen()
+
+        res, _ = interpret(f, 3)
+        assert list(res) == [100, 101, 102]
+
+    def test_bare_raise_unaffected_by_suspended_generator(self):
+        def f():
+            def g():
+                try:
+                    raise KeyError("k")
+                except KeyError:
+                    yield 1  # suspend while handling KeyError
+            it = g()
+            next(it)
+            try:
+                raise ValueError("v")
+            except ValueError:
+                try:
+                    raise
+                except ValueError:
+                    return "ok"
+
+        res, _ = interpret(f)
+        assert res == "ok"
+
+    def test_generator_in_traced_function(self):
+        """Generators interleave with proxy ops inside the jitted fn."""
+        def f(x):
+            def scaled(x):
+                for s in (1.0, 2.0, 3.0):
+                    yield ltorch.mul(x, s)
+            total = x
+            for t in scaled(x):
+                total = total + t
+            return total
+
+        x = rng.standard_normal((4,)).astype(np.float32)
+        out = tt.jit(f, interpretation="bytecode")(x)
+        np.testing.assert_allclose(np.asarray(out), x * 7.0, rtol=1e-6)
+
+    def test_suspended_generator_exc_state_swapped_out(self):
+        """CPython swaps a generator's handled exception out of the thread
+        state at yield: a bare raise elsewhere must NOT see it."""
+        def f():
+            def g():
+                try:
+                    raise KeyError("k")
+                except KeyError:
+                    yield 1
+            it = g()
+            next(it)
+            def helper():
+                raise
+            try:
+                helper()
+            except RuntimeError:
+                return "ok"
+
+        res, _ = interpret(f)
+        assert res == "ok"
+
+    def test_pop_except_is_frame_local(self):
+        def f():
+            def g():
+                try:
+                    raise KeyError("k")
+                except KeyError:
+                    yield 1
+            it = g()
+            try:
+                raise ValueError("v")
+            except ValueError:
+                next(it)  # generator suspends while handling KeyError
+            # outer handler done (POP_EXCEPT ran with the generator's entry
+            # still on the thread stack); a bare raise must now find nothing
+            def helper():
+                raise
+            try:
+                helper()
+            except RuntimeError:
+                return "ok"
+
+        res, _ = interpret(f)
+        assert res == "ok"
+
+    def test_throw_delegates_through_yield_from(self):
+        def f():
+            def inner():
+                try:
+                    yield 1
+                except ValueError:
+                    yield 99
+            def outer():
+                yield from inner()
+            g = outer()
+            next(g)
+            return g.throw(ValueError("x"))
+
+        res, _ = interpret(f)
+        assert res == 99
+
+    def test_throw_stopiteration_into_yield_from(self):
+        def f():
+            def inner():
+                yield 1
+            def outer():
+                r = yield from inner()
+                yield r
+            g = outer()
+            next(g)
+            try:
+                g.throw(StopIteration(42))
+            except RuntimeError as e:
+                return "pep479" in str(e) or "StopIteration" in str(e)
+
+        res, _ = interpret(f)
+        assert res is True
+
+    def test_jit_of_generator_function_rejected(self):
+        def f(x):
+            yield ltorch.mul(x, 2)
+
+        x = rng.standard_normal((3,)).astype(np.float32)
+        with pytest.raises(TypeError, match="generator"):
+            tt.jit(f, interpretation="bytecode")(x)
+        with pytest.raises(TypeError, match="generator"):
+            tt.jit(f)(x)
+
+    def test_throw_stopiteration_into_yield_from_plain_iterator(self):
+        """CLEANUP_THROW stack contract (pop 3, push none+value): throwing
+        StopIteration into a yield-from over a PLAIN iterator resumes the
+        outer generator with the thrown value."""
+        def f():
+            def outer():
+                r = yield from iter([1, 2, 3])
+                yield ("done", r)
+            g = outer()
+            next(g)
+            return g.throw(StopIteration(7))
+
+        res, _ = interpret(f)
+        assert res == ("done", 7)

@@ -411,3 +411,47 @@ class TestInt64Canonicalization:
     def test_numpy_int64_input(self):
         got = run(lambda x: ltorch.add(x, 1), np.arange(6, dtype=np.int64))
         np.testing.assert_array_equal(got, np.arange(1, 7))
+
+
+def test_mixed_basic_and_list_index():
+    import numpy as np
+    import thunder_tpu as tt
+    import thunder_tpu.torch as lt
+
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+
+    def f(a):
+        return a[:, [-1, 0]], a[:, [1]], a[1, [2, 0]]
+
+    o1, o2, o3 = tt.jit(f)(x)
+    np.testing.assert_array_equal(np.asarray(o1), x[:, [-1, 0]])
+    np.testing.assert_array_equal(np.asarray(o2), x[:, [1]])
+    np.testing.assert_array_equal(np.asarray(o3), x[1, [2, 0]])
+
+
+def test_mixed_basic_and_tensor_index():
+    import numpy as np
+    import thunder_tpu as tt
+
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    idx = np.array([2, 0], dtype=np.int32)
+
+    def f(a, i):
+        return a[:, i]
+
+    out = tt.jit(f)(x, idx)
+    np.testing.assert_array_equal(np.asarray(out), x[:, [2, 0]])
+
+
+def test_int_basic_plus_tensor_index():
+    import numpy as np
+    import thunder_tpu as tt
+
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    idx = np.array([2, 0], dtype=np.int32)
+
+    def f(a, i):
+        return a[1, i]
+
+    out = tt.jit(f)(x, idx)
+    np.testing.assert_array_equal(np.asarray(out), x[1, [2, 0]])

@@ -628,10 +628,12 @@ def _basic_index(a: TensorProxy, key) -> TensorProxy:
         key = (key,)
     # expand Ellipsis
     n_specified = len([k for k in key if k is not None and k is not Ellipsis])
-    if Ellipsis in key:
-        i = key.index(Ellipsis)
+    # identity scan, not `in`/`index`: those call __eq__, which a TensorProxy
+    # element would turn into an elementwise op
+    ell = next((j for j, k in enumerate(key) if k is Ellipsis), None)
+    if ell is not None:
         fill = a.ndim - n_specified
-        key = key[:i] + (slice(None),) * fill + key[i + 1 :]
+        key = key[:ell] + (slice(None),) * fill + key[ell + 1 :]
     else:
         key = key + (slice(None),) * (a.ndim - n_specified)
 
@@ -639,6 +641,7 @@ def _basic_index(a: TensorProxy, key) -> TensorProxy:
     out_shape = []
     squeeze_dims = []
     unsqueeze_positions = []
+    advanced = None  # at most one (dim, list-of-ints | int tensor) among basics
     dim = 0
     out_dim = 0
     for k in key:
@@ -647,7 +650,7 @@ def _basic_index(a: TensorProxy, key) -> TensorProxy:
             out_dim += 1
             continue
         size = a.shape[dim]
-        if isinstance(k, (int, NumberProxy)):
+        if isinstance(k, (int, NumberProxy)) and not isinstance(k, bool):
             i = int(pyval(k) if isinstance(k, NumberProxy) else k)
             if i < 0:
                 i += size
@@ -663,6 +666,20 @@ def _basic_index(a: TensorProxy, key) -> TensorProxy:
             stops.append(max(start, stop))
             strides.append(stride)
             out_dim += 1
+        elif (
+            isinstance(k, list)
+            and k
+            and all(isinstance(e, int) and not isinstance(e, bool) for e in k)
+        ) or (isinstance(k, TensorProxy) and k.ndim == 1 and not dtypes.is_boolean_dtype(k.dtype)):
+            # ONE advanced index mixed with basics (torch a[:, [-1, 0]]):
+            # keep the dim whole here, gather along it afterwards
+            check(advanced is None, lambda: "only one advanced index among basic indices is supported")
+            check(not unsqueeze_positions, lambda: "None + advanced index mixing is not supported")
+            advanced = (dim, k)
+            starts.append(0)
+            stops.append(size)
+            strides.append(1)
+            out_dim += 1
         else:
             raise TypeError(f"Unsupported basic index {k!r}")
         dim += 1
@@ -670,6 +687,13 @@ def _basic_index(a: TensorProxy, key) -> TensorProxy:
     result = prims.slice_prim(a, starts, stops, strides)
     if squeeze_dims:
         result = prims.squeeze(result, tuple(squeeze_dims))
+    if advanced is not None:
+        adv_dim, k = advanced
+        pos = adv_dim - len([d for d in squeeze_dims if d < adv_dim])  # NB: `sum` is clang's op here
+        if isinstance(k, TensorProxy):
+            result = prims.take(result, k, pos)
+        else:
+            result = _gather_static_list(result, k, pos)
     for pos in unsqueeze_positions:
         result = unsqueeze(result, pos)
     return result
@@ -693,17 +717,29 @@ def getitem(a: TensorProxy, key) -> TensorProxy:
         if any(isinstance(k, bool) for k in key):
             raise NotImplementedError("boolean mask indexing produces dynamic shapes; use where/masked ops")
         check(all(isinstance(k, int) for k in key), lambda: "list indexing requires a list of ints")
-        check(len(key) > 0, lambda: "empty list index is not supported")
-        parts = []
-        for i in key:
-            if i < 0:
-                i += a.shape[0]
-            check(0 <= i < a.shape[0], lambda: f"list index {i} out of range for dim of size {a.shape[0]}")
-            parts.append(slice_in_dim(a, i, i + 1, dim=0))
-        return cat(parts, 0) if len(parts) > 1 else parts[0]
+        return _gather_static_list(a, key, 0)
     if isinstance(key, tuple) and any(isinstance(k, TensorProxy) for k in key):
-        return _mixed_advanced_index(a, key)
+        try:
+            return _mixed_advanced_index(a, key)
+        except NotImplementedError:
+            # a single 1-D integer tensor among non-full-slice basics
+            # (a[1, idx]) is served by the basic path's advanced arm
+            return _basic_index(a, key)
     return _basic_index(a, key)
+
+
+def _gather_static_list(a: TensorProxy, ints: list, dim: int) -> TensorProxy:
+    """Static-list gather along ``dim``: unit slices + cat (fully static for
+    XLA).  Shared by plain list indexing and the basic path's advanced arm."""
+    check(len(ints) > 0, lambda: "empty list index is not supported")
+    size = a.shape[dim]
+    parts = []
+    for i in ints:
+        if i < 0:
+            i += size
+        check(0 <= i < size, lambda: f"list index {i} out of range for dim of size {size}", IndexError)
+        parts.append(slice_in_dim(a, i, i + 1, dim=dim))
+    return cat(parts, dim) if len(parts) > 1 else parts[0]
 
 
 def _mixed_advanced_index(a: TensorProxy, key: tuple) -> TensorProxy:

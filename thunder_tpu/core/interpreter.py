@@ -12,9 +12,10 @@ outside the explicit arguments.
 Scope (deliberate, documented): the common Python subset model code uses —
 arithmetic, containers, control flow, comprehensions, nested function calls,
 closures, imports, try/except/finally (full 3.12 exception-table dispatch),
-and ``with`` blocks (incl. exception suppression).  Generators and async
-raise ``InterpreterError`` with a pointer to the escape hatch.  Targets
-CPython 3.12 bytecode.
+``with`` blocks (incl. exception suppression), and generators (suspendable
+interpreted frames with send/throw/close, ``yield from``, genexprs, PEP-479).
+Async raises ``InterpreterError`` with a pointer to the escape hatch.
+Targets CPython 3.12 bytecode.
 """
 from __future__ import annotations
 
@@ -107,7 +108,9 @@ class InterpreterCompileCtx:
     read_callback: Callable | None = None
     # thread-level "currently handled exception" stack (CPython's
     # tstate->exc_info chain): a bare `raise` in a helper function re-raises
-    # the exception its *caller* is handling, so the state must span frames
+    # the exception its *caller* is handling, so the state must span frames.
+    # Entries are (frame, exc) so a frame's residue can be removed on its
+    # exit even when suspended generator frames interleave pushes
     exc_stack: list = field(default_factory=list)
     max_depth: int = 32
     # callables never interpreted (treated as opaque host calls)
@@ -190,10 +193,9 @@ class Frame:
 
 
 _UNSUPPORTED = {
-    "RETURN_GENERATOR": "generator/async functions cannot be traced; call them outside the jitted fn",
     "GET_AWAITABLE": "async is not supported",
-    "SEND": "generators are not supported",
-    "YIELD_VALUE": "generators are not supported",
+    "BEFORE_ASYNC_WITH": "async is not supported",
+    "GET_AITER": "async is not supported",
 }
 
 # CPython's stack NULL is a real null pointer, distinct from Py_None — the
@@ -253,6 +255,11 @@ def _bind_args(code: types.CodeType, fn: types.FunctionType | None, args: tuple,
     """Binds call args to local variable names (defaults, *args, **kwargs)."""
     import inspect
 
+    names = code.co_varnames[: code.co_argcount]
+    if any(n.startswith(".") for n in names):
+        # genexpr/comprehension codes take the compiler-named '.0' iterator,
+        # which inspect.signature cannot represent — bind positionally
+        return dict(zip(names, args))
     if fn is not None:
         sig = inspect.signature(fn)
         bound = sig.bind(*args, **kwargs)
@@ -276,6 +283,10 @@ def _run_function(ctx: InterpreterCompileCtx, fn: types.FunctionType, args: tupl
     if fn.__closure__:
         for name, cell in zip(code.co_freevars, fn.__closure__):
             frame.cells[name] = cell
+    if code.co_flags & (0x80 | 0x200):  # CO_COROUTINE / CO_ASYNC_GENERATOR
+        raise InterpreterError("async functions cannot be traced; call them outside the jitted fn")
+    if code.co_flags & 0x20:  # CO_GENERATOR: suspend-capable frame
+        return InterpretedGenerator(frame)
     return _run_frame(frame)
 
 
@@ -287,14 +298,71 @@ def _run_frame(frame: Frame):
     # balance the thread-level handled-exception stack on ANY exit from this
     # frame: an exception propagating out of an except block skips POP_EXCEPT,
     # and a stale entry would leak into sibling calls' bare-raise lookups
-    exc_depth = len(frame.ctx.exc_stack)
     try:
-        return _run_frame_inner(frame, instrs, exc_table)
+        loop = _frame_loop(frame, instrs, exc_table)
+        try:
+            next(loop)
+        except StopIteration as e:
+            return e.value
+        raise InterpreterError(f"unexpected yield in non-generator frame {frame.code.co_name}")
     finally:
-        del frame.ctx.exc_stack[exc_depth:]
+        frame.ctx.exc_stack[:] = [p for p in frame.ctx.exc_stack if p[0] is not frame]
 
 
-def _run_frame_inner(frame: Frame, instrs, exc_table):
+def _gen_driver(frame: Frame):
+    """The resumable loop behind an InterpretedGenerator (a real Python
+    generator, so suspend/resume/throw/close and StopIteration.value all come
+    from the host machinery)."""
+    exc_table = dis._parse_exception_table(frame.code)
+    try:
+        return (yield from _frame_loop(frame, frame.instrs, exc_table))
+    finally:
+        frame.ctx.exc_stack[:] = [p for p in frame.ctx.exc_stack if p[0] is not frame]
+
+
+class InterpretedGenerator:
+    """A suspended interpreted frame exposing the generator protocol
+    (reference: the interpreter runs generator frames natively;
+    thunder/core/interpreter.py generator handling)."""
+
+    def __init__(self, frame: Frame):
+        self._frame = frame
+        self._loop = _gen_driver(frame)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self._loop.send(None)
+
+    def send(self, value):
+        return self._loop.send(value)
+
+    def throw(self, *exc):
+        return self._loop.throw(*exc)
+
+    def close(self):
+        return self._loop.close()
+
+
+def _unwind(frame: Frame, ins, exc_table, e: BaseException) -> int:
+    """Dispatches ``e`` raised at ``ins`` to the frame's exception table:
+    truncates the value stack to the handler depth and returns the handler's
+    instruction index.  Re-raises when no handler covers the offset."""
+    entry = next((t for t in exc_table if t.start <= ins.offset < t.end), None)
+    if entry is None:
+        raise e
+    del frame.stack[entry.depth :]
+    if entry.lasti:
+        frame.push(ins.offset)
+    frame.push(e)
+    # current_exc is NOT set here: the handler's PUSH_EXC_INFO saves the
+    # outer state first, then installs e — setting it early would make
+    # POP_EXCEPT "restore" the exception being handled
+    return frame.jump_to_offset(entry.target)
+
+
+def _frame_loop(frame: Frame, instrs, exc_table):
     i = 0
     n = len(instrs)
     while i < n:
@@ -316,29 +384,59 @@ def _run_frame_inner(frame: Frame, instrs, exc_table):
             # BaseException, not Exception: SystemExit/KeyboardInterrupt must
             # still run finally blocks and reach `except BaseException:`
             # handlers (the table entry exists for them like any other)
-            entry = next(
-                (t for t in exc_table if t.start <= ins.offset < t.end), None
-            )
-            if entry is None:
-                raise
-            # unwind: truncate the value stack to the handler's depth,
-            # optionally push the resume offset (lasti), then the exception
-            del frame.stack[entry.depth :]
-            if entry.lasti:
-                frame.push(ins.offset)
-            frame.push(e)
-            # current_exc is NOT set here: the handler's PUSH_EXC_INFO saves
-            # the outer state first, then installs e — setting it early would
-            # make POP_EXCEPT "restore" the exception being handled
-            i = frame.jump_to_offset(entry.target)
+            i = _unwind(frame, ins, exc_table, e)
             continue
         if isinstance(res, _Return):
             return res.value
+        if isinstance(res, _Yield):
+            # Suspend.  CPython swaps the generator's handled-exception state
+            # out of the thread state across the yield, keeps the value slot
+            # on the stack (the sent value replaces it on resume), and
+            # delegates throw() to the sub-iterator when suspended at a
+            # yield-from (YIELD_VALUE directly after SEND).
+            to_yield = res.value
+            ctx_stack = frame.ctx.exc_stack
+            while True:
+                mine = [p for p in ctx_stack if p[0] is frame]
+                if mine:
+                    ctx_stack[:] = [p for p in ctx_stack if p[0] is not frame]
+                try:
+                    sent = yield to_yield
+                except BaseException as e:
+                    ctx_stack.extend(mine)
+                    in_yield_from = i > 0 and instrs[i - 1].opname == "SEND"
+                    recv = frame.stack[-2] if in_yield_from and len(frame.stack) >= 2 else None
+                    if recv is not None and hasattr(recv, "throw"):
+                        try:
+                            to_yield = recv.throw(e)
+                            continue  # sub-iterator yielded again: re-suspend
+                        except StopIteration as si:
+                            # sub-iterator finished: SEND-exhaustion contract
+                            frame.stack[-1] = getattr(si, "value", None)
+                            i = frame.jump_to_offset(instrs[i - 1].argval)
+                            break
+                        except BaseException as e2:
+                            e = e2
+                    i = _unwind(frame, ins, exc_table, e)
+                    break
+                else:
+                    ctx_stack.extend(mine)
+                    frame.stack[-1] = sent
+                    i += 1
+                    break
+            continue
         i = res if isinstance(res, int) else i + 1
     raise InterpreterError(f"fell off the end of {frame.code.co_name}")
 
 
 class _Return:
+    __slots__ = ("value",)
+
+    def __init__(self, value):
+        self.value = value
+
+
+class _Yield:
     __slots__ = ("value",)
 
     def __init__(self, value):
@@ -902,6 +1000,13 @@ def _call_intrinsic_1(frame, ins, i):
         frame.push(+v)
     elif ins.arg == 6:  # LIST_TO_TUPLE
         frame.push(tuple(v))
+    elif ins.arg == 3:  # STOPITERATION_ERROR (PEP 479 in generator frames)
+        if isinstance(v, StopIteration):
+            e = RuntimeError("generator raised StopIteration")
+            e.__cause__ = v
+            frame.push(e)
+        else:
+            frame.push(v)
     else:
         raise InterpreterError(f"CALL_INTRINSIC_1 {ins.arg} is not supported")
 
@@ -962,7 +1067,7 @@ def _raise_varargs(frame, ins, i):
     if frame.current_exc is not None:
         raise frame.current_exc
     if frame.ctx.exc_stack:
-        raise frame.ctx.exc_stack[-1]
+        raise frame.ctx.exc_stack[-1][1]
     raise RuntimeError("No active exception to reraise")
 
 
@@ -981,7 +1086,7 @@ def _push_exc_info(frame, ins, i):
     frame.push(exc)
     if isinstance(exc, BaseException):
         frame.current_exc = exc
-        frame.ctx.exc_stack.append(exc)
+        frame.ctx.exc_stack.append((frame, exc))
 
 
 @register_opcode_handler("CHECK_EXC_MATCH")
@@ -995,8 +1100,84 @@ def _check_exc_match(frame, ins, i):
 def _pop_except(frame, ins, i):
     prev = frame.pop()  # the saved exception state from PUSH_EXC_INFO
     frame.current_exc = prev if isinstance(prev, BaseException) else None
-    if frame.ctx.exc_stack:
-        frame.ctx.exc_stack.pop()
+    # pop THIS frame's most recent entry (a suspended generator's entry may
+    # sit above it on the shared thread-level stack)
+    stack = frame.ctx.exc_stack
+    for j in range(len(stack) - 1, -1, -1):
+        if stack[j][0] is frame:
+            del stack[j]
+            break
+
+
+#
+# Generator opcodes (3.12).  Generator frames are created suspended at call
+# time (_run_function returns InterpretedGenerator), so RETURN_GENERATOR at
+# the top of the body only needs a placeholder for the following POP_TOP.
+#
+
+
+@register_opcode_handler("RETURN_GENERATOR")
+def _return_generator(frame, ins, i):
+    frame.push(None)
+
+
+@register_opcode_handler("YIELD_VALUE")
+def _yield_value(frame, ins, i):
+    # peek, don't pop: CPython keeps the value slot across the suspension
+    # (the sent value replaces it on resume), and the exception-table depths
+    # for yield-from regions assume the slot is present
+    return _Yield(frame.stack[-1])
+
+
+@register_opcode_handler("GET_YIELD_FROM_ITER")
+def _get_yield_from_iter(frame, ins, i):
+    v = frame.stack[-1]
+    if not isinstance(v, (types.GeneratorType, InterpretedGenerator)):
+        frame.stack[-1] = iter(v)
+
+
+@register_opcode_handler("SEND")
+def _send(frame, ins, i):
+    # stack [receiver, v] → [receiver, receiver.send(v)]; on StopIteration
+    # push its value and jump to the target (END_SEND)
+    v = frame.pop()
+    recv = frame.stack[-1]
+    try:
+        if hasattr(recv, "send"):
+            res = recv.send(v)
+        else:
+            if v is not None:
+                raise InterpreterError(f"cannot send non-None into {type(recv).__name__}")
+            res = next(recv)
+    except StopIteration as e:
+        frame.push(getattr(e, "value", None))
+        return frame.jump_to_offset(ins.argval)
+    frame.push(res)
+
+
+@register_opcode_handler("END_SEND")
+def _end_send(frame, ins, i):
+    # del STACK[-2]: drop the exhausted sub-iterator under the result
+    res = frame.pop()
+    frame.pop()
+    frame.push(res)
+
+
+@register_opcode_handler("CLEANUP_THROW")
+def _cleanup_throw(frame, ins, i):
+    # handles an exception raised by throw()/close() at a SEND suspension.
+    # CPython contract: (sub_iter, last_sent_val, exc_value -- none, value)
+    # for StopIteration (the following END_SEND drops the none); anything
+    # else re-raises
+    exc = frame.stack[-1]
+    if isinstance(exc, StopIteration):
+        frame.pop()
+        frame.pop()
+        frame.pop()
+        frame.push(None)
+        frame.push(exc.value)
+        return None
+    raise exc
 
 
 @register_opcode_handler("BEFORE_WITH")

@@ -579,6 +579,9 @@ class TensorProxy(Proxy, TensorProxyInterface):
     def dim(self) -> int:
         return len(self._shape)
 
+    def is_floating_point(self) -> bool:
+        return dtypes.is_float_dtype(self._dtype)
+
     def replace_name(self, name: str | None = None):
         return self.replace(name=name)
 

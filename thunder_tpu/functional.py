@@ -102,6 +102,15 @@ def trace_from_fn(
     """
     from thunder_tpu.core.pytree import tree_map
 
+    import inspect as _inspect
+
+    if _inspect.isgeneratorfunction(fn) or _inspect.iscoroutinefunction(fn):
+        raise TypeError(
+            f"cannot jit the generator/async function {getattr(fn, '__name__', fn)!r}: "
+            "its body would execute lazily, outside the trace; wrap it in a function "
+            "that materializes the outputs (e.g. list(gen(...)))"
+        )
+
     flat, spec = tree_flatten((tuple(args), dict(kwargs)))
 
     # per-leaf differentiability flags, aligned with `flat`

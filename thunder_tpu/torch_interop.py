@@ -68,33 +68,70 @@ class ThunderTracingMode(torch.overrides.TorchFunctionMode):
                 return mask_function(q[:, None], kv[None, :])
         return fn
 
-    # refcounted so nested modes don't restore the original mid-trace
+    # refcounted so nested modes don't restore the originals mid-trace
     _patch_depth = 0
-    _patch_orig = None
+    _patches: list = []
+
+    @staticmethod
+    def _tensor_shim(orig):
+        # torch.tensor(scalar, dtype=<thunder dtype>, device=<Device>) in HF
+        # mask code: translate the dtype, build the constant through the
+        # thunder op surface while a trace is active
+        def shim(data, *args, dtype=None, device=None, **kwargs):
+            from thunder_tpu.core import dtypes as ttd
+            from thunder_tpu.core.trace import get_tracectx
+
+            if get_tracectx() is not None and isinstance(data, (int, float, bool)):
+                import thunder_tpu.torch as ltorch
+
+                return ltorch.full((), data, dtype=dtype)
+            if isinstance(dtype, ttd.dtype):
+                dtype = ttd.to_torch_dtype(dtype)
+            if dtype is not None:
+                kwargs["dtype"] = dtype
+            return orig(data, *args, **kwargs)
+
+        return shim
+
+    @staticmethod
+    def _finfo_shim(orig):
+        # torch.finfo/iinfo reject thunder dtypes at the C arg parser (they
+        # are not torch.dtype); HF mask code calls torch.finfo(t.dtype).min
+        def shim(dtype=None):
+            from thunder_tpu.core import dtypes as ttd
+
+            if isinstance(dtype, ttd.dtype):
+                dtype = ttd.to_torch_dtype(dtype)
+            return orig(dtype) if dtype is not None else orig()
+
+        return shim
 
     def __enter__(self):
         import sys as _sys
 
         cls = ThunderTracingMode
-        mu = _sys.modules.get("transformers.masking_utils")
-        if mu is not None and hasattr(mu, "_vmap_for_bhqkv"):
-            if cls._patch_depth == 0:
-                cls._patch_orig = (mu, mu._vmap_for_bhqkv)
+        if cls._patch_depth == 0:
+            cls._patches = []
+            mu = _sys.modules.get("transformers.masking_utils")
+            if mu is not None and hasattr(mu, "_vmap_for_bhqkv"):
+                cls._patches.append((mu, "_vmap_for_bhqkv", mu._vmap_for_bhqkv))
                 mu._vmap_for_bhqkv = self._broadcast_bhqkv
-            cls._patch_depth += 1
-            self._patched = True
-        else:
-            self._patched = False
+            for name in ("finfo", "iinfo"):
+                orig = getattr(torch, name)
+                cls._patches.append((torch, name, orig))
+                setattr(torch, name, self._finfo_shim(orig))
+            cls._patches.append((torch, "tensor", torch.tensor))
+            torch.tensor = self._tensor_shim(torch.tensor)
+        cls._patch_depth += 1
         return super().__enter__()
 
     def __exit__(self, *exc):
         cls = ThunderTracingMode
-        if self._patched:
-            cls._patch_depth -= 1
-            if cls._patch_depth == 0 and cls._patch_orig is not None:
-                mu, orig = cls._patch_orig
-                mu._vmap_for_bhqkv = orig
-                cls._patch_orig = None
+        cls._patch_depth -= 1
+        if cls._patch_depth == 0:
+            for obj, name, orig in reversed(cls._patches):
+                setattr(obj, name, orig)
+            cls._patches = []
         return super().__exit__(*exc)
 
 

@@ -728,3 +728,17 @@ class TestGenerators:
 
         res, _ = interpret(f)
         assert res == ("done", 7)
+
+    def test_stopiteration_identity_across_frames(self):
+        """A user StopIteration crossing an interpreted frame boundary must
+        not be PEP-479-wrapped (only generator frames wrap)."""
+        def f():
+            def g():
+                next(iter([]))
+            try:
+                g()
+            except StopIteration:
+                return "caught"
+
+        res, _ = interpret(f)
+        assert res == "caught"

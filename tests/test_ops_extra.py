@@ -455,3 +455,19 @@ def test_int_basic_plus_tensor_index():
 
     out = tt.jit(f)(x, idx)
     np.testing.assert_array_equal(np.asarray(out), x[1, [2, 0]])
+
+
+def test_noncontiguous_tensor_runs_keep_rewrite_hint():
+    import numpy as np
+    import pytest
+    import thunder_tpu as tt
+
+    x = np.arange(120, dtype=np.float32).reshape(2, 3, 4, 5)
+    i1 = np.array([0, 1], dtype=np.int32)
+    i2 = np.array([1, 0], dtype=np.int32)
+
+    def f(a, i, j):
+        return a[i, 0, j]
+
+    with pytest.raises(NotImplementedError, match="take/gather"):
+        tt.jit(f)(x, i1, i2)

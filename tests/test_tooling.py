@@ -251,3 +251,63 @@ class TestProfileMarkers:
         with prof.add_markers("region-x"):
             x = np.ones(3).sum()
         assert x == 3.0
+
+
+def test_execution_callback_file(tmp_path):
+    """Generated programs dump to the execution file; a user-edited program
+    is executed instead (reference trace.py:565-574)."""
+    import glob
+    import os
+
+    import numpy as np
+
+    import thunder_tpu as tt
+    import thunder_tpu.torch as lt
+
+    base = str(tmp_path / "prog")
+    tt.set_execution_callback_file(base)
+    try:
+        def f(x):
+            return lt.mul(x, 2.0)
+
+        x = np.ones((3,), dtype=np.float32)
+        out = np.asarray(tt.jit(f)(x))
+        np.testing.assert_allclose(out, 2.0 * x)
+        files = glob.glob(base + ".*.py")
+        assert files, "no program dumped"
+        comp = [p for p in files if "2.0" in open(p).read()]
+        assert comp, f"no dumped program contains the computation: {files}"
+        target = comp[0]
+        src = open(target).read()
+        edited = src.replace("2.0", "3.0")
+        assert edited != src, src
+        with open(target, "w") as fh:
+            fh.write(edited)
+        out2 = np.asarray(tt.jit(f)(x))
+        np.testing.assert_allclose(out2, 3.0 * x)
+    finally:
+        tt.set_execution_callback_file(None)
+
+
+def test_execution_callback_file_per_program(tmp_path):
+    """Different functions (and retraces) get distinct dump files — one
+    function's edited program is never executed for another."""
+    import numpy as np
+
+    import thunder_tpu as tt
+    import thunder_tpu.torch as lt
+
+    base = str(tmp_path / "prog")
+    tt.set_execution_callback_file(base)
+    try:
+        x = np.ones((3,), dtype=np.float32)
+        out2 = np.asarray(tt.jit(lambda a: lt.mul(a, 2.0))(x))
+        out5 = np.asarray(tt.jit(lambda a: lt.mul(a, 5.0))(x))
+        np.testing.assert_allclose(out2, 2.0 * x)
+        np.testing.assert_allclose(out5, 5.0 * x)
+        # retrace with a new shape must not reuse the old dumped prologue
+        y = np.ones((5,), dtype=np.float32)
+        out_y = np.asarray(tt.jit(lambda a: lt.mul(a, 2.0))(y))
+        np.testing.assert_allclose(out_y, 2.0 * y)
+    finally:
+        tt.set_execution_callback_file(None)

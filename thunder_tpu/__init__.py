@@ -29,7 +29,7 @@ from thunder_tpu.core.options import (
 )
 from thunder_tpu.core.autocast import autocast
 from thunder_tpu.core.batching import jvp, vmap
-from thunder_tpu.core.trace import TraceCtx, TraceResults
+from thunder_tpu.core.trace import TraceCtx, TraceResults, set_execution_callback_file
 from thunder_tpu.core.transform_common import cse, dce
 from thunder_tpu.extend import resolve_executors
 from thunder_tpu.functional import trace_from_fn

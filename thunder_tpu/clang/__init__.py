@@ -723,8 +723,17 @@ def getitem(a: TensorProxy, key) -> TensorProxy:
             return _mixed_advanced_index(a, key)
         except NotImplementedError:
             # a single 1-D integer tensor among non-full-slice basics
-            # (a[1, idx]) is served by the basic path's advanced arm
-            return _basic_index(a, key)
+            # (a[1, idx]) is served by the basic path's advanced arm; other
+            # rejected patterns keep _mixed_advanced_index's rewrite hint
+            tps = [k for k in key if isinstance(k, TensorProxy)]
+            if (
+                len(tps) == 1
+                and tps[0].ndim == 1
+                and not dtypes.is_boolean_dtype(tps[0].dtype)
+                and not any(k is None for k in key)
+            ):
+                return _basic_index(a, key)
+            raise
     return _basic_index(a, key)
 
 

@@ -304,6 +304,12 @@ def _run_frame(frame: Frame):
             next(loop)
         except StopIteration as e:
             return e.value
+        except _StopIterationCarrier as c:
+            # a user StopIteration crossing a NON-generator interpreted frame
+            # must keep its identity; _frame_loop smuggles it out in a
+            # carrier so the host doesn't PEP-479-wrap it (while a genuine
+            # wrap from a generator frame passes through untouched)
+            raise c.exc
         raise InterpreterError(f"unexpected yield in non-generator frame {frame.code.co_name}")
     finally:
         frame.ctx.exc_stack[:] = [p for p in frame.ctx.exc_stack if p[0] is not frame]
@@ -363,70 +369,81 @@ def _unwind(frame: Frame, ins, exc_table, e: BaseException) -> int:
 
 
 def _frame_loop(frame: Frame, instrs, exc_table):
-    i = 0
-    n = len(instrs)
-    while i < n:
-        ins = instrs[i]
-        op = ins.opname
-        if op in _UNSUPPORTED:
-            raise InterpreterError(f"{op}: {_UNSUPPORTED[op]}")
-        h = _handlers.get(op)
-        if h is None:
-            raise InterpreterError(
-                f"opcode {op} is not supported by the bytecode interpreter yet "
-                f"(in {frame.code.co_name}); use the functional frontend or mark the callee opaque"
-            )
-        try:
-            res = h(frame, ins, i)
-        except InterpreterError:
-            raise  # interpreter-machinery faults never unwind to user handlers
-        except BaseException as e:
-            # BaseException, not Exception: SystemExit/KeyboardInterrupt must
-            # still run finally blocks and reach `except BaseException:`
-            # handlers (the table entry exists for them like any other)
-            i = _unwind(frame, ins, exc_table, e)
-            continue
-        if isinstance(res, _Return):
-            return res.value
-        if isinstance(res, _Yield):
-            # Suspend.  CPython swaps the generator's handled-exception state
-            # out of the thread state across the yield, keeps the value slot
-            # on the stack (the sent value replaces it on resume), and
-            # delegates throw() to the sub-iterator when suspended at a
-            # yield-from (YIELD_VALUE directly after SEND).
-            to_yield = res.value
-            ctx_stack = frame.ctx.exc_stack
-            while True:
-                mine = [p for p in ctx_stack if p[0] is frame]
-                if mine:
-                    ctx_stack[:] = [p for p in ctx_stack if p[0] is not frame]
-                try:
-                    sent = yield to_yield
-                except BaseException as e:
-                    ctx_stack.extend(mine)
-                    in_yield_from = i > 0 and instrs[i - 1].opname == "SEND"
-                    recv = frame.stack[-2] if in_yield_from and len(frame.stack) >= 2 else None
-                    if recv is not None and hasattr(recv, "throw"):
-                        try:
-                            to_yield = recv.throw(e)
-                            continue  # sub-iterator yielded again: re-suspend
-                        except StopIteration as si:
-                            # sub-iterator finished: SEND-exhaustion contract
-                            frame.stack[-1] = getattr(si, "value", None)
-                            i = frame.jump_to_offset(instrs[i - 1].argval)
-                            break
-                        except BaseException as e2:
-                            e = e2
-                    i = _unwind(frame, ins, exc_table, e)
-                    break
-                else:
-                    ctx_stack.extend(mine)
-                    frame.stack[-1] = sent
-                    i += 1
-                    break
-            continue
-        i = res if isinstance(res, int) else i + 1
-    raise InterpreterError(f"fell off the end of {frame.code.co_name}")
+    # For NON-generator frames an escaping user StopIteration is smuggled out
+    # in a carrier (the try wraps the whole loop below) — _frame_loop is a
+    # host generator, and letting StopIteration escape it raw would PEP-479
+    # wrap it into RuntimeError, changing exception identity at interpreted
+    # frame boundaries.  Generator frames keep the wrap: that IS CPython.
+    is_gen_frame = bool(frame.code.co_flags & 0x20)
+    try:
+        i = 0
+        n = len(instrs)
+        while i < n:
+            ins = instrs[i]
+            op = ins.opname
+            if op in _UNSUPPORTED:
+                raise InterpreterError(f"{op}: {_UNSUPPORTED[op]}")
+            h = _handlers.get(op)
+            if h is None:
+                raise InterpreterError(
+                    f"opcode {op} is not supported by the bytecode interpreter yet "
+                    f"(in {frame.code.co_name}); use the functional frontend or mark the callee opaque"
+                )
+            try:
+                res = h(frame, ins, i)
+            except InterpreterError:
+                raise  # interpreter-machinery faults never unwind to user handlers
+            except BaseException as e:
+                # BaseException, not Exception: SystemExit/KeyboardInterrupt must
+                # still run finally blocks and reach `except BaseException:`
+                # handlers (the table entry exists for them like any other)
+                i = _unwind(frame, ins, exc_table, e)
+                continue
+            if isinstance(res, _Return):
+                return res.value
+            if isinstance(res, _Yield):
+                # Suspend.  CPython swaps the generator's handled-exception state
+                # out of the thread state across the yield, keeps the value slot
+                # on the stack (the sent value replaces it on resume), and
+                # delegates throw() to the sub-iterator when suspended at a
+                # yield-from (YIELD_VALUE directly after SEND).
+                to_yield = res.value
+                ctx_stack = frame.ctx.exc_stack
+                while True:
+                    mine = [p for p in ctx_stack if p[0] is frame]
+                    if mine:
+                        ctx_stack[:] = [p for p in ctx_stack if p[0] is not frame]
+                    try:
+                        sent = yield to_yield
+                    except BaseException as e:
+                        ctx_stack.extend(mine)
+                        in_yield_from = i > 0 and instrs[i - 1].opname == "SEND"
+                        recv = frame.stack[-2] if in_yield_from and len(frame.stack) >= 2 else None
+                        if recv is not None and hasattr(recv, "throw"):
+                            try:
+                                to_yield = recv.throw(e)
+                                continue  # sub-iterator yielded again: re-suspend
+                            except StopIteration as si:
+                                # sub-iterator finished: SEND-exhaustion contract
+                                frame.stack[-1] = getattr(si, "value", None)
+                                i = frame.jump_to_offset(instrs[i - 1].argval)
+                                break
+                            except BaseException as e2:
+                                e = e2
+                        i = _unwind(frame, ins, exc_table, e)
+                        break
+                    else:
+                        ctx_stack.extend(mine)
+                        frame.stack[-1] = sent
+                        i += 1
+                        break
+                continue
+            i = res if isinstance(res, int) else i + 1
+        raise InterpreterError(f"fell off the end of {frame.code.co_name}")
+    except StopIteration as e:
+        if is_gen_frame:
+            raise
+        raise _StopIterationCarrier(e) from None
 
 
 class _Return:
@@ -441,6 +458,17 @@ class _Yield:
 
     def __init__(self, value):
         self.value = value
+
+
+class _StopIterationCarrier(Exception):
+    """Smuggles a user StopIteration out of _frame_loop (a host generator)
+    for non-generator frames, so the host's PEP-479 wrap doesn't change its
+    identity at interpreted frame boundaries."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc):
+        self.exc = exc
 
 
 #

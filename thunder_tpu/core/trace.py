@@ -230,9 +230,32 @@ class TraceCtx:
         return ctx
 
     def python_callable(self, **kwargs) -> Callable:
-        """Compiles this trace's printed program and returns the callable."""
+        """Compiles this trace's printed program and returns the callable.
+
+        When an execution file is set (``set_execution_callback_file``,
+        reference trace.py:565-574), the generated program is dumped there —
+        and if the file already holds a user-edited program, that version is
+        compiled and executed instead (debug lever: edit the generated code,
+        rerun)."""
         python_str = self.python(**kwargs)
         si = self.siginfo()
+        path = _execution_file.get()
+        if path is not None:
+            import hashlib
+            import os
+
+            # keyed by generated-source hash: a different function (or a
+            # retrace with new shapes) gets its own file instead of silently
+            # executing another program's edited dump; the same generated
+            # program keeps finding the user's edits
+            digest = hashlib.sha1(python_str.encode()).hexdigest()[:10]
+            fname = f"{path}.{si.name}.{digest}.py"
+            if os.path.exists(fname):
+                with open(fname) as f:
+                    python_str = f.read()
+            else:
+                with open(fname, "w") as f:
+                    f.write(python_str)
         fn = compile_and_exec(si.name, python_str, self.import_ctx())
         fn.__thunder_trace__ = self
         return fn
@@ -259,6 +282,15 @@ class TraceResults:
 #
 
 _tracectx_var: ContextVar[TraceCtx | None] = ContextVar("tracectx", default=None)
+
+# debug lever (reference trace.py:565-574): when set, generated programs are
+# dumped to <path>.<fn name>.py and user-edited versions are executed instead
+_execution_file: ContextVar[str | None] = ContextVar("execution_file", default=None)
+
+
+def set_execution_callback_file(path: str | None) -> None:
+    """Dump every generated program under ``path`` and execute user edits."""
+    _execution_file.set(path)
 
 
 def get_tracectx() -> TraceCtx | None:

@@ -1,0 +1,227 @@
+"""Autoregressive inference with a KV cache (BASELINE milestone E).
+
+The reference has no in-tree generation loop (models come from LitGPT, which
+brings its own `generate`); milestone E requires MoE inference with the
+quantized path.  The TPU-native design:
+
+- **prefill**: one forward over the prompt writes K/V for every position into
+  a preallocated ``(L, B, ng, T_max, hs)`` cache — static shapes, one XLA
+  program;
+- **decode**: the whole new-token loop is ONE compiled program — a
+  ``lax.scan`` whose body runs a single-token forward against the cache,
+  updates it in place with ``dynamic_update_slice`` (XLA aliases the buffer;
+  no reallocation), and samples the next token.  No per-token dispatch or
+  retracing, which is where naive eager decode loops lose on TPU;
+- causality is positional: a query at global position ``p`` attends to cache
+  slots ``<= p``, so no (T, T) mask is ever materialized;
+- ``quantized=True`` routes every weight matmul through the int8 executor's
+  kernels (``executors/quantex.int8_linear``: dynamic per-token/per-channel
+  scales, int32 MXU accumulation) — the TransformerEngine-analog inference
+  path.
+
+Math mirrors ``models/llama`` (same param pytree, configs, GQA, partial
+rotary, RMSNorm/LayerNorm, LLaMAMLP/GptNeoxMLP/LLaMAMoE); written in plain
+jnp because the decode step lives inside ``lax.scan``.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from thunder_tpu.models.llama import Config, build_rope_cache
+
+__all__ = ["init_cache", "forward_with_cache", "generate"]
+
+
+def _linear(x, w, *, quantized=False):
+    if quantized:
+        from thunder_tpu.executors.quantex import int8_linear
+
+        return int8_linear(x, w)
+    return x @ w.T
+
+
+def _norm(x, w, cfg: Config):
+    xf = x.astype(jnp.float32)
+    if cfg.norm_class == "RMSNorm":
+        ms = jnp.mean(xf * xf, axis=-1, keepdims=True)
+        xf = xf * jax.lax.rsqrt(ms + cfg.norm_eps)
+    else:
+        mu = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.var(xf, axis=-1, keepdims=True)
+        xf = (xf - mu) * jax.lax.rsqrt(var + cfg.norm_eps)
+    return (xf * w.astype(jnp.float32)).astype(x.dtype)
+
+
+def _rope(x, cos, sin):
+    # x: (B, h, T, n_elem); cos/sin: (T, n_elem) for the global positions
+    half = x.shape[-1] // 2
+    rotated = jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+    return (x * cos + rotated * sin).astype(x.dtype)
+
+
+def _mlp(mp, x, cfg: Config, *, quantized=False):
+    lin = partial(_linear, quantized=quantized)
+    if cfg.mlp_class == "LLaMAMoE":
+        E, k = cfg.n_expert, cfg.n_expert_per_token
+        router = x.astype(jnp.float32) @ mp["gate"].T.astype(jnp.float32)
+        top_logits, top_idx = jax.lax.top_k(router, k)
+        probs = jax.nn.softmax(top_logits, axis=-1)
+        y = None
+        for e in range(E):
+            w_e = jnp.sum(probs * (top_idx == e).astype(jnp.float32), axis=-1)
+            xe = lin(jax.nn.silu(lin(x, mp["fc_1"][e])) * lin(x, mp["fc_2"][e]), mp["proj"][e])
+            contrib = xe * w_e[..., None].astype(x.dtype)
+            y = contrib if y is None else y + contrib
+        return y
+    if cfg.mlp_class == "LLaMAMLP":
+        return lin(jax.nn.silu(lin(x, mp["fc_1"])) * lin(x, mp["fc_2"]), mp["proj"])
+    return lin(jax.nn.gelu(lin(x, mp["fc"]), approximate=False), mp["proj"])
+
+
+def init_cache(cfg: Config, B: int, T_max: int, dtype=jnp.bfloat16) -> dict:
+    """Preallocated KV cache: ``{"k"/"v": (L, B, n_query_groups, T_max, hs)}``."""
+    shape = (cfg.n_layer, B, cfg.n_query_groups, T_max, cfg.head_size)
+    return {"k": jnp.zeros(shape, dtype=dtype), "v": jnp.zeros(shape, dtype=dtype)}
+
+
+def _attn_with_cache(ap, x, cos_t, sin_t, ck, cv, pos, cfg: Config, *, quantized=False):
+    """x: (B, T, C) new tokens at global positions [pos, pos+T).  Writes their
+    K/V into the per-layer cache (ck/cv: (B, ng, T_max, hs)) and attends
+    against every filled slot."""
+    B, T, C = x.shape
+    hs, nh, ng = cfg.head_size, cfg.n_head, cfg.n_query_groups
+    lin = partial(_linear, quantized=quantized)
+
+    q = lin(x, ap["wq"]).reshape(B, T, nh, hs).transpose(0, 2, 1, 3)
+    k = lin(x, ap["wk"]).reshape(B, T, ng, hs).transpose(0, 2, 1, 3)
+    v = lin(x, ap["wv"]).reshape(B, T, ng, hs).transpose(0, 2, 1, 3)
+
+    n_elem = cfg.rope_n_elem
+    if n_elem > 0:
+        q_r = _rope(q[..., :n_elem], cos_t, sin_t)
+        k_r = _rope(k[..., :n_elem], cos_t, sin_t)
+        q = jnp.concatenate([q_r, q[..., n_elem:]], axis=-1) if n_elem < hs else q_r
+        k = jnp.concatenate([k_r, k[..., n_elem:]], axis=-1) if n_elem < hs else k_r
+
+    ck = jax.lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), pos, axis=2)
+    cv = jax.lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), pos, axis=2)
+
+    kk, vv = ck, cv
+    if ng != nh:
+        rep = nh // ng
+        T_max = kk.shape[2]
+        kk = jnp.broadcast_to(kk[:, :, None], (B, ng, rep, T_max, hs)).reshape(B, nh, T_max, hs)
+        vv = jnp.broadcast_to(vv[:, :, None], (B, ng, rep, T_max, hs)).reshape(B, nh, T_max, hs)
+
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, kk.astype(q.dtype), preferred_element_type=jnp.float32
+    ) / math.sqrt(hs)
+    # query at global position pos+t sees cache slots <= pos+t
+    j = jnp.arange(kk.shape[2])
+    qpos = pos + jnp.arange(T)
+    scores = jnp.where(j[None, None, None, :] <= qpos[None, None, :, None], scores, -jnp.inf)
+    w = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    y = jnp.einsum("bhqk,bhkd->bhqd", w, vv.astype(q.dtype))
+    y = y.transpose(0, 2, 1, 3).reshape(B, T, nh * hs)
+    return lin(y, ap["wo"]), ck, cv
+
+
+def forward_with_cache(params, idx, pos, cache, cos_all, sin_all, cfg: Config, *, quantized=False):
+    """Forward of new tokens ``idx`` (B, T) at global positions [pos, pos+T)
+    against/into ``cache``.  Returns (logits (B, T, V), updated cache)."""
+    B, T = idx.shape
+    x = params["wte"][idx]
+    cos_t = jax.lax.dynamic_slice_in_dim(cos_all, pos, T, axis=0)
+    sin_t = jax.lax.dynamic_slice_in_dim(sin_all, pos, T, axis=0)
+
+    new_k, new_v = [], []
+    for l, bp in enumerate(params["blocks"]):
+        n1 = _norm(x, bp["norm_1"], cfg)
+        h, ck, cv = _attn_with_cache(
+            bp["attn"], n1, cos_t, sin_t, cache["k"][l], cache["v"][l], pos, cfg,
+            quantized=quantized,
+        )
+        new_k.append(ck)
+        new_v.append(cv)
+        if cfg.parallel_residual:
+            n2 = n1 if cfg.shared_attention_norm else _norm(x, bp["norm_2"], cfg)
+            x = x + h + _mlp(bp["mlp"], n2, cfg, quantized=quantized)
+        else:
+            x = x + h
+            x = x + _mlp(bp["mlp"], _norm(x, bp["norm_2"], cfg), cfg, quantized=quantized)
+
+    cache = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+    x = _norm(x, params["ln_f"], cfg)
+    head = params["wte"] if cfg.tie_embeddings else params["lm_head"]
+    logits = (_linear(x, head, quantized=quantized)).astype(jnp.float32)
+    return logits, cache
+
+
+def _sample(logits, temperature, key):
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(key, logits / temperature, axis=-1).astype(jnp.int32)
+
+
+def generate(
+    params,
+    prompt,
+    cfg: Config,
+    max_new_tokens: int,
+    *,
+    T_max: int | None = None,
+    temperature: float = 0.0,
+    key: jax.Array | None = None,
+    quantized: bool = False,
+    cache_dtype=None,
+) -> jax.Array:
+    """Greedy/temperature sampling.  ``prompt``: (B, T_prompt) int tokens.
+    Returns (B, T_prompt + max_new_tokens).  Prefill is one compiled program;
+    the entire decode loop is a second one (lax.scan over the cache)."""
+    prompt = jnp.asarray(prompt)
+    B, T_prompt = prompt.shape
+    if T_max is None:
+        T_max = min(cfg.block_size, T_prompt + max_new_tokens)
+    assert T_prompt + max_new_tokens <= T_max, "T_max too small"
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    dtype = cache_dtype if cache_dtype is not None else params["wte"].dtype
+
+    cos_all, sin_all = build_rope_cache(cfg, T_max)
+    cache = init_cache(cfg, B, T_max, dtype=dtype)
+
+    @jax.jit
+    def prefill(params, prompt, cache, key):
+        logits, cache = forward_with_cache(
+            params, prompt, 0, cache, cos_all, sin_all, cfg, quantized=quantized
+        )
+        key, sub = jax.random.split(key)
+        nxt = _sample(logits[:, -1], temperature, sub)
+        return nxt, cache, key
+
+    @partial(jax.jit, donate_argnums=(2,))
+    def decode_all(params, first, cache, key):
+        def step(carry, _):
+            tok, pos, cache, key = carry
+            logits, cache = forward_with_cache(
+                params, tok[:, None], pos, cache, cos_all, sin_all, cfg,
+                quantized=quantized,
+            )
+            key, sub = jax.random.split(key)
+            nxt = _sample(logits[:, -1], temperature, sub)
+            return (nxt, pos + 1, cache, key), nxt
+
+        # N-1 steps: `first` (sampled at prefill) is the first new token
+        _, toks = jax.lax.scan(
+            step, (first, T_prompt, cache, key), None, length=max_new_tokens - 1
+        )
+        return jnp.concatenate([first[:, None], toks.transpose(1, 0)], axis=1)
+
+    first, cache, key = prefill(params, prompt, cache, key)
+    new_toks = decode_all(params, first, cache, key)
+    return jnp.concatenate([prompt, new_toks], axis=1)

@@ -79,6 +79,7 @@ class ThunderTracingMode(torch.overrides.TorchFunctionMode):
         # thunder op surface while a trace is active
         def shim(data, *args, dtype=None, device=None, **kwargs):
             from thunder_tpu.core import dtypes as ttd
+            from thunder_tpu.core.devices import Device as _TDev
             from thunder_tpu.core.trace import get_tracectx
 
             if get_tracectx() is not None and isinstance(data, (int, float, bool)):
@@ -89,6 +90,10 @@ class ThunderTracingMode(torch.overrides.TorchFunctionMode):
                 dtype = ttd.to_torch_dtype(dtype)
             if dtype is not None:
                 kwargs["dtype"] = dtype
+            # forward real torch devices; only thunder Devices (whose raw str
+            # is an xla spec torch can't allocate on) are dropped to CPU
+            if device is not None and not isinstance(device, _TDev):
+                kwargs["device"] = device
             return orig(data, *args, **kwargs)
 
         return shim

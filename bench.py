@@ -307,11 +307,54 @@ def micro_benchmarks(on_tpu: bool):
     return results
 
 
+def decode_benchmark(on_tpu: bool):
+    """KV-cache autoregressive decode throughput (milestone E inference),
+    fp vs int8-quantized weights."""
+    from thunder_tpu.models import generate as gen
+
+    if on_tpu:
+        cfg = llama.Config.from_name(
+            "Llama-2-7b-hf", n_layer=8, n_embd=2048, n_head=16, intermediate_size=5504
+        )
+        B, T_prompt, N = 8, 128, 256
+    else:
+        cfg = llama.Config.from_name("tiny-moe-debug")
+        B, T_prompt, N = 4, 16, 32
+    params = llama.init_params(cfg, jax.random.PRNGKey(0),
+                               dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (B, T_prompt), 0, cfg.vocab_size)
+
+    results = {}
+    for name, q in (("fp", False), ("int8", True)):
+        t0 = time.perf_counter()
+        out = gen.generate(params, prompt, cfg, N, quantized=q)
+        jax.block_until_ready(out)
+        compile_and_first = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        out = gen.generate(params, prompt, cfg, N, quantized=q)
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        tps = B * N / dt
+        results[name] = tps
+        log(f"decode[{name}] B={B} N={N}: {tps:,.0f} tokens/s "
+            f"({dt/N*1e3:.2f} ms/token-batch; first call {compile_and_first:.1f}s)")
+    return results
+
+
 def main():
     on_tpu = _resolve_backend() == "tpu"
     if len(sys.argv) > 1 and sys.argv[1] == "micro":
         micro_benchmarks(on_tpu)
         print(json.dumps({"metric": "micro", "value": 1.0, "unit": "ok", "vs_baseline": 1.0}))
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "decode":
+        r = decode_benchmark(on_tpu)
+        print(json.dumps({
+            "metric": "kvcache_decode_tokens_per_sec" if on_tpu else "kvcache_decode_cpu_smoke",
+            "value": round(r["fp"], 1),
+            "unit": "tokens/s",
+            "vs_baseline": round(r["int8"] / r["fp"], 3),
+        }))
         return
     if on_tpu:
         # Llama-2 architecture, ~540M params: training state fits one v5e chip

@@ -91,3 +91,39 @@ def test_autocast_sdpa_block():
     np.testing.assert_allclose(
         np.asarray(out, np.float32), np.asarray(ref), rtol=5e-2, atol=5e-2
     )
+
+
+def test_autocast_kwarg_sugar():
+    """jit(fn, autocast="bf16") == transforms=[autocast(bf16)]."""
+    import thunder_tpu.torch as ltorch
+
+    def fn(a, w):
+        return ltorch.matmul(a, w)
+
+    a = np.random.RandomState(3).randn(8, 8).astype(np.float32)
+    jfn = ttpu.jit(fn, autocast="bf16")
+    out = np.asarray(jfn(a, a))
+    src = ttpu.last_traces(jfn)[-1].python()
+    assert "bfloat16" in src, src
+    np.testing.assert_allclose(out, a @ a, rtol=2e-2, atol=2e-2)
+
+
+def test_autocast_kwarg_through_thunder_module():
+    torch = pytest.importorskip("torch")
+
+    class M(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc = torch.nn.Linear(16, 16, bias=False)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    torch.manual_seed(0)
+    m = M()
+    x = torch.randn(4, 16)
+    ref = m(x)
+    tm = ttpu.jit(m, autocast="bf16")
+    out = tm(x)
+    d = float((out - ref).abs().max())
+    assert 1e-7 < d < 0.5, d  # bf16 rounding visible but bounded

@@ -301,3 +301,37 @@ def test_grad_accumulation_equals_big_batch():
 
     np.testing.assert_allclose(float(acc_loss), float(big_loss), rtol=1e-6)
     _assert_tree_close(acc_params, big_params, atol=1e-6)
+
+
+def test_hybrid_mesh_fallback_and_train():
+    """hybrid_mesh without slice topology (virtual CPU devices) lays out a
+    plain mesh with DCN axes leading; a train step runs on it."""
+    import optax
+
+    from thunder_tpu.distributed import hybrid_mesh
+    from thunder_tpu.models import llama
+
+    mesh = hybrid_mesh({"fsdp": 4}, {"dp": 2})
+    assert mesh.axis_names == ("dp", "fsdp")
+    assert dict(mesh.shape) == {"dp": 2, "fsdp": 4}
+
+    cfg = llama.Config.from_name("tiny-llama-debug")
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    p = dist.fsdp(params, mesh, min_size=0)
+    step = dist.make_train_step(
+        lambda pp, i, t, c, s: llama.gpt_loss(pp, i, t, c, s, cfg),
+        optax.sgd(1e-2), mesh,
+    )
+    idx = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, cfg.vocab_size)
+    tgt = jax.random.randint(jax.random.PRNGKey(2), (8, 16), 0, cfg.vocab_size)
+    cos, sin = llama.build_rope_cache(cfg, 16)
+    o = step.init_optimizer_state(p)
+    _, _, loss = step(p, o, idx, tgt, cos, sin)
+    assert np.isfinite(float(loss))
+
+
+def test_initialize_multihost_single_process_noop():
+    from thunder_tpu.distributed import initialize_multihost
+
+    initialize_multihost(num_processes=1)  # must not raise on one process
+    assert jax.process_count() == 1

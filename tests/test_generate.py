@@ -73,3 +73,20 @@ def test_generate_quantized_int8_runs_close():
     assert out_q.shape == out_fp.shape
     agree = (np.asarray(out_q) == np.asarray(out_fp)).mean()
     assert agree >= 0.5, f"int8 generation diverged too much (agreement {agree:.2f})"
+
+
+def test_generate_zero_tokens_and_compile_cache():
+    from thunder_tpu.models.generate import _generate_cache
+
+    cfg = llama.Config.from_name("tiny-llama-debug")
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 3), 0, cfg.vocab_size)
+
+    out0 = gen.generate(params, prompt, cfg, 0)
+    np.testing.assert_array_equal(np.asarray(out0), np.asarray(prompt))
+
+    n_before = len(_generate_cache)
+    gen.generate(params, prompt, cfg, 3, cache_dtype=jnp.float32)
+    n_mid = len(_generate_cache)
+    gen.generate(params, prompt, cfg, 3, cache_dtype=jnp.float32)
+    assert len(_generate_cache) == n_mid > n_before  # second call reuses

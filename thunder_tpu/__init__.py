@@ -80,6 +80,18 @@ def jit(
     forward runs as a compiled program bridged into torch autograd
     (reference thunder.jit on modules, __init__.py:181).
     """
+    # sugar: jit(fn, autocast="bf16"|"fp16") appends the autocast transform
+    # (reference thunder.jit handles autocast in the jit entry, __init__.py:552)
+    ac = compile_options.pop("autocast", None)
+    if ac is not None:
+        from thunder_tpu.core import dtypes as _dt
+
+        _ac_map = {"bf16": _dt.bfloat16, "bfloat16": _dt.bfloat16,
+                   "fp16": _dt.float16, "float16": _dt.float16}
+        dtype = _ac_map.get(ac) if isinstance(ac, str) else ac
+        check(dtype is not None, lambda: f"unknown autocast target {ac!r}")
+        transforms = list(transforms or []) + [autocast(dtype)]
+
     try:
         import torch as _torch
     except ImportError:  # pragma: no cover - torch is an optional interop dep

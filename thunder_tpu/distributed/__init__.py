@@ -17,6 +17,7 @@ from thunder_tpu.distributed.checkpoint import (
     save_checkpoint,
 )
 from thunder_tpu.distributed.moe import ep_moe_mlp, expert_capacity
+from thunder_tpu.distributed.multihost import hybrid_mesh, initialize as initialize_multihost
 from thunder_tpu.distributed.pipeline import (
     gpipe,
     place_pipeline_params,
@@ -60,6 +61,8 @@ __all__ = [
     "ep_moe_mlp",
     "expert_capacity",
     "gpipe",
+    "hybrid_mesh",
+    "initialize_multihost",
     "stack_blocks",
     "place_pipeline_params",
     "pp_gpt_loss",

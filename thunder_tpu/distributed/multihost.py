@@ -1,0 +1,99 @@
+"""Multi-host scale-out: process init + ICI×DCN hybrid meshes.
+
+The reference's transport is NCCL via ``torch.distributed`` process groups
+(SURVEY §2.6); the TPU-native equivalent is jax's multi-controller runtime:
+every host runs the same program, ``jax.distributed.initialize`` wires the
+coordinator, and ONE global mesh spans all slices — XLA emits ICI
+collectives inside a slice and DCN collectives across slices.  The design
+rule (scaling playbook): put model-parallel axes (tp/fsdp within reach)
+on ICI, data-parallel on DCN — DCN bandwidth is ~an order of magnitude
+lower, and gradient all-reduce is the only traffic that tolerates it.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = ["initialize", "hybrid_mesh"]
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    **kwargs,
+) -> None:
+    """Starts the multi-controller runtime (idempotent).  On Cloud TPU all
+    arguments auto-detect from the metadata server; set them explicitly for
+    other fabrics (reference analog: ``torch.distributed.init_process_group``,
+    ``thunder/distributed/__init__.py:366``)."""
+    import os
+
+    if jax.process_count() > 1:
+        return  # already initialized
+    if num_processes == 1:
+        return  # explicitly single-process: no coordinator to reach
+    auto = coordinator_address is None and num_processes is None
+    cluster_hints = (
+        "JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+        "MEGASCALE_COORDINATOR_ADDRESS", "TPU_WORKER_HOSTNAMES",
+        "SLURM_JOB_ID", "OMPI_COMM_WORLD_SIZE",
+    )
+    if auto and not any(h in os.environ for h in cluster_hints):
+        return  # single host, nothing to auto-detect
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            **kwargs,
+        )
+    except RuntimeError as e:
+        if "already initialized" in str(e).lower():
+            return
+        # genuine bring-up failures must surface here, not as a far-away
+        # single-process mesh-size assertion
+        raise
+
+
+def hybrid_mesh(
+    ici_axes: dict[str, int],
+    dcn_axes: dict[str, int] | None = None,
+    *,
+    devices=None,
+) -> Mesh:
+    """A mesh whose ``dcn_axes`` cross slice boundaries (data parallel over
+    the data-center network) while ``ici_axes`` stay inside a slice (model
+    parallel over ICI).
+
+    ``hybrid_mesh({"fsdp": 4, "tp": 2}, {"dp": 2})`` on 2 slices of 8 chips →
+    a ("dp", "fsdp", "tp") mesh where each dp group is one slice.  Falls back
+    to a plain :func:`~thunder_tpu.distributed.make_mesh` layout when the
+    devices expose no slice topology (CPU, single slice).
+    """
+    from thunder_tpu.distributed.sharding import make_mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    dcn_axes = dict(dcn_axes or {})
+    names = tuple(dcn_axes.keys()) + tuple(ici_axes.keys())
+    sizes = tuple(dcn_axes.values()) + tuple(ici_axes.values())
+    assert math.prod(sizes) == len(devices), f"mesh {dict(zip(names, sizes))} != {len(devices)} devices"
+
+    slice_ids = {getattr(d, "slice_index", 0) for d in devices}
+    if len(slice_ids) > 1 and dcn_axes:
+        from jax.experimental import mesh_utils
+
+        # both shapes have one entry per mesh dim: the per-slice (ICI) extent
+        # and the across-slice (DCN) multiplier — 1 where the dim doesn't
+        # span that network
+        mesh_shape = (1,) * len(dcn_axes) + tuple(ici_axes.values())
+        dcn_mesh_shape = tuple(dcn_axes.values()) + (1,) * len(ici_axes)
+        arr = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape, dcn_mesh_shape, devices=devices
+        )
+        return Mesh(arr, names)
+    # no slice topology: plain reshape layout
+    return make_mesh(dict(zip(names, sizes)), devices=np.asarray(devices))

@@ -185,6 +185,9 @@ def generate(
     the entire decode loop is a second one (lax.scan over the cache)."""
     prompt = jnp.asarray(prompt)
     B, T_prompt = prompt.shape
+    assert max_new_tokens >= 0, max_new_tokens
+    if max_new_tokens == 0:
+        return prompt
     if T_max is None:
         T_max = min(cfg.block_size, T_prompt + max_new_tokens)
     assert T_prompt + max_new_tokens <= T_max, "T_max too small"
@@ -192,8 +195,33 @@ def generate(
         key = jax.random.PRNGKey(0)
     dtype = cache_dtype if cache_dtype is not None else params["wte"].dtype
 
-    cos_all, sin_all = build_rope_cache(cfg, T_max)
+    prefill, decode_all = _compiled_generate(
+        cfg, B, T_prompt, max_new_tokens, T_max, float(temperature), quantized, str(dtype)
+    )
     cache = init_cache(cfg, B, T_max, dtype=dtype)
+    first, cache, key = prefill(params, prompt, cache, key)
+    new_toks = decode_all(params, first, cache, key)
+    return jnp.concatenate([prompt, new_toks], axis=1)
+
+
+_generate_cache: dict = {}
+
+
+def _compiled_generate(cfg, B, T_prompt, max_new_tokens, T_max, temperature, quantized, dtype_str):
+    """Jitted prefill/decode pair, cached per static configuration so
+    repeated generate() calls (and benchmarks) hit steady-state compiled
+    programs instead of re-tracing."""
+    import dataclasses
+
+    key = (
+        tuple(sorted(dataclasses.asdict(cfg).items())),
+        B, T_prompt, max_new_tokens, T_max, temperature, quantized, dtype_str,
+    )
+    cached = _generate_cache.get(key)
+    if cached is not None:
+        return cached
+
+    cos_all, sin_all = build_rope_cache(cfg, T_max)
 
     @jax.jit
     def prefill(params, prompt, cache, key):
@@ -204,7 +232,7 @@ def generate(
         nxt = _sample(logits[:, -1], temperature, sub)
         return nxt, cache, key
 
-    @partial(jax.jit, donate_argnums=(2,))
+    @jax.jit
     def decode_all(params, first, cache, key):
         def step(carry, _):
             tok, pos, cache, key = carry
@@ -222,6 +250,5 @@ def generate(
         )
         return jnp.concatenate([first[:, None], toks.transpose(1, 0)], axis=1)
 
-    first, cache, key = prefill(params, prompt, cache, key)
-    new_toks = decode_all(params, first, cache, key)
-    return jnp.concatenate([prompt, new_toks], axis=1)
+    _generate_cache[key] = (prefill, decode_all)
+    return prefill, decode_all

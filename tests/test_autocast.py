@@ -127,3 +127,12 @@ def test_autocast_kwarg_through_thunder_module():
     out = tm(x)
     d = float((out - ref).abs().max())
     assert 1e-7 < d < 0.5, d  # bf16 rounding visible but bounded
+
+
+def test_autocast_kwarg_rejects_non_dtype():
+    import thunder_tpu.torch as ltorch
+
+    with pytest.raises(Exception, match="autocast target"):
+        ttpu.jit(lambda a, w: ltorch.matmul(a, w), autocast=True)
+    with pytest.raises(Exception, match="autocast target"):
+        ttpu.jit(lambda a, w: ltorch.matmul(a, w), autocast="int8")

@@ -90,3 +90,20 @@ def test_generate_zero_tokens_and_compile_cache():
     n_mid = len(_generate_cache)
     gen.generate(params, prompt, cfg, 3, cache_dtype=jnp.float32)
     assert len(_generate_cache) == n_mid > n_before  # second call reuses
+
+
+def test_tp_sharded_decode_matches_single_device():
+    """Tensor-parallel serving: params TP-placed, cache KV-group-sharded;
+    decoded tokens must equal the unsharded run."""
+    from thunder_tpu import distributed as dist
+
+    cfg = llama.Config.from_name("tiny-llama-debug")
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 0, cfg.vocab_size)
+
+    ref = gen.generate(params, prompt, cfg, 6, cache_dtype=jnp.float32)
+
+    mesh = dist.make_mesh({"tp": 2}, devices=jax.devices()[:2])
+    p_tp = dist.tp_fsdp(params, mesh)
+    out = gen.generate(p_tp, prompt, cfg, 6, cache_dtype=jnp.float32, mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))

@@ -311,3 +311,29 @@ def test_execution_callback_file_per_program(tmp_path):
         np.testing.assert_allclose(out_y, 2.0 * y)
     finally:
         tt.set_execution_callback_file(None)
+
+
+def test_optimization_fuel_limits_fusions():
+    """Fuel = 0 on the fusion executor: no XLA fusion regions are created
+    (miscompile-bisection lever, reference extend/__init__.py:136)."""
+    from thunder_tpu.examine import get_fusions
+    from thunder_tpu.extend import get_default_executors
+
+    def f(a):
+        return ltorch.sin(a) * ltorch.cos(a) + 1.0
+
+    a = rng.standard_normal((8, 8)).astype(np.float32)
+
+    xla = next(e for e in get_default_executors() if hasattr(e, "set_fuel"))
+    try:
+        xla.set_fuel(0)
+        jfn = tt.jit(f)
+        out = np.asarray(jfn(a))
+        np.testing.assert_allclose(out, np.sin(a) * np.cos(a) + 1.0, rtol=1e-6)
+        assert get_fusions(tt.last_traces(jfn)[-1]) == []
+    finally:
+        xla.set_fuel(None)
+
+    jfn2 = tt.jit(f)
+    jfn2(a)
+    assert len(get_fusions(tt.last_traces(jfn2)[-1])) == 1  # fuel restored

@@ -88,8 +88,13 @@ def jit(
 
         _ac_map = {"bf16": _dt.bfloat16, "bfloat16": _dt.bfloat16,
                    "fp16": _dt.float16, "float16": _dt.float16}
-        dtype = _ac_map.get(ac) if isinstance(ac, str) else ac
-        check(dtype is not None, lambda: f"unknown autocast target {ac!r}")
+        if isinstance(ac, str):
+            dtype = _ac_map.get(ac)
+        elif isinstance(ac, _dt.dtype) and _dt.is_float_dtype(ac):
+            dtype = ac
+        else:  # autocast=True / ints / bool dtypes: reject loudly
+            dtype = None
+        check(dtype is not None, lambda: f"unknown autocast target {ac!r} (use 'bf16'/'fp16' or a float dtype)")
         transforms = list(transforms or []) + [autocast(dtype)]
 
     try:

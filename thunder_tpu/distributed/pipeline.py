@@ -149,6 +149,8 @@ def _compiled_block_fn(config, mb_shape, cos, sin, dtype):
     cached = _block_fn_cache.get(key)
     if cached is not None:
         return cached
+    if len(_block_fn_cache) >= 16:  # bound for long-lived processes
+        _block_fn_cache.pop(next(iter(_block_fn_cache)))
     from thunder_tpu.distributed.api import _trace_to_jax_fn
     from thunder_tpu.executors.passes import transform_for_execution
     from thunder_tpu.extend import get_default_executors

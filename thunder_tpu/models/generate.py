@@ -83,10 +83,27 @@ def _mlp(mp, x, cfg: Config, *, quantized=False):
     return lin(jax.nn.gelu(lin(x, mp["fc"]), approximate=False), mp["proj"])
 
 
-def init_cache(cfg: Config, B: int, T_max: int, dtype=jnp.bfloat16) -> dict:
-    """Preallocated KV cache: ``{"k"/"v": (L, B, n_query_groups, T_max, hs)}``."""
+def init_cache(cfg: Config, B: int, T_max: int, dtype=jnp.bfloat16, *, mesh=None, axis="tp") -> dict:
+    """Preallocated KV cache: ``{"k"/"v": (L, B, n_query_groups, T_max, hs)}``.
+
+    With ``mesh``, the KV-group dim shards over ``axis`` (tensor-parallel
+    serving: each device holds its heads' cache; attention stays device-local
+    and only the output projection reduces)."""
     shape = (cfg.n_layer, B, cfg.n_query_groups, T_max, cfg.head_size)
-    return {"k": jnp.zeros(shape, dtype=dtype), "v": jnp.zeros(shape, dtype=dtype)}
+    sh = None
+    if mesh is not None and axis in mesh.axis_names and mesh.shape[axis] > 1:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        assert cfg.n_query_groups % mesh.shape[axis] == 0, (
+            f"n_query_groups {cfg.n_query_groups} must divide {axis}={mesh.shape[axis]}"
+        )
+        sh = NamedSharding(mesh, P(None, None, axis, None, None))
+
+    def zeros():  # two independent buffers, no copy traffic
+        z = jnp.zeros(shape, dtype=dtype)
+        return jax.device_put(z, sh) if sh is not None else z
+
+    return {"k": zeros(), "v": zeros()}
 
 
 def _attn_with_cache(ap, x, cos_t, sin_t, ck, cv, pos, cfg: Config, *, quantized=False):
@@ -179,10 +196,17 @@ def generate(
     key: jax.Array | None = None,
     quantized: bool = False,
     cache_dtype=None,
+    mesh=None,
 ) -> jax.Array:
     """Greedy/temperature sampling.  ``prompt``: (B, T_prompt) int tokens.
     Returns (B, T_prompt + max_new_tokens).  Prefill is one compiled program;
-    the entire decode loop is a second one (lax.scan over the cache)."""
+    the entire decode loop is a second one (lax.scan over the cache).
+
+    Tensor-parallel serving: pass ``mesh`` (with a ``tp`` axis) and params
+    already placed with TP shardings (``distributed.tp_fsdp``) — the cache
+    shards its KV-group dim, and XLA partitions the decode program from the
+    input placements (per-head attention local, one reduce at the output
+    projection)."""
     prompt = jnp.asarray(prompt)
     B, T_prompt = prompt.shape
     assert max_new_tokens >= 0, max_new_tokens
@@ -198,9 +222,16 @@ def generate(
     prefill, decode_all = _compiled_generate(
         cfg, B, T_prompt, max_new_tokens, T_max, float(temperature), quantized, str(dtype)
     )
-    cache = init_cache(cfg, B, T_max, dtype=dtype)
+    cache = init_cache(cfg, B, T_max, dtype=dtype, mesh=mesh)
     first, cache, key = prefill(params, prompt, cache, key)
-    new_toks = decode_all(params, first, cache, key)
+    import warnings
+
+    with warnings.catch_warnings():
+        # decode returns only tokens, so the donated cache can't alias an
+        # output; the donation still frees it for scratch — silence jax's
+        # "donated buffers were not usable" note
+        warnings.filterwarnings("ignore", message="Some donated buffers were not usable")
+        new_toks = decode_all(params, first, cache, key)
     return jnp.concatenate([prompt, new_toks], axis=1)
 
 
@@ -213,6 +244,8 @@ def _compiled_generate(cfg, B, T_prompt, max_new_tokens, T_max, temperature, qua
     programs instead of re-tracing."""
     import dataclasses
 
+    # mesh deliberately absent from the key: jax.jit re-specializes on input
+    # shardings, so one cached pair serves every placement
     key = (
         tuple(sorted(dataclasses.asdict(cfg).items())),
         B, T_prompt, max_new_tokens, T_max, temperature, quantized, dtype_str,
@@ -220,10 +253,12 @@ def _compiled_generate(cfg, B, T_prompt, max_new_tokens, T_max, temperature, qua
     cached = _generate_cache.get(key)
     if cached is not None:
         return cached
+    if len(_generate_cache) >= 16:  # LRU-ish bound for long-lived serving loops
+        _generate_cache.pop(next(iter(_generate_cache)))
 
     cos_all, sin_all = build_rope_cache(cfg, T_max)
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=(2,))
     def prefill(params, prompt, cache, key):
         logits, cache = forward_with_cache(
             params, prompt, 0, cache, cos_all, sin_all, cfg, quantized=quantized
@@ -232,7 +267,7 @@ def _compiled_generate(cfg, B, T_prompt, max_new_tokens, T_max, temperature, qua
         nxt = _sample(logits[:, -1], temperature, sub)
         return nxt, cache, key
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=(2,))
     def decode_all(params, first, cache, key):
         def step(carry, _):
             tok, pos, cache, key = carry

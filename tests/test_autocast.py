@@ -136,3 +136,17 @@ def test_autocast_kwarg_rejects_non_dtype():
         ttpu.jit(lambda a, w: ltorch.matmul(a, w), autocast=True)
     with pytest.raises(Exception, match="autocast target"):
         ttpu.jit(lambda a, w: ltorch.matmul(a, w), autocast="int8")
+
+
+def test_autocast_kwarg_accepts_torch_and_jax_dtypes():
+    import jax.numpy as jnp
+    import torch
+
+    import thunder_tpu.torch as ltorch
+
+    a = np.random.RandomState(5).randn(8, 8).astype(np.float32)
+    for target in (torch.bfloat16, jnp.bfloat16):
+        jfn = ttpu.jit(lambda x, w: ltorch.matmul(x, w), autocast=target)
+        out = np.asarray(jfn(a, a))
+        assert "bfloat16" in ttpu.last_traces(jfn)[-1].python()
+        np.testing.assert_allclose(out, a @ a, rtol=2e-2, atol=2e-2)

@@ -116,3 +116,40 @@ def test_bert_forward_matches_eager():
     np.testing.assert_allclose(
         out.last_hidden_state.detach().numpy(), ref.numpy(), rtol=1e-4, atol=1e-5
     )
+
+
+def test_llama_backward_matches_eager():
+    cfg = transformers.LlamaConfig(
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        hidden_size=64,
+        intermediate_size=128,
+        vocab_size=128,
+        max_position_embeddings=64,
+        attn_implementation="eager",
+    )
+    ids = torch.randint(0, 128, (2, 16), generator=torch.Generator().manual_seed(5))
+
+    torch.manual_seed(1)
+    ref_model = transformers.LlamaForCausalLM(cfg)
+    ref_loss = ref_model(ids, labels=ids, use_cache=False).loss
+    ref_loss.backward()
+    ref_grads = {n: p.grad.clone() for n, p in ref_model.named_parameters() if p.grad is not None}
+
+    torch.manual_seed(1)
+    model = transformers.LlamaForCausalLM(cfg)
+    tm = ttpu.jit(model)
+    loss = tm(input_ids=ids, labels=ids, use_cache=False).loss
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5, atol=1e-6)
+    loss.backward()
+
+    checked = 0
+    for n, p in model.named_parameters():
+        if p.grad is None:
+            continue
+        np.testing.assert_allclose(
+            p.grad.numpy(), ref_grads[n].numpy(), rtol=2e-3, atol=1e-5, err_msg=n
+        )
+        checked += 1
+    assert checked >= 10, f"only {checked} param grads flowed"

@@ -90,10 +90,15 @@ def jit(
                    "fp16": _dt.float16, "float16": _dt.float16}
         if isinstance(ac, str):
             dtype = _ac_map.get(ac)
-        elif isinstance(ac, _dt.dtype) and _dt.is_float_dtype(ac):
-            dtype = ac
-        else:  # autocast=True / ints / bool dtypes: reject loudly
+        elif isinstance(ac, bool):  # autocast=True is an error, not bool-cast
             dtype = None
+        else:  # torch/jax/numpy/thunder dtype objects all convert
+            try:
+                dtype = _dt.to_dtype(ac)
+            except Exception:
+                dtype = None
+            if dtype is not None and not _dt.is_float_dtype(dtype):
+                dtype = None
         check(dtype is not None, lambda: f"unknown autocast target {ac!r} (use 'bf16'/'fp16' or a float dtype)")
         transforms = list(transforms or []) + [autocast(dtype)]
 

@@ -25,7 +25,8 @@ from thunder_tpu.distributed.pipeline import (
     stack_blocks,
 )
 from thunder_tpu.distributed.prims import DistributedReduceOps
-from thunder_tpu.distributed.ring_attention import ring_attention, ring_self_attention
+from thunder_tpu.distributed.ring_attention import ring_attend_shard, ring_attention, ring_self_attention
+from thunder_tpu.distributed.sp import sp_gpt_loss
 from thunder_tpu.distributed.sharding import (
     ShardingRules,
     apply_shardings,
@@ -57,6 +58,8 @@ __all__ = [
     "load_checkpoint",
     "latest_step",
     "ring_attention",
+    "ring_attend_shard",
+    "sp_gpt_loss",
     "ring_self_attention",
     "ep_moe_mlp",
     "expert_capacity",

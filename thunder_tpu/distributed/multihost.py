@@ -35,13 +35,8 @@ def initialize(
     # NB: must not touch jax.process_count()/jax.devices() here — they
     # initialize the XLA backend, after which jax.distributed.initialize
     # refuses to run at all
-    try:
-        from jax._src import distributed as _jd
-
-        if _jd.global_state.client is not None:
-            return  # already initialized
-    except Exception:
-        pass
+    if getattr(jax.distributed, "is_initialized", lambda: False)():
+        return  # already initialized
     if num_processes == 1:
         return  # explicitly single-process: no coordinator to reach
     auto = coordinator_address is None and num_processes is None

@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-__all__ = ["ring_attention", "ring_self_attention"]
+__all__ = ["ring_attention", "ring_self_attention", "ring_attend_shard"]
 
 # exp(_NEG - lse) underflows to exactly 0 without inf-inf NaN hazards
 _NEG = -0.7 * float(jnp.finfo(jnp.float32).max)
@@ -85,42 +85,63 @@ def ring_attention(
     B, H, T, hs = q.shape
     assert T % sp == 0, f"sequence {T} must divide over {axis}={sp}"
     scale = scale if scale is not None else 1.0 / math.sqrt(hs)
-    t_loc = T // sp
 
     def body(qb, kb, vb):
-        # qb/kb/vb: (B, H, t_loc, hs) — this device's blocks
-        idx = jax.lax.axis_index(axis)  # ring position of the resident q block
-        q_pos = idx * t_loc + jnp.arange(t_loc)  # global query positions
-
-        num = jnp.zeros((B, H, t_loc, hs), dtype=jnp.float32)
-        den = jnp.zeros((B, H, t_loc), dtype=jnp.float32)
-        m = jnp.full((B, H, t_loc), _NEG / 2, dtype=jnp.float32)
-        acc = (num, den, m)
-
-        cur_k, cur_v = kb, vb
-        cur_src = idx  # which shard's k/v this device currently holds
-        perm = [(i, (i + 1) % sp) for i in range(sp)]  # pass k/v to the next rank
-
-        for step in range(sp):
-            k_pos = cur_src * t_loc + jnp.arange(t_loc)
-            if causal:
-                mask = k_pos[None, :] <= q_pos[:, None]
-            else:
-                mask = jnp.ones((t_loc, t_loc), dtype=bool)
-            blk = _block_attend(qb, cur_k, cur_v, mask, scale)
-            acc = _merge(acc, blk)
-            if step != sp - 1:
-                cur_k = jax.lax.ppermute(cur_k, axis, perm)
-                cur_v = jax.lax.ppermute(cur_v, axis, perm)
-                cur_src = (cur_src - 1) % sp
-
-        num, den, _ = acc
-        out = num / jnp.maximum(den, 1e-30)[..., None]
-        return out.astype(qb.dtype)
+        return ring_attend_shard(qb, kb, vb, axis=axis, sp=sp, causal=causal, scale=scale)
 
     spec = P(None, None, axis, None)
     fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
     return fn(q, k, v)
+
+
+def ring_attend_shard(qb, kb, vb, *, axis: str, sp: int, causal: bool = True, scale: float | None = None):
+    """The in-shard ring: callable from INSIDE an existing ``shard_map`` over
+    ``axis`` (sequence-parallel training composes this with the rest of the
+    model in one shard_map).  qb: (B, H, T_local, hs); kb/vb: (B, Hk,
+    T_local, hs) with ``H % Hk == 0`` — GQA K/V rotate around the ring at
+    their *grouped* size (``Hk`` heads) and expand per step only for the
+    block matmuls, so ICI traffic and resident K/V stay at the grouped
+    footprint."""
+    B, H, t_loc, hs = qb.shape
+    Hk = kb.shape[1]
+    assert H % Hk == 0, f"query heads {H} must be a multiple of kv heads {Hk}"
+    rep = H // Hk
+    scale = scale if scale is not None else 1.0 / math.sqrt(hs)
+    idx = jax.lax.axis_index(axis)  # ring position of the resident q block
+    q_pos = idx * t_loc + jnp.arange(t_loc)  # global query positions
+
+    def expand(x):  # (B, Hk, T, hs) → (B, H, T, hs), a view-like broadcast
+        if rep == 1:
+            return x
+        return jnp.broadcast_to(x[:, :, None], (B, Hk, rep, x.shape[2], hs)).reshape(
+            B, H, x.shape[2], hs
+        )
+
+    num = jnp.zeros((B, H, t_loc, hs), dtype=jnp.float32)
+    den = jnp.zeros((B, H, t_loc), dtype=jnp.float32)
+    m = jnp.full((B, H, t_loc), _NEG / 2, dtype=jnp.float32)
+    acc = (num, den, m)
+
+    cur_k, cur_v = kb, vb
+    cur_src = idx  # which shard's k/v this device currently holds
+    perm = [(i, (i + 1) % sp) for i in range(sp)]  # pass k/v to the next rank
+
+    for step in range(sp):
+        k_pos = cur_src * t_loc + jnp.arange(t_loc)
+        if causal:
+            mask = k_pos[None, :] <= q_pos[:, None]
+        else:
+            mask = jnp.ones((t_loc, t_loc), dtype=bool)
+        blk = _block_attend(qb, expand(cur_k), expand(cur_v), mask, scale)
+        acc = _merge(acc, blk)
+        if step != sp - 1:
+            cur_k = jax.lax.ppermute(cur_k, axis, perm)
+            cur_v = jax.lax.ppermute(cur_v, axis, perm)
+            cur_src = (cur_src - 1) % sp
+
+    num, den, _ = acc
+    out = num / jnp.maximum(den, 1e-30)[..., None]
+    return out.astype(qb.dtype)
 
 
 def ring_self_attention(x, wq, wk, wv, wo, *, mesh: Mesh, n_head: int, axis: str = "sp", causal: bool = True):

@@ -1,0 +1,95 @@
+"""Sequence/context-parallel TRAINING: the full llama loss under one
+``shard_map`` over an ``sp`` axis.
+
+Long-context training is activation-bound: at T=128k a single (B, H, T, hs)
+activation set no longer fits one chip.  Sharding the *sequence* dimension
+makes every elementwise/matmul op local; only attention couples positions,
+and it runs as the ring (``ring_attention.ring_attend_shard``): K/V blocks
+rotate over ICI while each device keeps its queries resident.  Per-device
+memory is O(T/sp), so context length scales linearly with the ring size —
+the capability the reference lacks entirely (SURVEY §2.6: "no sequence
+parallelism anywhere").
+
+Params are replicated in-shard (compose with FSDP outside if needed);
+``jax.grad`` differentiates through the whole shard_map — the transpose of
+the replicated-param broadcast is the gradient psum, so data-parallel-style
+grad sync over ``sp`` comes out of autodiff.
+
+Math mirrors ``models/llama`` (same pytree/configs); plain jnp because the
+body executes inside shard_map (the helpers are shared with
+``models/generate``).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from thunder_tpu.distributed.ring_attention import ring_attend_shard
+from thunder_tpu.models.generate import _mlp, _norm, _rope
+
+__all__ = ["sp_gpt_loss"]
+
+
+def _sp_attention(ap, x, cos_b, sin_b, cfg, *, axis: str, sp: int):
+    """Attention over a sequence shard: projections/rope local (cos_b/sin_b
+    are this shard's global-position slices); the ring couples positions."""
+    B, T_loc, C = x.shape
+    hs, nh, ng = cfg.head_size, cfg.n_head, cfg.n_query_groups
+
+    q = (x @ ap["wq"].T).reshape(B, T_loc, nh, hs).transpose(0, 2, 1, 3)
+    k = (x @ ap["wk"].T).reshape(B, T_loc, ng, hs).transpose(0, 2, 1, 3)
+    v = (x @ ap["wv"].T).reshape(B, T_loc, ng, hs).transpose(0, 2, 1, 3)
+
+    n_elem = cfg.rope_n_elem
+    if n_elem > 0:
+        q_r = _rope(q[..., :n_elem], cos_b, sin_b)
+        k_r = _rope(k[..., :n_elem], cos_b, sin_b)
+        q = jnp.concatenate([q_r, q[..., n_elem:]], axis=-1) if n_elem < hs else q_r
+        k = jnp.concatenate([k_r, k[..., n_elem:]], axis=-1) if n_elem < hs else k_r
+
+    # GQA K/V stay at their grouped head count: the ring rotates the small
+    # buffers and expands per block-attend step (ring_attend_shard)
+    y = ring_attend_shard(q, k, v, axis=axis, sp=sp, causal=True)
+    y = y.transpose(0, 2, 1, 3).reshape(B, T_loc, nh * hs)
+    return y @ ap["wo"].T
+
+
+def sp_gpt_loss(params, idx, targets, cos, sin, cfg, *, mesh: Mesh, axis: str = "sp"):
+    """Next-token loss with the sequence dim sharded over ``mesh[axis]``.
+
+    ``idx``/``targets``: (B, T) with ``T % sp == 0``; ``cos``/``sin``: the
+    full (T, rope_n_elem) caches (sharded into position slices per device).
+    Matches ``models.llama.gpt_loss`` numerics.
+    """
+    sp = mesh.shape[axis]
+    B, T = idx.shape
+    assert T % sp == 0, f"sequence {T} must divide over {axis}={sp}"
+
+    def body(params, idx_b, tgt_b, cos_b, sin_b):
+        x = params["wte"][idx_b]  # (B, T_loc, C) — embedding lookup is local
+        for bp in params["blocks"]:
+            n1 = _norm(x, bp["norm_1"], cfg)
+            h = _sp_attention(bp["attn"], n1, cos_b, sin_b, cfg, axis=axis, sp=sp)
+            if cfg.parallel_residual:
+                n2 = n1 if cfg.shared_attention_norm else _norm(x, bp["norm_2"], cfg)
+                x = x + h + _mlp(bp["mlp"], n2, cfg)
+            else:
+                x = x + h
+                x = x + _mlp(bp["mlp"], _norm(x, bp["norm_2"], cfg), cfg)
+        x = _norm(x, params["ln_f"], cfg)
+        head = params["wte"] if cfg.tie_embeddings else params["lm_head"]
+        logits = (x @ head.T).astype(jnp.float32)
+        V = logits.shape[-1]
+        logp = jax.nn.log_softmax(logits.reshape(-1, V), axis=-1)
+        local = -jnp.take_along_axis(logp, tgt_b.reshape(-1, 1), axis=1).sum()
+        return jax.lax.psum(local, axis) / (B * T)
+
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(), P(None, axis), P(None, axis), P(axis), P(axis)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return fn(params, idx, targets, cos, sin)

@@ -95,7 +95,7 @@ def init_cache(cfg: Config, B: int, T_max: int, dtype=jnp.bfloat16, *, mesh=None
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         assert cfg.n_query_groups % mesh.shape[axis] == 0, (
-            f"n_query_groups {cfg.n_query_groups} must divide {axis}={mesh.shape[axis]}"
+            f"{axis}={mesh.shape[axis]} must divide n_query_groups {cfg.n_query_groups}"
         )
         sh = NamedSharding(mesh, P(None, None, axis, None, None))
 

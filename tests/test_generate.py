@@ -109,5 +109,7 @@ def test_tp_sharded_decode_matches_single_device():
     # sharded matmuls reduce in a different order; an ulp-level logit
     # perturbation may flip a near-tied argmax, so require near-total
     # agreement rather than bitwise-equal tokens
-    agree = (np.asarray(out) == np.asarray(ref)).mean()
+    # compare only the GENERATED tokens (the echoed prompt is equal by
+    # construction and would inflate agreement)
+    agree = (np.asarray(out)[:, 5:] == np.asarray(ref)[:, 5:]).mean()
     assert agree >= 0.9, f"tp decode agreement {agree:.2f}"

@@ -1,0 +1,20 @@
+"""End-to-end pretraining CLI (reference benchmark_litgpt.py analog)."""
+import json
+import subprocess
+import sys
+
+import pytest
+
+
+@pytest.mark.parametrize("mode", ["ddp", "fsdp"])
+def test_cli_runs_and_reports(mode, tmp_path):
+    out = subprocess.run(
+        [sys.executable, "train_cli.py", "--mode", mode, "--devices", "4",
+         "--virtual-cpu", "--steps", "2", "--batch", "4", "--seq", "32"],
+        capture_output=True, text=True, timeout=420, cwd="/root/repo",
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    report = json.loads(out.stdout.strip().splitlines()[-1])
+    assert report["mode"] == mode
+    assert report["tokens_per_sec"] > 0
+    assert report["final_loss"] < 6.0

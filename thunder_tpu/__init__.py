@@ -90,7 +90,8 @@ def jit(
                    "fp16": _dt.float16, "float16": _dt.float16}
         if isinstance(ac, str):
             dtype = _ac_map.get(ac)
-        elif isinstance(ac, bool):  # autocast=True is an error, not bool-cast
+        elif isinstance(ac, (bool, int, float, complex)) or hasattr(ac, "shape"):
+            # numbers and arrays are typos, not dtype requests: fail fast
             dtype = None
         else:  # torch/jax/numpy/thunder dtype objects all convert
             try:

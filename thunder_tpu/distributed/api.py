@@ -284,8 +284,13 @@ class TrainStep:
             # gradient-accumulation pieces (reference no_sync/_sync_grads,
             # distributed/__init__.py:28-95): a micro step that only
             # computes (loss, grads), and an apply that runs the optimizer
+            # grads leave with the params' exact placements so eagerly
+            # accumulated grads feed straight back into "apply" (whose
+            # in_shardings expect param_sh)
             "grads": jax.jit(
-                value_and_grad_fn, in_shardings=(param_sh,) + batch_sh
+                value_and_grad_fn,
+                in_shardings=(param_sh,) + batch_sh,
+                out_shardings=(None, param_sh),
             ),
             "apply": jax.jit(
                 apply_gradients,

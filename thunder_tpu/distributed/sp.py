@@ -26,7 +26,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from thunder_tpu.distributed.ring_attention import ring_attend_shard
-from thunder_tpu.models.generate import _mlp, _norm, _rope
+from thunder_tpu.models.generate import _mlp, _norm, _project_qkv
 
 __all__ = ["sp_gpt_loss"]
 
@@ -35,23 +35,11 @@ def _sp_attention(ap, x, cos_b, sin_b, cfg, *, axis: str, sp: int):
     """Attention over a sequence shard: projections/rope local (cos_b/sin_b
     are this shard's global-position slices); the ring couples positions."""
     B, T_loc, C = x.shape
-    hs, nh, ng = cfg.head_size, cfg.n_head, cfg.n_query_groups
-
-    q = (x @ ap["wq"].T).reshape(B, T_loc, nh, hs).transpose(0, 2, 1, 3)
-    k = (x @ ap["wk"].T).reshape(B, T_loc, ng, hs).transpose(0, 2, 1, 3)
-    v = (x @ ap["wv"].T).reshape(B, T_loc, ng, hs).transpose(0, 2, 1, 3)
-
-    n_elem = cfg.rope_n_elem
-    if n_elem > 0:
-        q_r = _rope(q[..., :n_elem], cos_b, sin_b)
-        k_r = _rope(k[..., :n_elem], cos_b, sin_b)
-        q = jnp.concatenate([q_r, q[..., n_elem:]], axis=-1) if n_elem < hs else q_r
-        k = jnp.concatenate([k_r, k[..., n_elem:]], axis=-1) if n_elem < hs else k_r
-
+    q, k, v = _project_qkv(ap, x, cos_b, sin_b, cfg)
     # GQA K/V stay at their grouped head count: the ring rotates the small
     # buffers and expands per block-attend step (ring_attend_shard)
     y = ring_attend_shard(q, k, v, axis=axis, sp=sp, causal=True)
-    y = y.transpose(0, 2, 1, 3).reshape(B, T_loc, nh * hs)
+    y = y.transpose(0, 2, 1, 3).reshape(B, T_loc, cfg.n_head * cfg.head_size)
     return y @ ap["wo"].T
 
 

@@ -83,6 +83,26 @@ def _mlp(mp, x, cfg: Config, *, quantized=False):
     return lin(jax.nn.gelu(lin(x, mp["fc"]), approximate=False), mp["proj"])
 
 
+def _project_qkv(ap, x, cos_t, sin_t, cfg: Config, *, lin=None):
+    """QKV projections + partial rotary for new tokens: x (B, T, C) →
+    q (B, nh, T, hs), k/v (B, ng, T, hs) — K/V stay at the grouped head
+    count.  Shared by KV-cache decode and sequence-parallel training."""
+    if lin is None:
+        lin = _linear
+    B, T, C = x.shape
+    hs, nh, ng = cfg.head_size, cfg.n_head, cfg.n_query_groups
+    q = lin(x, ap["wq"]).reshape(B, T, nh, hs).transpose(0, 2, 1, 3)
+    k = lin(x, ap["wk"]).reshape(B, T, ng, hs).transpose(0, 2, 1, 3)
+    v = lin(x, ap["wv"]).reshape(B, T, ng, hs).transpose(0, 2, 1, 3)
+    n_elem = cfg.rope_n_elem
+    if n_elem > 0:
+        q_r = _rope(q[..., :n_elem], cos_t, sin_t)
+        k_r = _rope(k[..., :n_elem], cos_t, sin_t)
+        q = jnp.concatenate([q_r, q[..., n_elem:]], axis=-1) if n_elem < hs else q_r
+        k = jnp.concatenate([k_r, k[..., n_elem:]], axis=-1) if n_elem < hs else k_r
+    return q, k, v
+
+
 def init_cache(cfg: Config, B: int, T_max: int, dtype=jnp.bfloat16, *, mesh=None, axis="tp") -> dict:
     """Preallocated KV cache: ``{"k"/"v": (L, B, n_query_groups, T_max, hs)}``.
 
@@ -113,17 +133,7 @@ def _attn_with_cache(ap, x, cos_t, sin_t, ck, cv, pos, cfg: Config, *, quantized
     B, T, C = x.shape
     hs, nh, ng = cfg.head_size, cfg.n_head, cfg.n_query_groups
     lin = partial(_linear, quantized=quantized)
-
-    q = lin(x, ap["wq"]).reshape(B, T, nh, hs).transpose(0, 2, 1, 3)
-    k = lin(x, ap["wk"]).reshape(B, T, ng, hs).transpose(0, 2, 1, 3)
-    v = lin(x, ap["wv"]).reshape(B, T, ng, hs).transpose(0, 2, 1, 3)
-
-    n_elem = cfg.rope_n_elem
-    if n_elem > 0:
-        q_r = _rope(q[..., :n_elem], cos_t, sin_t)
-        k_r = _rope(k[..., :n_elem], cos_t, sin_t)
-        q = jnp.concatenate([q_r, q[..., n_elem:]], axis=-1) if n_elem < hs else q_r
-        k = jnp.concatenate([k_r, k[..., n_elem:]], axis=-1) if n_elem < hs else k_r
+    q, k, v = _project_qkv(ap, x, cos_t, sin_t, cfg, lin=lin)
 
     ck = jax.lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), pos, axis=2)
     cv = jax.lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), pos, axis=2)

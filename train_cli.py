@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""End-to-end pretraining CLI (reference ``benchmarks/benchmark_litgpt.py``:
+config × parallelism × precision sweeps with tokens/s + memory reporting).
+
+Examples::
+
+    # single chip (or CPU smoke), flagship config scaled down
+    python train_cli.py --config tiny-llama-debug --steps 20
+
+    # 8 virtual CPU devices, FSDP, bf16 params
+    python train_cli.py --config tiny-llama-debug --mode fsdp --devices 8 \
+        --virtual-cpu --steps 10
+
+    # TP x FSDP with gradient accumulation
+    python train_cli.py --mode tp_fsdp --devices 8 --virtual-cpu --accum 2
+
+Modes map to the distributed API: ``none`` (single device), ``ddp``,
+``fsdp`` (ZeRO-2), ``zero3`` (regather-in-backward), ``tp_fsdp``
+(megatron rules x dim-0 shards).  Prints per-step timings and a final JSON
+summary line.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--config", default="tiny-llama-debug", help="model config name (models/llama.py zoo)")
+    ap.add_argument("--mode", default="none", choices=["none", "ddp", "fsdp", "zero3", "tp_fsdp"])
+    ap.add_argument("--devices", type=int, default=1)
+    ap.add_argument("--virtual-cpu", action="store_true", help="force N virtual CPU devices (no hardware needed)")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=None, help="sequence length (default: min(block_size, 128))")
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--accum", type=int, default=1, help="gradient-accumulation micro steps")
+    ap.add_argument("--dtype", default="float32", choices=["float32", "bfloat16"])
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--no-remat", action="store_true")
+    ap.add_argument("--checkpoint-dir", default=None, help="save a checkpoint at the end (orbax)")
+    args = ap.parse_args(argv)
+
+    if args.virtual_cpu:
+        from thunder_tpu._platform import force_cpu
+
+        force_cpu(args.devices)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from thunder_tpu import distributed as dist
+    from thunder_tpu.models import llama
+
+    devices = jax.devices()[: args.devices]
+    assert len(devices) >= args.devices, f"need {args.devices} devices, have {len(jax.devices())}"
+
+    cfg = llama.Config.from_name(args.config)
+    T = args.seq or min(cfg.block_size, 128)
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
+    log(f"config={cfg.name} n_layer={cfg.n_layer} n_embd={cfg.n_embd} "
+        f"params={llama.param_count(params)/1e6:.1f}M B={args.batch} T={T} "
+        f"mode={args.mode} devices={args.devices} dtype={args.dtype}")
+
+    if args.mode == "none":
+        mesh = dist.make_mesh({"dp": 1}, devices=devices[:1])
+        params = dist.ddp(params, mesh)
+    elif args.mode == "ddp":
+        mesh = dist.make_mesh({"dp": args.devices}, devices=devices)
+        params = dist.ddp(params, mesh)
+    elif args.mode in ("fsdp", "zero3"):
+        mesh = dist.make_mesh({"fsdp": args.devices}, devices=devices)
+        params = dist.fsdp(params, mesh)
+    else:  # tp_fsdp
+        tp = 2 if args.devices % 2 == 0 else 1
+        mesh = dist.make_mesh({"fsdp": args.devices // tp, "tp": tp}, devices=devices)
+        params = dist.tp_fsdp(params, mesh)
+
+    def loss_fn(p, i, t, c, s):
+        return llama.gpt_loss(p, i, t, c, s, cfg)
+
+    step = dist.make_train_step(
+        loss_fn, optax.adamw(args.lr), mesh,
+        remat=not args.no_remat, zero3=(args.mode == "zero3"),
+    )
+    opt_state = step.init_optimizer_state(params)
+
+    idx = jax.random.randint(jax.random.PRNGKey(1), (args.batch, T), 0, cfg.vocab_size)
+    tgt = jax.random.randint(jax.random.PRNGKey(2), (args.batch, T), 0, cfg.vocab_size)
+    cos, sin = llama.build_rope_cache(cfg, T)
+
+    t0 = time.perf_counter()
+    if args.accum > 1:
+        mb = args.batch // args.accum
+        micro = [(idx[k * mb:(k + 1) * mb], tgt[k * mb:(k + 1) * mb], cos, sin) for k in range(args.accum)]
+        params, opt_state, loss = step.accumulate(params, opt_state, micro)
+    else:
+        params, opt_state, loss = step(params, opt_state, idx, tgt, cos, sin)
+    jax.block_until_ready(loss)
+    log(f"compile+first step: {time.perf_counter()-t0:.1f}s loss={float(loss):.4f}")
+
+    t0 = time.perf_counter()
+    last = loss
+    for k in range(args.steps):
+        if args.accum > 1:
+            params, opt_state, last = step.accumulate(params, opt_state, micro)
+        else:
+            params, opt_state, last = step(params, opt_state, idx, tgt, cos, sin)
+    jax.block_until_ready(last)
+    dt = time.perf_counter() - t0
+    tps = args.batch * T * args.steps / dt
+
+    if args.checkpoint_dir:
+        from thunder_tpu.distributed import save_checkpoint
+
+        save_checkpoint(args.checkpoint_dir, {"params": params, "opt_state": opt_state}, step=args.steps)
+        log(f"checkpoint saved to {args.checkpoint_dir}")
+
+    print(json.dumps({
+        "config": cfg.name, "mode": args.mode, "devices": args.devices,
+        "tokens_per_sec": round(tps, 1), "ms_per_step": round(dt / args.steps * 1e3, 2),
+        "final_loss": round(float(last), 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -742,3 +742,111 @@ class TestGenerators:
 
         res, _ = interpret(f)
         assert res == "caught"
+
+
+class TestAssertAndMatch:
+    def test_assert_statement(self):
+        # compile outside pytest's assertion rewriter so the interpreter sees
+        # the stock LOAD_ASSERTION_ERROR bytecode
+        ns: dict = {}
+        exec(
+            compile(
+                "def f(x):\n    assert x > 0, 'must be positive'\n    return x * 2\n",
+                "<assert_test>",
+                "exec",
+            ),
+            ns,
+        )
+        f = ns["f"]
+        assert interpret(f, 3)[0] == 6
+        with pytest.raises(AssertionError, match="positive"):
+            interpret(f, -1)
+
+    def test_match_literal_and_capture(self):
+        def f(v):
+            match v:
+                case 0:
+                    return "zero"
+                case [a, b]:
+                    return a + b
+                case {"k": x}:
+                    return x * 10
+                case str() as s:
+                    return s.upper()
+                case _:
+                    return "other"
+
+        assert interpret(f, 0)[0] == "zero"
+        assert interpret(f, [2, 3])[0] == 5
+        assert interpret(f, {"k": 4})[0] == 40
+        assert interpret(f, "hi")[0] == "HI"
+        assert interpret(f, 7.5)[0] == "other"
+
+    def test_match_class_pattern(self):
+        from dataclasses import dataclass
+
+        @dataclass
+        class Point:
+            x: int
+            y: int
+
+        def f(p):
+            match p:
+                case Point(x=0, y=0):
+                    return "origin"
+                case Point(x=xx, y=yy):
+                    return xx + yy
+                case _:
+                    return "none"
+
+        assert interpret(f, Point(0, 0))[0] == "origin"
+        assert interpret(f, Point(2, 5))[0] == 7
+        assert interpret(f, "nope")[0] == "none"
+
+    def test_store_delete_global(self):
+        def f():
+            global _TMP_G
+            _TMP_G = 42
+            v = _TMP_G
+            del _TMP_G
+            return v
+
+        assert interpret(f)[0] == 42
+        assert "_TMP_G" not in globals()
+
+    def test_match_self_matching_builtins(self):
+        def f(v):
+            match v:
+                case int(n):
+                    return ("int", n)
+                case str(s):
+                    return ("str", s)
+                case _:
+                    return "other"
+
+        assert interpret(f, 5)[0] == ("int", 5)
+        assert interpret(f, "x")[0] == ("str", "x")
+        assert interpret(f, 2.5)[0] == "other"
+
+    def test_match_keys_does_not_mutate_defaultdict(self):
+        def f(d):
+            match d:
+                case {"k": x}:
+                    return ("hit", x)
+            return "miss"
+
+        from collections import defaultdict
+
+        d = defaultdict(list, {"other": 1})
+        assert interpret(f, d)[0] == "miss"
+        assert "k" not in d  # probe must not fire __missing__
+
+    def test_delete_missing_global_raises_nameerror(self):
+        def f():
+            global _NO_SUCH_GLOBAL_XYZ
+            try:
+                del _NO_SUCH_GLOBAL_XYZ
+            except NameError:
+                return "caught"
+
+        assert interpret(f)[0] == "caught"

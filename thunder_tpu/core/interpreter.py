@@ -644,6 +644,151 @@ def _load_attr(frame, ins, i):
         frame.push(v)
 
 
+@register_opcode_handler("LOAD_ASSERTION_ERROR")
+def _load_assertion_error(frame, ins, i):
+    frame.push(AssertionError)
+
+
+@register_opcode_handler("STORE_GLOBAL")
+def _store_global(frame, ins, i):
+    v = frame.pop()
+    from thunder_tpu.core.proxies import Proxy
+
+    if isinstance(v, Proxy):
+        # same external-state contract as STORE_ATTR: a proxy written to the
+        # live module dict would outlive the trace as a stale guard/constant
+        raise InterpreterError(
+            f"storing a traced tensor into the global {ins.argval!r} is not "
+            f"supported; return it (or pass state explicitly) instead"
+        )
+    frame.globals_[ins.argval] = v
+
+
+@register_opcode_handler("DELETE_GLOBAL")
+def _delete_global(frame, ins, i):
+    try:
+        del frame.globals_[ins.argval]
+    except KeyError:
+        raise NameError(f"name {ins.argval!r} is not defined") from None
+
+
+@register_opcode_handler("DELETE_NAME")
+def _delete_name(frame, ins, i):
+    # like LOAD_NAME: local namespace first, then globals (class/module scope)
+    name = ins.argval
+    if name in frame.localsplus:
+        del frame.localsplus[name]
+        return
+    try:
+        del frame.globals_[name]
+    except KeyError:
+        raise NameError(f"name {name!r} is not defined") from None
+
+
+@register_opcode_handler("DELETE_ATTR")
+def _delete_attr(frame, ins, i):
+    delattr(frame.pop(), ins.argval)
+
+
+@register_opcode_handler("DELETE_DEREF")
+def _delete_deref(frame, ins, i):
+    name = ins.argval
+    if name in frame.cells:
+        cell = frame.cells[name]
+        try:
+            cell.cell_contents  # raises ValueError when already unbound
+        except ValueError:
+            raise NameError(f"name {name!r} is not defined") from None
+        del cell.cell_contents
+        return
+    try:
+        del frame.localsplus[name]
+    except KeyError:
+        raise NameError(f"name {name!r} is not defined") from None
+
+
+#
+# match statements (3.12 structural pattern matching)
+#
+
+
+@register_opcode_handler("GET_LEN")
+def _get_len(frame, ins, i):
+    frame.push(len(frame.stack[-1]))
+
+
+@register_opcode_handler("MATCH_SEQUENCE")
+def _match_sequence(frame, ins, i):
+    from collections.abc import Sequence
+
+    v = frame.stack[-1]
+    frame.push(isinstance(v, Sequence) and not isinstance(v, (str, bytes, bytearray)))
+
+
+@register_opcode_handler("MATCH_MAPPING")
+def _match_mapping(frame, ins, i):
+    from collections.abc import Mapping
+
+    frame.push(isinstance(frame.stack[-1], Mapping))
+
+
+_MATCH_MISSING = object()
+
+# builtins with Py_TPFLAGS_MATCH_SELF: `case int(n)` binds the subject itself
+_SELF_MATCH_TYPES = (bool, bytearray, bytes, dict, float, frozenset, int, list, set, str, tuple)
+
+
+@register_opcode_handler("MATCH_KEYS")
+def _match_keys(frame, ins, i):
+    # stack [subject, keys] → [subject, keys, values-tuple | None].  CPython
+    # probes with .get(key, sentinel) — NOT __getitem__ — so __missing__
+    # (defaultdict) neither fires nor mutates the subject
+    keys = frame.stack[-1]
+    subject = frame.stack[-2]
+    values = []
+    for k in keys:
+        v = subject.get(k, _MATCH_MISSING)
+        if v is _MATCH_MISSING:
+            frame.push(None)
+            return
+        values.append(v)
+    frame.push(tuple(values))
+
+
+@register_opcode_handler("MATCH_CLASS")
+def _match_class(frame, ins, i):
+    # stack [subject, cls, kw-names] → [values-tuple | None]; arg = count of
+    # positional sub-patterns (bound via cls.__match_args__)
+    kw_names = frame.pop()
+    cls = frame.pop()
+    subject = frame.pop()
+    n_pos = ins.arg or 0
+    if not isinstance(subject, cls):
+        frame.push(None)
+        return
+    try:
+        attrs = []
+        match_args = getattr(cls, "__match_args__", ())
+        if n_pos > len(match_args):
+            # self-matching builtins (Py_TPFLAGS_MATCH_SELF): `case int(n)`
+            # binds the subject itself as the single positional value
+            if cls in _SELF_MATCH_TYPES and not match_args and n_pos == 1:
+                attrs.append(subject)
+            else:
+                raise TypeError(
+                    f"{cls.__name__}() accepts {len(match_args)} positional "
+                    f"sub-patterns ({n_pos} given)"
+                )
+        else:
+            for name in match_args[:n_pos]:
+                attrs.append(getattr(subject, name))
+        for name in kw_names:
+            attrs.append(getattr(subject, name))
+        frame.push(tuple(attrs))
+    except AttributeError:
+        frame.push(None)
+
+
 @register_opcode_handler("STORE_ATTR")
 def _store_attr(frame, ins, i):
     obj = frame.pop()

@@ -335,3 +335,26 @@ def test_initialize_multihost_single_process_noop():
 
     initialize_multihost(num_processes=1)  # must not raise on one process
     assert jax.process_count() == 1
+
+
+def test_no_sync_context_yields_micro_grads():
+    import optax
+
+    from thunder_tpu.models import llama
+
+    cfg = llama.Config.from_name("tiny-llama-debug")
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    mesh = dist.make_mesh({"dp": 2}, devices=jax.devices()[:2])
+    p = dist.ddp(params, mesh)
+    step = dist.make_train_step(
+        lambda pp, i, t, c, s: llama.gpt_loss(pp, i, t, c, s, cfg),
+        optax.sgd(1e-2), mesh,
+    )
+    o = step.init_optimizer_state(p)
+    idx = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, cfg.vocab_size)
+    tgt = jax.random.randint(jax.random.PRNGKey(2), (4, 16), 0, cfg.vocab_size)
+    cos, sin = llama.build_rope_cache(cfg, 16)
+    with step.no_sync() as micro:
+        loss, grads = micro(p, o, idx, tgt, cos, sin)
+    assert np.isfinite(float(loss))
+    assert jax.tree_util.tree_structure(grads) == jax.tree_util.tree_structure(p)

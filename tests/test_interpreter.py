@@ -850,3 +850,56 @@ class TestAssertAndMatch:
                 return "caught"
 
         assert interpret(f)[0] == "caught"
+
+    def test_match_self_matching_builtin_subclass(self):
+        class MyInt(int):
+            pass
+
+        def f(v):
+            match v:
+                case MyInt(x):
+                    return ("myint", int(x))
+                case _:
+                    return "other"
+
+        assert interpret(f, MyInt(3))[0] == ("myint", 3)
+        assert interpret(f, 3)[0] == "other"  # plain int is not MyInt
+
+    def test_match_class_duplicate_attr_raises(self):
+        class P:
+            __match_args__ = ("x", "y")
+
+            def __init__(self):
+                self.x, self.y = 1, 2
+
+        def f(p):
+            match p:
+                case P(1, x=1):
+                    return "matched"
+            return "no"
+
+        with pytest.raises(TypeError, match="multiple sub-patterns"):
+            interpret(f, P())
+
+    def test_store_global_rejected_during_tracing(self):
+        def f(x):
+            global _TRACE_G
+            _TRACE_G = 1
+            return ltorch.mul(x, 2.0)
+
+        x = rng.standard_normal((3,)).astype(np.float32)
+        with pytest.raises(Exception, match="global.*tracing|tracing.*global"):
+            tt.jit(f, interpretation="bytecode")(x)
+
+    def test_match_destructured_global_is_guarded(self):
+        def f(x):
+            match MODULE_CFG:
+                case {"depth": d}:
+                    return ltorch.mul(x, float(d))
+            return x
+
+        x = rng.standard_normal((3,)).astype(np.float32)
+        jfn = tt.jit(f, interpretation="bytecode")
+        np.testing.assert_allclose(np.asarray(jfn(x)), x * 2.0, rtol=1e-6)
+        src = tt.last_prologue_traces(jfn)[-1].python()
+        assert "'depth'" in src  # destructured read became a prologue guard

@@ -652,14 +652,16 @@ def _load_assertion_error(frame, ins, i):
 @register_opcode_handler("STORE_GLOBAL")
 def _store_global(frame, ins, i):
     v = frame.pop()
-    from thunder_tpu.core.proxies import Proxy
+    from thunder_tpu.core.trace import get_tracectx
 
-    if isinstance(v, Proxy):
-        # same external-state contract as STORE_ATTR: a proxy written to the
-        # live module dict would outlive the trace as a stale guard/constant
+    if get_tracectx() is not None:
+        # a trace-time global store is NOT replayed on cache hits (the
+        # compiled program never re-executes it) and invalidates any guard on
+        # the same name — refuse instead of silently diverging from eager
         raise InterpreterError(
-            f"storing a traced tensor into the global {ins.argval!r} is not "
-            f"supported; return it (or pass state explicitly) instead"
+            f"writing the global {ins.argval!r} during tracing is not supported "
+            f"(the store would not replay on cache hits); return the value or "
+            f"pass state explicitly"
         )
     frame.globals_[ins.argval] = v
 
@@ -674,15 +676,13 @@ def _delete_global(frame, ins, i):
 
 @register_opcode_handler("DELETE_NAME")
 def _delete_name(frame, ins, i):
-    # like LOAD_NAME: local namespace first, then globals (class/module scope)
+    # CPython DELETE_NAME deletes from the LOCAL namespace only (unlike
+    # LOAD_NAME, which falls back to globals on reads)
     name = ins.argval
     if name in frame.localsplus:
         del frame.localsplus[name]
         return
-    try:
-        del frame.globals_[name]
-    except KeyError:
-        raise NameError(f"name {name!r} is not defined") from None
+    raise NameError(f"name {name!r} is not defined")
 
 
 @register_opcode_handler("DELETE_ATTR")
@@ -745,12 +745,18 @@ def _match_keys(frame, ins, i):
     # (defaultdict) neither fires nor mutates the subject
     keys = frame.stack[-1]
     subject = frame.stack[-2]
+    base_rec = frame.ctx.prov_of(subject)
     values = []
     for k in keys:
         v = subject.get(k, _MATCH_MISSING)
         if v is _MATCH_MISSING:
             frame.push(None)
             return
+        if base_rec is not None:
+            # destructured reads guard/proxify like BINARY_SUBSCR would
+            rec = ProvenanceRecord(PseudoInst.BINARY_SUBSCR, inputs=(base_rec,), key=k)
+            v = frame.ctx.record_read(rec, v)
+            frame.ctx.track(v, rec)
         values.append(v)
     frame.push(tuple(values))
 
@@ -766,13 +772,25 @@ def _match_class(frame, ins, i):
     if not isinstance(subject, cls):
         frame.push(None)
         return
+    base_rec = frame.ctx.prov_of(subject)
+
+    def read_attr(name):
+        v = getattr(subject, name)
+        if base_rec is not None:
+            # destructured reads guard/proxify like LOAD_ATTR would
+            rec = ProvenanceRecord(PseudoInst.LOAD_ATTR, inputs=(base_rec,), key=name)
+            v = frame.ctx.record_read(rec, v)
+            frame.ctx.track(v, rec)
+        return v
+
     try:
         attrs = []
+        seen: set = set()
         match_args = getattr(cls, "__match_args__", ())
         if n_pos > len(match_args):
-            # self-matching builtins (Py_TPFLAGS_MATCH_SELF): `case int(n)`
-            # binds the subject itself as the single positional value
-            if cls in _SELF_MATCH_TYPES and not match_args and n_pos == 1:
+            # self-matching builtins (Py_TPFLAGS_MATCH_SELF, inherited by
+            # subclasses): `case int(n)` binds the subject itself
+            if issubclass(cls, _SELF_MATCH_TYPES) and not match_args and n_pos == 1:
                 attrs.append(subject)
             else:
                 raise TypeError(
@@ -781,9 +799,12 @@ def _match_class(frame, ins, i):
                 )
         else:
             for name in match_args[:n_pos]:
-                attrs.append(getattr(subject, name))
+                seen.add(name)
+                attrs.append(read_attr(name))
         for name in kw_names:
-            attrs.append(getattr(subject, name))
+            if name in seen:
+                raise TypeError(f"{cls.__name__}() got multiple sub-patterns for attribute {name!r}")
+            attrs.append(read_attr(name))
         frame.push(tuple(attrs))
     except AttributeError:
         frame.push(None)

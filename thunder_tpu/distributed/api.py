@@ -342,6 +342,17 @@ class TrainStep:
             entry = self._get_entry(params, opt_state, batch_template)
             return entry["apply"](params, opt_state, grads)
 
+    def no_sync(self):
+        """Reference-compat alias (``thunder/distributed/__init__.py:200``):
+        a context yielding the micro-step ``grads`` entry — (loss, grads)
+        with no optimizer update.  NOTE: under SPMD one program computes the
+        grads, so the data-parallel mean (psum) still runs per micro step —
+        this skips the *optimizer*, not the collective; comm-free local
+        accumulation does not exist in the sharding design (SURVEY §2.6)."""
+        import contextlib
+
+        return contextlib.nullcontext(self.grads)
+
     def accumulate(self, params, opt_state, micro_batches):
         """Gradient accumulation: N micro batches, one optimizer update.
 

@@ -903,3 +903,20 @@ class TestAssertAndMatch:
         np.testing.assert_allclose(np.asarray(jfn(x)), x * 2.0, rtol=1e-6)
         src = tt.last_prologue_traces(jfn)[-1].python()
         assert "'depth'" in src  # destructured read became a prologue guard
+
+    def test_failed_match_on_global_guards_and_retraces(self):
+        def f(x):
+            match MODULE_CFG:
+                case {"missing_key": d}:
+                    return ltorch.mul(x, float(d))
+            return ltorch.mul(x, -1.0)
+
+        x = rng.standard_normal((3,)).astype(np.float32)
+        jfn = tt.jit(f, interpretation="bytecode")
+        np.testing.assert_allclose(np.asarray(jfn(x)), -x, rtol=1e-6)
+        # inserting the key must retrace into the match branch, not replay
+        MODULE_CFG["missing_key"] = 3.0
+        try:
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 3.0, rtol=1e-6)
+        finally:
+            del MODULE_CFG["missing_key"]

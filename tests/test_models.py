@@ -153,3 +153,51 @@ def test_tied_embeddings():
     logits = tt.jit(fwd)(params, idx, cos, sin)
     expected = ref_forward(params, idx, cos, sin, cfg)
     np.testing.assert_allclose(np.asarray(logits), np.asarray(expected), atol=2e-4, rtol=2e-4)
+
+
+def test_nanogpt_style_config_traces_and_trains():
+    """GPT-2/nanoGPT family: learned positional embeddings, LayerNorm, gelu
+    MLP, tied embeddings, no rotary (reference nanogpt_model.py)."""
+    import optax
+
+    from thunder_tpu import distributed as dist
+
+    cfg = llama.Config.from_name("nanogpt-debug")
+    assert cfg.rope_n_elem == 0 and cfg.learned_pos_embedding
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    assert "wpe" in params and "lm_head" not in params  # tied
+    B, T = 4, 32
+    idx = jax.random.randint(jax.random.PRNGKey(1), (B, T), 0, cfg.vocab_size)
+    tgt = jax.random.randint(jax.random.PRNGKey(2), (B, T), 0, cfg.vocab_size)
+    cos, sin = llama.build_rope_cache(cfg, T)
+
+    mesh = dist.make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    step = dist.make_train_step(
+        lambda p, i, t, c, s: llama.gpt_loss(p, i, t, c, s, cfg), optax.adam(1e-2), mesh
+    )
+    o = step.init_optimizer_state(params)
+    losses = []
+    p = params
+    for _ in range(3):
+        p, o, loss = step(p, o, idx, tgt, cos, sin)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+
+def test_nanogpt_generate_matches_full_forward():
+    import thunder_tpu as tt
+    from thunder_tpu.models import generate as gen
+
+    cfg = llama.Config.from_name("nanogpt-debug")
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 4), 0, cfg.vocab_size)
+
+    jfn = tt.jit(lambda p, i, c, s: llama.gpt_forward(p, i, c, s, cfg))
+    toks = prompt
+    for _ in range(5):
+        cos, sin = llama.build_rope_cache(cfg, toks.shape[1])
+        nxt = jnp.argmax(jfn(params, toks, cos, sin)[:, -1].astype(jnp.float32), -1).astype(toks.dtype)
+        toks = jnp.concatenate([toks, nxt[:, None]], 1)
+
+    out = gen.generate(params, prompt, cfg, 5, cache_dtype=jnp.float32)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(toks))

@@ -668,6 +668,15 @@ def _store_global(frame, ins, i):
 
 @register_opcode_handler("DELETE_GLOBAL")
 def _delete_global(frame, ins, i):
+    from thunder_tpu.core.trace import get_tracectx
+
+    if get_tracectx() is not None:
+        # same non-replay contract as STORE_GLOBAL: the compiled program
+        # would never re-execute the delete on cache hits
+        raise InterpreterError(
+            f"deleting the global {ins.argval!r} during tracing is not supported "
+            f"(the delete would not replay on cache hits)"
+        )
     try:
         del frame.globals_[ins.argval]
     except KeyError:
@@ -750,6 +759,11 @@ def _match_keys(frame, ins, i):
     for k in keys:
         v = subject.get(k, _MATCH_MISSING)
         if v is _MATCH_MISSING:
+            if base_rec is not None:
+                # a FAILED match against guarded state must also guard: read
+                # the whole subject so a later key insertion retraces instead
+                # of replaying the baked no-match branch
+                frame.ctx.record_read(base_rec, subject)
             frame.push(None)
             return
         if base_rec is not None:
@@ -769,10 +783,12 @@ def _match_class(frame, ins, i):
     cls = frame.pop()
     subject = frame.pop()
     n_pos = ins.arg or 0
+    base_rec = frame.ctx.prov_of(subject)
     if not isinstance(subject, cls):
+        if base_rec is not None:
+            frame.ctx.record_read(base_rec, subject)  # guard the failed match
         frame.push(None)
         return
-    base_rec = frame.ctx.prov_of(subject)
 
     def read_attr(name):
         v = getattr(subject, name)

@@ -43,6 +43,14 @@ def _guardable(v) -> bool:
         return True
     if isinstance(v, tuple) and all(isinstance(e, _GUARDABLE) for e in v):
         return True
+    # small all-primitive dicts guard as literal-likes (match-statement
+    # subjects: a failed `case {"k": _}` must retrace when the dict changes)
+    if (
+        isinstance(v, dict)
+        and len(v) <= 16
+        and all(isinstance(k, _GUARDABLE) and isinstance(e, _GUARDABLE) for k, e in v.items())
+    ):
+        return True
     return False
 
 
@@ -143,6 +151,8 @@ def build_state_prologue(prologue_trace, fn: Callable, cap: StateCapture, dtype_
         leaf = unpack(path)
         if isinstance(value, str):
             prims.check_string_value(leaf, value)
+        elif isinstance(value, (dict, tuple)):
+            prims.check_literal_like(leaf, value)
         else:
             prims.check_number_type_and_value(leaf, value)
 

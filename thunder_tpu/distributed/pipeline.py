@@ -207,6 +207,8 @@ def pp_gpt_loss(
 
     # embed replicated, reshape to the microbatch stream
     x = params["wte"][idx]  # (B, T, C)
+    if config.learned_pos_embedding:
+        x = x + params["wpe"][:T]
     mbs = x.reshape(n_micro, mb, T, x.shape[-1])
 
     stage = _compiled_block_fn(config, (mb, T, x.shape[-1]), cos, sin, dtype)

@@ -54,6 +54,10 @@ def sp_gpt_loss(params, idx, targets, cos, sin, cfg, *, mesh: Mesh, axis: str = 
     B, T = idx.shape
     assert T % sp == 0, f"sequence {T} must divide over {axis}={sp}"
 
+    assert not cfg.learned_pos_embedding, (
+        "sp_gpt_loss does not shard learned position embeddings yet; use rope configs"
+    )
+
     def body(params, idx_b, tgt_b, cos_b, sin_b):
         x = params["wte"][idx_b]  # (B, T_loc, C) — embedding lookup is local
         for bp in params["blocks"]:

@@ -163,6 +163,8 @@ def forward_with_cache(params, idx, pos, cache, cos_all, sin_all, cfg: Config, *
     against/into ``cache``.  Returns (logits (B, T, V), updated cache)."""
     B, T = idx.shape
     x = params["wte"][idx]
+    if cfg.learned_pos_embedding:
+        x = x + jax.lax.dynamic_slice_in_dim(params["wpe"], pos, T, axis=0)
     cos_t = jax.lax.dynamic_slice_in_dim(cos_all, pos, T, axis=0)
     sin_t = jax.lax.dynamic_slice_in_dim(sin_all, pos, T, axis=0)
 
@@ -225,6 +227,11 @@ def generate(
     if T_max is None:
         T_max = min(cfg.block_size, T_prompt + max_new_tokens)
     assert T_prompt + max_new_tokens <= T_max, "T_max too small"
+    if cfg.learned_pos_embedding:
+        # wpe has block_size rows; dynamic_slice would silently clamp past it
+        assert T_max <= cfg.block_size, (
+            f"T_max {T_max} exceeds block_size {cfg.block_size} with learned position embeddings"
+        )
     if key is None:
         key = jax.random.PRNGKey(0)
     dtype = cache_dtype if cache_dtype is not None else params["wte"].dtype

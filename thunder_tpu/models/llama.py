@@ -66,6 +66,9 @@ class Config:
     shared_attention_norm: bool = False
     lm_head_bias: bool = False
     tie_embeddings: bool = False
+    # GPT-2/nanoGPT style: learned absolute position embeddings (wpe); used
+    # with rotary_percentage=0.0 (reference nanogpt_model.py)
+    learned_pos_embedding: bool = False
     # MoE (reference: litgpt LLaMAMoE via tests/litgpt_model.py:98-110)
     n_expert: int = 0
     n_expert_per_token: int = 2
@@ -139,6 +142,13 @@ configs: list[Config] = [
            intermediate_size=14336),
     Config(name="CodeLlama-2-like", block_size=16384, vocab_size=32016, n_layer=32,
            n_head=32, n_embd=4096, intermediate_size=11008, rope_base=1000000),
+    Config(name="nanogpt-debug", block_size=128, vocab_size=256, n_layer=2, n_head=4,
+           n_embd=64, rotary_percentage=0.0, learned_pos_embedding=True,
+           parallel_residual=False, norm_class="LayerNorm", mlp_class="GptNeoxMLP",
+           tie_embeddings=True),
+    Config(name="gpt2-124m", block_size=1024, vocab_size=50257, n_layer=12, n_head=12,
+           n_embd=768, rotary_percentage=0.0, learned_pos_embedding=True,
+           norm_class="LayerNorm", mlp_class="GptNeoxMLP", tie_embeddings=True),
     Config(name="tiny-moe-debug", block_size=128, vocab_size=256, n_layer=2, n_head=4,
            n_embd=64, n_query_groups=2, intermediate_size=96, mlp_class="LLaMAMoE",
            n_expert=4, n_expert_per_token=2),
@@ -169,7 +179,7 @@ def init_params(config: Config, key: jax.Array | None = None, dtype=jnp.bfloat16
     def dense(key, fan_in, fan_out):
         return (jax.random.normal(key, (fan_out, fan_in), dtype=jnp.float32) * std).astype(dtype)
 
-    n_keys = 2 + config.n_layer * (5 + 3 * max(1, config.n_expert))
+    n_keys = 3 + config.n_layer * (5 + 3 * max(1, config.n_expert))
     keys = iter(jax.random.split(key, n_keys))
 
     params: dict[str, Any] = {
@@ -180,6 +190,9 @@ def init_params(config: Config, key: jax.Array | None = None, dtype=jnp.bfloat16
     }
     if not config.tie_embeddings:
         params["lm_head"] = dense(next(keys), config.n_embd, config.padded_vocab_size)
+    if config.learned_pos_embedding:
+        params["wpe"] = (jax.random.normal(next(keys), (config.block_size, config.n_embd),
+                                           dtype=jnp.float32) * std).astype(dtype)
 
     for _ in range(config.n_layer):
         block = {
@@ -345,6 +358,9 @@ def block_forward(bp, x, cos, sin, config: Config):
 def gpt_forward(params, idx, cos, sin, config: Config):
     """Token ids (B, T) int32 → logits (B, T, padded_vocab_size)."""
     x = ltorch.embedding(idx, params["wte"])
+    if config.learned_pos_embedding:
+        T = idx.shape[1]
+        x = x + params["wpe"][:T]
     for bp in params["blocks"]:
         x = block_forward(bp, x, cos, sin, config)
     x = _norm(x, params["ln_f"], config)

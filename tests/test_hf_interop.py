@@ -153,3 +153,68 @@ def test_llama_backward_matches_eager():
         )
         checked += 1
     assert checked >= 10, f"only {checked} param grads flowed"
+
+
+def test_bert_sdpa_attention_mask_hits_flash_path(monkeypatch):
+    """HF BERT with attn_implementation="sdpa" and a real padding mask stays
+    on the fused-SDPA fast path (O(T) residuals): the execution trace claims
+    ``pallas_sdpa`` and numerics match HF eager (VERDICT r2 item 2 done bar;
+    reference checker matrix sdpaex.py:240-474)."""
+    monkeypatch.setenv("THUNDER_TPU_PALLAS_INTERPRET", "1")
+    cfg = transformers.BertConfig(
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        hidden_size=256,  # head_size 64: zero-padded to the 128 lane width
+        intermediate_size=512,
+        vocab_size=128,
+        max_position_embeddings=256,
+        hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0,
+        attn_implementation="sdpa",
+    )
+    torch.manual_seed(0)
+    model = transformers.BertModel(cfg).eval()
+    B, T = 2, 128
+    ids = torch.randint(0, 128, (B, T), generator=torch.Generator().manual_seed(4))
+    mask = torch.ones_like(ids)
+    mask[:, -32:] = 0  # padded tail
+    with torch.no_grad():
+        ref = model(ids, attention_mask=mask).last_hidden_state
+
+    jm = ttpu.jit(model)
+    out = jm(input_ids=ids, attention_mask=mask)
+    np.testing.assert_allclose(
+        out.last_hidden_state.detach().numpy()[:, :-32], ref.numpy()[:, :-32],
+        rtol=1e-4, atol=1e-5,
+    )
+    src = ttpu.last_traces(jm)[-1].python()
+    assert "pallas_sdpa" in src, f"masked BERT fell off the flash path:\n{src[:2000]}"
+
+
+def test_llama_sdpa_gqa_hits_flash_path(monkeypatch):
+    """HF Llama with attn_implementation="sdpa" (causal mask + GQA config)
+    claims the Pallas kernels at block-sized T."""
+    monkeypatch.setenv("THUNDER_TPU_PALLAS_INTERPRET", "1")
+    cfg = transformers.LlamaConfig(
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        hidden_size=256,
+        intermediate_size=512,
+        vocab_size=128,
+        max_position_embeddings=256,
+        attn_implementation="sdpa",
+    )
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(cfg).eval()
+    ids = torch.randint(0, 128, (2, 128), generator=torch.Generator().manual_seed(3))
+    with torch.no_grad():
+        ref = model(ids, use_cache=False).logits
+
+    jm = ttpu.jit(model)
+    out = jm(input_ids=ids, use_cache=False)
+    np.testing.assert_allclose(
+        out.logits.detach().numpy(), ref.numpy(), rtol=1e-3, atol=1e-4
+    )
+    src = ttpu.last_traces(jm)[-1].python()
+    assert "pallas_sdpa" in src, f"HF Llama sdpa fell off the flash path:\n{src[:2000]}"

@@ -231,3 +231,51 @@ class TestQuantExecutor:
         # logits agree to quantization tolerance
         denom = np.abs(ref).mean()
         assert np.abs(got - ref).mean() / denom < 0.1, float(np.abs(got - ref).mean() / denom)
+
+
+class TestQuantizedTraining:
+    """Int8 TRAINING (VERDICT r2 item 3): the TE-executor contract — int8
+    forward GEMMs, full-precision grads (reference
+    transformer_engineex.py:183-336 claims prims.linear inside the training
+    fw+bw; here quant claims the forward trace only)."""
+
+    def _train(self, quant, steps=12):
+        import optax
+
+        from thunder_tpu import distributed as dist
+
+        cfg = llama.Config.from_name("tiny-llama-debug")
+        mesh = dist.make_mesh({"dp": 1}, devices=jax.devices()[:1])
+        params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+        B, T = 4, 32
+        idx = jax.random.randint(jax.random.PRNGKey(1), (B, T), 0, cfg.vocab_size)
+        tgt = jax.random.randint(jax.random.PRNGKey(2), (B, T), 0, cfg.vocab_size)
+        cos, sin = llama.build_rope_cache(cfg, T)
+
+        def loss_fn(p, i, t, c, s):
+            return llama.gpt_loss(p, i, t, c, s, cfg)
+
+        step = dist.make_train_step(loss_fn, optax.adamw(3e-3), mesh, quant=quant)
+        opt = step.init_optimizer_state(params)
+        losses = []
+        for _ in range(steps):
+            params, opt, loss = step(params, opt, idx, tgt, cos, sin)
+            losses.append(float(loss))
+        return losses, step
+
+    def test_int8_training_converges_like_fp32(self):
+        l_fp, _ = self._train(None)
+        l_q, _ = self._train("int8")
+        # both learn; the quantized path tracks full precision closely
+        assert l_fp[-1] < l_fp[0] - 0.2
+        assert l_q[-1] < l_q[0] - 0.2
+        assert abs(l_q[-1] - l_fp[-1]) < 0.15, (l_q[-1], l_fp[-1])
+
+    def test_int8_claims_forward_only(self):
+        _, step = self._train("int8", steps=1)
+        fw_src = step.fw_trace.python()
+        bw_src = step.bw_trace.python()
+        assert "int8_linear" in fw_src or "int8_matmul" in fw_src, fw_src[:2000]
+        assert "int8_linear" not in bw_src and "int8_matmul" not in bw_src, (
+            "grads must stay full precision (TE contract)"
+        )

@@ -399,14 +399,24 @@ def vjp(fn: Callable, argnums: Sequence[int] | None = None, **jit_kwargs) -> Cal
 #
 
 
+def _unwrap_cfn(cfn):
+    """ThunderModule holds its compiled function internally (the vjp of the
+    functionalized forward); introspection accepts either, like the
+    reference's last_traces on ThunderModule (reference __init__.py:709)."""
+    vjp_fn = getattr(cfn, "_vjp_fn", None)
+    if vjp_fn is not None and not hasattr(cfn, "_lc_cs"):
+        return vjp_fn
+    return cfn
+
+
 def _get_cs(cfn) -> CompileStats:
-    cs = getattr(cfn, "_lc_cs", None)
+    cs = getattr(_unwrap_cfn(cfn), "_lc_cs", None)
     check(cs is not None, lambda: f"{cfn} is not a thunder_tpu-compiled function")
     return cs
 
 
 def compile_data(cfn) -> CompileData:
-    cd = getattr(cfn, "_lc_cd", None)
+    cd = getattr(_unwrap_cfn(cfn), "_lc_cd", None)
     check(cd is not None, lambda: f"{cfn} is not a thunder_tpu-compiled function")
     return cd
 

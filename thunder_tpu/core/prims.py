@@ -1033,8 +1033,43 @@ def _convolution_meta(
 convolution = make_prim(PrimIDs.CONVOLUTION, "convolution", meta=_convolution_meta, tags=(OpTags.MATMUL_OP,))
 
 
+def _sdpa_check_gqa(q: TensorProxy, k: TensorProxy, v: TensorProxy) -> None:
+    """Batch-dim validation shared by the SDPA metas.
+
+    Equal batch dims is plain MHA.  Grouped-query attention (the memory
+    layout of Llama-2-70B/Llama-3/Mixtral: fewer KV heads than Q heads) is
+    expressed natively — q ``(..., H, Tq, hs)`` with k/v ``(..., G, Tk, hs)``,
+    ``H % G == 0`` — so executors index KV groups directly instead of the
+    model pre-expanding K/V to H heads (the reference leans on aten's
+    ``enable_gqa``, sdpaex.py:240; pre-expansion costs H/G× KV bandwidth).
+    """
+    if q.shape[:-2] == k.shape[:-2]:
+        return
+    check(q.ndim >= 3, lambda: "sdpa GQA: need an explicit head dim (rank >= 3)")
+    check(
+        q.shape[:-3] == k.shape[:-3],
+        lambda: f"sdpa: leading batch dims must match, got {q.shape} vs {k.shape}",
+    )
+    H, G = q.shape[-3], k.shape[-3]
+    check(G > 0 and H % G == 0, lambda: f"sdpa GQA: n_head {H} not a multiple of kv groups {G}")
+
+
+def _sdpa_check_mask(mask: TensorProxy | None, q: TensorProxy, k: TensorProxy) -> None:
+    """``mask`` is an additive float bias broadcastable (right-aligned) to
+    ``q.shape[:-2] + (Tq, Tk)`` — boolean masks are canonicalized to additive
+    form at the torch layer (torch/__init__.py scaled_dot_product_attention)."""
+    if mask is None:
+        return
+    _check_tensor(mask)
+    check(dtypes.is_float_dtype(mask.dtype), lambda: f"sdpa: mask must be additive float, got {mask.dtype}")
+    target = q.shape[:-2] + (q.shape[-2], k.shape[-2])
+    check(mask.ndim <= len(target), lambda: f"sdpa: mask rank {mask.ndim} > operand rank {len(target)}")
+    for md, td in zip(reversed(mask.shape), reversed(target)):
+        check(md == 1 or md == td, lambda: f"sdpa: mask shape {mask.shape} not broadcastable to {target}")
+
+
 def _sdpa_meta(
-    q: TensorProxy, k: TensorProxy, v: TensorProxy, causal: bool, scale: float
+    q: TensorProxy, k: TensorProxy, v: TensorProxy, mask: TensorProxy | None, causal: bool, scale: float
 ) -> tuple[TensorProxy, TensorProxy]:
     """Fused scaled-dot-product attention over (..., T, hs) q/k/v.
 
@@ -1042,6 +1077,10 @@ def _sdpa_meta(
     scaled scores per query row — the residual a flash-attention backward
     needs instead of the (T, T) probability matrix (the memory property the
     reference gets from aten/cudnn flash kernels, sdpaex.py:240).
+
+    ``mask`` (optional) is an additive float bias applied to the scaled
+    scores; grouped-query K/V (fewer heads than q) is accepted natively —
+    see ``_sdpa_check_gqa``/``_sdpa_check_mask``.
     """
     for t in (q, k, v):
         _check_tensor(t)
@@ -1051,7 +1090,9 @@ def _sdpa_meta(
     check(q.ndim == k.ndim == v.ndim, lambda: f"sdpa: rank mismatch {q.ndim}/{k.ndim}/{v.ndim}")
     check(q.shape[-1] == k.shape[-1], lambda: f"sdpa: q/k head dims {q.shape[-1]} != {k.shape[-1]}")
     check(k.shape[-2] == v.shape[-2], lambda: f"sdpa: k/v lengths {k.shape[-2]} != {v.shape[-2]}")
-    check(q.shape[:-2] == k.shape[:-2] == v.shape[:-2], lambda: "sdpa: batch dims must match (no broadcasting)")
+    check(k.shape[:-2] == v.shape[:-2], lambda: "sdpa: k/v batch dims must match")
+    _sdpa_check_gqa(q, k, v)
+    _sdpa_check_mask(mask, q, k)
     rg = (q.requires_grad or k.requires_grad or v.requires_grad) and dtypes.is_inexact_dtype(q.dtype)
     out = _out_like(q, shape=q.shape[:-1] + (v.shape[-1],), requires_grad=rg)
     lse = TensorProxy(shape=q.shape[:-1], device=q.device, dtype=dtypes.float32, requires_grad=False)
@@ -1068,11 +1109,14 @@ def _sdpa_backward_meta(
     v: TensorProxy,
     out: TensorProxy,
     lse: TensorProxy,
+    mask: TensorProxy | None,
     causal: bool,
     scale: float,
 ) -> tuple[TensorProxy, TensorProxy, TensorProxy]:
     for t in (g, q, k, v, out, lse):
         _check_tensor(t)
+    _sdpa_check_gqa(q, k, v)
+    _sdpa_check_mask(mask, q, k)
     dq = _out_like(q, requires_grad=False)
     dk = _out_like(k, requires_grad=False)
     dv = _out_like(v, requires_grad=False)

@@ -659,14 +659,21 @@ def _sdpa_bw(bsym, g_out, g_lse):
     added via the decomposed probability matrix — an O(T²) cost paid only in
     that rare case (e.g. distillation losses over lse).
     """
-    q, k, v, causal, scale = bsym.args
+    q, k, v, mask, causal, scale = bsym.args
     out, lse = bsym.output
     if g_out is None:
         g_out = clang.full_like(out, 0.0)
-    dq, dk, dv = prims.sdpa_backward(g_out, q, k, v, out, lse, causal, scale)
+    dq, dk, dv = prims.sdpa_backward(g_out, q, k, v, out, lse, mask, causal, scale)
     if g_lse is not None:
         # d lse_i/dq_i = scale * sum_j p_ij k_j ; d lse_i/dk_j = scale * p_ij q_i
+        if q.shape[:-2] != k.shape[:-2]:
+            raise NotImplementedError(
+                "differentiating through sdpa's lse output with grouped-query K/V "
+                "is not supported; expand K/V to the query head count first"
+            )
         s = clang.mul(prims.matmul(q, clang.transpose(k, -2, -1)), scale)
+        if mask is not None:
+            s = clang.add(s, mask)
         if causal:
             Tq, Tk = q.shape[-2], k.shape[-2]
             row = clang.arange(0, Tq, device=q.device, dtype=dtypes.int32)

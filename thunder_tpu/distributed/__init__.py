@@ -8,7 +8,14 @@ prims (``thunder_tpu.distributed.prims``) for algorithms that need them
 (ring attention, expert dispatch).
 """
 from thunder_tpu.distributed import prims  # noqa: F401  (registers jax impls)
-from thunder_tpu.distributed.api import TrainStep, ddp, fsdp, make_train_step, tp_fsdp
+from thunder_tpu.distributed.api import (
+    TrainStep,
+    combine_threshold_options,
+    ddp,
+    fsdp,
+    make_train_step,
+    tp_fsdp,
+)
 from thunder_tpu.distributed.checkpoint import (
     StateDictOptions,
     full_state_dict,
@@ -16,7 +23,7 @@ from thunder_tpu.distributed.checkpoint import (
     load_checkpoint,
     save_checkpoint,
 )
-from thunder_tpu.distributed.moe import ep_moe_mlp, expert_capacity
+from thunder_tpu.distributed.moe import ep_gpt_loss, ep_moe_mlp, expert_capacity
 from thunder_tpu.distributed.multihost import hybrid_mesh, initialize as initialize_multihost
 from thunder_tpu.distributed.pipeline import (
     gpipe,
@@ -43,6 +50,7 @@ __all__ = [
     "fsdp",
     "tp_fsdp",
     "make_train_step",
+    "combine_threshold_options",
     "DistributedReduceOps",
     "ShardingRules",
     "apply_shardings",
@@ -62,6 +70,7 @@ __all__ = [
     "sp_gpt_loss",
     "ring_self_attention",
     "ep_moe_mlp",
+    "ep_gpt_loss",
     "expert_capacity",
     "gpipe",
     "hybrid_mesh",

@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-__all__ = ["ep_moe_mlp", "expert_capacity"]
+__all__ = ["ep_moe_mlp", "ep_gpt_loss", "expert_capacity"]
 
 
 def expert_capacity(tokens_per_device: int, n_expert: int, k: int, capacity_factor: float) -> int:
@@ -130,3 +130,59 @@ def ep_moe_mlp(
         check_vma=False,
     )
     return fn(x, mp["gate"], mp["fc_1"], mp["fc_2"], mp["proj"])
+
+
+def ep_gpt_loss(params, idx, targets, cos, sin, cfg, *, mesh: Mesh, axis: str = "ep",
+                capacity_factor: float = 4.0):
+    """Full MoE-model next-token loss with every MoE MLP dispatched
+    expert-parallel over ``mesh[axis]`` (all_to_all token exchange).
+
+    The Mixtral-style training step the reference cannot express (its MoE
+    models run unsharded, SURVEY §2.6): dense layers (attention, norms, the
+    head) compute on the batch-sharded activations via XLA SPMD; the MoE MLP
+    routes through ``ep_moe_mlp``.  Math mirrors ``models.llama.gpt_loss``
+    up to capacity drops (use a generous ``capacity_factor`` to compare).
+    ``B % ep == 0`` required.
+    """
+    from thunder_tpu.models.generate import _norm, _project_qkv
+
+    assert cfg.mlp_class == "LLaMAMoE", "ep_gpt_loss is for MoE configs"
+    B, T = idx.shape
+    hs = cfg.head_size
+
+    def dense_attn(ap, x):
+        q, k, v = _project_qkv(ap, x, cos, sin, cfg)  # (B, nh|ng, T, hs)
+        if cfg.n_query_groups != cfg.n_head:
+            rep = cfg.n_head // cfg.n_query_groups
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) / (hs ** 0.5)
+        s = jnp.where(jnp.tril(jnp.ones((T, T), dtype=bool)), s, -jnp.inf)
+        y = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1).astype(q.dtype), v)
+        y = y.transpose(0, 2, 1, 3).reshape(B, T, cfg.n_head * hs)
+        return y @ ap["wo"].T
+
+    x = params["wte"][idx]
+    for bp in params["blocks"]:
+        n1 = _norm(x, bp["norm_1"], cfg)
+        h = dense_attn(bp["attn"], n1)
+        if cfg.parallel_residual:
+            n2 = n1 if cfg.shared_attention_norm else _norm(x, bp["norm_2"], cfg)
+            x = x + h + ep_moe_mlp(
+                bp["mlp"], n2, mesh=mesh, n_expert=cfg.n_expert,
+                n_expert_per_token=cfg.n_expert_per_token, axis=axis,
+                capacity_factor=capacity_factor,
+            )
+        else:
+            x = x + h
+            x = x + ep_moe_mlp(
+                bp["mlp"], _norm(x, bp["norm_2"], cfg), mesh=mesh,
+                n_expert=cfg.n_expert, n_expert_per_token=cfg.n_expert_per_token,
+                axis=axis, capacity_factor=capacity_factor,
+            )
+    x = _norm(x, params["ln_f"], cfg)
+    head = params["wte"] if cfg.tie_embeddings else params["lm_head"]
+    logits = (x @ head.T).astype(jnp.float32)
+    V = logits.shape[-1]
+    logp = jax.nn.log_softmax(logits.reshape(-1, V), axis=-1)
+    return -jnp.take_along_axis(logp, targets.reshape(-1, 1), axis=1).mean()

@@ -485,19 +485,31 @@ def _convolution_impl(a, weight, bias, stride, padding, dilation, transposed, ou
 # these hooks so every execution path — claimed traces, XLA fusion regions,
 # and the distributed TrainStep's trace evaluation — dispatches to them when
 # the shapes/backend qualify.
-_sdpa_fast_path: Callable | None = None  # (q, k, v, causal, scale) -> (out, lse) or None
+_sdpa_fast_path: Callable | None = None  # (q, k, v, mask, causal, scale) -> (out, lse) or None
 _sdpa_bwd_fast_path: Callable | None = None
 
 
-def _sdpa_reference(q, k, v, causal, scale):
+def _gqa_expand(q, k, v):
+    """Expand grouped K/V heads to q's head count for the decomposed path
+    (the fused kernels index groups natively instead — pallasex.py)."""
+    if q.shape[:-2] == k.shape[:-2]:
+        return k, v, 1
+    rep = q.shape[-3] // k.shape[-3]
+    return jnp.repeat(k, rep, axis=-3), jnp.repeat(v, rep, axis=-3), rep
+
+
+def _sdpa_reference(q, k, v, mask, causal, scale):
+    k, v, _ = _gqa_expand(q, k, v)
     s = jnp.einsum("...qd,...kd->...qk", q, k, preferred_element_type=jnp.float32)
     s = s * scale
+    if mask is not None:
+        s = s + mask.astype(jnp.float32)
     if causal:
         # top-left alignment (query i attends keys j <= i), matching the
         # torch-level decomposition and the Pallas kernels
         Tq, Tk = q.shape[-2], k.shape[-2]
-        mask = jnp.tril(jnp.ones((Tq, Tk), dtype=bool))
-        s = jnp.where(mask, s, -jnp.inf)
+        cm = jnp.tril(jnp.ones((Tq, Tk), dtype=bool))
+        s = jnp.where(cm, s, -jnp.inf)
     lse = jax.nn.logsumexp(s, axis=-1)
     p = jnp.exp(s - lse[..., None])
     out = jnp.einsum("...qk,...kd->...qd", p.astype(v.dtype), v)
@@ -505,37 +517,44 @@ def _sdpa_reference(q, k, v, causal, scale):
 
 
 @impl(PrimIDs.SDPA)
-def _sdpa_impl(q, k, v, causal, scale):
+def _sdpa_impl(q, k, v, mask, causal, scale):
     if _sdpa_fast_path is not None:
-        res = _sdpa_fast_path(q, k, v, causal, scale)
+        res = _sdpa_fast_path(q, k, v, mask, causal, scale)
         if res is not None:
             return res
-    return _sdpa_reference(q, k, v, causal, scale)
+    return _sdpa_reference(q, k, v, mask, causal, scale)
 
 
-def _sdpa_backward_reference(g, q, k, v, out, lse, causal, scale):
-    s = jnp.einsum("...qd,...kd->...qk", q, k, preferred_element_type=jnp.float32) * scale
+def _sdpa_backward_reference(g, q, k, v, out, lse, mask, causal, scale):
+    kx, vx, rep = _gqa_expand(q, k, v)
+    s = jnp.einsum("...qd,...kd->...qk", q, kx, preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = s + mask.astype(jnp.float32)
     if causal:
-        Tq, Tk = q.shape[-2], k.shape[-2]
-        mask = jnp.tril(jnp.ones((Tq, Tk), dtype=bool))
-        s = jnp.where(mask, s, -jnp.inf)
+        Tq, Tk = q.shape[-2], kx.shape[-2]
+        cm = jnp.tril(jnp.ones((Tq, Tk), dtype=bool))
+        s = jnp.where(cm, s, -jnp.inf)
     p = jnp.exp(s - lse[..., None])  # (..., Tq, Tk) f32
     dv = jnp.einsum("...qk,...qd->...kd", p, g.astype(jnp.float32))
-    dp = jnp.einsum("...qd,...kd->...qk", g, v, preferred_element_type=jnp.float32)
+    dp = jnp.einsum("...qd,...kd->...qk", g, vx, preferred_element_type=jnp.float32)
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True)
     ds = p * (dp - delta) * scale
-    dq = jnp.einsum("...qk,...kd->...qd", ds, k.astype(jnp.float32))
+    dq = jnp.einsum("...qk,...kd->...qd", ds, kx.astype(jnp.float32))
     dk = jnp.einsum("...qk,...qd->...kd", ds, q.astype(jnp.float32))
+    if rep > 1:  # sum the expanded-head grads back onto the shared KV groups
+        G = k.shape[-3]
+        dk = dk.reshape(*dk.shape[:-3], G, rep, *dk.shape[-2:]).sum(axis=-3)
+        dv = dv.reshape(*dv.shape[:-3], G, rep, *dv.shape[-2:]).sum(axis=-3)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 @impl(PrimIDs.SDPA_BACKWARD)
-def _sdpa_backward_impl(g, q, k, v, out, lse, causal, scale):
+def _sdpa_backward_impl(g, q, k, v, out, lse, mask, causal, scale):
     if _sdpa_bwd_fast_path is not None:
-        res = _sdpa_bwd_fast_path(g, q, k, v, out, lse, causal, scale)
+        res = _sdpa_bwd_fast_path(g, q, k, v, out, lse, mask, causal, scale)
         if res is not None:
             return res
-    return _sdpa_backward_reference(g, q, k, v, out, lse, causal, scale)
+    return _sdpa_backward_reference(g, q, k, v, out, lse, mask, causal, scale)
 
 
 _ce_fast_path: Callable | None = None  # installed by pallasex (fused CE kernel)

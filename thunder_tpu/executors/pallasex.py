@@ -99,10 +99,73 @@ def _pad128(hs: int) -> int:
     return -(-hs // 128) * 128
 
 
-def _supported(q_shape, k_shape, v_shape, dtype, causal) -> bool:
+def _gqa_rep(q_shape, k_shape) -> int | None:
+    """Heads-per-KV-group, or None if the shapes aren't kernel-compatible.
+
+    1 = plain MHA.  GQA (q ``(..., H, Tq, hs)``, k/v ``(..., G, Tk, hs)``)
+    is handled natively: the kernels' K/V BlockSpec index maps gather the
+    group's block for each q head, so K/V are never expanded in HBM —
+    the H/G× KV-bandwidth saving is the point of GQA (reference leans on
+    aten's enable_gqa, sdpaex.py:240)."""
+    if q_shape[:-2] == k_shape[:-2]:
+        return 1
+    if len(q_shape) < 3 or q_shape[:-3] != k_shape[:-3]:
+        return None
+    H, G = q_shape[-3], k_shape[-3]
+    if G <= 0 or H % G != 0:
+        return None
+    return H // G
+
+
+def _canon_mask(mask_shape, q_shape, k_shape):
+    """Classify an additive mask for blockwise loading.
+
+    Returns ``(mode, mq)`` — mode names how the mask's (flattened) leading
+    dim indexes against the kernel's flat batch×head grid axis — or None if
+    the layout isn't expressible as a BlockSpec index map:
+
+    - ``shared``: broadcast over all batch dims (e.g. a (Tq, Tk) ALiBi bias)
+    - ``batch``: per-batch, head-broadcast — the HF padding-mask layout
+      (B, 1, 1|Tq, Tk); index = flat // H
+    - ``head``: per-head, batch-broadcast (1, H, ., .); index = flat % H
+    - ``full``: every batch×head has its own slice; index = flat
+
+    ``mq`` is 1 (row-broadcast: the whole mask is O(Tk) per batch — padding
+    masks stay O(T) in HBM) or Tq.
+    """
+    *qb, Tq, _ = q_shape
+    Tk = k_shape[-2]
+    if len(mask_shape) > len(qb) + 2:
+        return None
+    ms = (1,) * (len(qb) + 2 - len(mask_shape)) + tuple(mask_shape)
+    if ms[-1] != Tk:
+        return None
+    mq = ms[-2]
+    if mq not in (1, Tq):
+        return None
+    mb = ms[:-2]
+    for md, qd in zip(mb, qb):
+        if md not in (1, qd):
+            return None
+    if all(md == 1 for md in mb):
+        return ("shared", mq)
+    if all(md == qd for md, qd in zip(mb, qb)):
+        return ("full", mq)
+    if len(mb) == 2 and mb[1] == 1:
+        return ("batch", mq)
+    if len(mb) == 2 and mb[0] == 1:
+        return ("head", mq)
+    return None
+
+
+def _supported(q_shape, k_shape, v_shape, dtype, causal, mask_shape=None) -> bool:
     *_, Tq, hs = q_shape
     Tk = k_shape[-2]
     if v_shape[-1] != hs:  # kernels assume one head dim for q/k/v
+        return False
+    if _gqa_rep(q_shape, k_shape) is None:
+        return False
+    if k_shape[:-2] != v_shape[:-2]:
         return False
     # head sizes that aren't lane-aligned (e.g. 64) run zero-padded to 128
     if _pad128(hs) > 512:
@@ -114,6 +177,8 @@ def _supported(q_shape, k_shape, v_shape, dtype, causal) -> bool:
     # full K and V blocks + f32 accumulators must fit VMEM comfortably
     if str(dtype) not in ("bfloat16", "float32"):
         return False
+    if mask_shape is not None and _canon_mask(mask_shape, q_shape, k_shape) is None:
+        return False
     return True
 
 
@@ -122,7 +187,12 @@ def _supported(q_shape, k_shape, v_shape, dtype, causal) -> bool:
 #
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *, BQ, BK, causal, scale):
+def _fwd_kernel(*refs, BQ, BK, causal, scale, has_mask):
+    if has_mask:
+        q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, m_s, l_s, acc_s = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s = refs
+        mask_ref = None
     i = pl.program_id(1)
     j = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -144,6 +214,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *, BQ, BK,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # (BQ, BK)
+        if has_mask:
+            s = s + mask_ref[0].astype(jnp.float32)  # (1|BQ, BK) broadcasts
         if causal:
             row = i * BQ + jax.lax.broadcasted_iota(jnp.int32, (BQ, BK), 0)
             col = j * BK + jax.lax.broadcasted_iota(jnp.int32, (BQ, BK), 1)
@@ -164,28 +236,63 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *, BQ, BK,
         lse_ref[0] = m_s[...] + jnp.log(l_s[...])
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "scale"))
-def _flash_fwd(q, k, v, causal: bool, scale: float):
-    """q/k/v: (BH, T, hs) -> out (BH, Tq, hs), lse (BH, Tq, 1) f32."""
+def _kv_index(H: int, G: int):
+    """K/V BlockSpec head gather: flat q index ``b*H + h`` reads KV group
+    ``h // (H//G)`` — GQA without expanding K/V in HBM (rep=1 ⇒ identity)."""
+    rep = H // G
+
+    def index(b, i, j):
+        return ((b // H) * G + (b % H) // rep, j, 0)
+
+    return index
+
+
+def _mask_index(mode: str, H: int, mq_blocked: bool):
+    """Mask BlockSpec index map for the canonical (M, mq, Tk) layout."""
+
+    def index(b, i, j):
+        m = {"shared": 0, "batch": b // H, "head": b % H, "full": b}[mode]
+        return (m, i if mq_blocked else 0, j)
+
+    return index
+
+
+def _mask_spec(mode: str, mq: int, H: int, BQ: int, BK: int):
+    blk = (1, BQ if mq > 1 else 1, BK)
+    return pl.BlockSpec(blk, _mask_index(mode, H, mq > 1))
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "H", "G", "mode", "mq"))
+def _flash_fwd(q, k, v, mask, causal: bool, scale: float, H: int, G: int, mode: str | None, mq: int):
+    """q (BH, Tq, hs), k/v (BG, Tk, hs), mask (M, mq, Tk) f32 or None
+    -> out (BH, Tq, hs), lse (BH, Tq, 1) f32.  ``H``/``G`` are the per-shard
+    q/KV head counts (the flat-batch gather key for GQA); ``mode``/``mq``
+    classify the mask layout (see _canon_mask)."""
     BH, Tq, hs = q.shape
     Tk = k.shape[1]
     BQ, BK = _block(Tq), _block(Tk)
     grid = (BH, Tq // BQ, Tk // BK)
+    has_mask = mask is not None
 
-    kernel = functools.partial(_fwd_kernel, BQ=BQ, BK=BK, causal=causal, scale=scale)
+    kernel = functools.partial(_fwd_kernel, BQ=BQ, BK=BK, causal=causal, scale=scale, has_mask=has_mask)
     params = {}
     if pltpu is not None and not _interpret():
         params["compiler_params"] = pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         )
+    in_specs = [
+        pl.BlockSpec((1, BQ, hs), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, BK, hs), _kv_index(H, G)),
+        pl.BlockSpec((1, BK, hs), _kv_index(H, G)),
+    ]
+    operands = [q, k, v]
+    if has_mask:
+        in_specs.append(_mask_spec(mode, mq, H, BQ, BK))
+        operands.append(mask)
     return pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, BQ, hs), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, BK, hs), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, BK, hs), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, BQ, hs), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, BQ, 1), lambda b, i, j: (b, i, 0)),
@@ -201,7 +308,7 @@ def _flash_fwd(q, k, v, causal: bool, scale: float):
         ],
         interpret=_interpret(),
         **params,
-    )(q, k, v)
+    )(*operands)
 
 
 #
@@ -209,7 +316,12 @@ def _flash_fwd(q, k, v, causal: bool, scale: float):
 #
 
 
-def _bwd_dq_kernel(g_ref, q_ref, k_ref, v_ref, lse_ref, delta_ref, dq_ref, dq_s, *, BQ, BK, causal, scale):
+def _bwd_dq_kernel(*refs, BQ, BK, causal, scale, has_mask):
+    if has_mask:
+        g_ref, q_ref, k_ref, v_ref, lse_ref, delta_ref, mask_ref, dq_ref, dq_s = refs
+    else:
+        g_ref, q_ref, k_ref, v_ref, lse_ref, delta_ref, dq_ref, dq_s = refs
+        mask_ref = None
     i = pl.program_id(1)
     j = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -231,6 +343,8 @@ def _bwd_dq_kernel(g_ref, q_ref, k_ref, v_ref, lse_ref, delta_ref, dq_ref, dq_s,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
+        if has_mask:
+            s = s + mask_ref[0].astype(jnp.float32)
         p = jnp.exp(s - lse)  # (BQ, BK)
         if causal:
             row = i * BQ + jax.lax.broadcasted_iota(jnp.int32, (BQ, BK), 0)
@@ -249,7 +363,12 @@ def _bwd_dq_kernel(g_ref, q_ref, k_ref, v_ref, lse_ref, delta_ref, dq_ref, dq_s,
         dq_ref[0] = dq_s[...].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(g_ref, q_ref, k_ref, v_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_s, dv_s, *, BQ, BK, causal, scale):
+def _bwd_dkv_kernel(*refs, BQ, BK, causal, scale, has_mask):
+    if has_mask:
+        g_ref, q_ref, k_ref, v_ref, lse_ref, delta_ref, mask_ref, dk_ref, dv_ref, dk_s, dv_s = refs
+    else:
+        g_ref, q_ref, k_ref, v_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_s, dv_s = refs
+        mask_ref = None
     jk = pl.program_id(1)
     iq = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -272,6 +391,8 @@ def _bwd_dkv_kernel(g_ref, q_ref, k_ref, v_ref, lse_ref, delta_ref, dk_ref, dv_r
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # (BQ, BK)
+        if has_mask:
+            s = s + mask_ref[0].astype(jnp.float32)
         p = jnp.exp(s - lse)
         if causal:
             row = iq * BQ + jax.lax.broadcasted_iota(jnp.int32, (BQ, BK), 0)
@@ -295,12 +416,20 @@ def _bwd_dkv_kernel(g_ref, q_ref, k_ref, v_ref, lse_ref, delta_ref, dk_ref, dv_r
         dv_ref[0] = dv_s[...].astype(dv_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "scale"))
-def _flash_bwd(g, q, k, v, out, lse, causal: bool, scale: float):
-    """All of (BH, T, hs) except lse (BH, Tq, 1); returns (dq, dk, dv)."""
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "H", "G", "mode", "mq"))
+def _flash_bwd(g, q, k, v, out, lse, mask, causal: bool, scale: float, H: int, G: int, mode: str | None, mq: int):
+    """g/q/out (BH, Tq, hs), k/v (BG, Tk, hs), lse (BH, Tq, 1);
+    returns (dq (BH,...), dk, dv (BG,...)).
+
+    GQA: the kernels run over the expanded (BH) grid with K/V gathered by
+    index map; dk/dv come out per-q-head and are reduced over each group's
+    ``rep`` heads by XLA afterwards (one cheap (BG, rep) sum — the scores
+    recompute itself stays group-shared-K/V, which is the bandwidth win)."""
     BH, Tq, hs = q.shape
-    Tk = k.shape[1]
+    BG, Tk, _ = k.shape
     BQ, BK = _block(Tq), _block(Tk)
+    rep = H // G
+    has_mask = mask is not None
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True)
 
     params = {}
@@ -309,35 +438,52 @@ def _flash_bwd(g, q, k, v, out, lse, causal: bool, scale: float):
             dimension_semantics=("parallel", "parallel", "arbitrary")
         )
 
+    dq_in_specs = [
+        pl.BlockSpec((1, BQ, hs), lambda b, i, j: (b, i, 0)),  # g
+        pl.BlockSpec((1, BQ, hs), lambda b, i, j: (b, i, 0)),  # q
+        pl.BlockSpec((1, BK, hs), _kv_index(H, G)),  # k
+        pl.BlockSpec((1, BK, hs), _kv_index(H, G)),  # v
+        pl.BlockSpec((1, BQ, 1), lambda b, i, j: (b, i, 0)),  # lse
+        pl.BlockSpec((1, BQ, 1), lambda b, i, j: (b, i, 0)),  # delta
+    ]
+    dq_operands = [g, q, k, v, lse, delta]
+    if has_mask:
+        dq_in_specs.append(_mask_spec(mode, mq, H, BQ, BK))
+        dq_operands.append(mask)
+
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, BQ=BQ, BK=BK, causal=causal, scale=scale),
+        functools.partial(_bwd_dq_kernel, BQ=BQ, BK=BK, causal=causal, scale=scale, has_mask=has_mask),
         grid=(BH, Tq // BQ, Tk // BK),
-        in_specs=[
-            pl.BlockSpec((1, BQ, hs), lambda b, i, j: (b, i, 0)),  # g
-            pl.BlockSpec((1, BQ, hs), lambda b, i, j: (b, i, 0)),  # q
-            pl.BlockSpec((1, BK, hs), lambda b, i, j: (b, j, 0)),  # k
-            pl.BlockSpec((1, BK, hs), lambda b, i, j: (b, j, 0)),  # v
-            pl.BlockSpec((1, BQ, 1), lambda b, i, j: (b, i, 0)),  # lse
-            pl.BlockSpec((1, BQ, 1), lambda b, i, j: (b, i, 0)),  # delta
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, BQ, hs), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, Tq, hs), q.dtype),
         scratch_shapes=[pltpu.VMEM((BQ, hs), jnp.float32) if pltpu is not None else None],
         interpret=_interpret(),
         **params,
-    )(g, q, k, v, lse, delta)
+    )(*dq_operands)
+
+    # the dkv grid swaps (i, j): index-map arg order is (b, j, i)
+    kv_idx = _kv_index(H, G)
+    dkv_in_specs = [
+        pl.BlockSpec((1, BQ, hs), lambda b, j, i: (b, i, 0)),  # g
+        pl.BlockSpec((1, BQ, hs), lambda b, j, i: (b, i, 0)),  # q
+        pl.BlockSpec((1, BK, hs), lambda b, j, i: kv_idx(b, i, j)),  # k
+        pl.BlockSpec((1, BK, hs), lambda b, j, i: kv_idx(b, i, j)),  # v
+        pl.BlockSpec((1, BQ, 1), lambda b, j, i: (b, i, 0)),  # lse
+        pl.BlockSpec((1, BQ, 1), lambda b, j, i: (b, i, 0)),  # delta
+    ]
+    dkv_operands = [g, q, k, v, lse, delta]
+    if has_mask:
+        midx = _mask_index(mode, H, mq > 1)
+        dkv_in_specs.append(
+            pl.BlockSpec((1, BQ if mq > 1 else 1, BK), lambda b, j, i: midx(b, i, j))
+        )
+        dkv_operands.append(mask)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, BQ=BQ, BK=BK, causal=causal, scale=scale),
+        functools.partial(_bwd_dkv_kernel, BQ=BQ, BK=BK, causal=causal, scale=scale, has_mask=has_mask),
         grid=(BH, Tk // BK, Tq // BQ),
-        in_specs=[
-            pl.BlockSpec((1, BQ, hs), lambda b, j, i: (b, i, 0)),  # g
-            pl.BlockSpec((1, BQ, hs), lambda b, j, i: (b, i, 0)),  # q
-            pl.BlockSpec((1, BK, hs), lambda b, j, i: (b, j, 0)),  # k
-            pl.BlockSpec((1, BK, hs), lambda b, j, i: (b, j, 0)),  # v
-            pl.BlockSpec((1, BQ, 1), lambda b, j, i: (b, i, 0)),  # lse
-            pl.BlockSpec((1, BQ, 1), lambda b, j, i: (b, i, 0)),  # delta
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, BK, hs), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, BK, hs), lambda b, j, i: (b, j, 0)),
@@ -352,7 +498,11 @@ def _flash_bwd(g, q, k, v, out, lse, causal: bool, scale: float):
         ],
         interpret=_interpret(),
         **params,
-    )(g, q, k, v, lse, delta)
+    )(*dkv_operands)
+    if rep > 1:
+        # flat q-head order is (b, g, r): fold rep into the group dim and sum
+        dk = dk.reshape(BG, rep, Tk, hs).astype(jnp.float32).sum(axis=1).astype(k.dtype)
+        dv = dv.reshape(BG, rep, Tk, hs).astype(jnp.float32).sum(axis=1).astype(v.dtype)
     return dq, dk, dv
 
 
@@ -368,35 +518,63 @@ def _pad_hs(x, hs, hp):
     return jnp.pad(x, widths)
 
 
-def _fwd_local(q, k, v, causal: bool, scale: float):
-    """Single-device forward on concrete arrays: flatten batch, pad hs, run."""
+def _local_geometry(q_shape, k_shape):
+    """(BH, BG, H, G) for the flat-batch kernel grid, from LOCAL (per-shard)
+    shapes — so head counts stay correct under tp sharding inside shard_map."""
+    *qb, _, _ = q_shape
+    *kb, _, _ = k_shape
+    BH = 1
+    for b in qb:
+        BH *= b
+    BG = 1
+    for b in kb:
+        BG *= b
+    H = q_shape[-3] if len(q_shape) >= 3 else 1
+    G = k_shape[-3] if len(k_shape) >= 3 else 1
+    return BH, BG, H, G
+
+
+def _canon_mask_operand(mask, q_shape, k_shape):
+    """Canonicalize an additive mask to the kernels' (M, mq, Tk) f32 layout.
+    Returns (mask3, mode, mq); (None, None, 1) when mask is None."""
+    if mask is None:
+        return None, None, 1
+    mode, mq = _canon_mask(mask.shape, q_shape, k_shape)
+    Tk = k_shape[-2]
+    # broadcast dims are all 1, so the canonical form is a plain reshape
+    return mask.reshape(-1, mq, Tk).astype(jnp.float32), mode, mq
+
+
+def _fwd_local(q, k, v, mask, causal: bool, scale: float):
+    """Single-device forward on concrete arrays: flatten batch, pad hs, run.
+    ``mask`` is the original-rank additive mask or None."""
     *batch, Tq, hs = q.shape
     Tk = k.shape[-2]
     hp = _pad128(hs)
-    BH = 1
-    for b in batch:
-        BH *= b
+    BH, BG, H, G = _local_geometry(q.shape, k.shape)
+    mask3, mode, mq = _canon_mask_operand(mask, q.shape, k.shape)
     out, lse = _flash_fwd(
         _pad_hs(q.reshape(BH, Tq, hs), hs, hp),
-        _pad_hs(k.reshape(BH, Tk, hs), hs, hp),
-        _pad_hs(v.reshape(BH, Tk, hs), hs, hp),
-        bool(causal), float(scale),
+        _pad_hs(k.reshape(BG, Tk, hs), hs, hp),
+        _pad_hs(v.reshape(BG, Tk, hs), hs, hp),
+        mask3,
+        bool(causal), float(scale), H, G, mode, mq,
     )
     return out[..., :hs].reshape(*batch, Tq, hs), lse.reshape(*batch, Tq)
 
 
-def _bwd_local(g, q, k, v, out, lse, causal: bool, scale: float):
+def _bwd_local(g, q, k, v, out, lse, mask, causal: bool, scale: float):
     *batch, Tq, hs = q.shape
     Tk = k.shape[-2]
     hp = _pad128(hs)
-    BH = 1
-    for b in batch:
-        BH *= b
-    r3 = lambda x, T: _pad_hs(x.reshape(BH, T, hs), hs, hp)
+    BH, BG, H, G = _local_geometry(q.shape, k.shape)
+    mask3, mode, mq = _canon_mask_operand(mask, q.shape, k.shape)
+    r3 = lambda x, T, n: _pad_hs(x.reshape(n, T, hs), hs, hp)
     dq, dk, dv = _flash_bwd(
-        r3(g, Tq), r3(q, Tq), r3(k, Tk), r3(v, Tk), r3(out, Tq),
+        r3(g, Tq, BH), r3(q, Tq, BH), r3(k, Tk, BG), r3(v, Tk, BG), r3(out, Tq, BH),
         lse.reshape(BH, Tq, 1).astype(jnp.float32),
-        bool(causal), float(scale),
+        mask3,
+        bool(causal), float(scale), H, G, mode, mq,
     )
     return (
         dq[..., :hs].reshape(q.shape),
@@ -464,35 +642,76 @@ def _dispatch(local_fn, operands, specs):
     return local_fn(*operands)
 
 
-def flash_sdpa(q, k, v, causal, scale):
+def _mask_shard_spec(mask, q_shape, k_shape, qkv_spec):
+    """PartitionSpec for the mask under a sharded dispatch, or ``False`` when
+    the mask layout can't ride the mesh (per-head masks against tp-sharded
+    heads): the caller then declines and the jnp fallback shards as einsums."""
+    from jax.sharding import PartitionSpec as P
+
+    if mask is None:
+        return None
+    mode, _ = _canon_mask(mask.shape, q_shape, k_shape)
+    if mode == "shared":
+        return P(*(None,) * mask.ndim)
+    if mode == "batch" and mask.ndim == 4 and len(tuple(qkv_spec)) > 0:
+        # HF padding-mask layout (B, 1, 1|Tq, Tk): shard B like q's batch dim
+        return P(tuple(qkv_spec)[0], None, None, None)
+    return False
+
+
+def flash_sdpa(q, k, v, mask, causal, scale):
     """Returns (out, lse) via the flash kernels, or None if unsupported."""
-    if not _enabled() or not _supported(q.shape, k.shape, v.shape, q.dtype, causal):
+    if not _enabled() or not _supported(
+        q.shape, k.shape, v.shape, q.dtype, causal,
+        mask.shape if mask is not None else None,
+    ):
         return None
     from jax.sharding import PartitionSpec as P
 
     mesh = _mesh_var.get()
     spec = _qkv_spec(mesh, q.shape, k.shape) if mesh is not None else P()
     lse_spec = P(*tuple(spec)[:-1])
+    if mask is None:
+        return _dispatch(
+            lambda q, k, v: _fwd_local(q, k, v, None, bool(causal), float(scale)),
+            (q, k, v),
+            (((spec,) * 3), (spec, lse_spec)),
+        )
+    mspec = _mask_shard_spec(mask, q.shape, k.shape, spec)
+    if mspec is False and mesh is not None and mesh.devices.size > 1:
+        return None
     return _dispatch(
-        lambda q, k, v: _fwd_local(q, k, v, bool(causal), float(scale)),
-        (q, k, v),
-        (((spec,) * 3), (spec, lse_spec)),
+        lambda q, k, v, m: _fwd_local(q, k, v, m, bool(causal), float(scale)),
+        (q, k, v, mask),
+        ((spec, spec, spec, mspec), (spec, lse_spec)),
     )
 
 
-def flash_sdpa_backward(g, q, k, v, out, lse, causal, scale):
+def flash_sdpa_backward(g, q, k, v, out, lse, mask, causal, scale):
     """Returns (dq, dk, dv) via the flash kernels, or None if unsupported."""
-    if not _enabled() or not _supported(q.shape, k.shape, v.shape, q.dtype, causal):
+    if not _enabled() or not _supported(
+        q.shape, k.shape, v.shape, q.dtype, causal,
+        mask.shape if mask is not None else None,
+    ):
         return None
     from jax.sharding import PartitionSpec as P
 
     mesh = _mesh_var.get()
     spec = _qkv_spec(mesh, q.shape, k.shape) if mesh is not None else P()
     lse_spec = P(*tuple(spec)[:-1])
+    if mask is None:
+        return _dispatch(
+            lambda g, q, k, v, out, lse: _bwd_local(g, q, k, v, out, lse, None, bool(causal), float(scale)),
+            (g, q, k, v, out, lse),
+            ((spec, spec, spec, spec, spec, lse_spec), (spec, spec, spec)),
+        )
+    mspec = _mask_shard_spec(mask, q.shape, k.shape, spec)
+    if mspec is False and mesh is not None and mesh.devices.size > 1:
+        return None
     return _dispatch(
-        lambda g, q, k, v, out, lse: _bwd_local(g, q, k, v, out, lse, bool(causal), float(scale)),
-        (g, q, k, v, out, lse),
-        ((spec, spec, spec, spec, spec, lse_spec), (spec, spec, spec)),
+        lambda g, q, k, v, out, lse, m: _bwd_local(g, q, k, v, out, lse, m, bool(causal), float(scale)),
+        (g, q, k, v, out, lse, mask),
+        ((spec, spec, spec, spec, spec, lse_spec, mspec), (spec, spec, spec)),
     )
 
 
@@ -501,21 +720,21 @@ def flash_sdpa_backward(g, q, k, v, out, lse, causal, scale):
 #
 
 
-def _sdpa_full(q, k, v, causal, scale):
-    res = flash_sdpa(q, k, v, causal, scale)
+def _sdpa_full(q, k, v, mask, causal, scale):
+    res = flash_sdpa(q, k, v, mask, causal, scale)
     if res is None:  # checker raced with env change: stay correct
         from thunder_tpu.executors.jaxex import _sdpa_reference
 
-        return _sdpa_reference(q, k, v, causal, scale)
+        return _sdpa_reference(q, k, v, mask, causal, scale)
     return res
 
 
-def _sdpa_backward_full(g, q, k, v, out, lse, causal, scale):
-    res = flash_sdpa_backward(g, q, k, v, out, lse, causal, scale)
+def _sdpa_backward_full(g, q, k, v, out, lse, mask, causal, scale):
+    res = flash_sdpa_backward(g, q, k, v, out, lse, mask, causal, scale)
     if res is None:
         from thunder_tpu.executors.jaxex import _sdpa_backward_reference
 
-        return _sdpa_backward_reference(g, q, k, v, out, lse, causal, scale)
+        return _sdpa_backward_reference(g, q, k, v, out, lse, mask, causal, scale)
     return res
 
 
@@ -528,12 +747,18 @@ _sdpa_bwd_op = ex.register_operator(
 )
 
 
-def _sdpa_checker(q, k, v, causal, scale):
-    return _enabled() and _supported(q.shape, k.shape, v.shape, q.dtype, causal)
+def _sdpa_checker(q, k, v, mask, causal, scale):
+    return _enabled() and _supported(
+        q.shape, k.shape, v.shape, q.dtype, causal,
+        mask.shape if mask is not None else None,
+    )
 
 
-def _sdpa_bwd_checker(g, q, k, v, out, lse, causal, scale):
-    return _enabled() and _supported(q.shape, k.shape, v.shape, q.dtype, causal)
+def _sdpa_bwd_checker(g, q, k, v, out, lse, mask, causal, scale):
+    return _enabled() and _supported(
+        q.shape, k.shape, v.shape, q.dtype, causal,
+        mask.shape if mask is not None else None,
+    )
 
 
 ex.register_implementation(PrimIDs.SDPA, _sdpa_op, checker=_sdpa_checker)

@@ -959,17 +959,45 @@ def _convnd(a, weight, bias, stride, padding, dilation, groups, n):
 
 
 @torchsymbol(_tfn("nn", "functional", "scaled_dot_product_attention"))
-def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None):
+def scaled_dot_product_attention(
+    query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None, enable_gqa=False
+):
     """SDPA decomposition; the Pallas executor claims this whole symbol with a
-    flash-attention kernel (analog of reference sdpaex/cudnnex claiming)."""
+    flash-attention kernel (analog of reference sdpaex/cudnnex claiming).
+
+    Masked (bool or additive-float ``attn_mask``) and grouped-query
+    (``enable_gqa`` / fewer K/V heads) calls route through the fused prim too
+    — boolean masks are canonicalized to an additive float bias first, so HF
+    padding-mask models keep O(T) attention residuals (reference checker
+    matrix: sdpaex.py:240-474).  Only dropout and mask-needs-grad fall back
+    to the explicit decomposition.
+    """
     d = query.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
-    # fast path: the fused SDPA prim (flash-attention kernels claim it; the
-    # jax executor provides the decomposed fallback).  Mask/dropout variants
-    # take the explicit decomposition below
-    if attn_mask is None and dropout_p == 0.0 and query.shape[:-2] == key.shape[:-2] == value.shape[:-2]:
-        out, _lse = prims.sdpa(query, key, value, bool(is_causal), float(scale))
+    gqa_ok = query.shape[:-2] == key.shape[:-2] == value.shape[:-2] or (
+        query.ndim >= 3
+        and key.shape[:-2] == value.shape[:-2]
+        and query.shape[:-3] == key.shape[:-3]
+        and key.shape[-3] != 0
+        and query.shape[-3] % key.shape[-3] == 0
+    )
+    mask_ok = attn_mask is None or not getattr(attn_mask, "requires_grad", False)
+    if dropout_p == 0.0 and gqa_ok and mask_ok:
+        mask = attn_mask
+        if mask is not None and dtypes.is_boolean_dtype(mask.dtype):
+            # additive form: 0 where attended, a large-negative (not -inf:
+            # exp(finite - lse) underflows to 0 without the inf-inf NaN) where
+            # masked — matches the kernels' _MASK_VALUE convention
+            zeros = clang.full_like(mask, 0.0, dtype=dtypes.float32)
+            mask = clang.where(mask, zeros, -0.7 * 3.4028235e38)  # -0.7 * f32 max
+        elif mask is not None:
+            mask = clang.maybe_convert_to_dtype(mask, dtypes.float32)
+        out, _lse = prims.sdpa(query, key, value, mask, bool(is_causal), float(scale))
         return out
+    if enable_gqa and query.shape[-3] != key.shape[-3]:
+        rep = query.shape[-3] // key.shape[-3]
+        key = repeat_interleave(key, rep, dim=-3)
+        value = repeat_interleave(value, rep, dim=-3)
     q = clang.mul(query, scale)
     kt = clang.transpose(key, -2, -1)
     scores = clang.matmul(q, kt)

@@ -127,6 +127,12 @@ class ThunderTracingMode(torch.overrides.TorchFunctionMode):
                 setattr(torch, name, self._finfo_shim(orig))
             cls._patches.append((torch, "tensor", torch.tensor))
             torch.tensor = self._tensor_shim(torch.tensor)
+            # HF mask utils guard data-dependent branches ("skip the mask if
+            # torch.all(mask == 1)") behind torch.jit.is_tracing(); answer
+            # True so they take the tracing-safe path instead of forcing a
+            # TensorProxy into Python bool (modeling_attn_mask_utils.py:454)
+            cls._patches.append((torch.jit, "is_tracing", torch.jit.is_tracing))
+            torch.jit.is_tracing = lambda: True
         cls._patch_depth += 1
         return super().__enter__()
 

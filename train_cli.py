@@ -16,8 +16,12 @@ Examples::
 
 Modes map to the distributed API: ``none`` (single device), ``ddp``,
 ``fsdp`` (ZeRO-2), ``zero3`` (regather-in-backward), ``tp_fsdp``
-(megatron rules x dim-0 shards).  Prints per-step timings and a final JSON
-summary line.
+(megatron rules x dim-0 shards), ``sp`` (ring-attention sequence
+parallelism), ``pp`` (GPipe pipeline), ``ep`` (expert-parallel MoE
+all_to_all; MoE configs only).  ``--quant int8`` runs forward GEMMs
+dynamically int8-quantized with bf16/f32 grads (the TE-executor training
+contract, reference transformer_engineex.py:183).  Prints per-step timings
+and a final JSON summary line.
 """
 from __future__ import annotations
 
@@ -34,7 +38,12 @@ def log(*a):
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("--config", default="tiny-llama-debug", help="model config name (models/llama.py zoo)")
-    ap.add_argument("--mode", default="none", choices=["none", "ddp", "fsdp", "zero3", "tp_fsdp"])
+    ap.add_argument("--mode", default="none",
+                    choices=["none", "ddp", "fsdp", "zero3", "tp_fsdp", "sp", "pp", "ep"])
+    ap.add_argument("--quant", default=None, choices=["int8"],
+                    help="quantized training: int8 forward GEMMs, full-precision grads")
+    ap.add_argument("--comm-combine-mb", type=float, default=None,
+                    help="XLA collective-combining threshold in MiB (the bucket_size_in_mb analog)")
     ap.add_argument("--devices", type=int, default=1)
     ap.add_argument("--virtual-cpu", action="store_true", help="force N virtual CPU devices (no hardware needed)")
     ap.add_argument("--batch", type=int, default=8)
@@ -71,38 +80,91 @@ def main(argv=None):
         f"params={llama.param_count(params)/1e6:.1f}M B={args.batch} T={T} "
         f"mode={args.mode} devices={args.devices} dtype={args.dtype}")
 
-    if args.mode == "none":
-        mesh = dist.make_mesh({"dp": 1}, devices=devices[:1])
-        params = dist.ddp(params, mesh)
-    elif args.mode == "ddp":
-        mesh = dist.make_mesh({"dp": args.devices}, devices=devices)
-        params = dist.ddp(params, mesh)
-    elif args.mode in ("fsdp", "zero3"):
-        mesh = dist.make_mesh({"fsdp": args.devices}, devices=devices)
-        params = dist.fsdp(params, mesh)
-    else:  # tp_fsdp
-        tp = 2 if args.devices % 2 == 0 else 1
-        mesh = dist.make_mesh({"fsdp": args.devices // tp, "tp": tp}, devices=devices)
-        params = dist.tp_fsdp(params, mesh)
-
-    def loss_fn(p, i, t, c, s):
-        return llama.gpt_loss(p, i, t, c, s, cfg)
-
-    step = dist.make_train_step(
-        loss_fn, optax.adamw(args.lr), mesh,
-        remat=not args.no_remat, zero3=(args.mode == "zero3"),
-    )
-    opt_state = step.init_optimizer_state(params)
-
     idx = jax.random.randint(jax.random.PRNGKey(1), (args.batch, T), 0, cfg.vocab_size)
     tgt = jax.random.randint(jax.random.PRNGKey(2), (args.batch, T), 0, cfg.vocab_size)
     cos, sin = llama.build_rope_cache(cfg, T)
+    optimizer = optax.adamw(args.lr)
+
+    if args.mode in ("sp", "pp", "ep"):
+        assert args.quant is None, "--quant needs a TrainStep mode (not sp/pp/ep)"
+        assert args.comm_combine_mb is None, "--comm-combine-mb needs a TrainStep mode (not sp/pp/ep)"
+        # sequence / pipeline / expert parallelism drive the shard_map-based
+        # training losses directly: jax.value_and_grad through the shard_map
+        # (grad sync comes out of the broadcast transpose), optax update jitted
+        # alongside — one compiled program per step, like TrainStep
+        if args.mode == "sp":
+            assert T % args.devices == 0, f"--seq {T} must divide over sp={args.devices}"
+            mesh = dist.make_mesh({"sp": args.devices}, devices=devices)
+            train_params = params
+
+            def loss_fn(p, i, t):
+                return dist.sp_gpt_loss(p, i, t, cos, sin, cfg, mesh=mesh)
+        elif args.mode == "pp":
+            pp = args.devices
+            assert cfg.n_layer % pp == 0, f"n_layer {cfg.n_layer} must divide over pp={pp}"
+            n_micro = 2 if args.batch % 2 == 0 else 1
+            mesh = dist.make_mesh({"pp": pp}, devices=devices)
+            train_params = dist.place_pipeline_params(dist.stack_blocks(params), mesh)
+
+            def loss_fn(p, i, t):
+                return dist.pp_gpt_loss(p, i, t, cos, sin, cfg, mesh=mesh, n_micro=n_micro)
+        else:  # ep
+            assert cfg.mlp_class == "LLaMAMoE", (
+                f"--mode ep needs a MoE config (e.g. tiny-moe-debug, mixtral-like); got {cfg.name}"
+            )
+            assert args.batch % args.devices == 0, (
+                f"--batch {args.batch} must divide over ep={args.devices}"
+            )
+            mesh = dist.make_mesh({"ep": args.devices}, devices=devices)
+            train_params = params
+
+            def loss_fn(p, i, t):
+                return dist.ep_gpt_loss(p, i, t, cos, sin, cfg, mesh=mesh)
+
+        opt_state = optimizer.init(train_params)
+
+        @jax.jit
+        def sharded_step(p, o, i, t):
+            loss, grads = jax.value_and_grad(loss_fn)(p, i, t)
+            updates, o = optimizer.update(grads, o, p)
+            return optax.apply_updates(p, updates), o, loss
+
+        step = lambda p, o, i, t, c, s: sharded_step(p, o, i, t)
+        accumulate = None
+        params = train_params
+    else:
+        if args.mode == "none":
+            mesh = dist.make_mesh({"dp": 1}, devices=devices[:1])
+            params = dist.ddp(params, mesh)
+        elif args.mode == "ddp":
+            mesh = dist.make_mesh({"dp": args.devices}, devices=devices)
+            params = dist.ddp(params, mesh)
+        elif args.mode in ("fsdp", "zero3"):
+            mesh = dist.make_mesh({"fsdp": args.devices}, devices=devices)
+            params = dist.fsdp(params, mesh)
+        else:  # tp_fsdp
+            tp = 2 if args.devices % 2 == 0 else 1
+            mesh = dist.make_mesh({"fsdp": args.devices // tp, "tp": tp}, devices=devices)
+            params = dist.tp_fsdp(params, mesh)
+
+        def loss_fn(p, i, t, c, s):
+            return llama.gpt_loss(p, i, t, c, s, cfg)
+
+        train_step = dist.make_train_step(
+            loss_fn, optimizer, mesh,
+            remat=not args.no_remat, zero3=(args.mode == "zero3"),
+            quant=args.quant, comm_combine_threshold_mb=args.comm_combine_mb,
+        )
+        opt_state = train_step.init_optimizer_state(params)
+        step = train_step
+        accumulate = train_step.accumulate
 
     t0 = time.perf_counter()
     if args.accum > 1:
+        assert accumulate is not None, "--accum needs a TrainStep mode (not sp/pp/ep)"
         mb = args.batch // args.accum
         micro = [(idx[k * mb:(k + 1) * mb], tgt[k * mb:(k + 1) * mb], cos, sin) for k in range(args.accum)]
-        params, opt_state, loss = step.accumulate(params, opt_state, micro)
+        params, opt_state, loss = accumulate(params, opt_state, micro)
     else:
         params, opt_state, loss = step(params, opt_state, idx, tgt, cos, sin)
     jax.block_until_ready(loss)
@@ -112,7 +174,7 @@ def main(argv=None):
     last = loss
     for k in range(args.steps):
         if args.accum > 1:
-            params, opt_state, last = step.accumulate(params, opt_state, micro)
+            params, opt_state, last = accumulate(params, opt_state, micro)
         else:
             params, opt_state, last = step(params, opt_state, idx, tgt, cos, sin)
     jax.block_until_ready(last)
@@ -127,6 +189,7 @@ def main(argv=None):
 
     print(json.dumps({
         "config": cfg.name, "mode": args.mode, "devices": args.devices,
+        "quant": args.quant,
         "tokens_per_sec": round(tps, 1), "ms_per_step": round(dt / args.steps * 1e3, 2),
         "final_loss": round(float(last), 4),
     }))

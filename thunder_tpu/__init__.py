@@ -48,6 +48,8 @@ __all__ = [
     "last_traces",
     "last_backward_traces",
     "last_prologue_traces",
+    "last_interpreter_log",
+    "print_last_interpreter_log",
     "compile_data",
     "compile_stats",
     "cache_option",
@@ -244,6 +246,7 @@ def _compile(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict) -> Ca
 
     cs.last_traces = [computation_trace]
     cs.last_prologue_traces = [prologue_trace]
+    cs.last_interpreter_log = getattr(computation_trace, "_interpreter_log", [])
 
     computation_trace = dce(computation_trace)
     cs.last_traces.append(computation_trace)
@@ -435,6 +438,23 @@ def last_backward_traces(cfn) -> list[TraceCtx]:
 
 def last_prologue_traces(cfn) -> list[TraceCtx]:
     return _get_cs(cfn).last_prologue_traces
+
+
+def last_interpreter_log(cfn) -> list:
+    """The bytecode frontend's per-opcode run log from the last trace
+    (reference ``thunder.last_interpreter_log``, __init__.py:817).  Empty
+    unless the function was compiled with ``interpretation="bytecode"``."""
+    return _get_cs(cfn).last_interpreter_log
+
+
+def print_last_interpreter_log(cfn, *, max_lines: int | None = 2000) -> None:
+    """Prints the last bytecode-interpreter run as an indented instruction
+    listing (reference ``print_last_interpreter_log``,
+    core/interpreter.py:6683-6789) — the first tool to reach for when the
+    bytecode frontend mis-traces a model."""
+    from thunder_tpu.core.interpreter import format_interpreter_log
+
+    print(format_interpreter_log(last_interpreter_log(cfn), max_lines=max_lines))
 
 
 def cache_option(cfn) -> CACHE_OPTIONS:

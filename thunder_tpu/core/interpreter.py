@@ -115,6 +115,22 @@ class InterpreterCompileCtx:
     max_depth: int = 32
     # callables never interpreted (treated as opaque host calls)
     opaque: set = field(default_factory=set)
+    # function substitution: target callable → replacement, consulted before
+    # interpretability (the reference's lookaside registry,
+    # interpreter.py:1234-1298) — routes e.g. ``torch.foo`` → ltorch inside
+    # interpreted code without relying on __torch_function__
+    lookasides: dict = field(default_factory=dict)
+    # per-run event log: ("op", depth, co_name, opname, argrepr) for every
+    # executed instruction plus ("call"/"lookaside"/"opaque", depth, name)
+    # at call boundaries (reference's interpreter log, interpreter.py:6683)
+    log: list = field(default_factory=list)
+    log_limit: int = 200_000
+
+    def record(self, *event):
+        if len(self.log) < self.log_limit:
+            self.log.append(event)
+        elif len(self.log) == self.log_limit:
+            self.log.append(("truncated", self.log_limit))
 
     def track(self, value, record: ProvenanceRecord):
         if value is None or isinstance(value, (int, float, bool, str, bytes, complex)):
@@ -141,6 +157,32 @@ def register_opcode_handler(name: str):
         return fn
 
     return deco
+
+
+# process-wide lookaside/opaque registries, merged into every interpretation
+# (per-call sets passed to ``interpret`` add to these)
+_default_lookasides: dict[Callable, Callable] = {}
+_default_opaque: set = set()
+
+
+def register_lookaside(target: Callable):
+    """Registers a replacement for ``target`` inside interpreted code:
+    ``@register_lookaside(some_fn) def _(args...)`` — whenever interpreted
+    bytecode calls ``some_fn``, the replacement runs (as a host call)
+    instead.  The reference's lookaside mechanism (interpreter.py:1234)."""
+
+    def deco(replacement: Callable):
+        _default_lookasides[target] = replacement
+        return replacement
+
+    return deco
+
+
+def make_opaque(fn: Callable) -> Callable:
+    """Marks ``fn`` as never-interpreted: calls run as host calls (the
+    reference's ``interpreter_needs_wrap``/opaque contract)."""
+    _default_opaque.add(fn)
+    return fn
 
 
 class Frame:
@@ -231,13 +273,26 @@ def _is_interpretable(fn) -> bool:
 
 
 def _call_value(ctx: InterpreterCompileCtx, depth: int, fn, args, kwargs):
-    """Calls ``fn``: user Python functions recurse through the interpreter;
-    everything else runs as an opaque host call."""
+    """Calls ``fn``: lookasides substitute first, user Python functions
+    recurse through the interpreter; everything else runs as an opaque host
+    call."""
     from thunder_tpu.core.proxies import Proxy
 
+    try:
+        la = ctx.lookasides.get(fn)
+    except TypeError:  # unhashable callable (e.g. dataclass(eq=True) instance)
+        la = None
+    if la is None and isinstance(fn, types.MethodType):
+        la = ctx.lookasides.get(fn.__func__)
+        if la is not None:
+            args = (fn.__self__, *args)
+    if la is not None:
+        ctx.record("lookaside", depth, getattr(fn, "__qualname__", repr(fn)))
+        return la(*args, **kwargs)
     if depth >= ctx.max_depth:
         return fn(*args, **kwargs)
     if isinstance(fn, types.MethodType) and _is_interpretable(fn.__func__) and fn.__func__ not in ctx.opaque:
+        ctx.record("call", depth, getattr(fn, "__qualname__", repr(fn)))
         return _run_function(ctx, fn.__func__, (fn.__self__, *args), kwargs, depth + 1)
     if _is_interpretable(fn) and fn not in ctx.opaque:
         # torch-surface functions keep their __torch_function__ diversion:
@@ -246,7 +301,9 @@ def _call_value(ctx: InterpreterCompileCtx, depth: int, fn, args, kwargs):
         # packages (site-packages) to keep the interpreter on user code
         mod = getattr(fn, "__module__", "") or ""
         if mod.startswith(("thunder_tpu", "torch", "jax", "numpy", "optax", "flax")):
+            ctx.record("opaque", depth, getattr(fn, "__qualname__", repr(fn)))
             return fn(*args, **kwargs)
+        ctx.record("call", depth, getattr(fn, "__qualname__", repr(fn)))
         return _run_function(ctx, fn, args, kwargs, depth + 1)
     return fn(*args, **kwargs)
 
@@ -378,9 +435,13 @@ def _frame_loop(frame: Frame, instrs, exc_table):
     try:
         i = 0
         n = len(instrs)
+        ctx_log = frame.ctx
+        co_name = frame.code.co_name
+        depth = frame.depth
         while i < n:
             ins = instrs[i]
             op = ins.opname
+            ctx_log.record("op", depth, co_name, op, ins.argrepr)
             if op in _UNSUPPORTED:
                 raise InterpreterError(f"{op}: {_UNSUPPORTED[op]}")
             h = _handlers.get(op)
@@ -638,6 +699,24 @@ def _load_attr(frame, ins, i):
     if is_method:
         # getattr already bound the method, so use the plain-call layout
         # ([NULL, callable]) — CALL accepts either convention
+        frame.push(_NULL)
+        frame.push(v)
+    else:
+        frame.push(v)
+
+
+@register_opcode_handler("LOAD_SUPER_ATTR")
+def _load_super_attr(frame, ins, i):
+    """3.12 super() access: pops (self, class, the global ``super``); oparg
+    bit 0 = method form (push [NULL, bound] like LOAD_ATTR), bit 1 = the
+    source spelled a two-argument ``super(cls, self)``; name = arg >> 2
+    (dis resolves ``argval`` already)."""
+    self_obj = frame.pop()
+    cls = frame.pop()
+    frame.pop()  # the super callable itself (we construct directly)
+    v = getattr(super(cls, self_obj), ins.argval)
+    if ins.arg & 1:
+        # getattr already bound, so plain-call layout ([NULL, callable])
         frame.push(_NULL)
         frame.push(v)
     else:
@@ -1429,18 +1508,46 @@ def interpret(
     *args,
     read_callback: Callable | None = None,
     opaque: set | None = None,
+    lookasides: dict | None = None,
     **kwargs,
 ):
     """Interprets ``fn(*args, **kwargs)`` instruction by instruction.
 
     Returns ``(result, ctx)`` where ``ctx.reads`` records every provenance-
-    tracked read (globals, closure cells, attr/item chains off them).
-    ``read_callback(record, value) -> value`` may substitute values at read
-    time (the general jit proxifies tensors there).
+    tracked read (globals, closure cells, attr/item chains off them) and
+    ``ctx.log`` the per-opcode run log.  ``read_callback(record, value) ->
+    value`` may substitute values at read time (the general jit proxifies
+    tensors there).  ``lookasides`` (merged over the process registry,
+    ``register_lookaside``) substitutes callables before interpretation.
     """
     if not _is_interpretable(fn):
         raise InterpreterError(f"cannot interpret {fn!r}: not a pure-Python function")
-    ctx = InterpreterCompileCtx(fn=fn, read_callback=read_callback, opaque=opaque or set())
+    ctx = InterpreterCompileCtx(
+        fn=fn,
+        read_callback=read_callback,
+        opaque=_default_opaque | (opaque or set()),
+        lookasides={**_default_lookasides, **(lookasides or {})},
+    )
     ctx.track(fn, ProvenanceRecord(PseudoInst.INPUT_FN))
     result = _run_function(ctx, fn, args, kwargs, depth=0)
     return result, ctx
+
+
+def format_interpreter_log(log: list, *, max_lines: int | None = None) -> str:
+    """Renders a run log (``ctx.log`` / ``CompileStats.last_interpreter_log``)
+    as an indented instruction listing (the reference's
+    print_last_interpreter_log, interpreter.py:6683-6789)."""
+    lines = []
+    for ev in log[: max_lines if max_lines is not None else len(log)]:
+        kind = ev[0]
+        if kind == "op":
+            _, depth, co_name, opname, argrepr = ev
+            lines.append(f"{'  ' * depth}[{co_name}] {opname}" + (f" {argrepr}" if argrepr else ""))
+        elif kind in ("call", "lookaside", "opaque"):
+            _, depth, name = ev
+            lines.append(f"{'  ' * depth}-> {kind} {name}")
+        elif kind == "truncated":
+            lines.append(f"... log truncated at {ev[1]} events")
+    if max_lines is not None and len(log) > max_lines:
+        lines.append(f"... {len(log) - max_lines} more events")
+    return "\n".join(lines)

@@ -62,10 +62,27 @@ class StateCapture:
         self.guards: dict[tuple, Any] = {}
         # path -> (concrete value, proxy) extra tensor inputs
         self.tensors: dict[tuple, tuple[Any, TensorProxy]] = {}
+        # the interpreter's per-opcode run log (ctx.log) for introspection
+        self.interpreter_log: list = []
 
     @property
     def tensor_proxies(self) -> list[TensorProxy]:
         return [p for _, p in self.tensors.values()]
+
+
+def _internal_root(fn: Callable, path: tuple) -> bool:
+    """True when the access chain is rooted at a thunder_tpu-internal global
+    (e.g. ``ThunderTracingMode._patch_depth`` read inside the torch-interop
+    wrapper): framework tracing state is not program state — guarding it
+    would pin trace-time-only values and fail every post-trace prologue."""
+    if not path or path[0][0] != "globals":
+        return False
+    try:
+        base = fn.__globals__.get(path[0][1])
+    except Exception:
+        return False
+    mod = getattr(base, "__module__", "") or ""
+    return isinstance(mod, str) and mod.startswith("thunder_tpu")
 
 
 def interpret_with_state(fn: Callable, proxy_args: tuple, proxy_kwargs: dict):
@@ -79,6 +96,8 @@ def interpret_with_state(fn: Callable, proxy_args: tuple, proxy_kwargs: dict):
             return value
         if path in cap.tensors:
             return cap.tensors[path][1]
+        if _internal_root(fn, path):
+            return value
         if _is_tensor_like(value):
             p = tensorproxy(value)
             cap.tensors[path] = (value, p)
@@ -88,6 +107,7 @@ def interpret_with_state(fn: Callable, proxy_args: tuple, proxy_kwargs: dict):
         return value
 
     result, _ctx = interpret(fn, *proxy_args, read_callback=read_cb, **proxy_kwargs)
+    cap.interpreter_log = _ctx.log
     return result, cap
 
 

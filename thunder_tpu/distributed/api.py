@@ -340,9 +340,15 @@ class TrainStep:
         self.compiler_options = copts
         jit_kw = {"compiler_options": copts} if copts else {}
         entry = {
+            # out_shardings pin the updated params/opt state to their INPUT
+            # placements: without them XLA may pick a different layout for
+            # the outputs, forcing a full reshard at the next step's input
+            # boundary (observed as SPMD "involuntary full rematerialization"
+            # warnings) and defeating buffer donation
             "step": jax.jit(
                 step,
                 in_shardings=(param_sh, opt_sh) + batch_sh,
+                out_shardings=(param_sh, opt_sh, None),
                 donate_argnums=(0, 1) if self.donate else (),
                 **jit_kw,
             ),
@@ -361,6 +367,7 @@ class TrainStep:
             "apply": jax.jit(
                 apply_gradients,
                 in_shardings=(param_sh, opt_sh, param_sh),
+                out_shardings=(param_sh, opt_sh),
                 donate_argnums=(0, 1) if self.donate else (),
                 **jit_kw,
             ),

@@ -26,11 +26,21 @@ class Region:
 
         produced: OrderedSet = OrderedSet()
         consumed: OrderedSet = OrderedSet()
-        for bsym in self.bsyms:
+
+        def visit(bsym: BoundSymbol) -> None:
+            # walk the WHOLE composite tree: a proxy consumed only by a
+            # subsymbol (e.g. the implicit rng_key inside dropout's uniform)
+            # is still a region input — evaluation descends into subsymbols,
+            # so the top-level arg list alone under-reports consumption
             for out in bsym.flat_proxy_outs:
                 produced.add(variableify(out))
             for arg in bsym.flat_proxy_args:
                 consumed.add(variableify(arg))
+            for sub in bsym.subsymbols:
+                visit(sub)
+
+        for bsym in self.bsyms:
+            visit(bsym)
 
         self.inputs = OrderedSet(v for v in consumed if v not in produced)
 

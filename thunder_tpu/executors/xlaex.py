@@ -122,6 +122,13 @@ class XLAFusionExecutor(FusionExecutor):
         return bsym
 
     def fusion_pass(self, trace: TraceCtx) -> TraceCtx:
+        from thunder_tpu.core.trace import _execution_file
+
+        if _execution_file.get() is not None:
+            # execution-callback-file debugging: the dumped program must stay
+            # hand-editable, and an XLA fusion's constants live inside an
+            # opaque compiled callable — keep per-prim eager execution instead
+            return trace
         start = time.perf_counter_ns()
 
         min_size = get_compile_option(
@@ -147,10 +154,23 @@ class XLAFusionExecutor(FusionExecutor):
 
         groups = fuse_bound_symbols(trace.bound_symbols, self._is_fusible)
 
+        def weight(bsym: BoundSymbol) -> int:
+            # region size counts FLATTENED prims: one composite call (gelu,
+            # softmax) is one top-level bsym but many ops — leaving it
+            # unfused would decompose it to per-prim eager jax dispatch,
+            # ~10× per-call overhead on small ops
+            if not bsym.subsymbols:
+                return 1
+            return sum(weight(s) for s in bsym.subsymbols)
+
         new_bsyms: list[BoundSymbol] = []
         fusion_counter = 0
         for g in groups:
-            if not g.fusible or len(g.bsyms) < int(min_size) or not self.get_fuel():
+            if (
+                not g.fusible
+                or sum(weight(b) for b in g.bsyms) < int(min_size)
+                or not self.get_fuel()
+            ):
                 new_bsyms.extend(g.bsyms)
             else:
                 new_bsyms.append(self.fuse(g.bsyms, fusion_counter, producers_map, consumers_map, return_proxies))

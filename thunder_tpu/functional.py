@@ -161,6 +161,7 @@ def trace_from_fn(
                 from thunder_tpu.core.jit_ext import interpret_with_state
 
                 result, state_cap = interpret_with_state(fn, tuple(proxy_args), dict(proxy_kwargs))
+                computation_trace._interpreter_log = state_cap.interpreter_log
             else:
                 result = fn(*proxy_args, **proxy_kwargs)
         # epilogue: record mutations of the input containers (the reference

@@ -974,8 +974,11 @@ def scaled_dot_product_attention(
     """
     d = query.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    if is_causal:
+        check(attn_mask is None, lambda: "is_causal and attn_mask are mutually exclusive")
     gqa_ok = query.shape[:-2] == key.shape[:-2] == value.shape[:-2] or (
         query.ndim >= 3
+        and key.ndim >= 3
         and key.shape[:-2] == value.shape[:-2]
         and query.shape[:-3] == key.shape[:-3]
         and key.shape[-3] != 0

@@ -263,19 +263,32 @@ class TestQuantizedTraining:
             losses.append(float(loss))
         return losses, step
 
-    def test_int8_training_converges_like_fp32(self):
+    @pytest.mark.parametrize("mode", ["int8", "fp8"])
+    def test_quantized_training_converges_like_fp32(self, mode):
         l_fp, _ = self._train(None)
-        l_q, _ = self._train("int8")
+        l_q, _ = self._train(mode)
         # both learn; the quantized path tracks full precision closely
         assert l_fp[-1] < l_fp[0] - 0.2
         assert l_q[-1] < l_q[0] - 0.2
         assert abs(l_q[-1] - l_fp[-1]) < 0.15, (l_q[-1], l_fp[-1])
 
-    def test_int8_claims_forward_only(self):
-        _, step = self._train("int8", steps=1)
+    @pytest.mark.parametrize("mode", ["int8", "fp8"])
+    def test_quant_claims_forward_only(self, mode):
+        _, step = self._train(mode, steps=1)
         fw_src = step.fw_trace.python()
         bw_src = step.bw_trace.python()
-        assert "int8_linear" in fw_src or "int8_matmul" in fw_src, fw_src[:2000]
-        assert "int8_linear" not in bw_src and "int8_matmul" not in bw_src, (
+        assert f"{mode}_linear" in fw_src or f"{mode}_matmul" in fw_src, fw_src[:2000]
+        assert f"{mode}_linear" not in bw_src and f"{mode}_matmul" not in bw_src, (
             "grads must stay full precision (TE contract)"
         )
+
+    def test_fp8_linear_numerics(self):
+        from thunder_tpu.executors import quantex
+
+        a = rng.standard_normal((16, 64)).astype(np.float32)
+        w = rng.standard_normal((32, 64)).astype(np.float32) * 0.05
+        got = np.asarray(quantex.fp8_linear(jnp.asarray(a), jnp.asarray(w)))
+        ref = a @ w.T
+        # e4m3 keeps ~2 significant digits (TE contract)
+        err = np.abs(got - ref) / (np.abs(ref) + 1e-3)
+        assert np.median(err) < 0.05, np.median(err)

@@ -713,8 +713,9 @@ def _load_super_attr(frame, ins, i):
     (dis resolves ``argval`` already)."""
     self_obj = frame.pop()
     cls = frame.pop()
-    frame.pop()  # the super callable itself (we construct directly)
-    v = getattr(super(cls, self_obj), ins.argval)
+    sup = frame.pop()  # usually builtins.super, but it may be shadowed
+    obj = super(cls, self_obj) if sup is super else sup(cls, self_obj)
+    v = getattr(obj, ins.argval)
     if ins.arg & 1:
         # getattr already bound, so plain-call layout ([NULL, callable])
         frame.push(_NULL)

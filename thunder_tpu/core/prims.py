@@ -1445,6 +1445,41 @@ write_path = make_prim(
 )
 
 
+# prologue-guard hot path: dtype-name and device-string lookups are cached —
+# str(np.dtype(...)) plus the jax device walk cost ~25 µs per tensor per
+# call, the dominant prologue cost on small programs
+_dtype_str_cache: dict = {}
+_jax_device_str_cache: dict = {}
+_MISSING = object()  # cache-miss sentinel (cached values may be None)
+
+
+def _dtype_name(dtype) -> str:
+    import numpy as np
+
+    s = _dtype_str_cache.get(dtype)
+    if s is None:
+        s = str(np.dtype(dtype))
+        _dtype_str_cache[dtype] = s
+    return s
+
+
+def _jax_device_str(t) -> str | None:
+    try:
+        dev = next(iter(t.devices()))  # jax devices are canonical singletons
+    except Exception:
+        return None
+    s = _jax_device_str_cache.get(dev, _MISSING)
+    if s is _MISSING:
+        try:
+            from thunder_tpu.core.devices import from_jax_device
+
+            s = from_jax_device(dev).device_str()
+        except Exception:
+            s = None
+        _jax_device_str_cache[dev] = s
+    return s
+
+
 def _check_tensor_metadata_impl(t, shape: tuple, device: str, dtype_str: str, requires_grad: bool):
     import jax
     import numpy as np
@@ -1453,16 +1488,11 @@ def _check_tensor_metadata_impl(t, shape: tuple, device: str, dtype_str: str, re
     actual_rg = None  # only torch tensors carry requires_grad; None skips the check
     if isinstance(t, jax.Array):
         actual_shape = tuple(t.shape)
-        actual_dtype = str(np.dtype(t.dtype))
-        try:
-            from thunder_tpu.core.devices import from_jax_device
-
-            actual_device = from_jax_device(list(t.devices())[0]).device_str()
-        except Exception:
-            actual_device = None
+        actual_dtype = _dtype_name(t.dtype)
+        actual_device = _jax_device_str(t)
     elif isinstance(t, np.ndarray):
         actual_shape = tuple(t.shape)
-        actual_dtype = str(np.dtype(t.dtype))
+        actual_dtype = _dtype_name(t.dtype)
         actual_device = "cpu:0"
     else:
         try:

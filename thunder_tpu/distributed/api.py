@@ -211,8 +211,8 @@ class TrainStep:
         self.remat = remat
         self.zero3 = zero3
         self.executors = executors
-        if quant not in (None, "int8"):
-            raise ValueError(f"quant must be None or 'int8', got {quant!r}")
+        if quant not in (None, "int8", "fp8"):
+            raise ValueError(f"quant must be None, 'int8', or 'fp8', got {quant!r}")
         self.quant = quant
         self.comm_combine_threshold_mb = comm_combine_threshold_mb
         self.bucketer = bucketer
@@ -267,16 +267,17 @@ class TrainStep:
 
         executors = self.executors if self.executors is not None else get_default_executors()
         fw_executors = executors
-        if self.quant == "int8":
+        if self.quant is not None:
             # quantized TRAINING, the TE-executor contract (reference
             # transformer_engineex.py:183-336: low-precision fwd matmuls,
-            # higher-precision grads): int8 claims prims.linear/matmul in the
-            # FORWARD trace only — the backward trace keeps bf16/f32 math, so
-            # weight grads stay full precision while fwd GEMMs run at the
-            # MXU's 2× int8 rate
+            # higher-precision grads): int8/fp8 claims prims.linear/matmul in
+            # the FORWARD trace only — the backward trace keeps bf16/f32
+            # math, so weight grads stay full precision while fwd GEMMs run
+            # low-precision (int8 at the v5e MXU's 2× rate; fp8 = the literal
+            # TE e4m3 recipe)
             from thunder_tpu.executors import quantex
 
-            fw_executors = [quantex.ex, *executors]
+            fw_executors = [quantex.ex if self.quant == "int8" else quantex.fp8_ex, *executors]
         fw_trace = transform_for_execution(fw_trace, fw_executors)
         bw_trace = transform_for_execution(bw_trace, executors)
         self.fw_trace, self.bw_trace = fw_trace, bw_trace

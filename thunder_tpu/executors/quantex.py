@@ -28,7 +28,7 @@ from thunder_tpu.core import dtypes
 from thunder_tpu.core.prims import PrimIDs, prim_lookup
 from thunder_tpu.extend import OperatorExecutor, register_executor
 
-__all__ = ["ex", "quant_ex", "int8_linear", "int8_matmul"]
+__all__ = ["ex", "quant_ex", "fp8_ex", "int8_linear", "int8_matmul", "fp8_linear", "fp8_matmul"]
 
 ex = OperatorExecutor("quant_int8", version="0.1")
 quant_ex = ex
@@ -37,6 +37,41 @@ register_executor(ex)
 # claim threshold on the contraction dim: tiny K has nothing to amortize the
 # quantize/dequantize traffic (and error) against
 min_k = 64
+
+
+def _make_quant_ops(quantize_fn, accum_dtype):
+    """Builds the (linear, matmul) pair for one quantization format.
+
+    ``quantize_fn(x) -> (q, scale)`` quantizes over the last dim with absmax
+    scaling; ``accum_dtype`` is the dot's preferred_element_type (int32 on
+    the int8 MXU path, float32 for e4m3).  Shared by the int8 and fp8
+    executors so the scale handling can never drift between them.
+    """
+
+    def q_linear(a, w, bias=None):
+        qa, sa = quantize_fn(a)  # (..., K), (..., 1)
+        qw, sw = quantize_fn(w)  # (N, K), (N, 1)
+        acc = jax.lax.dot_general(
+            qa, qw, (((qa.ndim - 1,), (1,)), ((), ())), preferred_element_type=accum_dtype
+        )  # (..., N)
+        out = acc.astype(jnp.float32) * sa * sw.reshape((1,) * (acc.ndim - 1) + (-1,))
+        if bias is not None:
+            out = out + bias.astype(jnp.float32)
+        return out.astype(a.dtype)
+
+    def q_matmul(a, b):
+        if a.ndim == 1 or b.ndim == 1:  # matvec paths gain nothing; stay exact
+            return jnp.matmul(a, b)
+        qa, sa = quantize_fn(a)  # scale (..., M, 1)
+        # quantize b per output column: absmax over its contraction dim (-2)
+        bf = jnp.swapaxes(b.astype(jnp.float32), -1, -2)  # (..., N, K)
+        qb, sb = quantize_fn(bf)  # (..., N, K), (..., N, 1)
+        qb = jnp.swapaxes(qb, -1, -2)  # (..., K, N)
+        acc = jnp.matmul(qa, qb, preferred_element_type=accum_dtype)  # (..., M, N)
+        out = acc.astype(jnp.float32) * sa * jnp.swapaxes(sb, -1, -2)  # (...,1,N)
+        return out.astype(a.dtype)
+
+    return q_linear, q_matmul
 
 
 def _quantize_lastdim(x):
@@ -49,36 +84,9 @@ def _quantize_lastdim(x):
     return q, scale
 
 
-def int8_linear(a, w, bias=None):
-    """``a @ w.T (+ bias)`` with both operands dynamically int8-quantized.
-
-    a: (..., K); w: (N, K) — torch linear layout.  int32 accumulation on the
-    MXU, float32 dequant, result cast back to ``a.dtype``.
-    """
-    qa, sa = _quantize_lastdim(a)  # (..., K), (..., 1)
-    qw, sw = _quantize_lastdim(w)  # (N, K), (N, 1)
-    acc = jax.lax.dot_general(
-        qa, qw, (((qa.ndim - 1,), (1,)), ((), ())), preferred_element_type=jnp.int32
-    )  # (..., N)
-    out = acc.astype(jnp.float32) * sa * sw.reshape((1,) * (acc.ndim - 1) + (-1,))
-    if bias is not None:
-        out = out + bias.astype(jnp.float32)
-    return out.astype(a.dtype)
-
-
-def int8_matmul(a, b):
-    """``a @ b`` with dynamic int8 quantization (2D/batched, torch matmul
-    layout: contraction is a's last dim × b's second-to-last dim)."""
-    if a.ndim == 1 or b.ndim == 1:  # matvec paths gain nothing; stay exact
-        return jnp.matmul(a, b)
-    qa, sa = _quantize_lastdim(a)  # scale (..., M, 1)
-    # quantize b per output column: absmax over its contraction dim (-2)
-    bf = jnp.swapaxes(b.astype(jnp.float32), -1, -2)  # (..., N, K)
-    qb, sb = _quantize_lastdim(bf)  # (..., N, K), (..., N, 1)
-    qb = jnp.swapaxes(qb, -1, -2)  # (..., K, N)
-    acc = jnp.matmul(qa, qb, preferred_element_type=jnp.int32)  # (..., M, N)
-    out = acc.astype(jnp.float32) * sa * jnp.swapaxes(sb, -1, -2)  # (...,1,N)
-    return out.astype(a.dtype)
+# int8: a @ w.T (+ bias) / a @ b with per-token activations, per-output-
+# channel weights, int32 accumulation on the MXU, float32 dequant
+int8_linear, int8_matmul = _make_quant_ops(_quantize_lastdim, jnp.int32)
 
 
 def _linear_checker(a, w, bias=None):
@@ -99,14 +107,49 @@ def _matmul_checker(a, b):
     return a.shape[-1] >= min_k
 
 
-_linear_op = ex.register_operator("int8_linear", like=prim_lookup[PrimIDs.LINEAR], fn=int8_linear)
-_matmul_op = ex.register_operator("int8_matmul", like=prim_lookup[PrimIDs.MATMUL], fn=int8_matmul)
-ex.register_implementation(PrimIDs.LINEAR, _linear_op, checker=_linear_checker)
-ex.register_implementation(PrimIDs.MATMUL, _matmul_op, checker=_matmul_checker)
-# the claiming pass consults executors before a composite is decomposed (and
-# before the XLA fusion executor preserves it), so the torch-surface symbols
-# must be claimable directly — same signatures as the prims they wrap
-ex.register_implementation("torch.linear", _linear_op, checker=_linear_checker)
-ex.register_implementation("torch.matmul", _matmul_op, checker=_matmul_checker)
-ex.register_implementation("torch.mm", _matmul_op, checker=_matmul_checker)
-ex.register_implementation("torch.bmm", _matmul_op, checker=_matmul_checker)
+def _register_quant(executor, prefix, q_linear, q_matmul):
+    linear_op = executor.register_operator(f"{prefix}_linear", like=prim_lookup[PrimIDs.LINEAR], fn=q_linear)
+    matmul_op = executor.register_operator(f"{prefix}_matmul", like=prim_lookup[PrimIDs.MATMUL], fn=q_matmul)
+    executor.register_implementation(PrimIDs.LINEAR, linear_op, checker=_linear_checker)
+    executor.register_implementation(PrimIDs.MATMUL, matmul_op, checker=_matmul_checker)
+    # the claiming pass consults executors before a composite is decomposed
+    # (and before the XLA fusion executor preserves it), so the torch-surface
+    # symbols must be claimable directly — same signatures as the prims
+    executor.register_implementation("torch.linear", linear_op, checker=_linear_checker)
+    executor.register_implementation("torch.matmul", matmul_op, checker=_matmul_checker)
+    executor.register_implementation("torch.mm", matmul_op, checker=_matmul_checker)
+    executor.register_implementation("torch.bmm", matmul_op, checker=_matmul_checker)
+
+
+_register_quant(ex, "int8", int8_linear, int8_matmul)
+
+
+#
+# FP8 (e4m3) executor — the literal TransformerEngine recipe
+# (reference transformer_engineex.py:183-336: per-tensor amax scaling into
+# e4m3 for the forward GEMMs).  thunder_tpu's fp8 dtypes
+# (core/dtypes.py:199-202) execute through here.  On TPU generations without
+# fp8 matmul units the cast runs on the VPU and the dot accumulates from the
+# dequantized operands — numerics-faithful to the TE contract (amax/absmax
+# scaling, e4m3 range ±448) and ready for fp8-capable hardware; int8 remains
+# the v5e-native fast path.
+#
+
+_E4M3_MAX = 448.0
+
+
+def _quantize_fp8_lastdim(x):
+    """absmax scaling into float8_e4m3; returns (q, scale) like the int8
+    variant (scale broadcastable against the dot result)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    scale = jnp.where(amax == 0.0, 1.0, amax / _E4M3_MAX)
+    q = (xf / scale).astype(jnp.float8_e4m3fn)
+    return q, scale
+
+
+fp8_linear, fp8_matmul = _make_quant_ops(_quantize_fp8_lastdim, jnp.float32)
+
+fp8_ex = OperatorExecutor("quant_fp8", version="0.1")
+register_executor(fp8_ex)
+_register_quant(fp8_ex, "fp8", fp8_linear, fp8_matmul)

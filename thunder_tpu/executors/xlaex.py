@@ -160,6 +160,12 @@ class XLAFusionExecutor(FusionExecutor):
             # unfused would decompose it to per-prim eager jax dispatch,
             # ~10× per-call overhead on small ops
             if not bsym.subsymbols:
+                # a leaf prim whose jnp impl is itself a multi-op program
+                # (fused sdpa/CE decompositions, matmul-class ops) is worth a
+                # compiled region on its own — executing it eagerly pays one
+                # dispatch per internal jnp op
+                if bsym.sym.tags and OpTags.MATMUL_OP in bsym.sym.tags:
+                    return 1_000
                 return 1
             return sum(weight(s) for s in bsym.subsymbols)
 

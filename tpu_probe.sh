@@ -19,6 +19,9 @@ for i in $(seq 1 200); do
     echo "$(date -u +%T) running micro bench" >> "$LOG"
     timeout 3000 python bench.py micro > /root/repo/BENCH_TPU_MICRO.json 2>> "$LOG"
     echo "$(date -u +%T) micro rc=$?" >> "$LOG"
+    echo "$(date -u +%T) running sweep bench" >> "$LOG"
+    timeout 3000 python bench.py sweep > /dev/null 2>> "$LOG"
+    echo "$(date -u +%T) sweep rc=$? (BENCH_MICRO.json updated on-TPU)" >> "$LOG"
     if grep -q '"tokens/s"' /root/repo/BENCH_TPU.json 2>/dev/null && ! grep -q cpu_smoke /root/repo/BENCH_TPU.json; then
       echo "$(date -u +%T) SUCCESS — TPU bench captured" >> "$LOG"
       exit 0

@@ -40,8 +40,8 @@ def main(argv=None):
     ap.add_argument("--config", default="tiny-llama-debug", help="model config name (models/llama.py zoo)")
     ap.add_argument("--mode", default="none",
                     choices=["none", "ddp", "fsdp", "zero3", "tp_fsdp", "sp", "pp", "ep"])
-    ap.add_argument("--quant", default=None, choices=["int8"],
-                    help="quantized training: int8 forward GEMMs, full-precision grads")
+    ap.add_argument("--quant", default=None, choices=["int8", "fp8"],
+                    help="quantized training: int8/fp8(e4m3) forward GEMMs, full-precision grads")
     ap.add_argument("--comm-combine-mb", type=float, default=None,
                     help="XLA collective-combining threshold in MiB (the bucket_size_in_mb analog)")
     ap.add_argument("--devices", type=int, default=1)

@@ -1020,3 +1020,30 @@ class TestRunLogAndLookasides:
         np.testing.assert_allclose(
             out.logits.detach().numpy(), ref.numpy(), rtol=1e-4, atol=1e-5
         )
+
+    def test_executor_replaces_lookaside_reaches_interpreter(self):
+        """register_operator(replaces=fn) diverts direct calls to ``fn``
+        inside bytecode-interpreted code to the executor's symbol (reference
+        extend/__init__.py:31-124 _lookasides)."""
+        import jax.numpy as jnp
+
+        from thunder_tpu.core.prims import PrimIDs, prim_lookup
+        from thunder_tpu.extend import OperatorExecutor, register_executor
+
+        def my_softplus(x):  # a host fn the traced code calls directly
+            raise AssertionError("host version must not run under tracing")
+
+        myex = OperatorExecutor("lookaside_test", version="0")
+        register_executor(myex)
+        op = myex.register_operator(
+            "soft_plus", like=prim_lookup[PrimIDs.EXP], replaces=my_softplus,
+            fn=lambda x: jnp.log1p(jnp.exp(x)),
+        )
+
+        def f(x):
+            return my_softplus(x)
+
+        xv = rng.standard_normal((8,)).astype(np.float32)
+        jfn = tt.jit(f, interpretation="bytecode", executors=[myex])
+        out = jfn(xv)
+        np.testing.assert_allclose(np.asarray(out), np.log1p(np.exp(xv)), rtol=1e-5)

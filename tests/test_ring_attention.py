@@ -11,6 +11,7 @@ import pytest
 
 from thunder_tpu import distributed as dist
 from thunder_tpu.distributed.ring_attention import ring_attention, ring_self_attention
+from thunder_tpu.models import llama
 
 rng = np.random.default_rng(23)
 
@@ -166,3 +167,63 @@ class TestSequenceParallelTraining:
         mesh = dist.make_mesh({"sp": 4}, devices=jax.devices()[:4])
         loss = dist.sp_gpt_loss(params, idx, tgt, cos, sin, cfg, mesh=mesh)
         assert abs(float(loss) - float(ref_loss)) < 1e-4
+
+
+class TestUlysses:
+    """All-to-all (DeepSpeed-Ulysses-style) sequence parallelism — the
+    second long-context scheme next to the ring (neither exists in the
+    reference, SURVEY §2.6)."""
+
+    def _setup(self, T=64, B=2):
+        cfg = llama.Config.from_name("tiny-llama-debug")
+        params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+        idx = jax.random.randint(jax.random.PRNGKey(1), (B, T), 0, cfg.vocab_size)
+        tgt = jax.random.randint(jax.random.PRNGKey(2), (B, T), 0, cfg.vocab_size)
+        cos, sin = llama.build_rope_cache(cfg, T)
+        return cfg, params, idx, tgt, cos, sin
+
+    def test_loss_matches_single_device(self):
+        cfg, params, idx, tgt, cos, sin = self._setup()
+        single_mesh = dist.make_mesh({"sp": 1}, devices=jax.devices()[:1])
+        single = float(jax.jit(
+            lambda p: dist.sp_gpt_loss(p, idx, tgt, cos, sin, cfg, mesh=single_mesh)
+        )(params))
+        mesh = dist.make_mesh({"sp": 4}, devices=jax.devices()[:4])
+        loss = float(jax.jit(
+            lambda p: dist.ulysses_gpt_loss(p, idx, tgt, cos, sin, cfg, mesh=mesh)
+        )(params))
+        np.testing.assert_allclose(loss, single, rtol=1e-5)
+
+    def test_grads_match_ring_sp(self):
+        cfg, params, idx, tgt, cos, sin = self._setup()
+        mesh = dist.make_mesh({"sp": 4}, devices=jax.devices()[:4])
+        _, g_u = jax.jit(jax.value_and_grad(
+            lambda p: dist.ulysses_gpt_loss(p, idx, tgt, cos, sin, cfg, mesh=mesh)
+        ))(params)
+        _, g_r = jax.jit(jax.value_and_grad(
+            lambda p: dist.sp_gpt_loss(p, idx, tgt, cos, sin, cfg, mesh=mesh)
+        ))(params)
+        for a, b in zip(jax.tree_util.tree_leaves(g_u), jax.tree_util.tree_leaves(g_r)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5, rtol=1e-4)
+
+    def test_attend_shard_matches_dense(self):
+        """ulysses_attend_shard under shard_map == dense causal attention."""
+        from jax.sharding import PartitionSpec as P
+
+        B, H, T, hs = 2, 4, 64, 16
+        ks = jax.random.split(jax.random.PRNGKey(5), 3)
+        q = jax.random.normal(ks[0], (B, H, T, hs))
+        k = jax.random.normal(ks[1], (B, H, T, hs))
+        v = jax.random.normal(ks[2], (B, H, T, hs))
+        mesh = dist.make_mesh({"sp": 4}, devices=jax.devices()[:4])
+        out = jax.jit(jax.shard_map(
+            lambda q, k, v: dist.ulysses_attend_shard(q, k, v, axis="sp", sp=4),
+            mesh=mesh,
+            in_specs=(P(None, None, "sp"),) * 3,
+            out_specs=P(None, None, "sp"),
+            check_vma=False,
+        ))(q, k, v)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) / (hs ** 0.5)
+        s = jnp.where(jnp.tril(jnp.ones((T, T), bool)), s, -jnp.inf)
+        ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)

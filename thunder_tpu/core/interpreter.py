@@ -714,7 +714,12 @@ def _load_super_attr(frame, ins, i):
     self_obj = frame.pop()
     cls = frame.pop()
     sup = frame.pop()  # usually builtins.super, but it may be shadowed
-    obj = super(cls, self_obj) if sup is super else sup(cls, self_obj)
+    if sup is super:
+        obj = super(cls, self_obj)
+    else:
+        # oparg bit 2: the source spelled two-argument super(cls, self);
+        # otherwise CPython calls a shadowing super with NO arguments
+        obj = sup(cls, self_obj) if ins.arg & 2 else sup()
     v = getattr(obj, ins.argval)
     if ins.arg & 1:
         # getattr already bound, so plain-call layout ([NULL, callable])

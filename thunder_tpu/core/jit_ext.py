@@ -106,7 +106,25 @@ def interpret_with_state(fn: Callable, proxy_args: tuple, proxy_kwargs: dict):
             cap.guards[path] = value
         return value
 
-    result, _ctx = interpret(fn, *proxy_args, read_callback=read_cb, **proxy_kwargs)
+    # executor-registered lookasides (register_operator(replaces=...),
+    # reference extend/__init__.py:31-124) divert direct Python calls inside
+    # interpreted code to the executor's symbol
+    from thunder_tpu.core.compile_data import get_compile_data
+
+    lookasides: dict = {}
+    cd = get_compile_data()
+    if cd is not None:
+        for ex in getattr(cd, "executors_list", None) or ():
+            la = getattr(ex, "_lookasides", None)
+            if la:
+                for target, repl in la.items():
+                    # first executor wins, matching the claiming pass's
+                    # priority order (executors/passes.py)
+                    lookasides.setdefault(target, repl)
+
+    result, _ctx = interpret(
+        fn, *proxy_args, read_callback=read_cb, lookasides=lookasides, **proxy_kwargs
+    )
     cap.interpreter_log = _ctx.log
     return result, cap
 

@@ -28,7 +28,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from thunder_tpu.distributed.ring_attention import ring_attend_shard
 from thunder_tpu.models.generate import _mlp, _norm, _project_qkv
 
-__all__ = ["sp_gpt_loss"]
+__all__ = ["sp_gpt_loss", "seq_parallel_gpt_loss"]
 
 
 def _sp_attention(ap, x, cos_b, sin_b, cfg, *, axis: str, sp: int):
@@ -43,26 +43,26 @@ def _sp_attention(ap, x, cos_b, sin_b, cfg, *, axis: str, sp: int):
     return y @ ap["wo"].T
 
 
-def sp_gpt_loss(params, idx, targets, cos, sin, cfg, *, mesh: Mesh, axis: str = "sp"):
-    """Next-token loss with the sequence dim sharded over ``mesh[axis]``.
-
-    ``idx``/``targets``: (B, T) with ``T % sp == 0``; ``cos``/``sin``: the
-    full (T, rope_n_elem) caches (sharded into position slices per device).
-    Matches ``models.llama.gpt_loss`` numerics.
-    """
+def seq_parallel_gpt_loss(params, idx, targets, cos, sin, cfg, *, mesh: Mesh,
+                          axis: str, attend_fn):
+    """Shared sequence-parallel training loss: everything but attention is
+    sequence-local; ``attend_fn(ap, x, cos_b, sin_b, cfg, axis=, sp=)``
+    supplies the cross-shard attention (the ring here; the all_to_all
+    variant in ``distributed/ulysses.py``).  Matches ``models.llama.
+    gpt_loss`` numerics."""
     sp = mesh.shape[axis]
     B, T = idx.shape
     assert T % sp == 0, f"sequence {T} must divide over {axis}={sp}"
 
     assert not cfg.learned_pos_embedding, (
-        "sp_gpt_loss does not shard learned position embeddings yet; use rope configs"
+        "sequence-parallel losses do not shard learned position embeddings yet; use rope configs"
     )
 
     def body(params, idx_b, tgt_b, cos_b, sin_b):
         x = params["wte"][idx_b]  # (B, T_loc, C) — embedding lookup is local
         for bp in params["blocks"]:
             n1 = _norm(x, bp["norm_1"], cfg)
-            h = _sp_attention(bp["attn"], n1, cos_b, sin_b, cfg, axis=axis, sp=sp)
+            h = attend_fn(bp["attn"], n1, cos_b, sin_b, cfg, axis=axis, sp=sp)
             if cfg.parallel_residual:
                 n2 = n1 if cfg.shared_attention_norm else _norm(x, bp["norm_2"], cfg)
                 x = x + h + _mlp(bp["mlp"], n2, cfg)
@@ -85,3 +85,16 @@ def sp_gpt_loss(params, idx, targets, cos, sin, cfg, *, mesh: Mesh, axis: str = 
         check_vma=False,
     )
     return fn(params, idx, targets, cos, sin)
+
+
+def sp_gpt_loss(params, idx, targets, cos, sin, cfg, *, mesh: Mesh, axis: str = "sp"):
+    """Next-token loss with the sequence dim sharded over ``mesh[axis]``,
+    attention via the ring.
+
+    ``idx``/``targets``: (B, T) with ``T % sp == 0``; ``cos``/``sin``: the
+    full (T, rope_n_elem) caches (sharded into position slices per device).
+    """
+    return seq_parallel_gpt_loss(
+        params, idx, targets, cos, sin, cfg, mesh=mesh, axis=axis,
+        attend_fn=_sp_attention,
+    )

@@ -1,0 +1,93 @@
+"""Ulysses-style (all-to-all) sequence parallelism over an ``sp`` mesh axis.
+
+The second of the two standard long-context schemes (the first, ring
+attention, is ``distributed/ring_attention.py``; the reference has neither —
+SURVEY §2.6: "no sequence/context parallelism anywhere").  DeepSpeed-Ulysses
+layout: activations live sequence-sharded; around attention, a tiled
+``all_to_all`` re-shards from sequence to **heads** so every device computes
+full-sequence attention for H/sp of the heads, then a second ``all_to_all``
+restores the sequence sharding —
+
+- two all_to_alls move O(B·T_loc·C) per device per attention (cheaper than a
+  full all_gather of K/V when sp is large) and ride ICI;
+- attention itself is the plain full-T kernel per local head group, so the
+  flash/XLA fast paths apply unchanged — no online-softmax merging needed
+  (contrast: the ring pays sp neighbor hops but never materializes full T);
+- everything else (norms, MLPs, embeddings, loss) stays sequence-local,
+  identical to ``sp_gpt_loss``.
+
+Trade-off guide: Ulysses needs ``n_head % sp == 0`` and holds full-T K/V per
+head group (memory O(T) per device in the attention); the ring holds only
+O(T/sp) K/V but serializes sp communication rounds.  Both are differentiable
+straight through ``jax.grad`` (all_to_all transposes to all_to_all).
+
+Math mirrors ``models/llama`` (same pytree/configs); plain jnp because the
+body executes inside shard_map.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from thunder_tpu.models.generate import _mlp, _norm, _project_qkv
+
+__all__ = ["ulysses_attend_shard", "ulysses_gpt_loss"]
+
+
+def ulysses_attend_shard(q, k, v, *, axis: str, sp: int, causal: bool = True):
+    """Full-sequence attention from sequence-sharded q/k/v via two
+    all_to_alls (runs under shard_map).
+
+    q: (B, H, T_loc, hs); k/v: (B, G, T_loc, hs) with GQA groups expanded to
+    H when G doesn't divide over ``sp``.  Returns (B, H, T_loc, hs) with the
+    same sequence sharding as the inputs.
+    """
+    B, H, T_loc, hs = q.shape
+    G = k.shape[1]
+    if G != H and G % sp != 0:
+        # GQA groups thinner than the mesh axis: expand to H so the head
+        # all_to_all divides (costs the expansion ring attention avoids)
+        rep = H // G
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+        G = H
+    assert H % sp == 0, f"ulysses: n_head {H} must divide over {axis}={sp}"
+
+    # seq-sharded → head-sharded: split heads, gather sequence
+    a2a = lambda x: jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+    qh, kh, vh = a2a(q), a2a(k), a2a(v)  # (B, H/sp | G/sp, T, hs)
+    if kh.shape[1] != qh.shape[1]:  # grouped K/V that did divide: expand locally
+        rep = qh.shape[1] // kh.shape[1]
+        kh = jnp.repeat(kh, rep, axis=1)
+        vh = jnp.repeat(vh, rep, axis=1)
+
+    T = qh.shape[2]
+    s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh, preferred_element_type=jnp.float32)
+    s = s / (hs ** 0.5)
+    if causal:
+        s = jnp.where(jnp.tril(jnp.ones((T, T), dtype=bool)), s, -jnp.inf)
+    o = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1).astype(vh.dtype), vh)
+
+    # head-sharded → seq-sharded: split sequence, gather heads
+    return jax.lax.all_to_all(o, axis, split_axis=2, concat_axis=1, tiled=True)
+
+
+def _ulysses_attention(ap, x, cos_b, sin_b, cfg, *, axis: str, sp: int):
+    B, T_loc, C = x.shape
+    q, k, v = _project_qkv(ap, x, cos_b, sin_b, cfg)
+    y = ulysses_attend_shard(q, k, v, axis=axis, sp=sp, causal=True)
+    y = y.transpose(0, 2, 1, 3).reshape(B, T_loc, cfg.n_head * cfg.head_size)
+    return y @ ap["wo"].T
+
+
+def ulysses_gpt_loss(params, idx, targets, cos, sin, cfg, *, mesh: Mesh, axis: str = "sp"):
+    """Next-token loss with the sequence dim sharded over ``mesh[axis]`` and
+    attention computed head-parallel via all_to_all.  Same contract and
+    numerics as ``sp_gpt_loss`` (which uses the ring instead)."""
+    from thunder_tpu.distributed.sp import seq_parallel_gpt_loss
+
+    return seq_parallel_gpt_loss(
+        params, idx, targets, cos, sin, cfg, mesh=mesh, axis=axis,
+        attend_fn=_ulysses_attention,
+    )

@@ -125,13 +125,14 @@ _register_quant(ex, "int8", int8_linear, int8_matmul)
 
 
 #
-# FP8 (e4m3) executor — the literal TransformerEngine recipe
-# (reference transformer_engineex.py:183-336: per-tensor amax scaling into
-# e4m3 for the forward GEMMs).  thunder_tpu's fp8 dtypes
-# (core/dtypes.py:199-202) execute through here.  On TPU generations without
-# fp8 matmul units the cast runs on the VPU and the dot accumulates from the
-# dequantized operands — numerics-faithful to the TE contract (amax/absmax
-# scaling, e4m3 range ±448) and ready for fp8-capable hardware; int8 remains
+# FP8 (e4m3) executor — the TransformerEngine-class capability (reference
+# transformer_engineex.py:183-336 runs forward GEMMs in e4m3).  Scaling here
+# is dynamic per-ROW absmax (per-token activations, per-output-channel
+# weights, like the int8 path) — finer-grained than TE's per-tensor
+# amax-history recipe, so numerics are at least as tight but NOT bit-matched
+# to TE.  thunder_tpu's fp8 dtypes (core/dtypes.py:199-202) execute through
+# here.  On TPU generations without fp8 matmul units the cast runs on the
+# VPU and the dot accumulates from the dequantized operands; int8 remains
 # the v5e-native fast path.
 #
 

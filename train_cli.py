@@ -44,6 +44,9 @@ def main(argv=None):
                     help="quantized training: int8/fp8(e4m3) forward GEMMs, full-precision grads")
     ap.add_argument("--comm-combine-mb", type=float, default=None,
                     help="XLA collective-combining threshold in MiB (the bucket_size_in_mb analog)")
+    ap.add_argument("--sp-impl", default="ring", choices=["ring", "ulysses"],
+                    help="sequence-parallel attention: ring (ppermute K/V rotation) or "
+                         "ulysses (all_to_all seq<->head re-shard)")
     ap.add_argument("--devices", type=int, default=1)
     ap.add_argument("--virtual-cpu", action="store_true", help="force N virtual CPU devices (no hardware needed)")
     ap.add_argument("--batch", type=int, default=8)
@@ -96,9 +99,10 @@ def main(argv=None):
             assert T % args.devices == 0, f"--seq {T} must divide over sp={args.devices}"
             mesh = dist.make_mesh({"sp": args.devices}, devices=devices)
             train_params = params
+            sp_loss = dist.ulysses_gpt_loss if args.sp_impl == "ulysses" else dist.sp_gpt_loss
 
             def loss_fn(p, i, t):
-                return dist.sp_gpt_loss(p, i, t, cos, sin, cfg, mesh=mesh)
+                return sp_loss(p, i, t, cos, sin, cfg, mesh=mesh)
         elif args.mode == "pp":
             pp = args.devices
             assert cfg.n_layer % pp == 0, f"n_layer {cfg.n_layer} must divide over pp={pp}"

@@ -218,3 +218,45 @@ def test_llama_sdpa_gqa_hits_flash_path(monkeypatch):
     )
     src = ttpu.last_traces(jm)[-1].python()
     assert "pallas_sdpa" in src, f"HF Llama sdpa fell off the flash path:\n{src[:2000]}"
+
+
+def test_bart_encoder_decoder_cross_attention(monkeypatch):
+    """Encoder-decoder cross-attention (the reference keeps an HF BART
+    attention test model, tests/hf_bart_self_attn.py): a stock BART model
+    traces through ThunderModule — decoder self-attention (causal), encoder
+    self-attention (padding mask), and cross-attention (Tq != Tk) all in one
+    forward."""
+    cfg = transformers.BartConfig(
+        encoder_layers=1,
+        decoder_layers=1,
+        encoder_attention_heads=2,
+        decoder_attention_heads=2,
+        d_model=32,
+        encoder_ffn_dim=64,
+        decoder_ffn_dim=64,
+        vocab_size=128,
+        max_position_embeddings=64,
+        dropout=0.0,
+        attention_dropout=0.0,
+        activation_dropout=0.0,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    model = transformers.BartModel(cfg).eval()
+    gen = torch.Generator().manual_seed(7)
+    enc_ids = torch.randint(0, 128, (2, 12), generator=gen)
+    dec_ids = torch.randint(0, 128, (2, 8), generator=gen)
+    enc_mask = torch.ones_like(enc_ids)
+    enc_mask[:, -3:] = 0
+    with torch.no_grad():
+        ref = model(
+            input_ids=enc_ids, attention_mask=enc_mask,
+            decoder_input_ids=dec_ids, use_cache=False,
+        ).last_hidden_state
+
+    jm = ttpu.jit(model)
+    out = jm(input_ids=enc_ids, attention_mask=enc_mask,
+             decoder_input_ids=dec_ids, use_cache=False)
+    np.testing.assert_allclose(
+        out.last_hidden_state.detach().numpy(), ref.numpy(), rtol=1e-4, atol=1e-5
+    )

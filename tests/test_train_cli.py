@@ -11,7 +11,7 @@ def test_cli_runs_and_reports(mode, tmp_path):
     out = subprocess.run(
         [sys.executable, "train_cli.py", "--mode", mode, "--devices", "4",
          "--virtual-cpu", "--steps", "2", "--batch", "4", "--seq", "32"],
-        capture_output=True, text=True, timeout=420, cwd="/root/repo",
+        capture_output=True, text=True, timeout=900, cwd="/root/repo",
     )
     assert out.returncode == 0, out.stderr[-2000:]
     report = json.loads(out.stdout.strip().splitlines()[-1])
@@ -32,7 +32,7 @@ def test_cli_shard_modes(mode, config, devices, extra):
         [sys.executable, "train_cli.py", "--config", config, "--mode", mode,
          "--devices", str(devices), "--virtual-cpu", "--steps", "2",
          "--batch", "4", *extra],
-        capture_output=True, text=True, timeout=420, cwd="/root/repo",
+        capture_output=True, text=True, timeout=900, cwd="/root/repo",
     )
     assert out.returncode == 0, out.stderr[-2000:]
     report = json.loads(out.stdout.strip().splitlines()[-1])
@@ -46,7 +46,7 @@ def test_cli_quant_int8_training(tmp_path):
         [sys.executable, "train_cli.py", "--mode", "none", "--devices", "1",
          "--virtual-cpu", "--quant", "int8", "--steps", "2", "--batch", "4",
          "--seq", "32"],
-        capture_output=True, text=True, timeout=420, cwd="/root/repo",
+        capture_output=True, text=True, timeout=900, cwd="/root/repo",
     )
     assert out.returncode == 0, out.stderr[-2000:]
     report = json.loads(out.stdout.strip().splitlines()[-1])

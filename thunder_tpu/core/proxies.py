@@ -626,14 +626,30 @@ class TensorProxy(Proxy, TensorProxyInterface):
     def __torch_function__(cls, func, types, args=(), kwargs=None):
         kwargs = kwargs or {}
         from thunder_tpu.torch import _torch_to_thunder_function_map
+        from thunder_tpu.torch_interop import _bake_torch_constants
 
         mapped = _torch_to_thunder_function_map.get(func)
-        if mapped is None:
-            raise NotImplementedError(
-                f"torch function {func} is not yet mapped into thunder_tpu; "
-                f"register it in thunder_tpu/torch/__init__.py"
-            )
-        return mapped(*args, **kwargs)
+        if mapped is not None:
+            # real torch.Tensor operands (constants from the tracing mode's
+            # concrete-factory fast path) bake into the trace before dispatch
+            args, kwargs = _bake_torch_constants(args, kwargs)
+            return mapped(*args, **kwargs)
+
+        # mixed real-tensor ⊗ proxy METHOD calls dispatch here with the
+        # TensorBase slot fn (e.g. `real > proxy` → method 'gt'): bake the
+        # constants, then resolve by name on the receiver through the
+        # proxy's method protocol (langctx)
+        name = getattr(func, "__name__", "")
+        args, kwargs = _bake_torch_constants(args, kwargs)
+        if args and isinstance(args[0], TensorProxy) and name:
+            recv_method = getattr(args[0], name, None)
+            if callable(recv_method):
+                return recv_method(*args[1:], **kwargs)
+
+        raise NotImplementedError(
+            f"torch function {func} is not yet mapped into thunder_tpu; "
+            f"register it in thunder_tpu/torch/__init__.py"
+        )
 
     # numpy interop: real np.* calls on proxies divert into the numpy langctx
     # (the numpy analog of __torch_function__; reference thunder/numpy)
@@ -778,6 +794,32 @@ class TensorProxy(Proxy, TensorProxyInterface):
         if method is None:
             raise NotImplementedError("No getitem in the active language context")
         return method(self, key)
+
+    def __setitem__(self, key, value):
+        """In-place indexed assignment under functional tracing (torch's
+        ``a[k] = v`` contract): record the functional update, then REBIND
+        this Python object to the result.  Bound symbols hold proxy OBJECTS
+        and resolve names late, so everything already recorded against this
+        object is first re-pointed at a same-named snapshot of the old
+        value — after that, every later use of this object reads the updated
+        value while the history keeps the old one."""
+        from thunder_tpu.core.trace import get_tracectx
+
+        method = resolve_method("setitem", self, key, value)
+        if method is None:
+            raise NotImplementedError("No setitem in the active language context")
+        new = method(self, key, value)
+        trace = get_tracectx()
+        if trace is not None:
+            import copy as _copy
+
+            old_snapshot = _copy.copy(self)  # same name, distinct identity
+            swap = {variableify(self): old_snapshot}
+            # in-place: the active recording scope holds this list object
+            trace.bound_symbols[:] = [
+                b.from_bsym_swap_proxies(swap) for b in trace.bound_symbols
+            ]
+        self._name = new._name
 
     def __len__(self):
         check(self.ndim > 0, lambda: "len() of a 0-d tensor")

@@ -159,7 +159,20 @@ class Symbol(SymbolInterface):
                 return x
 
             flat, spec = _tf((args, kwargs))
-            args, kwargs = _tu([_fold(x) for x in flat], spec)
+            flat = [_fold(x) for x in flat]
+            # real torch.Tensor operands (constants from the tracing mode's
+            # concrete-factory fast path) bake to constant proxies BEFORE
+            # binding, so recorded bsym args never carry raw torch tensors
+            if any(type(x).__module__.startswith("torch") for x in flat):
+                import torch as _torch
+
+                from thunder_tpu.torch_interop import _const_tensor_proxy
+
+                flat = [
+                    _const_tensor_proxy(x) if isinstance(x, _torch.Tensor) else x
+                    for x in flat
+                ]
+            args, kwargs = _tu(flat, spec)
 
         if self.is_prim:
             # prims run their meta without recording subsymbols

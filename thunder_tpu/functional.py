@@ -153,6 +153,11 @@ def trace_from_fn(
         computation_trace._grad_meta = grad_meta
 
     proxy_args, proxy_kwargs = tree_unflatten(proxies, spec)
+    # __setitem__ on an input proxy rebinds the OBJECT to the updated value's
+    # name; the computation signature must keep binding the ORIGINAL name
+    # (the pre-assignment value the body's early uses reference), so input
+    # names are snapshotted here and restored onto same-named copies below
+    input_names = [p.name if isinstance(p, TensorProxy) else None for p in proxies]
 
     state_cap = None
     with tracectx(computation_trace):
@@ -164,12 +169,24 @@ def trace_from_fn(
                 computation_trace._interpreter_log = state_cap.interpreter_log
             else:
                 result = fn(*proxy_args, **proxy_kwargs)
+
         # epilogue: record mutations of the input containers (the reference
         # records setattrs into an epilogue trace, jit_ext.py:1336; here the
         # observable state is the argument pytree — d[key] = new_tensor in
         # the traced fn writes back into the caller's container after the
-        # computation runs)
+        # computation runs).  Runs BEFORE the input-name restore below: an
+        # in-place ``x[k] = v`` REBINDS the same proxy object (new is old →
+        # not a container mutation; the edit is functional), while a
+        # container-slot replacement swaps in a different object.
         mutations = _detect_mutations(proxies, spec, proxy_args, proxy_kwargs)
+
+        import copy as _copy
+
+        for i, (p, n) in enumerate(zip(proxies, input_names)):
+            if n is not None and isinstance(p, TensorProxy) and p.name != n:
+                restored = _copy.copy(p)
+                restored._name = n
+                proxies[i] = restored
         # one value per DISTINCT proxy (a tensor written to two slots appears
         # once in the return and the epilogue signature)
         mutated_values = list({p.name: p for _, p in mutations}.values())

@@ -13,6 +13,7 @@ against torch run under thunder_tpu tracing without a bytecode interpreter.
 from __future__ import annotations
 
 import builtins
+import functools
 import math
 import sys
 from numbers import Number
@@ -50,6 +51,8 @@ class torchsymbol:
 
     def __call__(self, fn: Callable) -> Symbol:
         name = fn.__name__
+        # real torch.Tensor operands bake to constant proxies centrally in
+        # Symbol.__call__ (pre-bind), so the meta needs no wrapping here
         sym = Symbol(name=name, meta=fn, id=self.id or f"torch.{name}", module=_this_module)
         _torchsymbols[name] = sym
         if self.is_method or self.method_name is not None:
@@ -451,6 +454,15 @@ def contiguous(a):
     return a  # layout is XLA's concern on TPU
 
 
+@torchsymbol(_tfn("clone"), is_method=True)
+def clone(a, *, memory_format=None):
+    """Tracing is functional, so clone's one obligation is a DISTINCT proxy:
+    in-place edits (``__setitem__`` rebinding) on the clone must not follow
+    the source object.  The same-dtype convert records a fresh named proxy;
+    XLA folds it to nothing."""
+    return prims.convert_element_type(a, a.dtype)
+
+
 @torchsymbol(_tfn("repeat_interleave"), is_method=True)
 def repeat_interleave(a, repeats: int, dim: int):
     dim = utils.canonicalize_dim(a.ndim, dim)
@@ -495,6 +507,79 @@ def roll(a, shifts, dims):
 @torchsymbol(method_name="getitem")
 def getitem(a, key):
     return clang.getitem(a, key)
+
+
+@torchsymbol(method_name="setitem")
+def setitem(a, key, value):
+    """Functional basic-indexing assignment: returns ``a`` with
+    ``a[key] = value``.  ``TensorProxy.__setitem__`` rebinds the Python
+    object to this result, which gives in-place semantics under tracing
+    (the HF mask-editing pattern ``m[:, :, :, :L] = m2.masked_fill(...)``).
+
+    Supported keys: ints, stride-1 slices, Ellipsis.  Lowering: the value is
+    broadcast into the selected region, zero-padded to ``a``'s shape, and
+    merged with an iota-derived region mask — static shapes throughout, so
+    XLA fuses the whole edit.
+    """
+    keyt = key if isinstance(key, tuple) else (key,)
+    if any(k is Ellipsis for k in keyt):
+        i = next(i for i, k in enumerate(keyt) if k is Ellipsis)
+        n_spec = sum(1 for k in keyt if k is not Ellipsis)
+        keyt = keyt[:i] + (slice(None),) * (a.ndim - n_spec) + keyt[i + 1 :]
+    keyt = keyt + (slice(None),) * (a.ndim - len(keyt))
+    check(len(keyt) == a.ndim, lambda: f"setitem: too many indices for rank {a.ndim}")
+
+    starts, stops, value_dims = [], [], []
+    for d, k in enumerate(keyt):
+        n = a.shape[d]
+        if isinstance(k, (int, NumberProxy)):
+            ki = int(pyval(k) if isinstance(k, NumberProxy) else k)
+            ki = ki + n if ki < 0 else ki
+            check(0 <= ki < n, lambda: f"setitem: index {ki} out of range for dim {d} (size {n})")
+            starts.append(ki)
+            stops.append(ki + 1)
+        elif isinstance(k, slice):
+            start, stop, step = k.indices(n)
+            check(step == 1, lambda: "setitem supports stride-1 slices only")
+            starts.append(start)
+            stops.append(builtins.max(start, stop))
+            value_dims.append(d)
+        else:
+            raise NotImplementedError(
+                "setitem supports int/slice/Ellipsis keys; use index_put for tensor indices"
+            )
+    region = tuple(stops[d] - starts[d] for d in range(a.ndim))
+
+    if isinstance(value, TensorProxy):
+        v = clang.maybe_convert_to_dtype(value, a.dtype)
+        check(
+            v.ndim <= len(value_dims),
+            lambda: f"setitem: value rank {v.ndim} exceeds selection rank {len(value_dims)}",
+        )
+        # right-align the value's dims against the sliced dims (torch
+        # broadcasting), with int-indexed dims as size-1
+        vshape = [1] * a.ndim
+        for vd, d in zip(reversed(range(v.ndim)), reversed(value_dims)):
+            vshape[d] = v.shape[vd]
+        v = clang.reshape(v, tuple(vshape))
+        v = clang.expand(v, region)
+    else:
+        v = clang.full(region, value, device=a.device, dtype=a.dtype)
+
+    pad_cfg = tuple((starts[d], a.shape[d] - stops[d], 0) for d in range(a.ndim))
+    v = clang.pad(v, 0, pad_cfg)
+
+    mask = None
+    for d in range(a.ndim):
+        if starts[d] == 0 and stops[d] == a.shape[d]:
+            continue  # full dim: no constraint
+        row = clang.arange(0, a.shape[d], device=a.device, dtype=dtypes.int32)
+        m = clang.bitwise_and(clang.ge(row, starts[d]), clang.lt(row, stops[d]))
+        m = clang.reshape(m, (1,) * d + (a.shape[d],) + (1,) * (a.ndim - d - 1))
+        mask = m if mask is None else clang.bitwise_and(mask, m)
+    if mask is None:  # whole-tensor assignment
+        return v
+    return clang.where(mask, v, a)
 
 
 @torchsymbol(_tfn("index_select"), is_method=True)

@@ -17,6 +17,7 @@ trace time.
 """
 from __future__ import annotations
 
+import itertools
 from typing import Any, Callable
 
 import jax.numpy as jnp
@@ -24,6 +25,68 @@ import numpy as np
 import torch
 
 __all__ = ["ThunderModule", "ThunderFunction", "functional_call", "ThunderTracingMode"]
+
+
+_const_counter = itertools.count()
+
+
+def _normalize_torch_device_kwarg(kwargs: dict) -> None:
+    dev = kwargs.get("device")
+    if isinstance(dev, torch.device):
+        typ = "tpu" if dev.type in ("cuda", "xla") else dev.type
+        kwargs["device"] = f"{typ}:{dev.index}" if dev.index is not None else typ
+
+
+def _const_tensor_proxy(t: torch.Tensor):
+    """Bakes a concrete torch tensor into the active trace as a CONSTANT:
+    records a zero-input producer bsym whose call-ctx callable returns the
+    jax value (the FusionCallable pattern, executors/xlaex.py) and returns
+    its output proxy.  This is how native-torch constant math (masks built
+    by the concrete-factory fast path) re-enters the traced program.
+
+    The proxy is memoized per tensor identity on the trace: re-baking the
+    SAME tensor returns the SAME proxy object, so an in-place traced edit
+    (``m[1:3] = traced`` rebinding the proxy) is visible to every later
+    diverted use of ``m``.  (Native real-tensor reads after a traced edit
+    still see the old buffer — mixing directions is inherently lossy.)"""
+    from thunder_tpu.core.proxies import tensorproxy
+    from thunder_tpu.core.symbol import Symbol
+    from thunder_tpu.core.trace import get_tracectx
+
+    trace = get_tracectx()
+    aliases = getattr(trace, "_torch_const_aliases", None)
+    if aliases is None:
+        aliases = trace._torch_const_aliases = {}
+    hit = aliases.get(id(t))
+    if hit is not None and hit[0] is t:
+        return hit[1]
+    arr = _to_jax(t.detach() if t.requires_grad else t)
+    p = tensorproxy(arr, requires_grad=False)
+    cname = f"TCONST{next(_const_counter)}"
+    sym = Symbol(name=cname, meta=None, is_fusion=True)
+    bsym = sym.bind(output=p, subsymbols=(), _call_ctx={cname: lambda arr=arr: arr})
+    trace.record(bsym)
+    aliases[id(t)] = (t, p)  # pins t so the id can't be recycled mid-trace
+    return p
+
+
+def _bake_torch_constants(args, kwargs):
+    """Replaces real torch.Tensor leaves in a diverted call's arguments with
+    baked constant proxies (lists/tuples walked one level — the layouts the
+    torch surface accepts)."""
+    from thunder_tpu.core.trace import get_tracectx
+
+    if get_tracectx() is None:
+        return args, kwargs
+
+    def conv(x):
+        if isinstance(x, torch.Tensor):
+            return _const_tensor_proxy(x)
+        if isinstance(x, (list, tuple)) and any(isinstance(e, torch.Tensor) for e in x):
+            return type(x)(conv(e) for e in x)
+        return x
+
+    return tuple(conv(a) for a in args), {k: conv(v) for k, v in kwargs.items()}
 
 
 class ThunderTracingMode(torch.overrides.TorchFunctionMode):
@@ -34,6 +97,31 @@ class ThunderTracingMode(torch.overrides.TorchFunctionMode):
     interpreter lookasides for this (jit_ext.py:884); a TorchFunctionMode is
     the functional-frontend equivalent."""
 
+    # deterministic factories: a call with fully concrete arguments produces
+    # a CONSTANT — keeping it a real torch.Tensor preserves downstream
+    # `isinstance(x, torch.Tensor)` branches (HF mask plumbing decides
+    # "user supplied a mask" that way) and lets constant mask math run
+    # natively once instead of being traced.  RNG factories are NOT here:
+    # they must divert so every compiled call resamples through thunder's
+    # RNG instead of baking one sample.
+    _CONCRETE_FACTORIES = frozenset(
+        f for f in (
+            getattr(torch, n, None)
+            for n in ("ones", "zeros", "full", "arange", "linspace", "eye", "empty")
+        ) if f is not None
+    )
+
+    @staticmethod
+    def _any_thunder_arg(args, kwargs) -> bool:
+        from thunder_tpu.core import dtypes as ttd
+        from thunder_tpu.core.devices import Device as _TDev
+        from thunder_tpu.core.proxies import Proxy
+
+        def is_thunder(x):
+            return isinstance(x, (Proxy, ttd.dtype, _TDev))
+
+        return any(is_thunder(a) for a in args) or any(is_thunder(v) for v in kwargs.values())
+
     def __torch_function__(self, func, types, args=(), kwargs=None):
         kwargs = dict(kwargs or {})
         from thunder_tpu.core.trace import get_tracectx
@@ -42,10 +130,11 @@ class ThunderTracingMode(torch.overrides.TorchFunctionMode):
         if get_tracectx() is not None:
             mapped = _torch_to_thunder_function_map.get(func)
             if mapped is not None:
-                dev = kwargs.get("device")
-                if isinstance(dev, torch.device):
-                    typ = "tpu" if dev.type in ("cuda", "xla") else dev.type
-                    kwargs["device"] = f"{typ}:{dev.index}" if dev.index is not None else typ
+                if func in self._CONCRETE_FACTORIES and not self._any_thunder_arg(args, kwargs):
+                    with torch._C.DisableTorchFunction():
+                        return func(*args, **kwargs)
+                _normalize_torch_device_kwarg(kwargs)
+                args, kwargs = _bake_torch_constants(args, kwargs)
                 return mapped(*args, **kwargs)
         return func(*args, **kwargs)
 
@@ -99,6 +188,35 @@ class ThunderTracingMode(torch.overrides.TorchFunctionMode):
         return shim
 
     @staticmethod
+    def _factory_shim(orig):
+        # torch.full/zeros/ones/... with dtype=<thunder dtype> (HF mask code
+        # feeds a proxy's .dtype back into a factory): torch's C arg parser
+        # rejects the foreign dtype BEFORE __torch_function__ dispatch can
+        # divert, so these factories are patched to route through the mapped
+        # thunder op while a trace is active
+        def shim(*args, **kwargs):
+            from thunder_tpu.core import dtypes as ttd
+            from thunder_tpu.core.devices import Device as _TDev
+            from thunder_tpu.core.trace import get_tracectx
+            from thunder_tpu.torch import _torch_to_thunder_function_map
+
+            dtype = kwargs.get("dtype")
+            if get_tracectx() is not None and isinstance(dtype, ttd.dtype):
+                mapped = _torch_to_thunder_function_map.get(orig)
+                if mapped is not None:
+                    _normalize_torch_device_kwarg(kwargs)
+                    return mapped(*args, **kwargs)
+                kwargs["dtype"] = ttd.to_torch_dtype(dtype)
+            dev = kwargs.get("device")
+            if isinstance(dev, _TDev):  # thunder Device str confuses torch
+                kwargs.pop("device")
+            return orig(*args, **kwargs)
+
+        return shim
+
+    _FACTORY_NAMES = ("full", "zeros", "ones", "empty", "arange", "linspace", "eye")
+
+    @staticmethod
     def _finfo_shim(orig):
         # torch.finfo/iinfo reject thunder dtypes at the C arg parser (they
         # are not torch.dtype); HF mask code calls torch.finfo(t.dtype).min
@@ -127,6 +245,10 @@ class ThunderTracingMode(torch.overrides.TorchFunctionMode):
                 setattr(torch, name, self._finfo_shim(orig))
             cls._patches.append((torch, "tensor", torch.tensor))
             torch.tensor = self._tensor_shim(torch.tensor)
+            for name in cls._FACTORY_NAMES:
+                orig = getattr(torch, name)
+                cls._patches.append((torch, name, orig))
+                setattr(torch, name, self._factory_shim(orig))
             # HF mask utils guard data-dependent branches ("skip the mask if
             # torch.all(mask == 1)") behind torch.jit.is_tracing(); answer
             # True so they take the tracing-safe path instead of forcing a

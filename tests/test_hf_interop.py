@@ -260,3 +260,46 @@ def test_bart_encoder_decoder_cross_attention(monkeypatch):
     np.testing.assert_allclose(
         out.last_hidden_state.detach().numpy(), ref.numpy(), rtol=1e-4, atol=1e-5
     )
+
+
+def test_mistral_sliding_window_forward_matches_eager():
+    """Mistral's sliding-window causal attention traces unmodified (the
+    window mask arrives as an additive bias through the SDPA mask path)."""
+    cfg = transformers.MistralConfig(
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        hidden_size=64,
+        intermediate_size=128,
+        vocab_size=128,
+        max_position_embeddings=64,
+        sliding_window=8,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    model = transformers.MistralForCausalLM(cfg).eval()
+    ids = torch.randint(0, 128, (2, 16), generator=torch.Generator().manual_seed(3))
+    with torch.no_grad():
+        ref = model(ids, use_cache=False).logits
+    out = ttpu.jit(model)(input_ids=ids, use_cache=False)
+    np.testing.assert_allclose(out.logits.detach().numpy(), ref.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_t5_relative_position_bias_matches_eager():
+    """T5's learned relative-position bias (bucketed distances computed with
+    torch.min/abs/log on constants, added to attention scores) traces
+    end-to-end, encoder and decoder."""
+    cfg = transformers.T5Config(
+        num_layers=1, num_decoder_layers=1, num_heads=2, d_model=32, d_ff=64,
+        d_kv=16, vocab_size=128, attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    model = transformers.T5Model(cfg).eval()
+    enc = torch.randint(0, 128, (2, 12), generator=torch.Generator().manual_seed(5))
+    dec = torch.randint(0, 128, (2, 8), generator=torch.Generator().manual_seed(6))
+    with torch.no_grad():
+        ref = model(input_ids=enc, decoder_input_ids=dec, use_cache=False).last_hidden_state
+    out = ttpu.jit(model)(input_ids=enc, decoder_input_ids=dec, use_cache=False)
+    np.testing.assert_allclose(
+        out.last_hidden_state.detach().numpy(), ref.numpy(), rtol=1e-3, atol=1e-4
+    )

@@ -815,10 +815,10 @@ class TensorProxy(Proxy, TensorProxyInterface):
 
             old_snapshot = _copy.copy(self)  # same name, distinct identity
             swap = {variableify(self): old_snapshot}
-            # in-place: the active recording scope holds this list object
-            trace.bound_symbols[:] = [
-                b.from_bsym_swap_proxies(swap) for b in trace.bound_symbols
-            ]
+            # in-place on the ACTIVE recording scope (a composite's subscope
+            # when one is open, else the trace's top level)
+            scope = trace.peek_scope()
+            scope[:] = [b.from_bsym_swap_proxies(swap) for b in scope]
         self._name = new._name
 
     def __len__(self):

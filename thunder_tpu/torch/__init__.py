@@ -552,6 +552,10 @@ def setitem(a, key, value):
 
     if isinstance(value, TensorProxy):
         v = clang.maybe_convert_to_dtype(value, a.dtype)
+        # torch broadcasting: extra LEADING size-1 dims beyond the selection
+        # rank are legal (c[0, :] = ones(1, 8)) — strip them
+        while v.ndim > len(value_dims) and v.shape[0] == 1:
+            v = clang.reshape(v, v.shape[1:])
         check(
             v.ndim <= len(value_dims),
             lambda: f"setitem: value rank {v.ndim} exceeds selection rank {len(value_dims)}",
@@ -707,6 +711,8 @@ def amin(a, dim=None, keepdim=False):
 def max(a, dim=None, keepdim=False):
     if dim is None:
         return clang.amax(a, None, False)
+    if isinstance(dim, TensorProxy):  # torch.max(a, other): elementwise
+        return clang.maximum(a, dim)
     dim = utils.canonicalize_dim(a.ndim, dim)
     values = clang.amax(a, dim, keepdim)
     indices = clang.argmax(a, dim, keepdim)
@@ -717,6 +723,8 @@ def max(a, dim=None, keepdim=False):
 def min(a, dim=None, keepdim=False):
     if dim is None:
         return clang.amin(a, None, False)
+    if isinstance(dim, TensorProxy):  # torch.min(a, other): elementwise
+        return clang.minimum(a, dim)
     dim = utils.canonicalize_dim(a.ndim, dim)
     values = clang.amin(a, dim, keepdim)
     indices = clang.argmin(a, dim, keepdim)

@@ -30,6 +30,19 @@ __all__ = ["ThunderModule", "ThunderFunction", "functional_call", "ThunderTracin
 _const_counter = itertools.count()
 
 
+def _translate_thunder_metadata(x):
+    """thunder dtype → torch dtype; thunder Device → host device (constants
+    live on the host); everything else unchanged."""
+    from thunder_tpu.core import dtypes as ttd
+    from thunder_tpu.core.devices import Device as _TDev
+
+    if isinstance(x, ttd.dtype):
+        return ttd.to_torch_dtype(x)
+    if isinstance(x, _TDev):
+        return torch.device("cpu")
+    return x
+
+
 def _normalize_torch_device_kwarg(kwargs: dict) -> None:
     dev = kwargs.get("device")
     if isinstance(dev, torch.device):
@@ -60,7 +73,7 @@ def _const_tensor_proxy(t: torch.Tensor):
     hit = aliases.get(id(t))
     if hit is not None and hit[0] is t:
         return hit[1]
-    arr = _to_jax(t.detach() if t.requires_grad else t)
+    arr = _to_jax(t)  # _to_jax detaches
     p = tensorproxy(arr, requires_grad=False)
     cname = f"TCONST{next(_const_counter)}"
     sym = Symbol(name=cname, meta=None, is_fusion=True)
@@ -136,6 +149,23 @@ class ThunderTracingMode(torch.overrides.TorchFunctionMode):
                 _normalize_torch_device_kwarg(kwargs)
                 args, kwargs = _bake_torch_constants(args, kwargs)
                 return mapped(*args, **kwargs)
+            # unmapped call on REAL tensors that only carries thunder
+            # metadata (e.g. `real.to(dtype=proxy.dtype)` in T5): translate
+            # the dtype/device objects to torch equivalents and run natively
+            # — the result stays a real-tensor constant
+            from thunder_tpu.core import dtypes as ttd
+            from thunder_tpu.core.devices import Device as _TDev
+            from thunder_tpu.core.proxies import Proxy
+
+            flat_vals = list(args) + list(kwargs.values())
+            if any(isinstance(v, (ttd.dtype, _TDev)) for v in flat_vals) and not any(
+                isinstance(v, Proxy) for v in flat_vals
+            ):
+                with torch._C.DisableTorchFunction():
+                    return func(
+                        *(_translate_thunder_metadata(a) for a in args),
+                        **{k: _translate_thunder_metadata(v) for k, v in kwargs.items()},
+                    )
         return func(*args, **kwargs)
 
     # HF transformers builds 4D attention masks by torch.vmap-ing elementwise
@@ -184,6 +214,21 @@ class ThunderTracingMode(torch.overrides.TorchFunctionMode):
             if device is not None and not isinstance(device, _TDev):
                 kwargs["device"] = device
             return orig(data, *args, **kwargs)
+
+        return shim
+
+    @staticmethod
+    def _tensor_to_shim(orig):
+        # real_tensor.to(dtype=<thunder dtype>) (T5 casts constants to a
+        # proxy's dtype): torch's C parser rejects the foreign dtype before
+        # any __torch_function__ dispatch, so Tensor.to is patched to
+        # translate thunder dtype/Device objects first
+        def shim(self_t, *args, **kwargs):
+            return orig(
+                self_t,
+                *(_translate_thunder_metadata(a) for a in args),
+                **{k: _translate_thunder_metadata(v) for k, v in kwargs.items()},
+            )
 
         return shim
 
@@ -249,6 +294,8 @@ class ThunderTracingMode(torch.overrides.TorchFunctionMode):
                 orig = getattr(torch, name)
                 cls._patches.append((torch, name, orig))
                 setattr(torch, name, self._factory_shim(orig))
+            cls._patches.append((torch.Tensor, "to", torch.Tensor.to))
+            torch.Tensor.to = self._tensor_to_shim(torch.Tensor.to)
             # HF mask utils guard data-dependent branches ("skip the mask if
             # torch.all(mask == 1)") behind torch.jit.is_tracing(); answer
             # True so they take the tracing-safe path instead of forcing a

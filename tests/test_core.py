@@ -219,3 +219,56 @@ def test_cross_entropy_bf16_f32_accumulation():
         np.testing.assert_allclose(
             float(jnp.asarray(out, jnp.float32)), float(ref.float()), rtol=5e-3
         )
+
+
+class TestSymbolicValuesCache:
+    """CACHE_OPTIONS.SYMBOLIC_VALUES (reference core/options.py:95,
+    compile_data.py:75): int/float arguments stay symbolic — one compiled
+    entry serves every value of the same type, guarded by type-only prologue
+    checks.  Shapes are served by bucketing (TrainStep bucketer)."""
+
+    def test_one_entry_serves_many_scalar_values(self):
+        jfn = ttpu.jit(lambda x, scale: x * scale + 1.0, cache="symbolic values")
+        x = jnp.ones((4,))
+        for s in (2.0, 3.5, -1.0):
+            np.testing.assert_allclose(np.asarray(jfn(x, s)), s + 1.0)
+        assert ttpu.cache_misses(jfn) == 1 and ttpu.cache_hits(jfn) == 2
+
+    def test_type_change_retraces(self):
+        jfn = ttpu.jit(lambda x, s: x * s, cache="symbolic values")
+        x = jnp.ones((3,))
+        jfn(x, 2.0)
+        jfn(x, 3)  # float -> int: type guard fails, one retrace
+        assert ttpu.cache_misses(jfn) == 2
+        jfn(x, 7)
+        assert ttpu.cache_hits(jfn) == 1
+
+    def test_grad_through_symbolic_scalar(self):
+        vg = ttpu.value_and_grad(lambda x, s: (x * s).sum(), cache="symbolic values")
+        x = jnp.ones((4,))
+        _, g = vg(x, 2.5)
+        np.testing.assert_allclose(np.asarray(g), 2.5)
+        _, g2 = vg(x, 4.0)
+        np.testing.assert_allclose(np.asarray(g2), 4.0)
+        assert ttpu.cache_misses(vg) == 1
+
+    def test_default_cache_unchanged(self):
+        jfd = ttpu.jit(lambda x, s: x * s)
+        x = jnp.ones((3,))
+        jfd(x, 2.0)
+        jfd(x, 3.0)  # CONSTANT_VALUES: new constant, retrace
+        assert ttpu.cache_misses(jfd) == 2
+
+    def test_control_flow_on_symbolic_scalar_raises(self):
+        x = jnp.ones((4,))
+        with pytest.raises(NotImplementedError, match="symbolic"):
+            ttpu.jit(lambda x, s: x * s if s else x + 1.0, cache="symbolic values")(x, 2.0)
+        with pytest.raises(NotImplementedError, match="symbolic"):
+            ttpu.jit(lambda x, s: x + (1.0 if s == 0 else 2.0), cache="symbolic values")(x, 2.0)
+
+    def test_number_subclasses_canonicalize(self):
+        x = jnp.ones((4,))
+        jfn = ttpu.jit(lambda x, s: x * s, cache="symbolic values")
+        np.testing.assert_allclose(np.asarray(jfn(x, np.float64(2.0))), 2.0)
+        np.testing.assert_allclose(np.asarray(jfn(x, 3.0)), 3.0)
+        assert ttpu.cache_misses(jfn) == 1 and ttpu.cache_hits(jfn) == 1

@@ -237,6 +237,7 @@ def _compile(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict) -> Ca
             kwargs,
             grad_argnums=grad_argnums,
             interpretation=cd.compile_options.get("interpretation"),
+            symbolic_numbers=cd.cache_option is CACHE_OPTIONS.SYMBOLIC_VALUES,
         )
     cs.last_trace_tracing_stop = time.perf_counter_ns()
 

@@ -63,6 +63,7 @@ class PrimIDs(Enum):
     UNPACK_ATTR = auto()
     CHECK_TENSOR_METADATA = auto()
     CHECK_NUMBER_TYPE_AND_VALUE = auto()
+    CHECK_NUMBER_TYPE = auto()
     CHECK_STRING_VALUE = auto()
     CHECK_INSTANCE = auto()
     CHECK_LEN = auto()
@@ -1538,6 +1539,32 @@ check_number_type_and_value = make_prim(
     "check_number_type_and_value",
     meta=lambda n, value: None,
     python_impl=_check_number_type_and_value_impl,
+    tags=(OpTags.CHECK_OP, OpTags.DONT_DCE),
+)
+
+
+def _check_number_type_impl(n, type_name):
+    # symbolic-values caching: the guard pins only the CANONICAL type — any
+    # value of the same kind (incl. subclasses like np.float64/IntEnum)
+    # reuses the compiled entry (the number enters as a runtime scalar)
+    if isinstance(n, bool):
+        canonical = "bool"
+    elif isinstance(n, int):
+        canonical = "int"
+    elif isinstance(n, float):
+        canonical = "float"
+    else:
+        canonical = type(n).__name__
+    if canonical != type_name:
+        raise RuntimeError(f"Number input type changed: expected {type_name}, got {canonical}")
+    return None
+
+
+check_number_type = make_prim(
+    PrimIDs.CHECK_NUMBER_TYPE,
+    "check_number_type",
+    meta=lambda n, type_name: None,
+    python_impl=_check_number_type_impl,
     tags=(OpTags.CHECK_OP, OpTags.DONT_DCE),
 )
 

@@ -364,9 +364,20 @@ class NumberProxy(Proxy, NumberProxyInterface):
     def __abs__(self):
         return abs(pyval(self))
 
+    def _check_concrete(self, op: str) -> None:
+        if self._value is None:
+            raise NotImplementedError(
+                f"cannot use '{op}' on the symbolic number {self.name}: its value is "
+                "unknown at trace time (cache='symbolic values' keeps scalar inputs "
+                "symbolic).  Data-dependent Python control flow on a symbolic scalar "
+                "would bake one branch; use tensor ops (where/cond) instead, or the "
+                "default cache to specialize per value"
+            )
+
     def __eq__(self, other):
         if isinstance(other, Proxy) and not isinstance(other, NumberProxy):
             return NotImplemented
+        self._check_concrete("==")
         ov = pyval(other) if isinstance(other, NumberProxy) else other
         return pyval(self) == ov
 
@@ -392,6 +403,7 @@ class NumberProxy(Proxy, NumberProxyInterface):
         return hash(self._name)
 
     def __bool__(self):
+        self._check_concrete("bool()")
         return bool(pyval(self))
 
     def __int__(self):

@@ -90,6 +90,7 @@ def trace_from_fn(
     *,
     grad_argnums: tuple | None = None,
     interpretation: str | None = None,
+    symbolic_numbers: bool = False,
 ) -> TraceResults:
     """Runs ``fn`` over proxies, returning prologue/computation/epilogue traces.
 
@@ -132,6 +133,16 @@ def trace_from_fn(
                     p = TensorProxy(
                         shape=p.shape, device=p.device, dtype=p.dtype, requires_grad=False
                     )
+            elif symbolic_numbers and isinstance(leaf, (int, float)) and not isinstance(leaf, bool):
+                # CACHE_OPTIONS.SYMBOLIC_VALUES (reference core/options.py:95):
+                # int/float arguments stay SYMBOLIC — value-less NumberProxies
+                # that enter the computation as runtime scalar inputs, so one
+                # compiled entry serves every value of the same type.  A
+                # number that must be concrete at trace time (a shape, a
+                # static flag) raises the documented symbolic-values error at
+                # its use site.  bools stay static (they steer control flow);
+                # shapes are served by bucketing (llama.batch_bucketer).
+                p = numberproxy(float if isinstance(leaf, float) else int, None)
             else:
                 p = proxy_leaf(leaf, computation_trace)
             proxies.append(p)
@@ -201,9 +212,14 @@ def trace_from_fn(
             prims.python_return(result)
     computation_trace._mutations = mutations
 
-    # computation inputs: tensor proxies in flattening order (+ captured
-    # state tensors from the bytecode frontend, + implicit rng key)
-    comp_inputs: list[TensorProxy] = [p for p in proxies if isinstance(p, TensorProxy)]
+    # computation inputs: tensor proxies (+ symbolic runtime scalars) in
+    # flattening order (+ captured state tensors from the bytecode frontend,
+    # + implicit rng key)
+    comp_inputs: list = [
+        p for p in proxies
+        if isinstance(p, TensorProxy)
+        or (isinstance(p, NumberProxy) and p.value is None)
+    ]
     state_tensor_proxies = state_cap.tensor_proxies if state_cap is not None else []
     comp_inputs = comp_inputs + state_tensor_proxies
     rng_key = getattr(computation_trace, "_rng_key_proxy", None)
@@ -251,7 +267,10 @@ def trace_from_fn(
                         bool(getattr(leaf, "requires_grad", False)),
                     )
                 elif isinstance(cproxy, NumberProxy):
-                    prims.check_number_type_and_value(leaf_p, cproxy.value)
+                    if cproxy.value is None:  # symbolic: guard the type only
+                        prims.check_number_type(leaf_p, cproxy.python_type.__name__)
+                    else:
+                        prims.check_number_type_and_value(leaf_p, cproxy.value)
                 elif isinstance(cproxy, StringProxy):
                     prims.check_string_value(leaf_p, cproxy.value)
             else:
@@ -264,8 +283,13 @@ def trace_from_fn(
 
             state_out = build_state_prologue(prologue_trace, fn, state_cap, _dtype_str)
 
-        # return the tensors the computation consumes, in order
-        out_tensors = tuple(p for p in pro_leaf_proxies if isinstance(p, TensorProxy)) + tuple(state_out)
+        # return the tensors (+ symbolic scalars) the computation consumes,
+        # in order
+        out_tensors = tuple(
+            p for p in pro_leaf_proxies
+            if isinstance(p, TensorProxy)
+            or (isinstance(p, NumberProxy) and p.value is None)
+        ) + tuple(state_out)
         prims.python_return(out_tensors)
 
     pro_si = SigInfo(name="prologue")

@@ -303,3 +303,35 @@ def test_t5_relative_position_bias_matches_eager():
     np.testing.assert_allclose(
         out.last_hidden_state.detach().numpy(), ref.numpy(), rtol=1e-3, atol=1e-4
     )
+
+
+@pytest.mark.parametrize("family", ["qwen2", "phi", "gptneo", "gptj"])
+def test_more_decoder_families_match_eager(family):
+    """Breadth check: further decoder families trace unmodified (Qwen2 GQA,
+    Phi partial-rotary + layernorm, GPT-Neo local attention, GPT-J rotary)."""
+    torch.manual_seed(0)
+    ids = torch.randint(0, 128, (2, 16), generator=torch.Generator().manual_seed(3))
+    if family == "qwen2":
+        model = transformers.Qwen2ForCausalLM(transformers.Qwen2Config(
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            hidden_size=64, intermediate_size=128, vocab_size=128,
+            max_position_embeddings=64, attn_implementation="eager")).eval()
+    elif family == "phi":
+        model = transformers.PhiForCausalLM(transformers.PhiConfig(
+            num_hidden_layers=2, num_attention_heads=4, hidden_size=64,
+            intermediate_size=128, vocab_size=128, max_position_embeddings=64,
+            attn_implementation="eager")).eval()
+    elif family == "gptneo":
+        model = transformers.GPTNeoForCausalLM(transformers.GPTNeoConfig(
+            num_layers=2, num_heads=4, hidden_size=64,
+            attention_types=[[["global", "local"], 1]], window_size=8,
+            vocab_size=128, max_position_embeddings=64,
+            attn_implementation="eager")).eval()
+    else:
+        model = transformers.GPTJForCausalLM(transformers.GPTJConfig(
+            n_layer=2, n_head=4, n_embd=64, rotary_dim=16, vocab_size=128,
+            n_positions=64, attn_implementation="eager")).eval()
+    with torch.no_grad():
+        ref = model(ids, use_cache=False).logits
+    out = ttpu.jit(model)(input_ids=ids, use_cache=False)
+    np.testing.assert_allclose(out.logits.detach().numpy(), ref.numpy(), rtol=1e-3, atol=1e-4)

@@ -306,11 +306,9 @@ class NumberProxy(Proxy, NumberProxyInterface):
     def _number_op(self, op: Callable, *args):
         vals = []
         for a in (self,) + args:
-            v = pyval(a)
-            if v is None:
-                method = resolve_method("add", self)  # symbolic path not yet supported
-                raise NotImplementedError("Symbolic number values are not supported yet")
-            vals.append(v)
+            if isinstance(a, NumberProxy):
+                a._check_concrete("number arithmetic")
+            vals.append(pyval(a))
         return op(*vals)
 
     def __add__(self, other):
@@ -356,28 +354,37 @@ class NumberProxy(Proxy, NumberProxyInterface):
         return self._number_op(lambda a, b: b**a, other)
 
     def __neg__(self):
+        self._check_concrete("-x")
         return -pyval(self)
 
     def __pos__(self):
+        self._check_concrete("+x")
         return +pyval(self)
 
     def __abs__(self):
+        self._check_concrete("abs()")
         return abs(pyval(self))
 
     def _check_concrete(self, op: str) -> None:
         if self._value is None:
             raise NotImplementedError(
                 f"cannot use '{op}' on the symbolic number {self.name}: its value is "
-                "unknown at trace time (cache='symbolic values' keeps scalar inputs "
-                "symbolic).  Data-dependent Python control flow on a symbolic scalar "
-                "would bake one branch; use tensor ops (where/cond) instead, or the "
-                "default cache to specialize per value"
+                "unknown at trace time (a scalar input under cache='symbolic values', "
+                "or a tensor .item() result).  Data-dependent Python control flow on "
+                "it would bake one branch; use tensor ops (where/cond) instead, or "
+                "make the value concrete (default cache / avoid .item())"
             )
+
+    @staticmethod
+    def _check_operands_concrete(op: str, *vals) -> None:
+        for v in vals:
+            if isinstance(v, NumberProxy):
+                v._check_concrete(op)
 
     def __eq__(self, other):
         if isinstance(other, Proxy) and not isinstance(other, NumberProxy):
             return NotImplemented
-        self._check_concrete("==")
+        self._check_operands_concrete("==", self, other)
         ov = pyval(other) if isinstance(other, NumberProxy) else other
         return pyval(self) == ov
 
@@ -388,15 +395,19 @@ class NumberProxy(Proxy, NumberProxyInterface):
         return not result
 
     def __lt__(self, other):
+        self._check_operands_concrete("<", self, other)
         return pyval(self) < (pyval(other) if isinstance(other, NumberProxy) else other)
 
     def __le__(self, other):
+        self._check_operands_concrete("<=", self, other)
         return pyval(self) <= (pyval(other) if isinstance(other, NumberProxy) else other)
 
     def __gt__(self, other):
+        self._check_operands_concrete(">", self, other)
         return pyval(self) > (pyval(other) if isinstance(other, NumberProxy) else other)
 
     def __ge__(self, other):
+        self._check_operands_concrete(">=", self, other)
         return pyval(self) >= (pyval(other) if isinstance(other, NumberProxy) else other)
 
     def __hash__(self):
@@ -407,15 +418,19 @@ class NumberProxy(Proxy, NumberProxyInterface):
         return bool(pyval(self))
 
     def __int__(self):
+        self._check_concrete("int()")
         return int(pyval(self))
 
     def __float__(self):
+        self._check_concrete("float()")
         return float(pyval(self))
 
     def __complex__(self):
+        self._check_concrete("complex()")
         return complex(pyval(self))
 
     def __index__(self):
+        self._check_concrete("index()")
         return int(pyval(self))
 
 

@@ -47,6 +47,9 @@ def main(argv=None):
     ap.add_argument("--sp-impl", default="ring", choices=["ring", "ulysses"],
                     help="sequence-parallel attention: ring (ppermute K/V rotation) or "
                          "ulysses (all_to_all seq<->head re-shard)")
+    ap.add_argument("--bucket", action="store_true",
+                    help="pad batches to power-of-two (B, T) buckets so one compiled "
+                         "program serves every shape inside a bucket")
     ap.add_argument("--devices", type=int, default=1)
     ap.add_argument("--virtual-cpu", action="store_true", help="force N virtual CPU devices (no hardware needed)")
     ap.add_argument("--batch", type=int, default=8)
@@ -91,6 +94,7 @@ def main(argv=None):
     if args.mode in ("sp", "pp", "ep"):
         assert args.quant is None, "--quant needs a TrainStep mode (not sp/pp/ep)"
         assert args.comm_combine_mb is None, "--comm-combine-mb needs a TrainStep mode (not sp/pp/ep)"
+        assert not args.bucket, "--bucket needs a TrainStep mode (not sp/pp/ep)"
         # sequence / pipeline / expert parallelism drive the shard_map-based
         # training losses directly: jax.value_and_grad through the shard_map
         # (grad sync comes out of the broadcast transpose), optax update jitted
@@ -158,6 +162,7 @@ def main(argv=None):
             loss_fn, optimizer, mesh,
             remat=not args.no_remat, zero3=(args.mode == "zero3"),
             quant=args.quant, comm_combine_threshold_mb=args.comm_combine_mb,
+            bucketer=llama.batch_bucketer(cfg) if args.bucket else None,
         )
         opt_state = train_step.init_optimizer_state(params)
         step = train_step

@@ -335,3 +335,45 @@ def test_more_decoder_families_match_eager(family):
         ref = model(ids, use_cache=False).logits
     out = ttpu.jit(model)(input_ids=ids, use_cache=False)
     np.testing.assert_allclose(out.logits.detach().numpy(), ref.numpy(), rtol=1e-3, atol=1e-4)
+
+
+def test_vit_conv_patch_embed_matches_eager():
+    """Vision transformer: conv2d patch embedding + encoder trace unmodified
+    (the modality the reference never demonstrates)."""
+    cfg = transformers.ViTConfig(
+        num_hidden_layers=2, num_attention_heads=2, hidden_size=32,
+        intermediate_size=64, image_size=32, patch_size=8,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    model = transformers.ViTModel(cfg).eval()
+    px = torch.randn(2, 3, 32, 32, generator=torch.Generator().manual_seed(1))
+    with torch.no_grad():
+        ref = model(pixel_values=px).last_hidden_state
+    out = ttpu.jit(model)(pixel_values=px)
+    np.testing.assert_allclose(
+        out.last_hidden_state.detach().numpy(), ref.numpy(), rtol=1e-3, atol=1e-4
+    )
+
+
+def test_whisper_audio_encoder_decoder_matches_eager():
+    """Whisper: conv1d audio front end + encoder-decoder cross-attention."""
+    cfg = transformers.WhisperConfig(
+        encoder_layers=1, decoder_layers=1, encoder_attention_heads=2,
+        decoder_attention_heads=2, d_model=32, encoder_ffn_dim=64,
+        decoder_ffn_dim=64, vocab_size=128, num_mel_bins=16,
+        max_source_positions=32, max_target_positions=32,
+        pad_token_id=0, bos_token_id=1, eos_token_id=2,
+        decoder_start_token_id=1, suppress_tokens=None,
+        begin_suppress_tokens=None, attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    model = transformers.WhisperModel(cfg).eval()
+    feats = torch.randn(1, 16, 64, generator=torch.Generator().manual_seed(2))
+    dec = torch.randint(0, 128, (1, 8))
+    with torch.no_grad():
+        ref = model(input_features=feats, decoder_input_ids=dec, use_cache=False).last_hidden_state
+    out = ttpu.jit(model)(input_features=feats, decoder_input_ids=dec, use_cache=False)
+    np.testing.assert_allclose(
+        out.last_hidden_state.detach().numpy(), ref.numpy(), rtol=1e-3, atol=1e-4
+    )

@@ -304,8 +304,13 @@ class NumberProxy(Proxy, NumberProxyInterface):
 
     # Concrete-value arithmetic: numbers fold at trace time.
     def _number_op(self, op: Callable, *args):
+        operands = (self,) + args
+        # defer BEFORE any concreteness check: symbolic-scalar ⊗ tensor must
+        # reach TensorProxy's reflected op (which traces it), not raise here
+        if any(isinstance(a, Proxy) and not isinstance(a, NumberProxy) for a in operands):
+            return NotImplemented
         vals = []
-        for a in (self,) + args:
+        for a in operands:
             if isinstance(a, NumberProxy):
                 a._check_concrete("number arithmetic")
             vals.append(pyval(a))

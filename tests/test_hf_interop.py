@@ -305,10 +305,11 @@ def test_t5_relative_position_bias_matches_eager():
     )
 
 
-@pytest.mark.parametrize("family", ["qwen2", "phi", "gptneo", "gptj"])
+@pytest.mark.parametrize("family", ["qwen2", "phi", "gptneo", "gptj", "gemma", "falcon"])
 def test_more_decoder_families_match_eager(family):
     """Breadth check: further decoder families trace unmodified (Qwen2 GQA,
-    Phi partial-rotary + layernorm, GPT-Neo local attention, GPT-J rotary)."""
+    Phi partial-rotary + layernorm, GPT-Neo local attention, GPT-J rotary,
+    Gemma GeGLU + GQA, Falcon multi-query attention)."""
     torch.manual_seed(0)
     ids = torch.randint(0, 128, (2, 16), generator=torch.Generator().manual_seed(3))
     if family == "qwen2":
@@ -327,10 +328,19 @@ def test_more_decoder_families_match_eager(family):
             attention_types=[[["global", "local"], 1]], window_size=8,
             vocab_size=128, max_position_embeddings=64,
             attn_implementation="eager")).eval()
-    else:
+    elif family == "gptj":
         model = transformers.GPTJForCausalLM(transformers.GPTJConfig(
             n_layer=2, n_head=4, n_embd=64, rotary_dim=16, vocab_size=128,
             n_positions=64, attn_implementation="eager")).eval()
+    elif family == "gemma":
+        model = transformers.GemmaForCausalLM(transformers.GemmaConfig(
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            hidden_size=64, intermediate_size=128, head_dim=16, vocab_size=128,
+            max_position_embeddings=64, attn_implementation="eager")).eval()
+    else:
+        model = transformers.FalconForCausalLM(transformers.FalconConfig(
+            num_hidden_layers=2, num_attention_heads=4, hidden_size=64,
+            vocab_size=128, attn_implementation="eager")).eval()
     with torch.no_grad():
         ref = model(ids, use_cache=False).logits
     out = ttpu.jit(model)(input_ids=ids, use_cache=False)
@@ -374,6 +384,24 @@ def test_whisper_audio_encoder_decoder_matches_eager():
     with torch.no_grad():
         ref = model(input_features=feats, decoder_input_ids=dec, use_cache=False).last_hidden_state
     out = ttpu.jit(model)(input_features=feats, decoder_input_ids=dec, use_cache=False)
+    np.testing.assert_allclose(
+        out.last_hidden_state.detach().numpy(), ref.numpy(), rtol=1e-3, atol=1e-4
+    )
+
+
+def test_roberta_forward_matches_eager():
+    cfg = transformers.RobertaConfig(
+        num_hidden_layers=2, num_attention_heads=2, hidden_size=32,
+        intermediate_size=64, vocab_size=128, max_position_embeddings=80,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    model = transformers.RobertaModel(cfg).eval()
+    ids = torch.randint(0, 128, (2, 16), generator=torch.Generator().manual_seed(9))
+    with torch.no_grad():
+        ref = model(ids).last_hidden_state
+    out = ttpu.jit(model)(input_ids=ids)
     np.testing.assert_allclose(
         out.last_hidden_state.detach().numpy(), ref.numpy(), rtol=1e-3, atol=1e-4
     )

@@ -405,11 +405,15 @@ def vjp(fn: Callable, argnums: Sequence[int] | None = None, **jit_kwargs) -> Cal
 
 def _unwrap_cfn(cfn):
     """ThunderModule holds its compiled function internally (the vjp of the
-    functionalized forward); introspection accepts either, like the
-    reference's last_traces on ThunderModule (reference __init__.py:709)."""
-    vjp_fn = getattr(cfn, "_vjp_fn", None)
-    if vjp_fn is not None and not hasattr(cfn, "_lc_cs"):
-        return vjp_fn
+    functionalized forward, or the forward-only inference path);
+    introspection accepts either, like the reference's last_traces on
+    ThunderModule (reference __init__.py:709).  When both paths have been
+    compiled, the most recently INVOKED one answers (tracked by the module)."""
+    if not hasattr(cfn, "_lc_cs"):
+        for attr in ("_last_compiled", "_vjp_fn", "_fwd_fn"):
+            inner = getattr(cfn, attr, None)
+            if inner is not None and hasattr(inner, "_lc_cs"):
+                return inner
     return cfn
 
 

@@ -163,7 +163,17 @@ def trace_from_fn(
             offset += n
         computation_trace._grad_meta = grad_meta
 
-    proxy_args, proxy_kwargs = tree_unflatten(proxies, spec)
+    # the traced function receives RAW Python numbers/strings for
+    # known-value leaves (under CONSTANT_VALUES they fold to literals at
+    # every op boundary anyway) so user-code `isinstance(x, int)`/type
+    # branches behave exactly as in eager (HF's logits_to_keep et al.); the
+    # NumberProxy stays in `proxies` purely to emit the prologue VALUE guard.
+    # Symbolic (value-less) scalars keep their proxies.
+    comp_leaves = [
+        p.value if isinstance(p, (NumberProxy, StringProxy)) and p.value is not None else p
+        for p in proxies
+    ]
+    proxy_args, proxy_kwargs = tree_unflatten(comp_leaves, spec)
     # __setitem__ on an input proxy rebinds the OBJECT to the updated value's
     # name; the computation signature must keep binding the ORIGINAL name
     # (the pre-assignment value the body's early uses reference), so input
@@ -350,6 +360,16 @@ def _detect_mutations(orig_proxies, spec, proxy_args, proxy_kwargs):
     for (path, new), old in zip(new_paths_leaves, orig_proxies):
         if new is old:
             continue
+        if isinstance(old, (NumberProxy, StringProxy)) and not isinstance(new, Proxy):
+            # number/string leaves are handed to the traced fn as raw values
+            # (see trace_from_fn); an UNCHANGED raw value is not a mutation
+            if new == old.value:
+                continue
+            raise NotImplementedError(
+                f"input container entry at {tuple(plain(k) for k in path)} was "
+                f"reassigned from {old.value!r} to {new!r}; number/string state "
+                "updates are not written back — return the new value instead"
+            )
         if not isinstance(new, TensorProxy):
             raise NotImplementedError(
                 f"input container entry at {tuple(plain(k) for k in path)} was replaced "

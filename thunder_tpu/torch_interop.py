@@ -429,32 +429,55 @@ class ThunderModule(torch.nn.Module):
         self._orig_mod = module
         self._jit_kwargs = jit_kwargs
         self._vjp_fn = None  # built lazily (imports thunder_tpu)
+        self._fwd_fn = None  # forward-only compiled path (no-grad inference)
+        self._gen_shim = None  # cached GenerationMixin shim instance
         # torch→jax transfer cache keyed by (tensor identity, version): params
         # only re-upload after an in-place update (optimizer step), not on
         # every forward
         self._xfer_cache: dict[str, tuple[tuple[int, int], Any]] = {}
 
+    def _make_functional_fwd(self):
+        """The functionalized forward both compile paths share: swaps
+        params/buffers for proxies, runs under the tracing mode, and unwraps
+        HF ModelOutput (an OrderedDict subclass the pytree won't open) to a
+        plain dict of present fields — remembering the class in ONE shared
+        cell so forward() rewraps for the caller regardless of which path
+        traced it."""
+        module = self._orig_mod
+        if not hasattr(self, "_out_cls_cell"):
+            self._out_cls_cell = [None]
+        out_cls_cell = self._out_cls_cell
+
+        def functional_fwd(params, buffers, *args, **kwargs):
+            with ThunderTracingMode():
+                out = functional_call(module, {**params, **buffers}, args, kwargs)
+            if isinstance(out, dict) and type(out) is not dict:
+                out_cls_cell[0] = type(out)
+                out = {k: v for k, v in out.items() if v is not None}
+            return out
+
+        return functional_fwd
+
     def _get_vjp(self):
         if self._vjp_fn is None:
             import thunder_tpu as ttpu
 
-            module = self._orig_mod
-
-            out_cls_cell = self._out_cls_cell = [None]
-
-            def functional_fwd(params, buffers, *args, **kwargs):
-                with ThunderTracingMode():
-                    out = functional_call(module, {**params, **buffers}, args, kwargs)
-                # HF ModelOutput is an OrderedDict subclass the pytree won't
-                # open; unwrap to a plain dict of present fields and remember
-                # the class so forward() can rewrap for the caller
-                if isinstance(out, dict) and type(out) is not dict:
-                    out_cls_cell[0] = type(out)
-                    out = {k: v for k, v in out.items() if v is not None}
-                return out
-
-            self._vjp_fn = ttpu.vjp(functional_fwd, argnums=(0,), **self._jit_kwargs)
+            self._vjp_fn = ttpu.vjp(self._make_functional_fwd(), argnums=(0,), **self._jit_kwargs)
+        self._last_compiled = self._vjp_fn
         return self._vjp_fn
+
+    def _get_fwd_only(self):
+        """Forward-only compiled path for no-grad inference (generate()
+        decode loops, eval): no VJP split, no pullback residuals
+        materialized per call."""
+        if self._fwd_fn is None:
+            import thunder_tpu as ttpu
+
+            kw = dict(self._jit_kwargs)
+            kw["disable_grad"] = True
+            self._fwd_fn = ttpu.jit(self._make_functional_fwd(), **kw)
+        self._last_compiled = self._fwd_fn
+        return self._fwd_fn
 
     def _cached_to_jax(self, name: str, t: torch.Tensor):
         key = (id(t), t._version)
@@ -466,11 +489,8 @@ class ThunderModule(torch.nn.Module):
         return a
 
     def forward(self, *args, **kwargs):
-        vjp_fn = self._get_vjp()
         params = dict(self._orig_mod.named_parameters())
         buffers = dict(self._orig_mod.named_buffers())
-        param_names = sorted(params)
-        param_tensors = [params[n] for n in param_names]
 
         jax_params = {n: self._cached_to_jax(n, p) for n, p in params.items()}
         jax_buffers = {n: self._cached_to_jax(n, b) for n, b in buffers.items()}
@@ -479,16 +499,83 @@ class ThunderModule(torch.nn.Module):
             k: _to_jax(v) if isinstance(v, torch.Tensor) else v for k, v in kwargs.items()
         }
 
-        holder = {
-            "run": lambda: vjp_fn(jax_params, jax_buffers, *jax_args, **jax_kwargs),
-            "param_names": param_names,
-        }
-        flat_out = ThunderFunction.apply(holder, *param_tensors)
-        out = jax_tree_unflatten(holder["out_spec"], list(flat_out))
+        if not torch.is_grad_enabled():
+            # inference: forward-only compiled program, no residuals
+            out = self._get_fwd_only()(jax_params, jax_buffers, *jax_args, **jax_kwargs)
+            flat, spec = jax_tree_flatten(out)
+            out = jax_tree_unflatten(spec, [
+                _to_torch(x) if not isinstance(x, torch.Tensor) else x for x in flat
+            ])
+        else:
+            param_names = sorted(params)
+            param_tensors = [params[n] for n in param_names]
+            vjp_fn = self._get_vjp()
+            holder = {
+                "run": lambda: vjp_fn(jax_params, jax_buffers, *jax_args, **jax_kwargs),
+                "param_names": param_names,
+            }
+            flat_out = ThunderFunction.apply(holder, *param_tensors)
+            out = jax_tree_unflatten(holder["out_spec"], list(flat_out))
         out_cls = getattr(self, "_out_cls_cell", [None])[0]
         if out_cls is not None and isinstance(out, dict):
             out = out_cls(**out)
         return out
+
+    def generate(self, *args, **kwargs):
+        """HF GenerationMixin support: runs the wrapped model's ``generate``
+        with the main (decoder) forward dispatched through the compiled
+        thunder program (each new sequence length is one compile; repeated
+        lengths hit the cache; no-grad forwards take the forward-only path).
+        Encoder-decoder models run their encoder eagerly (HF calls
+        ``get_encoder()`` directly).
+
+        HF's mutating KV caches (``use_cache=True``) don't trace — the
+        compiled step is functional — so the cache is disabled: every step
+        recomputes the full prefix (our native ``models/generate.py`` is the
+        cached serving path).  HF resolves decoding methods off
+        ``type(self)``, so the call runs on a shim instance whose CLASS
+        subclasses the wrapped model's (keeping ``_sample``/config plumbing)
+        while ``forward`` routes here; the shim shares the wrapped module's
+        state dict-for-dict."""
+        if kwargs.get("use_cache"):
+            raise NotImplementedError(
+                "generate(use_cache=True) would mutate an HF KV cache inside the "
+                "compiled functional forward; pass use_cache=False (full-prefix "
+                "recompute) or serve with thunder_tpu.models.generate (one-program "
+                "KV-cache decode)"
+            )
+        kwargs["use_cache"] = False
+        cls = type(self._orig_mod)
+        if not hasattr(cls, "generate"):
+            raise AttributeError(f"{cls.__name__} has no generate()")
+
+        if self._gen_shim is None:
+            import functools as _ft
+            import inspect as _inspect
+
+            tm = self
+
+            def shim_forward(s, *a, **k):
+                return ThunderModule.forward(tm, *a, **k)
+
+            # HF validates model kwargs against inspect.signature(forward):
+            # carry the wrapped forward's real signature onto the shim
+            shim_forward = _ft.wraps(cls.forward)(shim_forward)
+            shim_forward.__signature__ = _inspect.signature(cls.forward)
+            shim_cls = type(f"Thunder{cls.__name__}", (cls,), {"forward": shim_forward})
+            shim = object.__new__(shim_cls)  # share state; skip __init__
+            shim.__dict__ = self._orig_mod.__dict__
+            self._gen_shim = shim
+        return type(self._gen_shim).generate(self._gen_shim, *args, **kwargs)
+
+    def __getattr__(self, name):
+        # delegate config/generation_config/prepare_inputs_for_generation/…
+        # lookups to the wrapped module (nn.Module.__getattr__ covers
+        # registered params/buffers/submodules first)
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(super().__getattr__("_orig_mod"), name)
 
     # reference ThunderModule passes state_dict through to the wrapped module
     def state_dict(self, *args, **kwargs):

@@ -88,13 +88,21 @@ def plain_jax_loss_fn(cfg: llama.Config):
 
 
 def time_steps(step, n, *state):
+    """Time n chained steps, fenced by a real host fetch.
+
+    ``jax.block_until_ready`` does not wait on the tunneled axon backend, so
+    the loop ends with ``_sync`` (fetch one element) and the measured fetch
+    round-trip floor is subtracted.  The steps chain through ``state`` so
+    in-order execution makes the final fetch fence the whole loop.
+    """
+    floor = _fetch_floor()
     t0 = time.perf_counter()
     out = None
     for _ in range(n):
         out = step(*state)
         state = out[:2] + state[2:] if isinstance(out, tuple) and len(out) >= 2 else state
-    jax.block_until_ready(out)
-    return time.perf_counter() - t0
+    _sync(out)
+    return max(time.perf_counter() - t0 - floor, 1e-9), state
 
 
 def make_batch(cfg, B, T):
@@ -117,9 +125,14 @@ def compiled_run(cfg, B, T, optimizer, steps):
     opt_state = step.init_optimizer_state(params)
     t0 = time.perf_counter()
     params2, opt2, loss = step(params, opt_state, idx, tgt, cos, sin)
-    jax.block_until_ready(loss)
-    log(f"compiled[B={B}] compile+first step: {time.perf_counter()-t0:.1f}s loss={float(loss):.4f}")
-    dt = time_steps(lambda p, o: step(p, o, idx, tgt, cos, sin), steps, params2, opt2)
+    loss_v = float(loss)  # real fetch: block_until_ready does not wait on axon
+    log(f"compiled[B={B}] compile+first step: {time.perf_counter()-t0:.1f}s loss={loss_v:.4f}")
+    # best of two timing loops: the tunnel drifts by whole percents between
+    # loops, and the first loop after compilation is occasionally cold.  State
+    # threads through because each loop donates its input buffers.
+    dt1, st = time_steps(lambda p, o: step(p, o, idx, tgt, cos, sin), steps, params2, opt2)
+    dt2, _ = time_steps(lambda p, o: step(p, o, idx, tgt, cos, sin), steps, *st)
+    dt = min(dt1, dt2)
     tps = B * T * steps / dt
     log(f"compiled[B={B}]: {tps:,.0f} tokens/s ({dt/steps*1e3:.1f} ms/step)")
     return tps
@@ -144,13 +157,11 @@ def baseline_run(cfg, B, T, optimizer, steps):
 
     t0 = time.perf_counter()
     p, o, l = jstep(p, o)  # compile + warmup
-    jax.block_until_ready(l)
-    log(f"jax.jit[B={B}] compile+first step: {time.perf_counter()-t0:.1f}s loss={float(l):.4f}")
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        p, o, l = jstep(p, o)
-    jax.block_until_ready(l)
-    dt = time.perf_counter() - t0
+    loss_v = float(l)  # real fetch: block_until_ready does not wait on axon
+    log(f"jax.jit[B={B}] compile+first step: {time.perf_counter()-t0:.1f}s loss={loss_v:.4f}")
+    dt1, st = time_steps(lambda pp, oo: jstep(pp, oo), steps, p, o)
+    dt2, _ = time_steps(lambda pp, oo: jstep(pp, oo), steps, *st)
+    dt = min(dt1, dt2)
     tps = B * T * steps / dt
     log(f"jax.jit[B={B}]: {tps:,.0f} tokens/s ({dt/steps*1e3:.1f} ms/step)")
     return tps
@@ -258,14 +269,68 @@ def mfu(tokens_per_sec: float, cfg: llama.Config, T: int, backend: str) -> float
 #
 
 
+_FETCH_FLOOR = None
+
+
+def _sync(x):
+    """Force execution by fetching one element to the host.
+
+    On the tunneled axon TPU backend ``jax.block_until_ready`` returns
+    without waiting (measured: a B=8 H=32 T=2048 SDPA "completed" in 50us,
+    20x the chip's peak FLOPS).  Only an actual device->host transfer
+    round-trips, so timing loops must end with a real fetch.  Execution is
+    in-order per device, so fetching the last output fences the whole loop.
+    """
+    leaf = next(l for l in jax.tree_util.tree_leaves(x) if hasattr(l, "dtype"))
+    return float(jnp.reshape(leaf, (-1,))[0].astype(jnp.float32))
+
+
+def _fetch_floor():
+    """Median cost of a tiny compute+fetch — the tunnel round-trip latency
+    (~84 ms over axon, ~us on local backends), subtracted from loop times."""
+    global _FETCH_FLOOR
+    if _FETCH_FLOOR is None:
+        xs = jnp.zeros((8,), jnp.float32)
+        _sync(xs + 1.0)
+        ts = []
+        for i in range(5):
+            t0 = time.perf_counter()
+            _sync(xs + float(i))
+            ts.append(time.perf_counter() - t0)
+        _FETCH_FLOOR = sorted(ts)[len(ts) // 2]
+    return _FETCH_FLOOR
+
+
 def _time_fn(fn, *args, iters=20):
     out = fn(*args)
-    jax.block_until_ready(out)
+    _sync(out)  # compile + warm
+    floor = _fetch_floor()
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters
+    _sync(out)
+    dt = time.perf_counter() - t0 - floor
+    per = max(dt / iters, 1e-9)
+    if dt < 5 * floor:  # fetch floor dominates: redo with enough iterations
+        iters = min(max(iters, int(10 * floor / per)), 2000)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        _sync(out)
+        dt = time.perf_counter() - t0 - floor
+        if dt < 0.5 * floor:  # fetch-floor jitter swamped the signal even at max iters
+            log(f"_time_fn: measurement unreliable (loop {dt*1e3:.1f} ms vs floor "
+                f"{floor*1e3:.1f} ms at {iters} iters)")
+            return float("nan")
+        per = max(dt / iters, 1e-9)
+    return per
+
+
+def _best_ms(fn, *args, reps=3):
+    """Best-of-reps wall time in ms — rides out tunnel cold-start drift.
+    NaN (unreliable) reps are dropped; all-NaN returns NaN."""
+    vals = [v for v in (_time_fn(fn, *args) for _ in range(reps)) if v == v]
+    return min(vals) * 1e3 if vals else float("nan")
 
 
 def micro_benchmarks(on_tpu: bool):
@@ -289,22 +354,24 @@ def micro_benchmarks(on_tpu: bool):
     def sdpa(q, k, v):
         return ltorch.scaled_dot_product_attention(q, k, v, is_causal=True)
 
-    results["sdpa_ms"] = _time_fn(tt.jit(sdpa), q, k, v) * 1e3
+    best = _best_ms  # best-of-3: rides out tunnel cold-start drift
+
+    results["sdpa_ms"] = best(tt.jit(sdpa), q, k, v)
     os.environ["THUNDER_TPU_DISABLE_PALLAS"] = "1"
     try:
-        results["sdpa_nokernel_ms"] = _time_fn(tt.jit(sdpa), q, k, v) * 1e3
+        results["sdpa_nokernel_ms"] = best(tt.jit(sdpa), q, k, v)
     finally:
         del os.environ["THUNDER_TPU_DISABLE_PALLAS"]
 
     # fused cross entropy
     logits = jax.random.normal(key, (B * T, V), dtype=jnp.float32)
     tgt = jax.random.randint(jax.random.fold_in(key, 3), (B * T,), 0, V)
-    results["cross_entropy_ms"] = _time_fn(tt.jit(lambda l, t: ltorch.cross_entropy(l, t)), logits, tgt) * 1e3
+    results["cross_entropy_ms"] = best(tt.jit(lambda l, t: ltorch.cross_entropy(l, t)), logits, tgt)
 
     # rmsnorm
     x = jax.random.normal(key, (B, T, C), dtype=dt)
     w = jnp.ones((C,), dtype=dt)
-    results["rms_norm_ms"] = _time_fn(tt.jit(lambda a, ww: ltorch.rms_norm(a, (C,), ww)), x, w) * 1e3
+    results["rms_norm_ms"] = best(tt.jit(lambda a, ww: ltorch.rms_norm(a, (C,), ww)), x, w)
 
     # one transformer block fwd
     cfg = llama.Config.from_name("tiny-llama-debug") if not on_tpu else llama.Config.from_name(
@@ -313,9 +380,9 @@ def micro_benchmarks(on_tpu: bool):
     params = llama.init_params(cfg, key, dtype=dt)
     Tb = min(T, cfg.block_size)
     idx, _, cos, sin = make_batch(cfg, B, Tb)
-    results["block_fwd_ms"] = _time_fn(
+    results["block_fwd_ms"] = best(
         tt.jit(lambda p, i, c, s: llama.gpt_forward(p, i, c, s, cfg)), params, idx, cos, sin
-    ) * 1e3
+    )
 
     for name, ms in results.items():
         log(f"micro {name}: {ms:.3f} ms")
@@ -374,7 +441,11 @@ def sweep_benchmarks(on_tpu: bool, out_path: str = "BENCH_MICRO.json"):
         return (jax.nn.silu(x @ w1.T) * (x @ w2.T)) @ w3.T
 
     cases = {
-        "gelu": (tt.jit(lambda a: ltorch.gelu(a)), jax.jit(jax.nn.gelu), (x_rows,)),
+        # approximate=False on the jax side: torch's gelu default is the exact
+        # erf form, jax.nn.gelu's default is the cheaper tanh approximation —
+        # comparing those would measure op semantics, not framework overhead.
+        "gelu": (tt.jit(lambda a: ltorch.gelu(a)),
+                 jax.jit(partial(jax.nn.gelu, approximate=False)), (x_rows,)),
         "cross_entropy": (
             tt.jit(lambda l, t: ltorch.cross_entropy(l, t)), jax.jit(plain_ce), (logits, tgt)),
         "rms_norm": (
@@ -397,8 +468,19 @@ def sweep_benchmarks(on_tpu: bool, out_path: str = "BENCH_MICRO.json"):
     results = {}
     for name, (tfn, jfn, args) in cases.items():
         try:
-            tt_ms = _time_fn(tfn, *args) * 1e3
-            jx_ms = _time_fn(jfn, *args) * 1e3
+            # Pairwise-interleaved reps, per-side min: the tunneled backend
+            # drifts by several ms on timescales of one rep, so each rep times
+            # both sides back-to-back and min() rides out the drift (measured:
+            # swiglu_mlp read 0.75x once, 1.00x on every re-measurement).
+            pairs = [(_time_fn(tfn, *args), _time_fn(jfn, *args)) for _ in range(3)]
+            tt_vals = [p[0] for p in pairs if p[0] == p[0]]
+            jx_vals = [p[1] for p in pairs if p[1] == p[1]]
+            if not tt_vals or not jx_vals:
+                results[name] = {"error": "measurement unreliable (fetch-floor jitter)"}
+                log(f"sweep {name}: UNRELIABLE (jitter swamped signal)")
+                continue
+            tt_ms = min(tt_vals) * 1e3
+            jx_ms = min(jx_vals) * 1e3
             results[name] = {
                 "thunder_ms": round(tt_ms, 4),
                 "jax_ms": round(jx_ms, 4),
@@ -453,8 +535,8 @@ def dist_throughput_smoke():
         )
         opt = step.init_optimizer_state(params)
         params, opt, loss = step(params, opt, idx, tgt, cos, sin)  # compile
-        jax.block_until_ready(loss)
-        dt_s = time_steps(lambda p, o: step(p, o, idx, tgt, cos, sin), steps, params, opt)
+        _sync(loss)
+        dt_s, _ = time_steps(lambda p, o: step(p, o, idx, tgt, cos, sin), steps, params, opt)
         results[name] = round(B * T * steps / dt_s, 1)
         log(f"dist {name}: {results[name]:,.0f} tokens/s (cpu smoke) loss={float(loss):.4f}")
     return results
@@ -481,12 +563,13 @@ def decode_benchmark(on_tpu: bool):
     for name, q in (("fp", False), ("int8", True)):
         t0 = time.perf_counter()
         out = gen.generate(params, prompt, cfg, N, quantized=q)
-        jax.block_until_ready(out)
+        _sync(out)
         compile_and_first = time.perf_counter() - t0
+        floor = _fetch_floor()
         t0 = time.perf_counter()
         out = gen.generate(params, prompt, cfg, N, quantized=q)
-        jax.block_until_ready(out)
-        dt = time.perf_counter() - t0
+        _sync(out)
+        dt = max(time.perf_counter() - t0 - floor, 1e-9)
         tps = B * N / dt
         results[name] = tps
         log(f"decode[{name}] B={B} N={N}: {tps:,.0f} tokens/s "

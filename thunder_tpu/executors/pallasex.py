@@ -88,7 +88,23 @@ def _enabled() -> bool:
     return _pallas_available()
 
 
-def _block(T: int) -> int:
+def _block(T: int, which: str = "") -> int:
+    """Largest supported block size dividing ``T``.
+
+    ``THUNDER_TPU_FLASH_BQ`` / ``THUNDER_TPU_FLASH_BK`` override the choice
+    for the q/kv axis (tuning knob; ignored when it does not divide T).
+    Overrides are read at trace time — call ``jax.clear_caches()`` after
+    changing them.
+    """
+    if which:
+        env = os.environ.get(f"THUNDER_TPU_FLASH_B{which}")
+        if env:
+            try:
+                b = int(env)
+            except ValueError:
+                b = 0
+            if b > 0 and T % b == 0:
+                return b
     for b in (512, 256, 128):
         if T % b == 0:
             return b
@@ -270,7 +286,7 @@ def _flash_fwd(q, k, v, mask, causal: bool, scale: float, H: int, G: int, mode: 
     classify the mask layout (see _canon_mask)."""
     BH, Tq, hs = q.shape
     Tk = k.shape[1]
-    BQ, BK = _block(Tq), _block(Tk)
+    BQ, BK = _block(Tq, "Q"), _block(Tk, "K")
     grid = (BH, Tq // BQ, Tk // BK)
     has_mask = mask is not None
 
@@ -427,7 +443,7 @@ def _flash_bwd(g, q, k, v, out, lse, mask, causal: bool, scale: float, H: int, G
     recompute itself stays group-shared-K/V, which is the bandwidth win)."""
     BH, Tq, hs = q.shape
     BG, Tk, _ = k.shape
-    BQ, BK = _block(Tq), _block(Tk)
+    BQ, BK = _block(Tq, "Q"), _block(Tk, "K")
     rep = H // G
     has_mask = mask is not None
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True)

@@ -1,0 +1,28 @@
+import sys; import os; sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax, jax.numpy as jnp, optax
+from bench import make_batch, time_steps, mfu
+from thunder_tpu.models import llama
+import thunder_tpu.distributed as dist
+
+cfg = llama.Config.from_name("Llama-2-7b-hf", n_layer=4)
+B, T = 2, 2048
+opt = optax.adamw(1e-4)
+for quant in ("int8", "fp8"):
+    try:
+        mesh = dist.make_mesh({"dp": 1}, devices=jax.devices()[:1])
+        params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+        idx, tgt, cos, sin = make_batch(cfg, B, T)
+        def loss_fn(p, i, t, c, s):
+            return llama.gpt_loss(p, i, t, c, s, cfg)
+        step = dist.make_train_step(loss_fn, opt, mesh, batch_specs=None, donate=True, quant=quant)
+        o = step.init_optimizer_state(params)
+        p2, o2, loss = step(params, o, idx, tgt, cos, sin)
+        lv = float(loss)
+        dt1, st = time_steps(lambda p, o: step(p, o, idx, tgt, cos, sin), 10, p2, o2)
+        dt2, _ = time_steps(lambda p, o: step(p, o, idx, tgt, cos, sin), 10, *st)
+        tps = B*T*10/min(dt1, dt2)
+        print(f"quant={quant}: {tps:,.0f} tok/s MFU-equiv {100*mfu(tps, cfg, T, 'tpu'):.1f}% loss={lv:.4f}", flush=True)
+        jax.clear_caches()
+    except Exception as e:
+        import traceback; traceback.print_exc()
+        print(f"quant={quant}: FAILED {type(e).__name__}: {str(e)[:200]}", flush=True)

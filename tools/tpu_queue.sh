@@ -1,0 +1,22 @@
+#!/bin/bash
+# Serial TPU experiment queue: waits for the flaky tunnel, then runs each
+# experiment alone (two concurrent clients wedge the tunnel — measured).
+cd /root/repo
+LOG=tpu_experiments
+mkdir -p "$LOG"
+for i in $(seq 1 400); do
+  out=$(timeout 180 python -c "import jax; print('UP', jax.default_backend())" 2>&1 | grep '^UP tpu')
+  if [ -n "$out" ]; then
+    echo "$(date -u +%T) TPU up (attempt $i)" >> "$LOG/queue.log"
+    timeout 2400 python tools/flash_tune.py  > "$LOG/flash_tune.log" 2>&1
+    echo "$(date -u +%T) flash_tune rc=$?" >> "$LOG/queue.log"
+    timeout 2400 python tools/quant_headline.py > "$LOG/quant_headline.log" 2>&1
+    echo "$(date -u +%T) quant_headline rc=$?" >> "$LOG/queue.log"
+    timeout 2400 python bench.py decode > "$LOG/decode.json" 2> "$LOG/decode.log"
+    echo "$(date -u +%T) decode rc=$?" >> "$LOG/queue.log"
+    echo "$(date -u +%T) queue done" >> "$LOG/queue.log"
+    exit 0
+  fi
+  echo "$(date -u +%T) attempt=$i tunnel down" >> "$LOG/queue.log"
+  sleep 60
+done

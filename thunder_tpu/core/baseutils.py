@@ -7,6 +7,7 @@ ported: the generated program targets JAX-callable Python.
 from __future__ import annotations
 
 import collections.abc
+import enum
 import functools
 import sys
 from types import CodeType, ModuleType
@@ -167,6 +168,12 @@ _printable_literal_types = (
 
 
 def is_base_printable_literal(x: Any) -> bool:
+    # Enum members subclass int/str but repr as '<Signals.SIGINT: 2>', which
+    # is not evaluable source — route them to the trace's named-object
+    # registry instead (found by tracing asyncio.run: the prologue guarded a
+    # signal-module constant and the generated program failed to compile)
+    if isinstance(x, enum.Enum):
+        return False
     return isinstance(x, _printable_literal_types)
 
 

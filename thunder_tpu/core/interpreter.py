@@ -20,7 +20,9 @@ Targets CPython 3.12 bytecode.
 from __future__ import annotations
 
 import builtins as _builtins
+import collections.abc as _abc
 import dis
+import inspect
 import types
 from dataclasses import dataclass, field
 from enum import Enum, auto
@@ -234,12 +236,6 @@ class Frame:
         return idx
 
 
-_UNSUPPORTED = {
-    "GET_AWAITABLE": "async is not supported",
-    "BEFORE_ASYNC_WITH": "async is not supported",
-    "GET_AITER": "async is not supported",
-}
-
 # CPython's stack NULL is a real null pointer, distinct from Py_None — the
 # call convention depends on the difference ([NULL, callable] plain call vs
 # [callable, self] method call with None as a legitimate self/argument)
@@ -300,7 +296,14 @@ def _call_value(ctx: InterpreterCompileCtx, depth: int, fn, args, kwargs):
         # is also fine — prefer the host call for functions from installed
         # packages (site-packages) to keep the interpreter on user code
         mod = getattr(fn, "__module__", "") or ""
-        if mod.startswith(("thunder_tpu", "torch", "jax", "numpy", "optax", "flax")):
+        # asyncio and friends: the event loop is runtime machinery — it runs
+        # host-side and drives InterpretedCoroutines via send(); interpreting
+        # its internals only manufactures prologue guards on loop/signal
+        # state that can never replay.  Exact-package match: a user module
+        # merely *named* signals.py must still interpret.
+        top = mod.split(".", 1)[0]
+        if top in ("thunder_tpu", "torch", "jax", "numpy", "optax", "flax",
+                   "asyncio", "selectors", "signal", "concurrent", "threading"):
             ctx.record("opaque", depth, getattr(fn, "__qualname__", repr(fn)))
             return fn(*args, **kwargs)
         ctx.record("call", depth, getattr(fn, "__qualname__", repr(fn)))
@@ -340,8 +343,10 @@ def _run_function(ctx: InterpreterCompileCtx, fn: types.FunctionType, args: tupl
     if fn.__closure__:
         for name, cell in zip(code.co_freevars, fn.__closure__):
             frame.cells[name] = cell
-    if code.co_flags & (0x80 | 0x200):  # CO_COROUTINE / CO_ASYNC_GENERATOR
-        raise InterpreterError("async functions cannot be traced; call them outside the jitted fn")
+    if code.co_flags & 0x200:  # CO_ASYNC_GENERATOR
+        return InterpretedAsyncGenerator(frame)
+    if code.co_flags & 0x80:  # CO_COROUTINE
+        return InterpretedCoroutine(frame)
     if code.co_flags & 0x20:  # CO_GENERATOR: suspend-capable frame
         return InterpretedGenerator(frame)
     return _run_frame(frame)
@@ -408,6 +413,215 @@ class InterpretedGenerator:
         return self._loop.close()
 
 
+class InterpretedCoroutine(_abc.Coroutine):
+    """A suspended interpreted CO_COROUTINE frame exposing the coroutine
+    protocol.  Subclassing ``collections.abc.Coroutine`` makes
+    ``asyncio.iscoroutine`` true, so an opaque event loop (``asyncio.run``)
+    can drive interpreted coroutines exactly as CPython ones: ``send(None)``
+    resumes to the next suspension, ``StopIteration.value`` carries the
+    result.  (Reference interpreter runs coroutine frames natively; its
+    3.10/3.11 opcode set reaches them via the same generator machinery.)"""
+
+    def __init__(self, frame: Frame):
+        self._frame = frame
+        self._loop = _gen_driver(frame)
+        self._done = False
+
+    def __await__(self):
+        # like CPython's coroutine_wrapper: an iterator over the same frame,
+        # routed through send/throw so the reuse guard still applies
+        return _CoroWrapper(self)
+
+    def send(self, value):
+        if self._done:
+            raise RuntimeError("cannot reuse already awaited coroutine")
+        try:
+            return self._loop.send(value)
+        except BaseException:  # StopIteration (completion) or error: dead either way
+            self._done = True
+            raise
+
+    def throw(self, *exc):
+        if self._done:
+            raise RuntimeError("cannot reuse already awaited coroutine")
+        try:
+            return self._loop.throw(*exc)
+        except BaseException:
+            self._done = True
+            raise
+
+    def close(self):
+        self._done = True
+        return self._loop.close()
+
+
+class _CoroWrapper:
+    """Iterator view of an InterpretedCoroutine (CPython's coroutine_wrapper)."""
+
+    __slots__ = ("_coro",)
+
+    def __init__(self, coro):
+        self._coro = coro
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self._coro.send(None)
+
+    def send(self, value):
+        return self._coro.send(value)
+
+    def throw(self, *exc):
+        return self._coro.throw(*exc)
+
+    def close(self):
+        return self._coro.close()
+
+
+class _ThrowIn:
+    """In-band exception delivery into a suspended interpreted frame: sent as
+    a value through the host generator channel and raised at the suspension
+    point.  Used for GeneratorExit, which host ``gen.throw`` would forbid
+    resuming from (no yield after throw(GeneratorExit)) — but async-gen
+    cleanup is allowed to await."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+class _AsyncGenWrapped:
+    """Marker around values yielded by an async generator (CPython's
+    internal _PyAsyncGenWrappedValue, produced by CALL_INTRINSIC_1
+    INTRINSIC_ASYNC_GEN_WRAP): distinguishes ``yield x`` (ends one
+    ``__anext__`` step) from yields forwarded out of an ``await`` inside the
+    generator body (which go to the event loop)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value):
+        self.value = value
+
+
+class _Awaitable:
+    """Minimal awaitable over a host generator (the __anext__/asend/athrow
+    driver below)."""
+
+    __slots__ = ("_gen",)
+
+    def __init__(self, gen):
+        self._gen = gen
+
+    def __await__(self):
+        return self._gen
+
+
+class InterpretedAsyncGenerator:
+    """A suspended interpreted CO_ASYNC_GENERATOR frame exposing the async
+    generator protocol (__anext__/asend/athrow/aclose return awaitables).
+
+    One async-iteration step drives the frame until a wrapped ``yield`` (its
+    value is the step's result), a bare return (→ StopAsyncIteration), or a
+    suspension from an inner ``await`` (forwarded to the outer event loop).
+    GeneratorExit is delivered in-band (``_ThrowIn``) so cleanup code may
+    await — host ``gen.throw(GeneratorExit)`` would forbid the subsequent
+    suspension."""
+
+    def __init__(self, frame: Frame):
+        self._frame = frame
+        self._loop = _gen_driver(frame)
+        self._started = False
+        self._running = False
+
+    def __aiter__(self):
+        return self
+
+    def _deliver(self, meth, args):
+        if meth == "throw":
+            exc = args[0] if args else None
+            is_ge = isinstance(exc, GeneratorExit) or (
+                isinstance(exc, type) and issubclass(exc, GeneratorExit)
+            )
+            if is_ge and self._started:
+                inst = exc if isinstance(exc, BaseException) else GeneratorExit()
+                return self._loop.send(_ThrowIn(inst))
+            return self._loop.throw(*args)
+        self._started = True
+        return self._loop.send(*args)
+
+    def _step(self, meth, args):
+        if self._running:
+            raise RuntimeError("anext(): asynchronous generator is already running")
+        self._running = True
+        try:
+            try:
+                res = self._deliver(meth, args)
+            except StopIteration:
+                raise StopAsyncIteration
+            while True:
+                if isinstance(res, _AsyncGenWrapped):
+                    return res.value  # → StopIteration(value) for the awaiter
+                try:
+                    sent = yield res  # inner await: forward to the event loop
+                except BaseException as e:  # athrow/cancellation during the await
+                    try:
+                        res = self._deliver("throw", (e,))
+                    except StopIteration:
+                        raise StopAsyncIteration
+                    continue
+                try:
+                    res = self._deliver("send", (sent,))
+                except StopIteration:
+                    raise StopAsyncIteration
+        finally:
+            self._running = False
+
+    def __anext__(self):
+        return _Awaitable(self._step("send", (None,)))
+
+    def asend(self, value):
+        return _Awaitable(self._step("send", (value,)))
+
+    def athrow(self, *exc):
+        return _Awaitable(self._step("throw", exc))
+
+    def aclose(self):
+        def _close():
+            # throw GeneratorExit; the generator may run cleanup awaits
+            # (forwarded to the loop) but may not yield another value
+            if not self._started:
+                self._loop.close()
+                return
+            step = self._step("throw", (GeneratorExit,))
+            try:
+                res = next(step)
+            except (StopAsyncIteration, GeneratorExit):
+                return
+            except StopIteration:  # a wrapped yield completed the step
+                raise RuntimeError("async generator ignored GeneratorExit")
+            while True:
+                try:
+                    sent = yield res
+                except BaseException as e:
+                    try:
+                        res = step.throw(e)
+                        continue
+                    except (StopAsyncIteration, GeneratorExit):
+                        return
+                    except StopIteration:
+                        raise RuntimeError("async generator ignored GeneratorExit")
+                try:
+                    res = step.send(sent)
+                except (StopAsyncIteration, GeneratorExit):
+                    return
+                except StopIteration:
+                    raise RuntimeError("async generator ignored GeneratorExit")
+
+        return _Awaitable(_close())
+
+
 def _unwind(frame: Frame, ins, exc_table, e: BaseException) -> int:
     """Dispatches ``e`` raised at ``ins`` to the frame's exception table:
     truncates the value stack to the handler depth and returns the handler's
@@ -442,8 +656,6 @@ def _frame_loop(frame: Frame, instrs, exc_table):
             ins = instrs[i]
             op = ins.opname
             ctx_log.record("op", depth, co_name, op, ins.argrepr)
-            if op in _UNSUPPORTED:
-                raise InterpreterError(f"{op}: {_UNSUPPORTED[op]}")
             h = _handlers.get(op)
             if h is None:
                 raise InterpreterError(
@@ -474,30 +686,38 @@ def _frame_loop(frame: Frame, instrs, exc_table):
                     mine = [p for p in ctx_stack if p[0] is frame]
                     if mine:
                         ctx_stack[:] = [p for p in ctx_stack if p[0] is not frame]
+                    thrown = None
                     try:
                         sent = yield to_yield
                     except BaseException as e:
-                        ctx_stack.extend(mine)
-                        in_yield_from = i > 0 and instrs[i - 1].opname == "SEND"
-                        recv = frame.stack[-2] if in_yield_from and len(frame.stack) >= 2 else None
-                        if recv is not None and hasattr(recv, "throw"):
-                            try:
-                                to_yield = recv.throw(e)
-                                continue  # sub-iterator yielded again: re-suspend
-                            except StopIteration as si:
-                                # sub-iterator finished: SEND-exhaustion contract
-                                frame.stack[-1] = getattr(si, "value", None)
-                                i = frame.jump_to_offset(instrs[i - 1].argval)
-                                break
-                            except BaseException as e2:
-                                e = e2
-                        i = _unwind(frame, ins, exc_table, e)
-                        break
+                        thrown = e
                     else:
-                        ctx_stack.extend(mine)
+                        # in-band exception delivery (_ThrowIn): a host
+                        # generator may not yield after throw(GeneratorExit),
+                        # which would forbid async-gen cleanup awaits — so
+                        # aclose() sends the exception as a value instead
+                        if isinstance(sent, _ThrowIn):
+                            thrown = sent.exc
+                    ctx_stack.extend(mine)
+                    if thrown is None:
                         frame.stack[-1] = sent
                         i += 1
                         break
+                    in_yield_from = i > 0 and instrs[i - 1].opname == "SEND"
+                    recv = frame.stack[-2] if in_yield_from and len(frame.stack) >= 2 else None
+                    if recv is not None and hasattr(recv, "throw"):
+                        try:
+                            to_yield = recv.throw(thrown)
+                            continue  # sub-iterator yielded again: re-suspend
+                        except StopIteration as si:
+                            # sub-iterator finished: SEND-exhaustion contract
+                            frame.stack[-1] = getattr(si, "value", None)
+                            i = frame.jump_to_offset(instrs[i - 1].argval)
+                            break
+                        except BaseException as e2:
+                            thrown = e2
+                    i = _unwind(frame, ins, exc_table, thrown)
+                    break
                 continue
             i = res if isinstance(res, int) else i + 1
         raise InterpreterError(f"fell off the end of {frame.code.co_name}")
@@ -1302,6 +1522,8 @@ def _call_intrinsic_1(frame, ins, i):
             frame.push(e)
         else:
             frame.push(v)
+    elif ins.arg == 4:  # ASYNC_GEN_WRAP: tag a ``yield`` in an async generator
+        frame.push(_AsyncGenWrapped(v))
     else:
         raise InterpreterError(f"CALL_INTRINSIC_1 {ins.arg} is not supported")
 
@@ -1456,6 +1678,79 @@ def _end_send(frame, ins, i):
     res = frame.pop()
     frame.pop()
     frame.push(res)
+
+
+#
+# Async opcodes (3.12).  ``await`` compiles to GET_AWAITABLE + the same
+# SEND/YIELD_VALUE/END_SEND loop as ``yield from``, so coroutine frames ride
+# the generator machinery; only awaitable resolution and the async-for/with
+# entry points are new.
+#
+
+
+def _resolve_awaitable(v):
+    """GET_AWAITABLE semantics: coroutines pass through, @types.coroutine
+    generators (CO_ITERABLE_COROUTINE) pass through, everything else goes
+    via type(v).__await__."""
+    if isinstance(v, InterpretedCoroutine) or inspect.iscoroutine(v):
+        return v
+    if isinstance(v, types.GeneratorType) and v.gi_code.co_flags & 0x100:
+        return v  # CO_ITERABLE_COROUTINE (@types.coroutine)
+    if isinstance(v, InterpretedGenerator) and v._frame.code.co_flags & 0x100:
+        return v  # interpreted @types.coroutine generator (asyncio.sleep's __sleep0)
+    if isinstance(v, _Awaitable):
+        return v.__await__()
+    await_m = getattr(type(v), "__await__", None)
+    if await_m is None:
+        raise TypeError(f"object {type(v).__name__} can't be used in 'await' expression")
+    return await_m(v)
+
+
+@register_opcode_handler("GET_AWAITABLE")
+def _get_awaitable(frame, ins, i):
+    frame.stack[-1] = _resolve_awaitable(frame.stack[-1])
+
+
+@register_opcode_handler("GET_AITER")
+def _get_aiter(frame, ins, i):
+    v = frame.stack[-1]
+    aiter_m = getattr(type(v), "__aiter__", None)
+    if aiter_m is None:
+        raise TypeError(f"'async for' requires an object with __aiter__ method, got {type(v).__name__}")
+    frame.stack[-1] = aiter_m(v)
+
+
+@register_opcode_handler("GET_ANEXT")
+def _get_anext(frame, ins, i):
+    # keep the iterator; push the resolved awaitable of its __anext__()
+    v = frame.stack[-1]
+    anext_m = getattr(type(v), "__anext__", None)
+    if anext_m is None:
+        raise TypeError(f"'async for' requires an iterator with __anext__ method, got {type(v).__name__}")
+    frame.push(_resolve_awaitable(anext_m(v)))
+
+
+@register_opcode_handler("END_ASYNC_FOR")
+def _end_async_for(frame, ins, i):
+    # stack [aiter, exc]: StopAsyncIteration ends the loop; anything else
+    # re-raises out of the frame
+    exc = frame.pop()
+    frame.pop()
+    if not isinstance(exc, StopAsyncIteration):
+        raise exc
+
+
+@register_opcode_handler("BEFORE_ASYNC_WITH")
+def _before_async_with(frame, ins, i):
+    mgr = frame.pop()
+    aexit = getattr(type(mgr), "__aexit__", None)
+    aenter = getattr(type(mgr), "__aenter__", None)
+    if aexit is None or aenter is None:
+        raise TypeError(
+            f"'async with' requires an object with __aenter__/__aexit__ methods, got {type(mgr).__name__}"
+        )
+    frame.push(aexit.__get__(mgr))
+    frame.push(aenter(mgr))  # the following GET_AWAITABLE awaits it
 
 
 @register_opcode_handler("CLEANUP_THROW")

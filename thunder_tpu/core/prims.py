@@ -1069,8 +1069,19 @@ def _sdpa_check_mask(mask: TensorProxy | None, q: TensorProxy, k: TensorProxy) -
         check(md == 1 or md == td, lambda: f"sdpa: mask shape {mask.shape} not broadcastable to {target}")
 
 
+def _sdpa_check_window(window, causal: bool) -> None:
+    """``window`` (sliding-window attention, Mistral-style) restricts query i
+    to keys in (i-window, i].  Causal-only: a two-sided band has no torch
+    analog and the kernels' block skipping assumes the causal diagonal."""
+    if window is None:
+        return
+    check(causal, lambda: "sdpa: sliding_window requires is_causal=True")
+    check(int(window) > 0, lambda: f"sdpa: sliding_window must be positive, got {window}")
+
+
 def _sdpa_meta(
-    q: TensorProxy, k: TensorProxy, v: TensorProxy, mask: TensorProxy | None, causal: bool, scale: float
+    q: TensorProxy, k: TensorProxy, v: TensorProxy, mask: TensorProxy | None, causal: bool, scale: float,
+    window: int | None = None,
 ) -> tuple[TensorProxy, TensorProxy]:
     """Fused scaled-dot-product attention over (..., T, hs) q/k/v.
 
@@ -1094,6 +1105,7 @@ def _sdpa_meta(
     check(k.shape[:-2] == v.shape[:-2], lambda: "sdpa: k/v batch dims must match")
     _sdpa_check_gqa(q, k, v)
     _sdpa_check_mask(mask, q, k)
+    _sdpa_check_window(window, causal)
     rg = (q.requires_grad or k.requires_grad or v.requires_grad) and dtypes.is_inexact_dtype(q.dtype)
     out = _out_like(q, shape=q.shape[:-1] + (v.shape[-1],), requires_grad=rg)
     lse = TensorProxy(shape=q.shape[:-1], device=q.device, dtype=dtypes.float32, requires_grad=False)
@@ -1113,11 +1125,13 @@ def _sdpa_backward_meta(
     mask: TensorProxy | None,
     causal: bool,
     scale: float,
+    window: int | None = None,
 ) -> tuple[TensorProxy, TensorProxy, TensorProxy]:
     for t in (g, q, k, v, out, lse):
         _check_tensor(t)
     _sdpa_check_gqa(q, k, v)
     _sdpa_check_mask(mask, q, k)
+    _sdpa_check_window(window, causal)
     dq = _out_like(q, requires_grad=False)
     dk = _out_like(k, requires_grad=False)
     dv = _out_like(v, requires_grad=False)

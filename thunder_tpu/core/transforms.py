@@ -659,12 +659,17 @@ def _sdpa_bw(bsym, g_out, g_lse):
     added via the decomposed probability matrix — an O(T²) cost paid only in
     that rare case (e.g. distillation losses over lse).
     """
-    q, k, v, mask, causal, scale = bsym.args
+    q, k, v, mask, causal, scale, *rest = bsym.args
+    window = rest[0] if rest else None
     out, lse = bsym.output
     if g_out is None:
         g_out = clang.full_like(out, 0.0)
-    dq, dk, dv = prims.sdpa_backward(g_out, q, k, v, out, lse, mask, causal, scale)
+    dq, dk, dv = prims.sdpa_backward(g_out, q, k, v, out, lse, mask, causal, scale, window)
     if g_lse is not None:
+        if window is not None:
+            raise NotImplementedError(
+                "differentiating through sdpa's lse output with sliding_window is not supported"
+            )
         # d lse_i/dq_i = scale * sum_j p_ij k_j ; d lse_i/dk_j = scale * p_ij q_i
         if q.shape[:-2] != k.shape[:-2]:
             raise NotImplementedError(

@@ -498,18 +498,25 @@ def _gqa_expand(q, k, v):
     return jnp.repeat(k, rep, axis=-3), jnp.repeat(v, rep, axis=-3), rep
 
 
-def _sdpa_reference(q, k, v, mask, causal, scale):
+def _band(Tq, Tk, window):
+    """Causal(+sliding-window) boolean mask: row i attends cols in
+    (i-window, i] — top-left aligned like the torch decomposition."""
+    cm = jnp.tril(jnp.ones((Tq, Tk), dtype=bool))
+    if window is not None:
+        row = jnp.arange(Tq)[:, None]
+        col = jnp.arange(Tk)[None, :]
+        cm = cm & (col > row - window)
+    return cm
+
+
+def _sdpa_reference(q, k, v, mask, causal, scale, window=None):
     k, v, _ = _gqa_expand(q, k, v)
     s = jnp.einsum("...qd,...kd->...qk", q, k, preferred_element_type=jnp.float32)
     s = s * scale
     if mask is not None:
         s = s + mask.astype(jnp.float32)
     if causal:
-        # top-left alignment (query i attends keys j <= i), matching the
-        # torch-level decomposition and the Pallas kernels
-        Tq, Tk = q.shape[-2], k.shape[-2]
-        cm = jnp.tril(jnp.ones((Tq, Tk), dtype=bool))
-        s = jnp.where(cm, s, -jnp.inf)
+        s = jnp.where(_band(q.shape[-2], k.shape[-2], window), s, -jnp.inf)
     lse = jax.nn.logsumexp(s, axis=-1)
     p = jnp.exp(s - lse[..., None])
     out = jnp.einsum("...qk,...kd->...qd", p.astype(v.dtype), v)
@@ -517,23 +524,21 @@ def _sdpa_reference(q, k, v, mask, causal, scale):
 
 
 @impl(PrimIDs.SDPA)
-def _sdpa_impl(q, k, v, mask, causal, scale):
+def _sdpa_impl(q, k, v, mask, causal, scale, window=None):
     if _sdpa_fast_path is not None:
-        res = _sdpa_fast_path(q, k, v, mask, causal, scale)
+        res = _sdpa_fast_path(q, k, v, mask, causal, scale, window)
         if res is not None:
             return res
-    return _sdpa_reference(q, k, v, mask, causal, scale)
+    return _sdpa_reference(q, k, v, mask, causal, scale, window)
 
 
-def _sdpa_backward_reference(g, q, k, v, out, lse, mask, causal, scale):
+def _sdpa_backward_reference(g, q, k, v, out, lse, mask, causal, scale, window=None):
     kx, vx, rep = _gqa_expand(q, k, v)
     s = jnp.einsum("...qd,...kd->...qk", q, kx, preferred_element_type=jnp.float32) * scale
     if mask is not None:
         s = s + mask.astype(jnp.float32)
     if causal:
-        Tq, Tk = q.shape[-2], kx.shape[-2]
-        cm = jnp.tril(jnp.ones((Tq, Tk), dtype=bool))
-        s = jnp.where(cm, s, -jnp.inf)
+        s = jnp.where(_band(q.shape[-2], kx.shape[-2], window), s, -jnp.inf)
     p = jnp.exp(s - lse[..., None])  # (..., Tq, Tk) f32
     dv = jnp.einsum("...qk,...qd->...kd", p, g.astype(jnp.float32))
     dp = jnp.einsum("...qd,...kd->...qk", g, vx, preferred_element_type=jnp.float32)
@@ -549,12 +554,12 @@ def _sdpa_backward_reference(g, q, k, v, out, lse, mask, causal, scale):
 
 
 @impl(PrimIDs.SDPA_BACKWARD)
-def _sdpa_backward_impl(g, q, k, v, out, lse, mask, causal, scale):
+def _sdpa_backward_impl(g, q, k, v, out, lse, mask, causal, scale, window=None):
     if _sdpa_bwd_fast_path is not None:
-        res = _sdpa_bwd_fast_path(g, q, k, v, out, lse, mask, causal, scale)
+        res = _sdpa_bwd_fast_path(g, q, k, v, out, lse, mask, causal, scale, window)
         if res is not None:
             return res
-    return _sdpa_backward_reference(g, q, k, v, out, lse, mask, causal, scale)
+    return _sdpa_backward_reference(g, q, k, v, out, lse, mask, causal, scale, window)
 
 
 _ce_fast_path: Callable | None = None  # installed by pallasex (fused CE kernel)

@@ -174,7 +174,9 @@ def _canon_mask(mask_shape, q_shape, k_shape):
     return None
 
 
-def _supported(q_shape, k_shape, v_shape, dtype, causal, mask_shape=None) -> bool:
+def _supported(q_shape, k_shape, v_shape, dtype, causal, mask_shape=None, window=None) -> bool:
+    if window is not None and (not causal or int(window) <= 0):
+        return False
     *_, Tq, hs = q_shape
     Tk = k_shape[-2]
     if v_shape[-1] != hs:  # kernels assume one head dim for q/k/v
@@ -203,7 +205,7 @@ def _supported(q_shape, k_shape, v_shape, dtype, causal, mask_shape=None) -> boo
 #
 
 
-def _fwd_kernel(*refs, BQ, BK, causal, scale, has_mask):
+def _fwd_kernel(*refs, BQ, BK, causal, scale, has_mask, window):
     if has_mask:
         q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, m_s, l_s, acc_s = refs
     else:
@@ -219,8 +221,11 @@ def _fwd_kernel(*refs, BQ, BK, causal, scale, has_mask):
         l_s[...] = jnp.zeros_like(l_s)
         acc_s[...] = jnp.zeros_like(acc_s)
 
-    # causal: skip KV blocks strictly above the diagonal
+    # causal: skip KV blocks strictly above the diagonal; sliding window
+    # additionally skips blocks entirely below the band (col <= row - window)
     run = (j * BK <= i * BQ + BQ - 1) if causal else True
+    if window is not None:
+        run = jnp.logical_and(run, j * BK + BK - 1 > i * BQ - window)
 
     @pl.when(run)
     def _compute():
@@ -235,7 +240,10 @@ def _fwd_kernel(*refs, BQ, BK, causal, scale, has_mask):
         if causal:
             row = i * BQ + jax.lax.broadcasted_iota(jnp.int32, (BQ, BK), 0)
             col = j * BK + jax.lax.broadcasted_iota(jnp.int32, (BQ, BK), 1)
-            s = jnp.where(row >= col, s, _MASK_VALUE)
+            keep = row >= col
+            if window is not None:
+                keep = jnp.logical_and(keep, col > row - window)
+            s = jnp.where(keep, s, _MASK_VALUE)
         m_prev = m_s[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -278,8 +286,9 @@ def _mask_spec(mode: str, mq: int, H: int, BQ: int, BK: int):
     return pl.BlockSpec(blk, _mask_index(mode, H, mq > 1))
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "scale", "H", "G", "mode", "mq"))
-def _flash_fwd(q, k, v, mask, causal: bool, scale: float, H: int, G: int, mode: str | None, mq: int):
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "H", "G", "mode", "mq", "window"))
+def _flash_fwd(q, k, v, mask, causal: bool, scale: float, H: int, G: int, mode: str | None, mq: int,
+               window: int | None = None):
     """q (BH, Tq, hs), k/v (BG, Tk, hs), mask (M, mq, Tk) f32 or None
     -> out (BH, Tq, hs), lse (BH, Tq, 1) f32.  ``H``/``G`` are the per-shard
     q/KV head counts (the flat-batch gather key for GQA); ``mode``/``mq``
@@ -290,7 +299,9 @@ def _flash_fwd(q, k, v, mask, causal: bool, scale: float, H: int, G: int, mode: 
     grid = (BH, Tq // BQ, Tk // BK)
     has_mask = mask is not None
 
-    kernel = functools.partial(_fwd_kernel, BQ=BQ, BK=BK, causal=causal, scale=scale, has_mask=has_mask)
+    kernel = functools.partial(
+        _fwd_kernel, BQ=BQ, BK=BK, causal=causal, scale=scale, has_mask=has_mask, window=window
+    )
     params = {}
     if pltpu is not None and not _interpret():
         params["compiler_params"] = pltpu.CompilerParams(
@@ -332,7 +343,7 @@ def _flash_fwd(q, k, v, mask, causal: bool, scale: float, H: int, G: int, mode: 
 #
 
 
-def _bwd_dq_kernel(*refs, BQ, BK, causal, scale, has_mask):
+def _bwd_dq_kernel(*refs, BQ, BK, causal, scale, has_mask, window):
     if has_mask:
         g_ref, q_ref, k_ref, v_ref, lse_ref, delta_ref, mask_ref, dq_ref, dq_s = refs
     else:
@@ -347,6 +358,8 @@ def _bwd_dq_kernel(*refs, BQ, BK, causal, scale, has_mask):
         dq_s[...] = jnp.zeros_like(dq_s)
 
     run = (j * BK <= i * BQ + BQ - 1) if causal else True
+    if window is not None:
+        run = jnp.logical_and(run, j * BK + BK - 1 > i * BQ - window)
 
     @pl.when(run)
     def _compute():
@@ -365,7 +378,10 @@ def _bwd_dq_kernel(*refs, BQ, BK, causal, scale, has_mask):
         if causal:
             row = i * BQ + jax.lax.broadcasted_iota(jnp.int32, (BQ, BK), 0)
             col = j * BK + jax.lax.broadcasted_iota(jnp.int32, (BQ, BK), 1)
-            p = jnp.where(row >= col, p, 0.0)
+            keep = row >= col
+            if window is not None:
+                keep = jnp.logical_and(keep, col > row - window)
+            p = jnp.where(keep, p, 0.0)
         dp = jax.lax.dot_general(
             g, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # (BQ, BK)
@@ -379,7 +395,7 @@ def _bwd_dq_kernel(*refs, BQ, BK, causal, scale, has_mask):
         dq_ref[0] = dq_s[...].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(*refs, BQ, BK, causal, scale, has_mask):
+def _bwd_dkv_kernel(*refs, BQ, BK, causal, scale, has_mask, window):
     if has_mask:
         g_ref, q_ref, k_ref, v_ref, lse_ref, delta_ref, mask_ref, dk_ref, dv_ref, dk_s, dv_s = refs
     else:
@@ -395,6 +411,8 @@ def _bwd_dkv_kernel(*refs, BQ, BK, causal, scale, has_mask):
         dv_s[...] = jnp.zeros_like(dv_s)
 
     run = (iq * BQ + BQ - 1 >= jk * BK) if causal else True
+    if window is not None:
+        run = jnp.logical_and(run, jk * BK + BK - 1 > iq * BQ - window)
 
     @pl.when(run)
     def _compute():
@@ -413,7 +431,10 @@ def _bwd_dkv_kernel(*refs, BQ, BK, causal, scale, has_mask):
         if causal:
             row = iq * BQ + jax.lax.broadcasted_iota(jnp.int32, (BQ, BK), 0)
             col = jk * BK + jax.lax.broadcasted_iota(jnp.int32, (BQ, BK), 1)
-            p = jnp.where(row >= col, p, 0.0)
+            keep = row >= col
+            if window is not None:
+                keep = jnp.logical_and(keep, col > row - window)
+            p = jnp.where(keep, p, 0.0)
         # dv += p^T @ g   (contract over q rows)
         dv_s[...] += jax.lax.dot_general(
             p.astype(g.dtype), g, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -432,8 +453,9 @@ def _bwd_dkv_kernel(*refs, BQ, BK, causal, scale, has_mask):
         dv_ref[0] = dv_s[...].astype(dv_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "scale", "H", "G", "mode", "mq"))
-def _flash_bwd(g, q, k, v, out, lse, mask, causal: bool, scale: float, H: int, G: int, mode: str | None, mq: int):
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "H", "G", "mode", "mq", "window"))
+def _flash_bwd(g, q, k, v, out, lse, mask, causal: bool, scale: float, H: int, G: int, mode: str | None, mq: int,
+               window: int | None = None):
     """g/q/out (BH, Tq, hs), k/v (BG, Tk, hs), lse (BH, Tq, 1);
     returns (dq (BH,...), dk, dv (BG,...)).
 
@@ -468,7 +490,9 @@ def _flash_bwd(g, q, k, v, out, lse, mask, causal: bool, scale: float, H: int, G
         dq_operands.append(mask)
 
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, BQ=BQ, BK=BK, causal=causal, scale=scale, has_mask=has_mask),
+        functools.partial(
+            _bwd_dq_kernel, BQ=BQ, BK=BK, causal=causal, scale=scale, has_mask=has_mask, window=window
+        ),
         grid=(BH, Tq // BQ, Tk // BK),
         in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, BQ, hs), lambda b, i, j: (b, i, 0)),
@@ -497,7 +521,9 @@ def _flash_bwd(g, q, k, v, out, lse, mask, causal: bool, scale: float, H: int, G
         dkv_operands.append(mask)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, BQ=BQ, BK=BK, causal=causal, scale=scale, has_mask=has_mask),
+        functools.partial(
+            _bwd_dkv_kernel, BQ=BQ, BK=BK, causal=causal, scale=scale, has_mask=has_mask, window=window
+        ),
         grid=(BH, Tk // BK, Tq // BQ),
         in_specs=dkv_in_specs,
         out_specs=[
@@ -561,7 +587,7 @@ def _canon_mask_operand(mask, q_shape, k_shape):
     return mask.reshape(-1, mq, Tk).astype(jnp.float32), mode, mq
 
 
-def _fwd_local(q, k, v, mask, causal: bool, scale: float):
+def _fwd_local(q, k, v, mask, causal: bool, scale: float, window: int | None = None):
     """Single-device forward on concrete arrays: flatten batch, pad hs, run.
     ``mask`` is the original-rank additive mask or None."""
     *batch, Tq, hs = q.shape
@@ -575,11 +601,12 @@ def _fwd_local(q, k, v, mask, causal: bool, scale: float):
         _pad_hs(v.reshape(BG, Tk, hs), hs, hp),
         mask3,
         bool(causal), float(scale), H, G, mode, mq,
+        window=None if window is None else int(window),
     )
     return out[..., :hs].reshape(*batch, Tq, hs), lse.reshape(*batch, Tq)
 
 
-def _bwd_local(g, q, k, v, out, lse, mask, causal: bool, scale: float):
+def _bwd_local(g, q, k, v, out, lse, mask, causal: bool, scale: float, window: int | None = None):
     *batch, Tq, hs = q.shape
     Tk = k.shape[-2]
     hp = _pad128(hs)
@@ -591,6 +618,7 @@ def _bwd_local(g, q, k, v, out, lse, mask, causal: bool, scale: float):
         lse.reshape(BH, Tq, 1).astype(jnp.float32),
         mask3,
         bool(causal), float(scale), H, G, mode, mq,
+        window=None if window is None else int(window),
     )
     return (
         dq[..., :hs].reshape(q.shape),
@@ -675,11 +703,11 @@ def _mask_shard_spec(mask, q_shape, k_shape, qkv_spec):
     return False
 
 
-def flash_sdpa(q, k, v, mask, causal, scale):
+def flash_sdpa(q, k, v, mask, causal, scale, window=None):
     """Returns (out, lse) via the flash kernels, or None if unsupported."""
     if not _enabled() or not _supported(
         q.shape, k.shape, v.shape, q.dtype, causal,
-        mask.shape if mask is not None else None,
+        mask.shape if mask is not None else None, window,
     ):
         return None
     from jax.sharding import PartitionSpec as P
@@ -689,7 +717,7 @@ def flash_sdpa(q, k, v, mask, causal, scale):
     lse_spec = P(*tuple(spec)[:-1])
     if mask is None:
         return _dispatch(
-            lambda q, k, v: _fwd_local(q, k, v, None, bool(causal), float(scale)),
+            lambda q, k, v: _fwd_local(q, k, v, None, bool(causal), float(scale), window),
             (q, k, v),
             (((spec,) * 3), (spec, lse_spec)),
         )
@@ -697,17 +725,17 @@ def flash_sdpa(q, k, v, mask, causal, scale):
     if mspec is False and mesh is not None and mesh.devices.size > 1:
         return None
     return _dispatch(
-        lambda q, k, v, m: _fwd_local(q, k, v, m, bool(causal), float(scale)),
+        lambda q, k, v, m: _fwd_local(q, k, v, m, bool(causal), float(scale), window),
         (q, k, v, mask),
         ((spec, spec, spec, mspec), (spec, lse_spec)),
     )
 
 
-def flash_sdpa_backward(g, q, k, v, out, lse, mask, causal, scale):
+def flash_sdpa_backward(g, q, k, v, out, lse, mask, causal, scale, window=None):
     """Returns (dq, dk, dv) via the flash kernels, or None if unsupported."""
     if not _enabled() or not _supported(
         q.shape, k.shape, v.shape, q.dtype, causal,
-        mask.shape if mask is not None else None,
+        mask.shape if mask is not None else None, window,
     ):
         return None
     from jax.sharding import PartitionSpec as P
@@ -717,7 +745,8 @@ def flash_sdpa_backward(g, q, k, v, out, lse, mask, causal, scale):
     lse_spec = P(*tuple(spec)[:-1])
     if mask is None:
         return _dispatch(
-            lambda g, q, k, v, out, lse: _bwd_local(g, q, k, v, out, lse, None, bool(causal), float(scale)),
+            lambda g, q, k, v, out, lse: _bwd_local(
+                g, q, k, v, out, lse, None, bool(causal), float(scale), window),
             (g, q, k, v, out, lse),
             ((spec, spec, spec, spec, spec, lse_spec), (spec, spec, spec)),
         )
@@ -725,7 +754,8 @@ def flash_sdpa_backward(g, q, k, v, out, lse, mask, causal, scale):
     if mspec is False and mesh is not None and mesh.devices.size > 1:
         return None
     return _dispatch(
-        lambda g, q, k, v, out, lse, m: _bwd_local(g, q, k, v, out, lse, m, bool(causal), float(scale)),
+        lambda g, q, k, v, out, lse, m: _bwd_local(
+            g, q, k, v, out, lse, m, bool(causal), float(scale), window),
         (g, q, k, v, out, lse, mask),
         ((spec, spec, spec, spec, spec, lse_spec, mspec), (spec, spec, spec)),
     )
@@ -736,21 +766,21 @@ def flash_sdpa_backward(g, q, k, v, out, lse, mask, causal, scale):
 #
 
 
-def _sdpa_full(q, k, v, mask, causal, scale):
-    res = flash_sdpa(q, k, v, mask, causal, scale)
+def _sdpa_full(q, k, v, mask, causal, scale, window=None):
+    res = flash_sdpa(q, k, v, mask, causal, scale, window)
     if res is None:  # checker raced with env change: stay correct
         from thunder_tpu.executors.jaxex import _sdpa_reference
 
-        return _sdpa_reference(q, k, v, mask, causal, scale)
+        return _sdpa_reference(q, k, v, mask, causal, scale, window)
     return res
 
 
-def _sdpa_backward_full(g, q, k, v, out, lse, mask, causal, scale):
-    res = flash_sdpa_backward(g, q, k, v, out, lse, mask, causal, scale)
+def _sdpa_backward_full(g, q, k, v, out, lse, mask, causal, scale, window=None):
+    res = flash_sdpa_backward(g, q, k, v, out, lse, mask, causal, scale, window)
     if res is None:
         from thunder_tpu.executors.jaxex import _sdpa_backward_reference
 
-        return _sdpa_backward_reference(g, q, k, v, out, lse, mask, causal, scale)
+        return _sdpa_backward_reference(g, q, k, v, out, lse, mask, causal, scale, window)
     return res
 
 
@@ -763,17 +793,17 @@ _sdpa_bwd_op = ex.register_operator(
 )
 
 
-def _sdpa_checker(q, k, v, mask, causal, scale):
+def _sdpa_checker(q, k, v, mask, causal, scale, window=None):
     return _enabled() and _supported(
         q.shape, k.shape, v.shape, q.dtype, causal,
-        mask.shape if mask is not None else None,
+        mask.shape if mask is not None else None, window,
     )
 
 
-def _sdpa_bwd_checker(g, q, k, v, out, lse, mask, causal, scale):
+def _sdpa_bwd_checker(g, q, k, v, out, lse, mask, causal, scale, window=None):
     return _enabled() and _supported(
         q.shape, k.shape, v.shape, q.dtype, causal,
-        mask.shape if mask is not None else None,
+        mask.shape if mask is not None else None, window,
     )
 
 

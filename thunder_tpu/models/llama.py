@@ -72,6 +72,11 @@ class Config:
     # MoE (reference: litgpt LLaMAMoE via tests/litgpt_model.py:98-110)
     n_expert: int = 0
     n_expert_per_token: int = 2
+    # Mistral-style sliding-window attention: query i attends keys in
+    # (i-window, i].  None = full causal.  The fused SDPA prim and the flash
+    # kernels band their block iteration, so long-T attention cost scales
+    # O(T·window) instead of O(T²)
+    sliding_window: int | None = None
 
     def __post_init__(self):
         if self.padded_vocab_size is None:
@@ -149,6 +154,11 @@ configs: list[Config] = [
     Config(name="gpt2-124m", block_size=1024, vocab_size=50257, n_layer=12, n_head=12,
            n_embd=768, rotary_percentage=0.0, learned_pos_embedding=True,
            norm_class="LayerNorm", mlp_class="GptNeoxMLP", tie_embeddings=True),
+    Config(name="tiny-mistral-debug", block_size=128, vocab_size=256, n_layer=2, n_head=4,
+           n_embd=64, n_query_groups=2, intermediate_size=176, sliding_window=32),
+    Config(name="Mistral-7B-like", block_size=32768, vocab_size=32000, n_layer=32,
+           n_head=32, n_embd=4096, n_query_groups=8, intermediate_size=14336,
+           sliding_window=4096),
     Config(name="tiny-moe-debug", block_size=128, vocab_size=256, n_layer=2, n_head=4,
            n_embd=64, n_query_groups=2, intermediate_size=96, mlp_class="LLaMAMoE",
            n_expert=4, n_expert_per_token=2),
@@ -301,7 +311,9 @@ def attention(ap, x, cos, sin, config: Config):
     # GQA (ng != nh) is passed natively: the fused SDPA prim gathers KV
     # groups by index inside the flash kernels, so K/V are never expanded
     # to nh heads in HBM (nh/ng× KV-bandwidth saving at Llama-70B/Mixtral)
-    y = ltorch.scaled_dot_product_attention(q, k, v, is_causal=True)  # (B, nh, T, hs)
+    y = ltorch.scaled_dot_product_attention(
+        q, k, v, is_causal=True, sliding_window=config.sliding_window
+    )  # (B, nh, T, hs)
     y = y.permute(0, 2, 1, 3).reshape(B, T, nh * hs)
     return ltorch.linear(y, ap["wo"])
 

@@ -1053,7 +1053,8 @@ def _convnd(a, weight, bias, stride, padding, dilation, groups, n):
 
 @torchsymbol(_tfn("nn", "functional", "scaled_dot_product_attention"))
 def scaled_dot_product_attention(
-    query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None, enable_gqa=False
+    query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None, enable_gqa=False,
+    sliding_window=None,
 ):
     """SDPA decomposition; the Pallas executor claims this whole symbol with a
     flash-attention kernel (analog of reference sdpaex/cudnnex claiming).
@@ -1088,8 +1089,16 @@ def scaled_dot_product_attention(
             mask = clang.where(mask, zeros, -0.7 * 3.4028235e38)  # -0.7 * f32 max
         elif mask is not None:
             mask = clang.maybe_convert_to_dtype(mask, dtypes.float32)
-        out, _lse = prims.sdpa(query, key, value, mask, bool(is_causal), float(scale))
+        out, _lse = prims.sdpa(
+            query, key, value, mask, bool(is_causal), float(scale),
+            None if sliding_window is None else int(sliding_window),
+        )
         return out
+    check(
+        sliding_window is None,
+        lambda: "sliding_window is only supported on the fused sdpa path "
+                "(no dropout, mask without requires_grad)",
+    )
     if enable_gqa and query.shape[-3] != key.shape[-3]:
         rep = query.shape[-3] // key.shape[-3]
         key = repeat_interleave(key, rep, dim=-3)

@@ -1,0 +1,25 @@
+"""Headline-config sweep on a live TPU: long-context and GQA variants of the
+Llama-2-7B layer program, thunder vs stock jax.jit.  Serial TPU client."""
+import sys, os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax, optax
+from bench import compiled_run, baseline_run, mfu
+from thunder_tpu.models import llama
+
+CASES = [
+    # (name, cfg-kwargs, B, T)
+    ("7b4L_T2048", dict(n_layer=4), 2, 2048),
+    ("7b4L_T4096", dict(n_layer=4, block_size=4096), 1, 4096),
+    ("gqa4L_T2048", dict(n_layer=4, n_query_groups=8, intermediate_size=14336), 2, 2048),
+]
+opt = optax.adamw(1e-4)
+for name, kw, B, T in CASES:
+    try:
+        cfg = llama.Config.from_name("Llama-2-7b-hf", **kw)
+        t = compiled_run(cfg, B, T, opt, 10); jax.clear_caches()
+        b = baseline_run(cfg, B, T, opt, 10); jax.clear_caches()
+        print(f"{name}: thunder {t:,.0f} tok/s ({100*mfu(t,cfg,T,'tpu'):.1f}% MFU) "
+              f"vs jax {b:,.0f} ({100*mfu(b,cfg,T,'tpu'):.1f}%) ratio {t/b:.3f}", flush=True)
+    except Exception as e:
+        import traceback; traceback.print_exc()
+        print(f"{name}: FAILED {type(e).__name__}: {str(e)[:200]}", flush=True)

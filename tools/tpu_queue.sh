@@ -12,6 +12,8 @@ for i in $(seq 1 400); do
     echo "$(date -u +%T) flash_tune rc=$?" >> "$LOG/queue.log"
     timeout 2400 python tools/quant_headline.py > "$LOG/quant_headline.log" 2>&1
     echo "$(date -u +%T) quant_headline rc=$?" >> "$LOG/queue.log"
+    timeout 2400 python tools/config_sweep.py > "$LOG/config_sweep.log" 2>&1
+    echo "$(date -u +%T) config_sweep rc=$?" >> "$LOG/queue.log"
     timeout 2400 python bench.py decode > "$LOG/decode.json" 2> "$LOG/decode.log"
     echo "$(date -u +%T) decode rc=$?" >> "$LOG/queue.log"
     echo "$(date -u +%T) queue done" >> "$LOG/queue.log"

@@ -296,14 +296,17 @@ def _call_value(ctx: InterpreterCompileCtx, depth: int, fn, args, kwargs):
         # is also fine — prefer the host call for functions from installed
         # packages (site-packages) to keep the interpreter on user code
         mod = getattr(fn, "__module__", "") or ""
-        # asyncio and friends: the event loop is runtime machinery — it runs
-        # host-side and drives InterpretedCoroutines via send(); interpreting
-        # its internals only manufactures prologue guards on loop/signal
-        # state that can never replay.  Exact-package match: a user module
-        # merely *named* signals.py must still interpret.
+        # Two opacity rules: ecosystem packages match by PREFIX (torchvision/
+        # torch_xla/jaxlib must stay host calls like torch/jax always have);
+        # stdlib runtime machinery (asyncio drives InterpretedCoroutines via
+        # send(); interpreting its internals only manufactures prologue
+        # guards on loop/signal state that can never replay) matches by exact
+        # top package, so a user module merely *named* signals.py or
+        # threading_utils.py still interprets.
         top = mod.split(".", 1)[0]
-        if top in ("thunder_tpu", "torch", "jax", "numpy", "optax", "flax",
-                   "asyncio", "selectors", "signal", "concurrent", "threading"):
+        if mod.startswith(("thunder_tpu", "torch", "jax", "numpy", "optax", "flax")) or top in (
+            "asyncio", "selectors", "signal", "concurrent", "threading"
+        ):
             ctx.record("opaque", depth, getattr(fn, "__qualname__", repr(fn)))
             return fn(*args, **kwargs)
         ctx.record("call", depth, getattr(fn, "__qualname__", repr(fn)))
@@ -534,9 +537,29 @@ class InterpretedAsyncGenerator:
         self._loop = _gen_driver(frame)
         self._started = False
         self._running = False
+        self._closed = False
+        self._finalizer = None
 
     def __aiter__(self):
         return self
+
+    def __del__(self):
+        # PEP 525 finalization: a partially-consumed async generator must
+        # still run its cleanup.  The event loop's finalizer hook (captured
+        # at first iteration, like CPython's firstiter/finalizer pair)
+        # schedules aclose(); without a loop, best-effort close the frame.
+        if self._closed or not self._started:
+            return
+        if self._finalizer is not None:
+            try:
+                self._finalizer(self)
+                return
+            except Exception:
+                pass
+        try:
+            self._loop.close()
+        except Exception:
+            pass
 
     def _deliver(self, meth, args):
         if meth == "throw":
@@ -548,6 +571,15 @@ class InterpretedAsyncGenerator:
                 inst = exc if isinstance(exc, BaseException) else GeneratorExit()
                 return self._loop.send(_ThrowIn(inst))
             return self._loop.throw(*args)
+        if not self._started:
+            # PEP 525 firstiter hook (asyncio registers the generator so
+            # loop.shutdown_asyncgens() can finalize it)
+            import sys as _sys
+
+            hooks = _sys.get_asyncgen_hooks()
+            self._finalizer = hooks.finalizer
+            if hooks.firstiter is not None:
+                hooks.firstiter(self)
         self._started = True
         return self._loop.send(*args)
 
@@ -559,6 +591,7 @@ class InterpretedAsyncGenerator:
             try:
                 res = self._deliver(meth, args)
             except StopIteration:
+                self._closed = True
                 raise StopAsyncIteration
             while True:
                 if isinstance(res, _AsyncGenWrapped):
@@ -569,11 +602,13 @@ class InterpretedAsyncGenerator:
                     try:
                         res = self._deliver("throw", (e,))
                     except StopIteration:
+                        self._closed = True
                         raise StopAsyncIteration
                     continue
                 try:
                     res = self._deliver("send", (sent,))
                 except StopIteration:
+                    self._closed = True
                     raise StopAsyncIteration
         finally:
             self._running = False
@@ -591,6 +626,7 @@ class InterpretedAsyncGenerator:
         def _close():
             # throw GeneratorExit; the generator may run cleanup awaits
             # (forwarded to the loop) but may not yield another value
+            self._closed = True
             if not self._started:
                 self._loop.close()
                 return

@@ -103,13 +103,25 @@ def _project_qkv(ap, x, cos_t, sin_t, cfg: Config, *, lin=None):
     return q, k, v
 
 
+def _cache_len(cfg: Config, T_max: int) -> int:
+    """Sequence capacity of the KV cache: ``sliding_window`` bounds it — a
+    banded model never attends further back, so the cache is a **ring** of
+    ``window`` slots (slot = position % window) and decode memory is
+    O(window), not O(T_max).  (Mistral's serving memory property; beyond-ref
+    — the reference has no generation loop at all.)"""
+    if cfg.sliding_window is not None:
+        return min(T_max, cfg.sliding_window)
+    return T_max
+
+
 def init_cache(cfg: Config, B: int, T_max: int, dtype=jnp.bfloat16, *, mesh=None, axis="tp") -> dict:
-    """Preallocated KV cache: ``{"k"/"v": (L, B, n_query_groups, T_max, hs)}``.
+    """Preallocated KV cache: ``{"k"/"v": (L, B, n_query_groups, Tc, hs)}``
+    where ``Tc = T_max``, bounded by ``cfg.sliding_window`` (ring cache).
 
     With ``mesh``, the KV-group dim shards over ``axis`` (tensor-parallel
     serving: each device holds its heads' cache; attention stays device-local
     and only the output projection reduces)."""
-    shape = (cfg.n_layer, B, cfg.n_query_groups, T_max, cfg.head_size)
+    shape = (cfg.n_layer, B, cfg.n_query_groups, _cache_len(cfg, T_max), cfg.head_size)
     sh = None
     if mesh is not None and axis in mesh.axis_names and mesh.shape[axis] > 1:
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -126,32 +138,78 @@ def init_cache(cfg: Config, B: int, T_max: int, dtype=jnp.bfloat16, *, mesh=None
     return {"k": zeros(), "v": zeros()}
 
 
+def _expand_groups(kk, vv, nh):
+    B, ng, Tc, hs = kk.shape
+    if ng != nh:
+        rep = nh // ng
+        kk = jnp.broadcast_to(kk[:, :, None], (B, ng, rep, Tc, hs)).reshape(B, nh, Tc, hs)
+        vv = jnp.broadcast_to(vv[:, :, None], (B, ng, rep, Tc, hs)).reshape(B, nh, Tc, hs)
+    return kk, vv
+
+
 def _attn_with_cache(ap, x, cos_t, sin_t, ck, cv, pos, cfg: Config, *, quantized=False):
     """x: (B, T, C) new tokens at global positions [pos, pos+T).  Writes their
-    K/V into the per-layer cache (ck/cv: (B, ng, T_max, hs)) and attends
-    against every filled slot."""
+    K/V into the per-layer cache (ck/cv: (B, ng, Tc, hs)) and attends against
+    every slot the model may see.
+
+    Two cache layouts (see ``_cache_len``): the plain layout (slot =
+    position) when the cache covers the full sequence, and the **ring**
+    layout (slot = position % window) when ``sliding_window`` bounds it.
+    Each branch decides (kk, vv, keep-mask, cache writes); the scoring tail
+    is shared.
+    """
     B, T, C = x.shape
     hs, nh, ng = cfg.head_size, cfg.n_head, cfg.n_query_groups
     lin = partial(_linear, quantized=quantized)
     q, k, v = _project_qkv(ap, x, cos_t, sin_t, cfg, lin=lin)
+    Tc = ck.shape[2]
+    W = cfg.sliding_window
+    ring = W is not None and Tc == W
 
-    ck = jax.lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), pos, axis=2)
-    cv = jax.lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), pos, axis=2)
+    if not ring:
+        ck = jax.lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), pos, axis=2)
+        cv = jax.lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), pos, axis=2)
+        kk, vv = ck, cv
+        # query at global position pos+t sees cache slots (pos+t-W, pos+t]
+        j = jnp.arange(Tc)[None, None, None, :]
+        qpos = (pos + jnp.arange(T))[None, None, :, None]
+        keep = j <= qpos
+        if W is not None:
+            keep = jnp.logical_and(keep, j > qpos - W)
+    elif T > 1:
+        # ring prefill: the chunk attends within itself (banded); the cache
+        # keeps each ring slot's latest prompt position.  pos==0 because a
+        # later chunk would need K/V already evicted from the ring.
+        import numpy as _np
 
-    kk, vv = ck, cv
-    if ng != nh:
-        rep = nh // ng
-        T_max = kk.shape[2]
-        kk = jnp.broadcast_to(kk[:, :, None], (B, ng, rep, T_max, hs)).reshape(B, nh, T_max, hs)
-        vv = jnp.broadcast_to(vv[:, :, None], (B, ng, rep, T_max, hs)).reshape(B, nh, T_max, hs)
+        assert isinstance(pos, int) and pos == 0, "ring-cache prefill must start at position 0"
+        kk, vv = k, v
+        row = jnp.arange(T)[None, None, :, None]
+        col = jnp.arange(T)[None, None, None, :]
+        keep = jnp.logical_and(col <= row, col > row - W)
+        # slot j <- the latest prompt position p ≡ j (mod W); slots with no
+        # such position stay garbage (masked positionally at decode)
+        src_pos = _np.array([j + ((T - 1 - j) // W) * W for j in range(W)])
+        gather = _np.maximum(src_pos, 0)
+        ck = jnp.take(k, gather, axis=2).astype(ck.dtype)
+        cv = jnp.take(v, gather, axis=2).astype(cv.dtype)
+    else:
+        # ring decode: one token at global position pos -> slot pos % W
+        slot = jax.lax.rem(pos, W)
+        ck = jax.lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), slot, axis=2)
+        cv = jax.lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), slot, axis=2)
+        kk, vv = ck, cv
+        # slot j holds global position pos - ((pos - j) mod W) — always in
+        # (pos-W, pos]; mask only slots never written (negative position)
+        j = jnp.arange(W)
+        gp = pos - jax.lax.rem(jax.lax.rem(pos - j, W) + W, W)
+        keep = (gp >= 0)[None, None, None, :]
 
+    kk, vv = _expand_groups(kk, vv, nh)
     scores = jnp.einsum(
         "bhqd,bhkd->bhqk", q, kk.astype(q.dtype), preferred_element_type=jnp.float32
     ) / math.sqrt(hs)
-    # query at global position pos+t sees cache slots <= pos+t
-    j = jnp.arange(kk.shape[2])
-    qpos = pos + jnp.arange(T)
-    scores = jnp.where(j[None, None, None, :] <= qpos[None, None, :, None], scores, -jnp.inf)
+    scores = jnp.where(keep, scores, -jnp.inf)
     w = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     y = jnp.einsum("bhqk,bhkd->bhqd", w, vv.astype(q.dtype))
     y = y.transpose(0, 2, 1, 3).reshape(B, T, nh * hs)

@@ -1,0 +1,125 @@
+"""Fused linear + cross-entropy (Liger-class, beyond-ref: the reference's
+apex/triton CE executors take materialized logits, apex_entropyex.py:15).
+
+The (N, V) logits never exist in HBM — forward is an online-logsumexp scan
+over vocab chunks, backward recomputes the softmax chunkwise from
+(h, w, target, lse)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import thunder_tpu as tt
+import thunder_tpu.torch as ltorch
+from thunder_tpu.models import llama
+
+
+def _inputs(N=24, C=32, V=128, dtype=jnp.float32, seed=0, n_ignored=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    h = jax.random.normal(ks[0], (N, C), dtype=dtype)
+    w = jax.random.normal(ks[1], (V, C), dtype=dtype) * 0.05
+    t = jax.random.randint(ks[2], (N,), 0, V)
+    if n_ignored:
+        t = t.at[:n_ignored].set(-100)
+    return h, w, t
+
+
+def _unfused(h, w, t, reduction="mean"):
+    logits = ltorch.linear(h, w).to(ltorch.float32)
+    return ltorch.cross_entropy(logits, t, reduction=reduction)
+
+
+class TestFusedLinearCE:
+    @pytest.mark.parametrize("reduction", ["mean", "sum", "none"])
+    def test_forward_matches_unfused(self, reduction):
+        h, w, t = _inputs()
+        fused = tt.jit(lambda h, w, t: ltorch.fused_linear_cross_entropy(h, w, t, reduction=reduction))
+        ref = tt.jit(lambda h, w, t: _unfused(h, w, t, reduction=reduction))
+        np.testing.assert_allclose(
+            np.asarray(fused(h, w, t)), np.asarray(ref(h, w, t)), atol=1e-5, rtol=1e-5)
+
+    def test_ignore_index_mean_normalization(self):
+        h, w, t = _inputs(n_ignored=5)
+        fused = tt.jit(lambda h, w, t: ltorch.fused_linear_cross_entropy(h, w, t))
+        ref = tt.jit(lambda h, w, t: _unfused(h, w, t))
+        np.testing.assert_allclose(
+            np.asarray(fused(h, w, t)), np.asarray(ref(h, w, t)), atol=1e-5, rtol=1e-5)
+
+    @pytest.mark.parametrize("n_ignored", [0, 7])
+    def test_grads_match_unfused(self, n_ignored):
+        h, w, t = _inputs(n_ignored=n_ignored)
+        gf_h, gf_w = tt.grad(
+            lambda h, w, t: ltorch.fused_linear_cross_entropy(h, w, t), argnums=(0, 1))(h, w, t)
+        gr_h, gr_w = tt.grad(lambda h, w, t: _unfused(h, w, t), argnums=(0, 1))(h, w, t)
+        np.testing.assert_allclose(np.asarray(gf_h), np.asarray(gr_h), atol=2e-5, rtol=2e-5)
+        np.testing.assert_allclose(np.asarray(gf_w), np.asarray(gr_w), atol=2e-5, rtol=2e-5)
+
+    def test_bf16_inputs_f32_accumulation(self):
+        h, w, t = _inputs(dtype=jnp.bfloat16)
+        fused = tt.jit(lambda h, w, t: ltorch.fused_linear_cross_entropy(h, w, t))
+        ref = tt.jit(lambda h, w, t: _unfused(h, w, t))
+        # both paths matmul in bf16 with f32 accumulation; CE math is f32
+        np.testing.assert_allclose(
+            np.asarray(fused(h, w, t)).astype(np.float32),
+            np.asarray(ref(h, w, t)).astype(np.float32), atol=3e-2, rtol=3e-2)
+
+    def test_no_logits_tensor_in_saved_residuals(self):
+        """The memory contract: nothing O(N·V) is saved for backward."""
+        h, w, t = _inputs(N=16, C=8, V=512)
+        jfn = tt.jit(lambda h, w, t: ltorch.fused_linear_cross_entropy(h, w, t))
+        vg = tt.value_and_grad(lambda h, w, t: ltorch.fused_linear_cross_entropy(h, w, t), argnums=(0, 1))
+        vg(h, w, t)
+        fw = tt.last_traces(vg)[-1] if hasattr(tt, "last_traces") else None
+        # structural check via the bw rule's contract: saved set is
+        # (h, w, target, lse) — assert by re-running grad and checking the
+        # fw trace has no (N, V) intermediate in its return
+        import thunder_tpu.core.prims as prims
+        traces = tt.last_traces(vg)
+        ret = [b for b in traces[-1].bound_symbols if b.sym.id == prims.PrimIDs.RETURN]
+        if ret and len(ret[-1].args) == 2:
+            _, saved = ret[-1].args
+            NV = 16 * 512
+            for p in saved:
+                if hasattr(p, "shape"):
+                    size = 1
+                    for s in p.shape:
+                        size *= int(s)
+                    assert size < NV, f"O(N*V) residual {p.name} {p.shape} saved"
+
+
+class TestModelFusedHeadCE:
+    def test_gpt_loss_matches_unfused_path(self):
+        cfg_f = llama.Config.from_name("tiny-llama-debug", fused_head_ce=True)
+        cfg_u = llama.Config.from_name("tiny-llama-debug")
+        params = llama.init_params(cfg_f, jax.random.PRNGKey(0), dtype=jnp.float32)
+        B, T = 2, 32
+        idx = jax.random.randint(jax.random.PRNGKey(1), (B, T), 0, cfg_f.vocab_size)
+        tgt = jax.random.randint(jax.random.PRNGKey(2), (B, T), 0, cfg_f.vocab_size)
+        cos, sin = llama.build_rope_cache(cfg_f, T)
+
+        lf, gf = tt.value_and_grad(
+            lambda p, i, t, c, s: llama.gpt_loss(p, i, t, c, s, cfg_f))(params, idx, tgt, cos, sin)
+        lu, gu = tt.value_and_grad(
+            lambda p, i, t, c, s: llama.gpt_loss(p, i, t, c, s, cfg_u))(params, idx, tgt, cos, sin)
+        np.testing.assert_allclose(float(lf), float(lu), atol=1e-5, rtol=1e-5)
+        for a, b in zip(jax.tree_util.tree_leaves(gf), jax.tree_util.tree_leaves(gu)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5, rtol=5e-5)
+
+    def test_bucketed_padding_still_bit_exact(self):
+        """ignore-index padding (batch_bucketer contract) survives fusion."""
+        cfg = llama.Config.from_name("tiny-llama-debug", fused_head_ce=True)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+        B, T, Tp = 2, 20, 32
+        idx = jax.random.randint(jax.random.PRNGKey(1), (B, T), 0, cfg.vocab_size)
+        tgt = jax.random.randint(jax.random.PRNGKey(2), (B, T), 0, cfg.vocab_size)
+        idx_p = jnp.pad(idx, ((0, 0), (0, Tp - T)))
+        tgt_p = jnp.pad(tgt, ((0, 0), (0, Tp - T)), constant_values=-100)
+        cos, sin = llama.build_rope_cache(cfg, T)
+        cos_p, sin_p = llama.build_rope_cache(cfg, Tp)
+        l = tt.jit(lambda p, i, t, c, s: llama.gpt_loss(p, i, t, c, s, cfg))(
+            params, idx, tgt, cos, sin)
+        lp = tt.jit(lambda p, i, t, c, s: llama.gpt_loss(p, i, t, c, s, cfg))(
+            params, idx_p, tgt_p, cos_p, sin_p)
+        np.testing.assert_allclose(float(l), float(lp), atol=1e-6)

@@ -1250,6 +1250,40 @@ class TestAsync:
         res, _ = interpret(f)
         assert res == (1, ("cleanup",))
 
+    def test_class_definition_inside_interpreted_fn(self):
+        def f(x):
+            class Acc:
+                scale = 2
+
+                def __init__(self, base):
+                    self.base = base
+
+                def apply(self, v):
+                    return self.base + v * self.scale
+
+            return Acc(10).apply(x)
+
+        res, _ = interpret(f, 5)
+        assert res == 20
+
+    def test_class_with_inheritance_and_traced_math(self):
+        import jax.numpy as jnp
+
+        def model(t):
+            class Base:
+                def shift(self, v):
+                    return v + 1.0
+
+            class Doubler(Base):
+                def run(self, v):
+                    return self.shift(v) * 2.0
+
+            return Doubler().run(t)
+
+        jfn = tt.jit(model, interpretation="bytecode")
+        out = jfn(jnp.ones((3,), jnp.float32))
+        np.testing.assert_allclose(np.asarray(out), 4.0)
+
     def test_coroutine_reuse_raises(self):
         def f():
             async def g():

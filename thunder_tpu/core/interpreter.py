@@ -166,6 +166,14 @@ def register_opcode_handler(name: str):
 _default_lookasides: dict[Callable, Callable] = {}
 _default_opaque: set = set()
 
+# top-level packages whose functions always run as opaque host calls
+_OPAQUE_TOP_PACKAGES = frozenset({
+    "thunder_tpu", "torch", "torchvision", "torchaudio", "torch_xla",
+    "jax", "jaxlib", "flax", "flaxlib", "optax", "numpy", "scipy", "einops",
+    "transformers", "accelerate", "safetensors", "tokenizers",
+    "asyncio", "selectors", "signal", "concurrent", "threading",
+})
+
 
 def register_lookaside(target: Callable):
     """Registers a replacement for ``target`` inside interpreted code:
@@ -296,17 +304,15 @@ def _call_value(ctx: InterpreterCompileCtx, depth: int, fn, args, kwargs):
         # is also fine — prefer the host call for functions from installed
         # packages (site-packages) to keep the interpreter on user code
         mod = getattr(fn, "__module__", "") or ""
-        # Two opacity rules: ecosystem packages match by PREFIX (torchvision/
-        # torch_xla/jaxlib must stay host calls like torch/jax always have);
-        # stdlib runtime machinery (asyncio drives InterpretedCoroutines via
-        # send(); interpreting its internals only manufactures prologue
-        # guards on loop/signal state that can never replay) matches by exact
-        # top package, so a user module merely *named* signals.py or
-        # threading_utils.py still interprets.
+        # Host-call opacity matches exact top packages — naming every
+        # ecosystem root explicitly (torchvision/torch_xla/jaxlib, not a
+        # "torch*" prefix) so a user module merely *named* jax_helpers.py or
+        # signals.py still interprets.  asyncio and friends are runtime
+        # machinery: the loop runs host-side and drives InterpretedCoroutines
+        # via send(); interpreting its internals only manufactures prologue
+        # guards on loop/signal state that can never replay.
         top = mod.split(".", 1)[0]
-        if mod.startswith(("thunder_tpu", "torch", "jax", "numpy", "optax", "flax")) or top in (
-            "asyncio", "selectors", "signal", "concurrent", "threading"
-        ):
+        if top in _OPAQUE_TOP_PACKAGES:
             ctx.record("opaque", depth, getattr(fn, "__qualname__", repr(fn)))
             return fn(*args, **kwargs)
         ctx.record("call", depth, getattr(fn, "__qualname__", repr(fn)))
@@ -1562,6 +1568,14 @@ def _call_intrinsic_1(frame, ins, i):
         frame.push(_AsyncGenWrapped(v))
     else:
         raise InterpreterError(f"CALL_INTRINSIC_1 {ins.arg} is not supported")
+
+
+@register_opcode_handler("LOAD_BUILD_CLASS")
+def _load_build_class(frame, ins, i):
+    # class statement: [NULL, __build_class__, body_fn, name, *bases] — the
+    # host builtin runs the MAKE_FUNCTION-synthesized body (a real function
+    # over the original code object), so class creation is CPython-exact
+    frame.push(_builtins.__build_class__)
 
 
 @register_opcode_handler("MAKE_FUNCTION")

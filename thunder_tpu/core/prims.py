@@ -205,6 +205,8 @@ class PrimIDs(Enum):
     # fused cross-entropy (analog of the reference's apex/triton CE executors,
     # apex_entropyex.py:15, triton_crossentropy_impl.py:18)
     CROSS_ENTROPY_FWD = auto()
+    FUSED_LINEAR_CE = auto()
+    FUSED_LINEAR_CE_BACKWARD = auto()
     # einsum stays one prim so XLA lowers it straight to dot_general
     # (the reference decomposes via opt_einsum, torch/__init__.py einsum)
     EINSUM = auto()
@@ -1165,6 +1167,58 @@ def _cross_entropy_fwd_meta(logits: TensorProxy, target: TensorProxy) -> tuple[T
 
 cross_entropy_fwd = make_prim(
     PrimIDs.CROSS_ENTROPY_FWD, "cross_entropy_fwd", meta=_cross_entropy_fwd_meta, tags=(OpTags.REDUCTION_OP,)
+)
+
+
+def _fused_linear_ce_meta(
+    h: TensorProxy, w: TensorProxy, target: TensorProxy, ignore_index: int = -100
+) -> tuple[TensorProxy, TensorProxy]:
+    """Fused lm-head linear + row-wise cross-entropy: ``h (N, C) @ w (V, C)^T``
+    consumed by an online-logsumexp CE without ever materializing the
+    ``(N, V)`` logits (executors chunk the vocab dim).  Returns
+    ``(losses, lse)``, float32 ``(N,)``; ignored rows produce zero loss.
+
+    The memory property goes beyond the reference's apex/triton CE
+    (apex_entropyex.py:15, which takes materialized logits): saved residuals
+    are ``(h, w, target, lse)`` — O(N·C + V·C) — instead of the O(N·V)
+    logits, the Liger-kernel-class fused_linear_cross_entropy capability.
+    """
+    for t in (h, w):
+        _check_tensor(t)
+    _check_tensor(target)
+    check(h.ndim == 2, lambda: f"fused_linear_ce: h must be 2D, got {h.ndim}D")
+    check(w.ndim == 2, lambda: f"fused_linear_ce: w must be 2D, got {w.ndim}D")
+    check(h.shape[1] == w.shape[1], lambda: f"fused_linear_ce: {h.shape} vs {w.shape}")
+    check(target.ndim == 1 and target.shape[0] == h.shape[0],
+          lambda: f"fused_linear_ce: target {target.shape} vs h {h.shape}")
+    check(dtypes.is_exact_dtype(target.dtype), lambda: "fused_linear_ce: target must be integer")
+    rg = (h.requires_grad or w.requires_grad) and dtypes.is_inexact_dtype(h.dtype)
+    losses = TensorProxy(shape=(h.shape[0],), device=h.device, dtype=dtypes.float32, requires_grad=rg)
+    lse = TensorProxy(shape=(h.shape[0],), device=h.device, dtype=dtypes.float32, requires_grad=False)
+    return losses, lse
+
+
+fused_linear_ce = make_prim(
+    PrimIDs.FUSED_LINEAR_CE, "fused_linear_ce", meta=_fused_linear_ce_meta,
+    tags=(OpTags.MATMUL_OP, OpTags.REDUCTION_OP),
+)
+
+
+def _fused_linear_ce_backward_meta(
+    g: TensorProxy, h: TensorProxy, w: TensorProxy, target: TensorProxy, lse: TensorProxy,
+    ignore_index: int = -100,
+) -> tuple[TensorProxy, TensorProxy]:
+    for t in (g, h, w, lse):
+        _check_tensor(t)
+    _check_tensor(target)
+    dh = _out_like(h, requires_grad=False)
+    dw = _out_like(w, requires_grad=False)
+    return dh, dw
+
+
+fused_linear_ce_backward = make_prim(
+    PrimIDs.FUSED_LINEAR_CE_BACKWARD, "fused_linear_ce_backward",
+    meta=_fused_linear_ce_backward_meta, tags=(OpTags.MATMUL_OP,),
 )
 
 

@@ -717,6 +717,26 @@ def _cross_entropy_fwd_bw(bsym, g_losses, g_lse):
 _cross_entropy_fwd_bw._accepts_none_cotangents = True
 
 
+@register_backward_rule(PrimIDs.FUSED_LINEAR_CE)
+def _fused_linear_ce_bw(bsym, g_losses, g_lse):
+    """Saved: (h, w, target, lse) — O(N·C + V·C); the (N, V) softmax is
+    recomputed chunkwise in the backward prim."""
+    h, w, target, *rest = bsym.args
+    ignore_index = rest[0] if rest else -100
+    losses, lse = bsym.output
+    if g_lse is not None:
+        raise NotImplementedError(
+            "differentiating through fused_linear_ce's lse output is not supported"
+        )
+    if g_losses is None:
+        g_losses = clang.full_like(losses, 0.0)
+    dh, dw = prims.fused_linear_ce_backward(g_losses, h, w, target, lse, ignore_index)
+    return [(h, dh), (w, dw)]
+
+
+_fused_linear_ce_bw._accepts_none_cotangents = True
+
+
 @register_backward_rule(PrimIDs.EMBEDDING)
 def _embedding_bw(bsym, g):
     indices = bsym.args[0]

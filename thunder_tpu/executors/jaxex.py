@@ -581,6 +581,78 @@ def _cross_entropy_fwd_impl(logits, target):
     return _cross_entropy_fwd_reference(logits, target)
 
 
+def _flce_chunk(V: int) -> int:
+    """Vocab chunk for the fused linear+CE scan: a few MXU-friendly slabs.
+    Must divide the padded vocab; vocab sizes here are 64-multiples."""
+    for c in (8192, 4096, 2048, 1024, 512, 256, 128, 64):
+        if V % c == 0:
+            return c
+    return V
+
+
+@impl(PrimIDs.FUSED_LINEAR_CE)
+def _fused_linear_ce_impl(h, w, target, ignore_index=-100):
+    """Online-logsumexp CE over vocab chunks of ``h @ w.T`` — the (N, V)
+    logits never exist in HBM; peak extra memory is one (N, CH) slab."""
+    N, C = h.shape
+    V = w.shape[0]
+    CH = _flce_chunk(V)
+    n_chunks = V // CH
+    tgt = target.astype(jnp.int32)
+
+    def body(carry, c):
+        m, s, tl = carry
+        off = c * CH
+        wc = jax.lax.dynamic_slice_in_dim(w, off, CH, axis=0)
+        lg = jax.lax.dot_general(h, wc, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)  # (N, CH)
+        m_new = jnp.maximum(m, jnp.max(lg, axis=-1))
+        s = s * jnp.exp(m - m_new) + jnp.sum(jnp.exp(lg - m_new[:, None]), axis=-1)
+        in_chunk = jnp.logical_and(tgt >= off, tgt < off + CH)
+        idx = jnp.clip(tgt - off, 0, CH - 1)
+        cand = jnp.take_along_axis(lg, idx[:, None], axis=1)[:, 0]
+        tl = jnp.where(in_chunk, cand, tl)
+        return (m_new, s, tl), None
+
+    init = (
+        jnp.full((N,), -jnp.inf, dtype=jnp.float32),
+        jnp.zeros((N,), dtype=jnp.float32),
+        jnp.zeros((N,), dtype=jnp.float32),
+    )
+    (m, s, tl), _ = jax.lax.scan(body, init, jnp.arange(n_chunks))
+    lse = m + jnp.log(s)
+    losses = jnp.where(tgt != ignore_index, lse - tl, 0.0)
+    return losses, lse
+
+
+@impl(PrimIDs.FUSED_LINEAR_CE_BACKWARD)
+def _fused_linear_ce_backward_impl(g, h, w, target, lse, ignore_index=-100):
+    """dh/dw from chunked softmax recompute: ds_c = (p_c - onehot_c) * g."""
+    N, C = h.shape
+    V = w.shape[0]
+    CH = _flce_chunk(V)
+    n_chunks = V // CH
+    tgt = target.astype(jnp.int32)
+    gg = jnp.where(tgt != ignore_index, g.astype(jnp.float32), 0.0)
+
+    def body(dh, c):
+        off = c * CH
+        wc = jax.lax.dynamic_slice_in_dim(w, off, CH, axis=0)
+        lg = jax.lax.dot_general(h, wc, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        p = jnp.exp(lg - lse[:, None])  # (N, CH)
+        col = off + jnp.arange(CH)
+        oh = (tgt[:, None] == col[None, :]).astype(jnp.float32)
+        ds = (p - oh) * gg[:, None]
+        dh = dh + jax.lax.dot_general(ds, wc.astype(jnp.float32), (((1,), (0,)), ((), ())))
+        dwc = jax.lax.dot_general(ds, h.astype(jnp.float32), (((0,), (0,)), ((), ())))
+        return dh, dwc.astype(w.dtype)
+
+    dh, dwcs = jax.lax.scan(body, jnp.zeros((N, C), dtype=jnp.float32), jnp.arange(n_chunks))
+    dw = dwcs.reshape(V, C)
+    return dh.astype(h.dtype), dw
+
+
 def get_prim_impl(pid: PrimIDs) -> Callable | None:
     return prim_impls.get(pid)
 

@@ -77,6 +77,10 @@ class Config:
     # kernels band their block iteration, so long-T attention cost scales
     # O(T·window) instead of O(T²)
     sliding_window: int | None = None
+    # Fuse the lm-head matmul into a chunked-vocab cross-entropy (no (N, V)
+    # logits in HBM; Liger-class fused_linear_cross_entropy).  Off by default
+    # pending an on-TPU A/B against the XLA-fused plain path
+    fused_head_ce: bool = False
 
     def __post_init__(self):
         if self.padded_vocab_size is None:
@@ -363,15 +367,20 @@ def block_forward(bp, x, cos, sin, config: Config):
     return x + mlp(bp["mlp"], _norm(x, bp["norm_2"], config), config)
 
 
-def gpt_forward(params, idx, cos, sin, config: Config):
-    """Token ids (B, T) int32 → logits (B, T, padded_vocab_size)."""
+def gpt_hidden(params, idx, cos, sin, config: Config):
+    """Token ids (B, T) int32 → final hidden states (B, T, C) (pre-head)."""
     x = ltorch.embedding(idx, params["wte"])
     if config.learned_pos_embedding:
         T = idx.shape[1]
         x = x + params["wpe"][:T]
     for bp in params["blocks"]:
         x = block_forward(bp, x, cos, sin, config)
-    x = _norm(x, params["ln_f"], config)
+    return _norm(x, params["ln_f"], config)
+
+
+def gpt_forward(params, idx, cos, sin, config: Config):
+    """Token ids (B, T) int32 → logits (B, T, padded_vocab_size)."""
+    x = gpt_hidden(params, idx, cos, sin, config)
     head = params["wte"] if config.tie_embeddings else params["lm_head"]
     return ltorch.linear(x, head)
 
@@ -382,6 +391,13 @@ def gpt_loss(params, idx, targets, cos, sin, config: Config):
     Targets of ``-100`` are ignored with exact mean normalization (torch's
     ignore_index default), so bucket-padded batches (``batch_bucketer``)
     produce bit-identical losses to the unpadded shapes."""
+    if config.fused_head_ce:
+        x = gpt_hidden(params, idx, cos, sin, config)
+        head = params["wte"] if config.tie_embeddings else params["lm_head"]
+        C = x.shape[-1]
+        return ltorch.fused_linear_cross_entropy(
+            x.reshape(-1, C), head, targets.reshape(-1)
+        )
     logits = gpt_forward(params, idx, cos, sin, config)
     V = logits.shape[-1]
     return ltorch.cross_entropy(logits.reshape(-1, V).to(ltorch.float32), targets.reshape(-1))

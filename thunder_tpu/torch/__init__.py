@@ -1215,6 +1215,33 @@ def cross_entropy(logits, target, weight=None, size_average=None, ignore_index=-
     return clang.add(clang.mul(nll, 1.0 - label_smoothing), clang.mul(smooth_ret, label_smoothing / C))
 
 
+@torchsymbol()
+def fused_linear_cross_entropy(h, weight, target, ignore_index=-100, reduction="mean"):
+    """Fused lm-head linear + cross-entropy: ``cross_entropy(h @ weight.T, target)``
+    without materializing the (N, V) logits (thunder extension; the
+    Liger-kernel-class capability — the reference's apex/triton CE executors
+    take materialized logits, apex_entropyex.py:15).  Backward saves
+    (h, weight, target, lse) and recomputes the softmax chunkwise.
+    """
+    check(h.ndim == 2, lambda: f"fused_linear_cross_entropy: h must be 2D, got {h.ndim}D")
+    check(reduction in ("mean", "sum", "none"), lambda: f"unsupported reduction {reduction!r}")
+    # ignore_index lives in ONE layer: the prim (executors mask both the row
+    # losses and the backward's row cotangents); raw targets pass through.
+    # The loss stays float32 regardless of h's dtype — the matmul accumulates
+    # f32 and the plain gpt_loss path (CE over f32 logits) returns f32 too.
+    losses, _lse = prims.fused_linear_ce(
+        h, weight, clang.maybe_convert_to_dtype(target, dtypes.int32), int(ignore_index)
+    )
+    if reduction == "none":
+        return losses
+    total = clang.sum(losses, None, False)
+    if reduction == "sum":
+        return total
+    valid = clang.ne(target, ignore_index)
+    n_valid = clang.sum(clang.maybe_convert_to_dtype(valid, losses.dtype), None, False)
+    return clang.true_divide(total, clang.maximum(n_valid, 1.0))
+
+
 @torchsymbol(_tfn("nn", "functional", "mse_loss"))
 def mse_loss(a, b, reduction="mean"):
     d = clang.sub(a, b)

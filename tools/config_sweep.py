@@ -9,6 +9,7 @@ from thunder_tpu.models import llama
 CASES = [
     # (name, cfg-kwargs, B, T)
     ("7b4L_T2048", dict(n_layer=4), 2, 2048),
+    ("7b4L_fusedCE", dict(n_layer=4, fused_head_ce=True), 2, 2048),
     ("7b4L_T4096", dict(n_layer=4, block_size=4096), 1, 4096),
     ("gqa4L_T2048", dict(n_layer=4, n_query_groups=8, intermediate_size=14336), 2, 2048),
 ]

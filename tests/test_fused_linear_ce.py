@@ -123,3 +123,36 @@ class TestModelFusedHeadCE:
         lp = tt.jit(lambda p, i, t, c, s: llama.gpt_loss(p, i, t, c, s, cfg))(
             params, idx_p, tgt_p, cos_p, sin_p)
         np.testing.assert_allclose(float(l), float(lp), atol=1e-6)
+
+    def test_fused_head_ce_under_fsdp_mesh_matches_single_device(self):
+        """GSPMD must partition the chunked scan correctly (dynamic_slice
+        over the replicated head, dp/fsdp-sharded rows)."""
+        import optax
+        from jax.sharding import PartitionSpec as P
+
+        import thunder_tpu.distributed as dist
+
+        cfg = llama.Config.from_name("tiny-llama-debug", fused_head_ce=True)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+        B, T = 8, 32
+        idx = jax.random.randint(jax.random.PRNGKey(1), (B, T), 0, cfg.vocab_size)
+        tgt = jax.random.randint(jax.random.PRNGKey(2), (B, T), 0, cfg.vocab_size)
+        cos, sin = llama.build_rope_cache(cfg, T)
+
+        def loss_fn(p, i, t, c, s):
+            return llama.gpt_loss(p, i, t, c, s, cfg)
+
+        opt = optax.adamw(1e-3)
+        results = {}
+        for name, axes, specs in (
+            ("single", {"dp": 1}, None),
+            ("fsdp", {"dp": 2, "fsdp": 2}, (P(("dp", "fsdp")), P(("dp", "fsdp")), P(), P())),
+        ):
+            n = axes.get("dp", 1) * axes.get("fsdp", 1)
+            mesh = dist.make_mesh(axes, devices=jax.devices()[:n])
+            p0 = dist.fsdp(params, mesh) if name == "fsdp" else params
+            step = dist.make_train_step(loss_fn, opt, mesh, batch_specs=specs, donate=False)
+            o = step.init_optimizer_state(p0)
+            _, _, loss = step(p0, o, idx, tgt, cos, sin)
+            results[name] = float(loss)
+        assert abs(results["single"] - results["fsdp"]) < 1e-5, results

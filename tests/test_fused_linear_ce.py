@@ -156,3 +156,67 @@ class TestModelFusedHeadCE:
             _, _, loss = step(p0, o, idx, tgt, cos, sin)
             results[name] = float(loss)
         assert abs(results["single"] - results["fsdp"]) < 1e-5, results
+
+
+class TestVocabParallelFusedCE:
+    """tp_fused_linear_ce: the head stays vocab-sharded; three O(N)
+    collectives merge the online-softmax partials (Megatron's vocab-parallel
+    CE recipe as shard_map + XLA collectives)."""
+
+    def _setup(self, N=16, C=32, V=256, n_ignored=3):
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        h = jax.random.normal(ks[0], (N, C), dtype=jnp.float32)
+        w = jax.random.normal(ks[1], (V, C), dtype=jnp.float32) * 0.05
+        t = jax.random.randint(ks[2], (N,), 0, V)
+        if n_ignored:
+            t = t.at[:n_ignored].set(-100)
+        return h, w, t
+
+    @pytest.mark.parametrize("reduction", ["mean", "sum", "none"])
+    @pytest.mark.parametrize("chunk", [8192, 16])  # 16 → 4 chunks/shard: cross-chunk targets
+    def test_matches_single_device_fused(self, reduction, chunk):
+        import thunder_tpu.distributed as dist
+
+        h, w, t = self._setup()
+        mesh = dist.make_mesh({"tp": 4}, devices=jax.devices()[:4])
+        out = dist.tp_fused_linear_ce(h, w, t, mesh=mesh, reduction=reduction, chunk=chunk)
+        ref = tt.jit(lambda h, w, t: ltorch.fused_linear_cross_entropy(
+            h, w, t, reduction=reduction))(h, w, t)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
+
+    def test_chunk_request_never_drops_tail_rows(self):
+        """A chunk request that does not divide the shard picks the largest
+        dividing slab instead of silently truncating the vocab scan."""
+        import thunder_tpu.distributed as dist
+
+        h, w, t = self._setup(V=96 * 4)  # Vl=96; chunk request 28 must resolve to a divisor
+        mesh = dist.make_mesh({"tp": 4}, devices=jax.devices()[:4])
+        out = dist.tp_fused_linear_ce(h, w, t, mesh=mesh, chunk=28)
+        ref = tt.jit(lambda h, w, t: ltorch.fused_linear_cross_entropy(h, w, t))(h, w, t)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
+
+    def test_invalid_reduction_raises(self):
+        import thunder_tpu.distributed as dist
+
+        h, w, t = self._setup()
+        mesh = dist.make_mesh({"tp": 4}, devices=jax.devices()[:4])
+        with pytest.raises(ValueError, match="unsupported reduction"):
+            dist.tp_fused_linear_ce(h, w, t, mesh=mesh, reduction="batchmean")
+
+    def test_grads_match_and_head_grad_stays_sharded(self):
+        import thunder_tpu.distributed as dist
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        h, w, t = self._setup()
+        mesh = dist.make_mesh({"tp": 4}, devices=jax.devices()[:4])
+        w_sharded = jax.device_put(w, NamedSharding(mesh, P("tp", None)))
+
+        gh, gw = jax.jit(jax.grad(
+            lambda h, w: dist.tp_fused_linear_ce(h, w, t, mesh=mesh), argnums=(0, 1)))(h, w_sharded)
+        rh, rw = tt.grad(lambda h, w, t: ltorch.fused_linear_cross_entropy(h, w, t),
+                         argnums=(0, 1))(h, w, t)
+        np.testing.assert_allclose(np.asarray(gh), np.asarray(rh), atol=2e-5, rtol=2e-5)
+        np.testing.assert_allclose(np.asarray(gw), np.asarray(rw), atol=2e-5, rtol=2e-5)
+        # the head grad must come out vocab-sharded, not gathered
+        spec = gw.sharding.spec
+        assert tuple(spec)[:1] == ("tp",), spec

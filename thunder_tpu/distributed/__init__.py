@@ -35,6 +35,7 @@ from thunder_tpu.distributed.prims import DistributedReduceOps
 from thunder_tpu.distributed.ring_attention import ring_attend_shard, ring_attention, ring_self_attention
 from thunder_tpu.distributed.sp import sp_gpt_loss
 from thunder_tpu.distributed.ulysses import ulysses_attend_shard, ulysses_gpt_loss
+from thunder_tpu.distributed.vocab_parallel import tp_fused_linear_ce
 from thunder_tpu.distributed.sharding import (
     ShardingRules,
     apply_shardings,
@@ -70,6 +71,7 @@ __all__ = [
     "ring_attend_shard",
     "sp_gpt_loss",
     "ulysses_gpt_loss",
+    "tp_fused_linear_ce",
     "ulysses_attend_shard",
     "ring_self_attention",
     "ep_moe_mlp",

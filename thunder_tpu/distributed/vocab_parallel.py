@@ -1,0 +1,89 @@
+"""Vocab-parallel fused linear + cross-entropy over a ``tp`` mesh axis.
+
+The TP sharding rules put the lm-head's vocab dim over ``tp``
+(``sharding.py`` ``^lm_head$`` → ``P("tp", "fsdp")``).  The single-device
+fused CE (``prims.fused_linear_ce``) scans vocab chunks with a
+``dynamic_slice``, which GSPMD cannot keep shard-local on a vocab-sharded
+head — it would all-gather the (V, C) weight.  This module runs the fused CE
+**inside shard_map**: each device computes its vocab shard's online-softmax
+partials — running max ``m_i``, normalizer ``s_i``, and the target logit
+``tl_i`` (nonzero on exactly the shard owning the target id) — and three
+O(N) collectives merge them:
+
+    m = pmax(m_i);  lse = m + log(psum(s_i * exp(m_i - m)));  tl = psum(tl_i)
+
+so per-device compute and memory stay 1/tp of the head, and nothing O(N·V)
+or O(V·C) ever moves across the interconnect (Megatron's vocab-parallel
+cross-entropy recipe, re-expressed as shard_map + XLA collectives).
+
+``jax.grad`` differentiates straight through the shard_map: the transposes
+of psum/pmax give each shard its local cotangents, and the chunked local
+backward recomputes its shard's softmax slab — grads of the head stay
+vocab-sharded.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from thunder_tpu.executors.jaxex import _flce_chunk, _flce_partials
+
+__all__ = ["tp_fused_linear_ce"]
+
+
+def tp_fused_linear_ce(
+    h,
+    w,
+    target,
+    *,
+    mesh: Mesh,
+    axis: str = "tp",
+    ignore_index: int = -100,
+    reduction: str = "mean",
+    chunk: int = 8192,
+):
+    """``cross_entropy(h @ w.T, target)`` with ``w`` vocab-sharded over
+    ``mesh[axis]`` and no materialized logits.
+
+    ``h``: (N, C) replicated over ``axis``; ``w``: (V, C); ``target``: (N,)
+    int with ``ignore_index`` rows excluded from the mean.  Returns the
+    reduced float32 loss ("mean"/"sum") or per-row losses ("none").
+    """
+    if reduction not in ("mean", "sum", "none"):
+        raise ValueError(f"unsupported reduction {reduction!r}")
+    tp = mesh.shape[axis]
+    V = w.shape[0]
+    assert V % tp == 0, f"vocab {V} must divide over {axis}={tp}"
+    Vl = V // tp
+    ch = _flce_chunk(Vl, desired=chunk)  # divisor of Vl: the scan may not drop tail rows
+
+    def local(h_l, w_l, t_l):
+        i = jax.lax.axis_index(axis)
+        off = i * Vl
+        tgt = t_l.astype(jnp.int32)
+        m_l, s_l, tl_l = _flce_partials(h_l, w_l, tgt, off, ch)
+        # the running max only stabilizes the exp; lse is mathematically
+        # invariant to it, so detach it (pmax has no differentiation rule)
+        m = jax.lax.pmax(jax.lax.stop_gradient(m_l), axis)
+        s = jax.lax.psum(s_l * jnp.exp(m_l - m), axis)
+        lse = m + jnp.log(s)
+        tl = jax.lax.psum(tl_l, axis)
+        losses = jnp.where(tgt != ignore_index, lse - tl, 0.0)
+        return losses
+
+    losses = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(), P(axis), P()),
+        out_specs=P(),
+        check_vma=False,
+    )(h, w, target)
+
+    if reduction == "none":
+        return losses
+    total = jnp.sum(losses)
+    if reduction == "sum":
+        return total
+    n_valid = jnp.sum((target != ignore_index).astype(jnp.float32))
+    return total / jnp.maximum(n_valid, 1.0)

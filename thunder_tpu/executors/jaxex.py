@@ -581,24 +581,26 @@ def _cross_entropy_fwd_impl(logits, target):
     return _cross_entropy_fwd_reference(logits, target)
 
 
-def _flce_chunk(V: int) -> int:
-    """Vocab chunk for the fused linear+CE scan: a few MXU-friendly slabs.
-    Must divide the padded vocab; vocab sizes here are 64-multiples."""
-    for c in (8192, 4096, 2048, 1024, 512, 256, 128, 64):
-        if V % c == 0:
+def _flce_chunk(V: int, desired: int = 8192) -> int:
+    """Vocab chunk for the fused linear+CE scan: the largest MXU-friendly
+    slab ≤ ``desired`` that DIVIDES ``V`` — divisibility is load-bearing, a
+    non-divisor would silently drop the tail vocab rows from the softmax."""
+    for c in (8192, 4096, 2048, 1024, 512, 256, 128, 64, 32, 16, 8, 4, 2, 1):
+        if c <= desired and V % c == 0:
             return c
     return V
 
 
-@impl(PrimIDs.FUSED_LINEAR_CE)
-def _fused_linear_ce_impl(h, w, target, ignore_index=-100):
-    """Online-logsumexp CE over vocab chunks of ``h @ w.T`` — the (N, V)
-    logits never exist in HBM; peak extra memory is one (N, CH) slab."""
-    N, C = h.shape
+def _flce_partials(h, w, tgt, global_off, CH):
+    """Online-logsumexp partials of ``h @ w.T`` scanned over vocab chunks of
+    size ``CH`` (must divide ``w.shape[0]``).  ``global_off`` is ``w``'s
+    offset in the full vocab (nonzero for a vocab shard, see
+    distributed/vocab_parallel.py).  Returns float32 (N,) ``(m, s, tl)``:
+    running max, normalizer at ``m``, and the target logit (0 when the
+    target id falls outside this ``w``)."""
+    N = h.shape[0]
     V = w.shape[0]
-    CH = _flce_chunk(V)
     n_chunks = V // CH
-    tgt = target.astype(jnp.int32)
 
     def body(carry, c):
         m, s, tl = carry
@@ -608,8 +610,9 @@ def _fused_linear_ce_impl(h, w, target, ignore_index=-100):
                                  preferred_element_type=jnp.float32)  # (N, CH)
         m_new = jnp.maximum(m, jnp.max(lg, axis=-1))
         s = s * jnp.exp(m - m_new) + jnp.sum(jnp.exp(lg - m_new[:, None]), axis=-1)
-        in_chunk = jnp.logical_and(tgt >= off, tgt < off + CH)
-        idx = jnp.clip(tgt - off, 0, CH - 1)
+        gcol = global_off + off
+        in_chunk = jnp.logical_and(tgt >= gcol, tgt < gcol + CH)
+        idx = jnp.clip(tgt - gcol, 0, CH - 1)
         cand = jnp.take_along_axis(lg, idx[:, None], axis=1)[:, 0]
         tl = jnp.where(in_chunk, cand, tl)
         return (m_new, s, tl), None
@@ -620,6 +623,16 @@ def _fused_linear_ce_impl(h, w, target, ignore_index=-100):
         jnp.zeros((N,), dtype=jnp.float32),
     )
     (m, s, tl), _ = jax.lax.scan(body, init, jnp.arange(n_chunks))
+    return m, s, tl
+
+
+@impl(PrimIDs.FUSED_LINEAR_CE)
+def _fused_linear_ce_impl(h, w, target, ignore_index=-100):
+    """Online-logsumexp CE over vocab chunks of ``h @ w.T`` — the (N, V)
+    logits never exist in HBM; peak extra memory is one (N, CH) slab."""
+    V = w.shape[0]
+    tgt = target.astype(jnp.int32)
+    m, s, tl = _flce_partials(h, w, tgt, 0, _flce_chunk(V))
     lse = m + jnp.log(s)
     losses = jnp.where(tgt != ignore_index, lse - tl, 0.0)
     return losses, lse

@@ -52,3 +52,16 @@ def test_cli_quant_int8_training(tmp_path):
     report = json.loads(out.stdout.strip().splitlines()[-1])
     assert report["quant"] == "int8"
     assert report["final_loss"] < 6.0
+
+
+def test_cli_fused_ce_training(tmp_path):
+    out = subprocess.run(
+        [sys.executable, "train_cli.py", "--mode", "fsdp", "--devices", "4",
+         "--virtual-cpu", "--fused-ce", "--steps", "2", "--batch", "4",
+         "--seq", "32"],
+        capture_output=True, text=True, timeout=900, cwd="/root/repo",
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    report = json.loads(out.stdout.strip().splitlines()[-1])
+    assert report["fused_ce"] is True
+    assert report["final_loss"] < 6.0

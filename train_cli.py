@@ -40,6 +40,9 @@ def main(argv=None):
     ap.add_argument("--config", default="tiny-llama-debug", help="model config name (models/llama.py zoo)")
     ap.add_argument("--mode", default="none",
                     choices=["none", "ddp", "fsdp", "zero3", "tp_fsdp", "sp", "pp", "ep"])
+    ap.add_argument("--fused-ce", action="store_true",
+                    help="fuse the lm-head matmul into a chunked-vocab cross-entropy "
+                         "(no materialized logits; Config.fused_head_ce)")
     ap.add_argument("--quant", default=None, choices=["int8", "fp8"],
                     help="quantized training: int8/fp8(e4m3) forward GEMMs, full-precision grads")
     ap.add_argument("--comm-combine-mb", type=float, default=None,
@@ -78,7 +81,9 @@ def main(argv=None):
     devices = jax.devices()[: args.devices]
     assert len(devices) >= args.devices, f"need {args.devices} devices, have {len(jax.devices())}"
 
-    cfg = llama.Config.from_name(args.config)
+    cfg = llama.Config.from_name(
+        args.config, **({"fused_head_ce": True} if args.fused_ce else {})
+    )
     T = args.seq or min(cfg.block_size, 128)
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
@@ -93,6 +98,7 @@ def main(argv=None):
 
     if args.mode in ("sp", "pp", "ep"):
         assert args.quant is None, "--quant needs a TrainStep mode (not sp/pp/ep)"
+        assert not args.fused_ce, "--fused-ce needs a TrainStep mode (not sp/pp/ep)"
         assert args.comm_combine_mb is None, "--comm-combine-mb needs a TrainStep mode (not sp/pp/ep)"
         assert not args.bucket, "--bucket needs a TrainStep mode (not sp/pp/ep)"
         # sequence / pipeline / expert parallelism drive the shard_map-based
@@ -199,6 +205,7 @@ def main(argv=None):
     print(json.dumps({
         "config": cfg.name, "mode": args.mode, "devices": args.devices,
         "quant": args.quant,
+        "fused_ce": bool(args.fused_ce),
         "tokens_per_sec": round(tps, 1), "ms_per_step": round(dt / args.steps * 1e3, 2),
         "final_loss": round(float(last), 4),
     }))

@@ -202,3 +202,51 @@ def test_remat_quality_vs_jax_checkpoint_dots_saveable():
         f"remat heuristic saves {ratio:.2f}x the dots_saveable residual bytes "
         f"({(thunder_saved - param_bytes) / 1e6:.1f} vs {(jax_saved - param_bytes) / 1e6:.1f} MB)"
     )
+
+
+class TestAutoRemat:
+    """remat="auto" on the train step: pay recompute only when residuals
+    would not fit device memory (measured ~1.5% MFU on the v5e headline)."""
+
+    def _step(self, remat):
+        import optax
+
+        import thunder_tpu.distributed as dist
+        from thunder_tpu.models import llama
+
+        cfg = llama.Config.from_name("tiny-llama-debug")
+        mesh = dist.make_mesh({"dp": 1}, devices=jax.devices()[:1])
+        params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+        idx = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, cfg.vocab_size)
+        tgt = jax.random.randint(jax.random.PRNGKey(2), (2, 32), 0, cfg.vocab_size)
+        cos, sin = llama.build_rope_cache(cfg, 32)
+        step = dist.make_train_step(
+            lambda p, i, t, c, s: llama.gpt_loss(p, i, t, c, s, cfg),
+            optax.adamw(1e-3), mesh, remat=remat,
+        )
+        o = step.init_optimizer_state(params)
+        _, _, loss = step(params, o, idx, tgt, cos, sin)
+        return step, float(loss)
+
+    def test_big_budget_skips_remat(self, monkeypatch):
+        monkeypatch.setenv("THUNDER_TPU_HBM_BYTES", str(1 << 40))  # 1 TiB
+        step, loss = self._step("auto")
+        assert step.last_remat_applied is False
+        assert loss > 0
+
+    def test_tiny_budget_applies_remat(self, monkeypatch):
+        monkeypatch.setenv("THUNDER_TPU_HBM_BYTES", str(1 << 20))  # 1 MiB
+        step, loss = self._step("auto")
+        assert step.last_remat_applied is True
+
+    def test_auto_matches_explicit_numerics(self, monkeypatch):
+        monkeypatch.setenv("THUNDER_TPU_HBM_BYTES", str(1 << 40))
+        _, l_auto = self._step("auto")
+        _, l_off = self._step(False)
+        _, l_on = self._step(True)
+        assert l_auto == l_off
+        assert abs(l_on - l_off) < 1e-5  # remat never changes the math
+
+    def test_invalid_remat_value_raises(self):
+        with pytest.raises(ValueError, match="remat must be"):
+            self._step("dots")

@@ -32,7 +32,7 @@ from thunder_tpu.core.symbol import BoundSymbol
 from thunder_tpu.core.trace import TraceCtx, from_trace, tracectx
 from thunder_tpu.core.transform_common import dce
 
-__all__ = ["rematerialize_forward_and_backward"]
+__all__ = ["rematerialize_forward_and_backward", "saved_bytes"]
 
 # ops cheap enough to re-execute in backward rather than save their outputs
 _CHEAP_IDS = {
@@ -85,6 +85,15 @@ def _bytes(p: Proxy) -> int:
     except Exception:
         width = 4
     return n * width
+
+
+def saved_bytes(fw_trace: TraceCtx) -> int:
+    """Total bytes of the forward trace's saved-for-backward residuals
+    (the second element of its RETURN) — the quantity remat shrinks."""
+    for b in fw_trace.bound_symbols:
+        if b.sym.id == PrimIDs.RETURN and len(b.args) == 2:
+            return sum(_bytes(p) for p in b.args[1] if isinstance(p, TensorProxy))
+    return 0
 
 
 def rematerialize_forward_and_backward(

@@ -23,6 +23,8 @@ from __future__ import annotations
 from enum import Enum, auto
 from typing import Any, Callable, Sequence
 
+import math
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -208,7 +210,11 @@ class TrainStep:
         self.mesh = mesh
         self.batch_specs = batch_specs
         self.donate = donate
+        if not (isinstance(remat, bool) or remat == "auto"):
+            raise ValueError(f"remat must be True, False, or 'auto', got {remat!r}")
         self.remat = remat
+        #: the resolved decision of the last _build (introspection/tests)
+        self.last_remat_applied: bool | None = None
         self.zero3 = zero3
         self.executors = executors
         if quant not in (None, "int8", "fp8"):
@@ -221,6 +227,47 @@ class TrainStep:
         # a fresh build
         self._cache: dict = {}
         self._jitted = None
+
+    def _auto_remat(self, fw_trace, params, opt_state, batch) -> bool:
+        """remat="auto": skip trace-level rematerialization when the
+        un-rematerialized residuals fit device memory with headroom —
+        recompute costs real backward FLOPs/bandwidth (measured ~1.5% MFU on
+        the v5e headline), so pay it only when memory demands it.
+
+        Budget: ``THUNDER_TPU_HBM_BYTES`` env override, else the device's
+        ``memory_stats()['bytes_limit']``; unknown → remat (conservative).
+        Residuals and batch are assumed mesh-sharded (dp/fsdp layouts);
+        params/opt-state are counted unsharded — also conservative."""
+        import os
+
+        budget = None
+        env = os.environ.get("THUNDER_TPU_HBM_BYTES")
+        if env:
+            budget = int(env)
+        else:
+            try:  # budget the device the step actually runs on
+                budget = self.mesh.devices.flat[0].memory_stats().get("bytes_limit")
+            except Exception:
+                budget = None
+        if not budget:
+            return True
+        from thunder_tpu.core.rematerialization import saved_bytes
+
+        def nbytes(tree):
+            return sum(
+                x.size * x.dtype.itemsize
+                for x in jax.tree_util.tree_leaves(tree)
+                if hasattr(x, "dtype") and hasattr(x, "size")
+            )
+
+        # residuals/batch shard over the DATA axes only (dp/fsdp); tp/sp/pp
+        # axes replicate or feature-shard activations, so dividing by the
+        # full mesh size would underestimate per-device memory by the tp
+        # degree and let "auto" skip remat into an OOM
+        data_axes = [a for a in ("dp", "fsdp") if a in self.mesh.shape]
+        n_data = max(int(math.prod(self.mesh.shape[a] for a in data_axes)), 1) if data_axes else 1
+        per_device = nbytes((params, opt_state)) + (nbytes(batch) + saved_bytes(fw_trace)) / n_data
+        return per_device * 1.5 > budget
 
     def init_optimizer_state(self, params):
         """Optimizer state inherits each param's sharding (ZeRO: sharded
@@ -250,7 +297,11 @@ class TrainStep:
         comp = cse(comp)
         comp.args = trace_results.computation_trace.args
         fw_trace, bw_trace = forward_and_backward_from_trace(comp)
-        if self.remat or self.zero3:
+        do_remat = self.remat if isinstance(self.remat, bool) else self._auto_remat(
+            fw_trace, params, opt_state, batch
+        )
+        self.last_remat_applied = bool(do_remat or self.zero3)
+        if do_remat or self.zero3:
             from thunder_tpu.core.rematerialization import rematerialize_forward_and_backward
 
             # zero3: aggressive remat — residuals shrink toward the inputs,

@@ -1,0 +1,55 @@
+"""Speculative decoding: greedy token-exactness vs plain generate()."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from thunder_tpu.models import generate as gen
+from thunder_tpu.models import llama
+from thunder_tpu.models.speculative import speculative_generate
+
+
+def _models(seed_target=0, seed_draft=9):
+    cfg = llama.Config.from_name("tiny-llama-debug")
+    draft_cfg = llama.Config.from_name("tiny-llama-debug", n_layer=1)
+    tp = llama.init_params(cfg, jax.random.PRNGKey(seed_target), dtype=jnp.float32)
+    dp = llama.init_params(draft_cfg, jax.random.PRNGKey(seed_draft), dtype=jnp.float32)
+    return cfg, draft_cfg, tp, dp
+
+
+class TestSpeculative:
+    @pytest.mark.parametrize("K", [1, 3, 5])
+    def test_token_exact_vs_greedy_generate(self, K):
+        cfg, draft_cfg, tp, dp = _models()
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 7), 0, cfg.vocab_size)
+        n = 20
+        ref = gen.generate(tp, prompt, cfg, n, cache_dtype=jnp.float32)
+        out = speculative_generate(tp, dp, prompt, cfg, draft_cfg, n, K=K,
+                                   cache_dtype=jnp.float32)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_perfect_draft_accepts_everything(self):
+        """Draft == target: every draft matches, K+1 tokens per verify."""
+        cfg, _, tp, _ = _models()
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 5), 0, cfg.vocab_size)
+        n = 12
+        ref = gen.generate(tp, prompt, cfg, n, cache_dtype=jnp.float32)
+        out = speculative_generate(tp, tp, prompt, cfg, cfg, n, K=4,
+                                   cache_dtype=jnp.float32)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_rejects_ring_cache_models(self):
+        cfg = llama.Config.from_name("tiny-mistral-debug", sliding_window=8)
+        tp = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+        prompt = jnp.zeros((1, 4), jnp.int32)
+        with pytest.raises(AssertionError, match="ring"):
+            speculative_generate(tp, tp, prompt, cfg, cfg, 16, T_max=64,
+                                 cache_dtype=jnp.float32)
+
+    def test_batch_gt_one_rejected(self):
+        cfg, draft_cfg, tp, dp = _models()
+        prompt = jnp.zeros((2, 4), jnp.int32)
+        with pytest.raises(AssertionError, match="B=1"):
+            speculative_generate(tp, dp, prompt, cfg, draft_cfg, 8)

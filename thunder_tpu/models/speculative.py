@@ -1,0 +1,174 @@
+"""Speculative decoding: a small draft model proposes K tokens, the target
+model verifies them in ONE forward (beyond-ref serving capability; the
+reference has no generation loop at all).
+
+Greedy contract: the emitted sequence is **token-identical** to plain greedy
+decoding with the target model alone — drafts are accepted exactly while
+they match the target's argmax, and the first mismatch is replaced by the
+target's own token (which the verify forward already computed).  Each
+iteration therefore emits between 1 and K+1 tokens for a single
+(K+1)-position target forward, against K+1 single-token forwards for plain
+decode — the speedup is the acceptance rate times the draft/target cost
+ratio.
+
+Cache bookkeeping rides the plain KV-cache semantics: a rejected draft's
+K/V entries sit at positions above the accepted prefix, where the next
+verify chunk either rewrites them or masks them out (queries attend slots
+``<= qpos`` only), so no rewind is needed.
+
+Single sequence (B=1): acceptance length is data-dependent per sequence, so
+batched speculative decoding would need per-row positions the cache API
+deliberately does not have.  Sliding-window (ring-cache) models are not
+supported: the ring prefill requires chunks to start at position 0.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from thunder_tpu.models.generate import _cache_len, forward_with_cache, init_cache
+from thunder_tpu.models.llama import Config, build_rope_cache
+
+__all__ = ["speculative_generate"]
+
+
+def _spec_step(cfg, draft_cfg, cos, sin, cos_d, sin_d, K, quantized):
+    """One speculate/verify iteration.  ``params`` are jit ARGUMENTS (not
+    closure captures) so the compiled program is reusable across calls and
+    across weight updates — see ``_spec_cache``."""
+
+    @partial(jax.jit, donate_argnums=(2, 3))
+    def step(params, draft_params, tcache, dcache, cur, pos):
+        # draft K tokens autoregressively (cheap model, small forwards).
+        # K+1 scan iterations: the extra one consumes d_K and writes its K/V
+        # at pos+K, so a fully-accepted round leaves no never-written hole in
+        # the draft cache (a zero-K/V slot would silently steal softmax mass
+        # from every later draft forward and decay the acceptance rate)
+        def dbody(carry, _):
+            tok, dpos, dc = carry
+            dlogits, dc = forward_with_cache(
+                draft_params, tok[:, None], dpos, dc, cos_d, sin_d, draft_cfg,
+                quantized=quantized,
+            )
+            nxt = jnp.argmax(dlogits[:, -1], axis=-1).astype(jnp.int32)
+            return (nxt, dpos + 1, dc), nxt
+
+        (_, _, dcache2), drafts_x = jax.lax.scan(dbody, (cur, pos, dcache), None, length=K + 1)
+        drafts = drafts_x[:K].transpose(1, 0)  # (1, K); the K+1th output is unused
+
+        # verify: one target forward over [cur, d_1..d_K] = K+1 positions
+        chunk = jnp.concatenate([cur[:, None], drafts], axis=1)  # (1, K+1)
+        tlogits, tcache2 = forward_with_cache(
+            params, chunk, pos, tcache, cos, sin, cfg, quantized=quantized,
+        )
+        tgt_toks = jnp.argmax(tlogits, axis=-1).astype(jnp.int32)  # (1, K+1)
+
+        # accepted prefix length m = first draft that disagrees with the
+        # target's argmax; all-match → m = K and tgt_toks[K] is a bonus token
+        match = drafts[0] == tgt_toks[0, :K]  # (K,)
+        m = jnp.argmin(jnp.concatenate([match, jnp.zeros((1,), bool)]).astype(jnp.int32))
+        n_emit = m + 1  # accepted drafts + the target's correction/bonus token
+
+        # fixed-shape emission: emitted[i] = drafts[i] for i < m, target's
+        # token at i == m, garbage (masked by n_emit) above
+        iota = jnp.arange(K + 1)
+        emitted = jnp.where(
+            iota < m,
+            jnp.concatenate([drafts[0], jnp.zeros((1,), jnp.int32)]),
+            tgt_toks[0, m],
+        )
+        new_cur = tgt_toks[0, m][None]  # next iteration continues from the correction
+        return tcache2, dcache2, emitted, n_emit, new_cur, pos + n_emit
+
+    return step
+
+
+def speculative_generate(
+    params,
+    draft_params,
+    prompt,
+    cfg: Config,
+    draft_cfg: Config,
+    max_new_tokens: int,
+    *,
+    K: int = 4,
+    T_max: int | None = None,
+    quantized: bool = False,
+    cache_dtype=None,
+):
+    """Greedy speculative decoding; returns (B=1, T_prompt + max_new_tokens)
+    tokens identical to ``generate(params, ...)`` (temperature=0).
+
+    ``draft_params``/``draft_cfg``: the small proposal model (must share the
+    tokenizer/vocab with the target).
+    """
+    prompt = jnp.asarray(prompt)
+    B, T_prompt = prompt.shape
+    assert B == 1, "speculative decoding tracks one sequence's acceptance length (B=1)"
+    assert max_new_tokens >= 0
+    assert cfg.padded_vocab_size == draft_cfg.padded_vocab_size, "draft must share the vocab"
+    if max_new_tokens == 0:
+        return prompt
+    if T_max is None:
+        T_max = min(cfg.block_size, T_prompt + max_new_tokens + K + 1)
+    # the last verify chunk may reach K positions past the final emitted token
+    assert T_prompt + max_new_tokens + K <= T_max, "T_max too small for K-token speculation"
+    assert _cache_len(cfg, T_max) == T_max and _cache_len(draft_cfg, T_max) == T_max, (
+        "speculative decoding needs full (non-ring) caches; sliding-window "
+        "models decode via generate()"
+    )
+    dtype = cache_dtype if cache_dtype is not None else params["wte"].dtype
+    prefill, step = _compiled_speculative(cfg, draft_cfg, T_prompt, T_max, K, quantized, str(dtype))
+
+    tcache = init_cache(cfg, 1, T_max, dtype=dtype)
+    dcache = init_cache(draft_cfg, 1, T_max, dtype=dtype)
+    tcache, dcache, cur = prefill(params, draft_params, tcache, dcache, prompt)
+
+    toks: list[int] = [int(cur[0])]
+    pos = jnp.asarray(T_prompt, jnp.int32)
+    while len(toks) < max_new_tokens:
+        tcache, dcache, emitted, n_emit, cur, pos = step(
+            params, draft_params, tcache, dcache, cur, pos)
+        n = int(n_emit)
+        toks.extend(int(t) for t in jax.device_get(emitted)[:n])
+    out = jnp.asarray(toks[:max_new_tokens], jnp.int32)[None, :]
+    return jnp.concatenate([prompt, out], axis=1)
+
+
+_spec_cache: dict = {}
+
+
+def _compiled_speculative(cfg, draft_cfg, T_prompt, T_max, K, quantized, dtype_str):
+    """Jitted (prefill, step) pair cached per static configuration — params
+    are arguments, so repeated serving calls (and weight updates) reuse the
+    compiled programs (the _generate_cache pattern, generate.py)."""
+    import dataclasses
+
+    key = (
+        tuple(sorted(dataclasses.asdict(cfg).items())),
+        tuple(sorted(dataclasses.asdict(draft_cfg).items())),
+        T_prompt, T_max, K, quantized, dtype_str,
+    )
+    cached = _spec_cache.get(key)
+    if cached is not None:
+        return cached
+    if len(_spec_cache) >= 16:
+        _spec_cache.pop(next(iter(_spec_cache)))
+
+    cos, sin = build_rope_cache(cfg, T_max)
+    cos_d, sin_d = build_rope_cache(draft_cfg, T_max)
+
+    @partial(jax.jit, donate_argnums=(2, 3))
+    def prefill(params, draft_params, tcache, dcache, prompt):
+        tlogits, tcache = forward_with_cache(
+            params, prompt, 0, tcache, cos, sin, cfg, quantized=quantized)
+        _, dcache = forward_with_cache(
+            draft_params, prompt, 0, dcache, cos_d, sin_d, draft_cfg, quantized=quantized)
+        first = jnp.argmax(tlogits[:, -1], axis=-1).astype(jnp.int32)
+        return tcache, dcache, first
+
+    step = _spec_step(cfg, draft_cfg, cos, sin, cos_d, sin_d, K, quantized)
+    _spec_cache[key] = (prefill, step)
+    return prefill, step

@@ -8,7 +8,7 @@ pipeline.  Capability analog of the reference's test/bench models
 ``jax.Array`` (no module object graph), so the same forward function works
 under ``thunder_tpu.jit``, ``jax.jit``, and sharded ``pjit`` over a mesh.
 """
-from thunder_tpu.models import generate, llama, speculative  # noqa: F401
+from thunder_tpu.models import generate, hf_weights, llama, speculative  # noqa: F401
 from thunder_tpu.models.llama import Config, gpt_forward, gpt_loss, init_params
 
-__all__ = ["llama", "generate", "speculative", "Config", "gpt_forward", "gpt_loss", "init_params"]
+__all__ = ["llama", "generate", "speculative", "hf_weights", "Config", "gpt_forward", "gpt_loss", "init_params"]

@@ -1,0 +1,140 @@
+"""Load HuggingFace Llama/Mistral-family checkpoints into the functional
+param pytree.
+
+The reference gets real checkpoints through LitGPT's converters; here the
+mapping is direct: HF ``LlamaForCausalLM``/``MistralForCausalLM`` state
+dicts share our weight layout (rotate-half rope, separate q/k/v, SwiGLU
+MLP), so conversion is a key rename plus vocab padding — no transposes.
+Logit parity against ``transformers`` is pinned in
+``tests/test_hf_weights.py``.
+
+Usage::
+
+    from transformers import AutoModelForCausalLM
+    m = AutoModelForCausalLM.from_pretrained("meta-llama/Llama-2-7b-hf")
+    cfg = config_from_hf(m.config)
+    params = from_hf_state_dict(m.state_dict(), cfg)
+    logits = tt.jit(lambda p, i, c, s: llama.gpt_forward(p, i, c, s, cfg))(...)
+"""
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+from thunder_tpu.models.llama import Config
+
+__all__ = ["config_from_hf", "from_hf_state_dict"]
+
+
+def config_from_hf(hf_config: Any, **overrides) -> Config:
+    """Builds a :class:`Config` from a HF ``LlamaConfig``/``MistralConfig``."""
+    mt = getattr(hf_config, "model_type", "llama")
+    if mt not in ("llama", "mistral"):
+        raise ValueError(f"unsupported HF model_type {mt!r} (llama/mistral family only)")
+    # reject config knobs the functional model does not implement — silent
+    # acceptance would convert cleanly and return wrong logits
+    scaling = getattr(hf_config, "rope_scaling", None)
+    condense = 1.0
+    if scaling:
+        stype = scaling.get("rope_type", scaling.get("type"))
+        if stype == "linear":
+            condense = float(scaling["factor"])
+        else:
+            raise ValueError(
+                f"unsupported rope_scaling {stype!r}: only 'linear' maps onto "
+                "rope_condense_ratio; llama3/yarn/dynamic scaling is not implemented"
+            )
+    for knob in ("attention_bias", "mlp_bias"):
+        if getattr(hf_config, knob, False):
+            raise ValueError(f"unsupported HF config {knob}=True: the functional model has no biases")
+    act = getattr(hf_config, "hidden_act", "silu")
+    if act not in ("silu", "swish"):
+        raise ValueError(f"unsupported hidden_act {act!r}: the LLaMAMLP path is SwiGLU (silu)")
+    kw = dict(
+        name=f"hf-{mt}",
+        block_size=int(hf_config.max_position_embeddings),
+        vocab_size=int(hf_config.vocab_size),
+        padded_vocab_size=int(hf_config.vocab_size),  # HF head is exactly vocab-sized
+        n_layer=int(hf_config.num_hidden_layers),
+        n_head=int(hf_config.num_attention_heads),
+        n_embd=int(hf_config.hidden_size),
+        n_query_groups=int(getattr(hf_config, "num_key_value_heads", None)
+                           or hf_config.num_attention_heads),
+        intermediate_size=int(hf_config.intermediate_size),
+        rope_base=int(getattr(hf_config, "rope_theta", 10000)),
+        rope_condense_ratio=condense,
+        norm_eps=float(getattr(hf_config, "rms_norm_eps", 1e-5)),
+        tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings", False)),
+        sliding_window=(int(hf_config.sliding_window)
+                        if getattr(hf_config, "sliding_window", None) else None),
+        head_size=(int(hf_config.head_dim)
+                   if getattr(hf_config, "head_dim", None) else None),
+    )
+    kw.update(overrides)
+    return Config(**kw)
+
+
+def _to_np(t) -> np.ndarray:
+    if hasattr(t, "detach"):  # torch tensor
+        t = t.detach().to("cpu")
+        import torch
+
+        if t.dtype == torch.bfloat16:  # numpy has no bf16: round-trip via f32
+            t = t.float()
+        return t.numpy()
+    return np.asarray(t)
+
+
+def _pad_vocab(x: np.ndarray, padded: int) -> np.ndarray:
+    if x.shape[0] == padded:
+        return x
+    out = np.zeros((padded,) + x.shape[1:], dtype=x.dtype)
+    out[: x.shape[0]] = x
+    return out
+
+
+def from_hf_state_dict(sd: Mapping[str, Any], cfg: Config, dtype=jnp.bfloat16) -> dict:
+    """Converts a HF Llama/Mistral ``state_dict`` into the
+    ``models/llama.init_params`` pytree layout (wq/wk/wv/wo, fc_1/fc_2/proj).
+
+    Handles the optional ``model.`` prefix, vocab padding to
+    ``cfg.padded_vocab_size``, and tied embeddings (no ``lm_head.weight``)."""
+
+    def get(name: str) -> np.ndarray:
+        for k in (name, f"model.{name}"):
+            if k in sd:
+                return _to_np(sd[k])
+        raise KeyError(f"HF checkpoint is missing {name!r}")
+
+    wte = _pad_vocab(get("embed_tokens.weight"), cfg.padded_vocab_size)
+    blocks = []
+    for i in range(cfg.n_layer):
+        p = f"layers.{i}."
+        blocks.append({
+            "norm_1": jnp.asarray(get(p + "input_layernorm.weight"), dtype),
+            "attn": {
+                "wq": jnp.asarray(get(p + "self_attn.q_proj.weight"), dtype),
+                "wk": jnp.asarray(get(p + "self_attn.k_proj.weight"), dtype),
+                "wv": jnp.asarray(get(p + "self_attn.v_proj.weight"), dtype),
+                "wo": jnp.asarray(get(p + "self_attn.o_proj.weight"), dtype),
+            },
+            "norm_2": jnp.asarray(get(p + "post_attention_layernorm.weight"), dtype),
+            "mlp": {
+                "fc_1": jnp.asarray(get(p + "mlp.gate_proj.weight"), dtype),
+                "fc_2": jnp.asarray(get(p + "mlp.up_proj.weight"), dtype),
+                "proj": jnp.asarray(get(p + "mlp.down_proj.weight"), dtype),
+            },
+        })
+    params = {
+        "wte": jnp.asarray(wte, dtype),
+        "blocks": blocks,
+        "ln_f": jnp.asarray(get("norm.weight"), dtype),
+    }
+    if not cfg.tie_embeddings:
+        head = sd.get("lm_head.weight")
+        if head is None:
+            raise KeyError("HF checkpoint has no lm_head.weight and tie_embeddings is False")
+        params["lm_head"] = jnp.asarray(_pad_vocab(_to_np(head), cfg.padded_vocab_size), dtype)
+    return params

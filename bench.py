@@ -560,6 +560,30 @@ def decode_benchmark(on_tpu: bool):
     prompt = jax.random.randint(jax.random.PRNGKey(1), (B, T_prompt), 0, cfg.vocab_size)
 
     results = {}
+    # speculative: the draft is the target's own first layers (true depth
+    # truncation — weight-correlated, so acceptance is meaningful; a random
+    # draft would agree with the target ~1/vocab of the time and measure
+    # nothing but overhead)
+    from thunder_tpu.models.speculative import speculative_generate
+
+    draft_cfg = llama.Config.from_name(cfg.name, **{**{k: getattr(cfg, k) for k in (
+        "n_embd", "n_head", "intermediate_size", "vocab_size", "block_size")},
+        "n_layer": max(cfg.n_layer // 4, 1)})
+    draft_params = {**params, "blocks": params["blocks"][: draft_cfg.n_layer]}
+    sp_prompt = prompt[:1]
+    t0 = time.perf_counter()
+    out = speculative_generate(params, draft_params, sp_prompt, cfg, draft_cfg, N, K=4)
+    _sync(out)
+    log(f"decode[speculative] compile+first: {time.perf_counter()-t0:.1f}s")
+    floor = _fetch_floor()
+    t0 = time.perf_counter()
+    out = speculative_generate(params, draft_params, sp_prompt, cfg, draft_cfg, N, K=4)
+    _sync(out)
+    dt = max(time.perf_counter() - t0 - floor, 1e-9)
+    results["speculative"] = N / dt
+    log(f"decode[speculative B=1 K=4 draft={draft_cfg.n_layer}L] N={N}: "
+        f"{results['speculative']:,.0f} tokens/s")
+
     for name, q in (("fp", False), ("int8", True)):
         t0 = time.perf_counter()
         out = gen.generate(params, prompt, cfg, N, quantized=q)

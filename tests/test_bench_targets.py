@@ -81,6 +81,7 @@ class TestHarnessTargets:
     def test_decode_benchmark_cpu(self):
         results = bench.decode_benchmark(on_tpu=False)
         assert results["fp"] > 0 and results["int8"] > 0
+        assert results["speculative"] > 0
 
     def test_headline_runs_at_toy_dims(self):
         """compiled_run/baseline_run (the headline's two timed runs) work and

@@ -35,11 +35,9 @@ __all__ = ["speculative_generate"]
 
 
 def _spec_step(cfg, draft_cfg, cos, sin, cos_d, sin_d, K, quantized):
-    """One speculate/verify iteration.  ``params`` are jit ARGUMENTS (not
-    closure captures) so the compiled program is reusable across calls and
-    across weight updates — see ``_spec_cache``."""
+    """One speculate/verify iteration (traced inside decode_all's
+    while_loop, so no jit of its own)."""
 
-    @partial(jax.jit, donate_argnums=(2, 3))
     def step(params, draft_params, tcache, dcache, cur, pos):
         # draft K tokens autoregressively (cheap model, small forwards).
         # K+1 scan iterations: the extra one consumes d_K and writes its K/V
@@ -120,36 +118,42 @@ def speculative_generate(
         "models decode via generate()"
     )
     dtype = cache_dtype if cache_dtype is not None else params["wte"].dtype
-    prefill, step = _compiled_speculative(cfg, draft_cfg, T_prompt, T_max, K, quantized, str(dtype))
+    prefill, decode_all = _compiled_speculative(
+        cfg, draft_cfg, T_prompt, max_new_tokens, T_max, K, quantized, str(dtype)
+    )
 
     tcache = init_cache(cfg, 1, T_max, dtype=dtype)
     dcache = init_cache(draft_cfg, 1, T_max, dtype=dtype)
     tcache, dcache, cur = prefill(params, draft_params, tcache, dcache, prompt)
+    import warnings
 
-    toks: list[int] = [int(cur[0])]
-    pos = jnp.asarray(T_prompt, jnp.int32)
-    while len(toks) < max_new_tokens:
-        tcache, dcache, emitted, n_emit, cur, pos = step(
-            params, draft_params, tcache, dcache, cur, pos)
-        n = int(n_emit)
-        toks.extend(int(t) for t in jax.device_get(emitted)[:n])
-    out = jnp.asarray(toks[:max_new_tokens], jnp.int32)[None, :]
-    return jnp.concatenate([prompt, out], axis=1)
+    with warnings.catch_warnings():
+        # decode_all returns only tokens, so the donated caches cannot alias
+        # an output; donation still frees them for scratch (same pattern and
+        # rationale as generate.py's decode loop)
+        warnings.filterwarnings("ignore", message="Some donated buffers were not usable")
+        out = decode_all(params, draft_params, tcache, dcache, cur)
+    return jnp.concatenate([prompt, out[None, :]], axis=1)
 
 
 _spec_cache: dict = {}
 
 
-def _compiled_speculative(cfg, draft_cfg, T_prompt, T_max, K, quantized, dtype_str):
-    """Jitted (prefill, step) pair cached per static configuration — params
-    are arguments, so repeated serving calls (and weight updates) reuse the
-    compiled programs (the _generate_cache pattern, generate.py)."""
+def _compiled_speculative(cfg, draft_cfg, T_prompt, max_new, T_max, K, quantized, dtype_str):
+    """Jitted (prefill, decode_all) pair cached per static configuration —
+    params are arguments, so repeated serving calls (and weight updates)
+    reuse the compiled programs (the _generate_cache pattern, generate.py).
+
+    ``decode_all`` is ONE compiled program: a ``lax.while_loop`` over
+    speculate/verify rounds writing into a fixed token buffer — no
+    host round-trip per round (a device->host fetch per round would cost
+    more than the verify forward it saves on a remote TPU)."""
     import dataclasses
 
     key = (
         tuple(sorted(dataclasses.asdict(cfg).items())),
         tuple(sorted(dataclasses.asdict(draft_cfg).items())),
-        T_prompt, T_max, K, quantized, dtype_str,
+        T_prompt, max_new, T_max, K, quantized, dtype_str,
     )
     cached = _spec_cache.get(key)
     if cached is not None:
@@ -170,5 +174,28 @@ def _compiled_speculative(cfg, draft_cfg, T_prompt, T_max, K, quantized, dtype_s
         return tcache, dcache, first
 
     step = _spec_step(cfg, draft_cfg, cos, sin, cos_d, sin_d, K, quantized)
-    _spec_cache[key] = (prefill, step)
-    return prefill, step
+
+    @partial(jax.jit, donate_argnums=(2, 3))
+    def decode_all(params, draft_params, tcache, dcache, first):
+        # buffer holds the worst-case overshoot of the final round; each
+        # round writes K+1 slots at offset n and only advances n by n_emit,
+        # so the next round's write overwrites the round's garbage tail
+        buf = jnp.zeros((max_new + K + 1,), jnp.int32).at[0].set(first[0])
+
+        def cond(state):
+            return state[5] < max_new
+
+        def body(state):
+            tcache, dcache, buf, cur, pos, n = state
+            tcache, dcache, emitted, n_emit, cur, pos = step(
+                params, draft_params, tcache, dcache, cur, pos)
+            buf = jax.lax.dynamic_update_slice(buf, emitted, (n,))
+            return (tcache, dcache, buf, cur, pos, n + n_emit)
+
+        init = (tcache, dcache, buf, first, jnp.asarray(T_prompt, jnp.int32),
+                jnp.asarray(1, jnp.int32))
+        _, _, buf, _, _, _ = jax.lax.while_loop(cond, body, init)
+        return buf[:max_new]
+
+    _spec_cache[key] = (prefill, decode_all)
+    return prefill, decode_all

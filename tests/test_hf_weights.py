@@ -111,12 +111,16 @@ class TestUnsupportedKnobs:
         base.update(kw)
         return transformers.LlamaConfig(**base)
 
-    def test_llama3_rope_scaling_rejected(self):
-        cfg = self._cfg(rope_scaling={
-            "rope_type": "llama3", "factor": 8.0, "original_max_position_embeddings": 8192,
-            "low_freq_factor": 1.0, "high_freq_factor": 4.0})
+    def test_yarn_rope_scaling_rejected(self):
+        cfg = self._cfg(rope_scaling={"rope_type": "yarn", "factor": 8.0})
         with pytest.raises(ValueError, match="rope_scaling"):
             config_from_hf(cfg)
+
+    def test_llama3_rope_scaling_accepted(self):
+        cfg = config_from_hf(self._cfg(rope_scaling={
+            "rope_type": "llama3", "factor": 8.0, "original_max_position_embeddings": 8192,
+            "low_freq_factor": 1.0, "high_freq_factor": 4.0}))
+        assert cfg.rope_scaling_llama3 is not None
 
     def test_linear_rope_scaling_maps_to_condense(self):
         cfg = config_from_hf(self._cfg(rope_scaling={"type": "linear", "factor": 4.0}))
@@ -129,3 +133,39 @@ class TestUnsupportedKnobs:
     def test_nonsilu_act_rejected(self):
         with pytest.raises(ValueError, match="hidden_act"):
             config_from_hf(self._cfg(hidden_act="gelu"))
+
+
+class TestLlama3RopeScaling:
+    def test_llama3_scaled_logit_parity(self):
+        """HF llama3 rope rescaling (Llama-3.1-style) must match exactly."""
+        hf_cfg = transformers.LlamaConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=176,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=256, rope_theta=500000.0,
+            rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                          "original_max_position_embeddings": 64,
+                          "low_freq_factor": 1.0, "high_freq_factor": 4.0},
+            tie_word_embeddings=False,
+        )
+        torch.manual_seed(2)
+        m = transformers.LlamaForCausalLM(hf_cfg).eval()
+        cfg = config_from_hf(m.config)
+        assert cfg.rope_scaling_llama3 is not None
+        params = from_hf_state_dict(m.state_dict(), cfg, dtype=jnp.float32)
+        idx = np.random.default_rng(4).integers(0, 128, (1, 48))
+        with torch.no_grad():
+            ref = m(torch.from_numpy(idx)).logits.numpy()
+        ours = _logits_ours(cfg, params, idx)
+        np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=3e-4)
+
+    def test_scaling_changes_the_rope(self):
+        from thunder_tpu.models.llama import build_rope_cache
+
+        base = llama.Config.from_name("tiny-llama-debug", block_size=256)
+        scaled = llama.Config.from_name(
+            "tiny-llama-debug", block_size=256,
+            rope_scaling_llama3={"factor": 8.0, "original_max_position_embeddings": 32,
+                                 "low_freq_factor": 1.0, "high_freq_factor": 4.0})
+        c0, _ = build_rope_cache(base, 128)
+        c1, _ = build_rope_cache(scaled, 128)
+        assert not np.allclose(np.asarray(c0), np.asarray(c1))

@@ -37,14 +37,18 @@ def config_from_hf(hf_config: Any, **overrides) -> Config:
     # acceptance would convert cleanly and return wrong logits
     scaling = getattr(hf_config, "rope_scaling", None)
     condense = 1.0
+    llama3_scaling = None
     if scaling:
         stype = scaling.get("rope_type", scaling.get("type"))
         if stype == "linear":
             condense = float(scaling["factor"])
+        elif stype == "llama3":
+            llama3_scaling = dict(scaling)
         else:
             raise ValueError(
-                f"unsupported rope_scaling {stype!r}: only 'linear' maps onto "
-                "rope_condense_ratio; llama3/yarn/dynamic scaling is not implemented"
+                f"unsupported rope_scaling {stype!r}: 'linear' maps onto "
+                "rope_condense_ratio and 'llama3' onto rope_scaling_llama3; "
+                "yarn/dynamic scaling is not implemented"
             )
     for knob in ("attention_bias", "mlp_bias"):
         if getattr(hf_config, knob, False):
@@ -65,6 +69,7 @@ def config_from_hf(hf_config: Any, **overrides) -> Config:
         intermediate_size=int(hf_config.intermediate_size),
         rope_base=int(getattr(hf_config, "rope_theta", 10000)),
         rope_condense_ratio=condense,
+        rope_scaling_llama3=llama3_scaling,
         norm_eps=float(getattr(hf_config, "rms_norm_eps", 1e-5)),
         tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings", False)),
         sliding_window=(int(hf_config.sliding_window)

@@ -77,12 +77,21 @@ class Config:
     # kernels band their block iteration, so long-T attention cost scales
     # O(T·window) instead of O(T²)
     sliding_window: int | None = None
+    # Llama-3.1-style rope frequency rescaling (hf rope_scaling rope_type=
+    # "llama3"): low-frequency components stretch by ``factor``, high-freq
+    # stay, mid-band interpolates — long-context finetunes of Llama-3 need
+    # this or logits diverge at every position.  None = plain rope.
+    # Stored as a sorted (key, value) tuple so configs stay hashable for the
+    # compiled-program caches (dicts are normalized in __post_init__)
+    rope_scaling_llama3: tuple | dict | None = None
     # Fuse the lm-head matmul into a chunked-vocab cross-entropy (no (N, V)
     # logits in HBM; Liger-class fused_linear_cross_entropy).  Off by default
     # pending an on-TPU A/B against the XLA-fused plain path
     fused_head_ce: bool = False
 
     def __post_init__(self):
+        if isinstance(self.rope_scaling_llama3, dict):
+            self.rope_scaling_llama3 = tuple(sorted(self.rope_scaling_llama3.items()))
         if self.padded_vocab_size is None:
             # pad to a multiple of 64 for TPU-friendly gather/matmul tiling
             self.padded_vocab_size = ((self.vocab_size + 63) // 64) * 64
@@ -255,10 +264,37 @@ def param_count(params) -> int:
     return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
 
 
+def _llama3_rescale_freqs(theta: jax.Array, params: dict) -> jax.Array:
+    """Llama-3.1 rope rescaling (matches HF ROPE_INIT_FUNCTIONS["llama3"]):
+    wavelengths longer than ``original_max_position_embeddings /
+    low_freq_factor`` divide by ``factor``; shorter than ``.../
+    high_freq_factor`` stay; the band between interpolates smoothly."""
+    import math as _math
+
+    factor = float(params["factor"])
+    low = float(params.get("low_freq_factor", 1.0))
+    high = float(params.get("high_freq_factor", 4.0))
+    orig = float(params.get("original_max_position_embeddings", 8192))
+    wavelen = 2 * _math.pi / theta
+    smooth = (orig / wavelen - low) / (high - low)
+    scaled = jnp.where(
+        wavelen > orig / low,   # low-frequency: full stretch
+        theta / factor,
+        jnp.where(
+            wavelen < orig / high,  # high-frequency: untouched
+            theta,
+            (1 - smooth) * theta / factor + smooth * theta,
+        ),
+    )
+    return scaled
+
+
 def build_rope_cache(config: Config, seq_len: int, dtype=jnp.float32) -> tuple[jax.Array, jax.Array]:
     """Precomputed (cos, sin) of shape (seq_len, rope_n_elem), host-side."""
     n_elem = config.rope_n_elem
     theta = 1.0 / (config.rope_base ** (jnp.arange(0, n_elem, 2, dtype=jnp.float32) / n_elem))
+    if config.rope_scaling_llama3 is not None:
+        theta = _llama3_rescale_freqs(theta, dict(config.rope_scaling_llama3))
     seq = jnp.arange(seq_len, dtype=jnp.float32) / config.rope_condense_ratio
     idx_theta = jnp.outer(seq, theta)  # (T, n_elem/2)
     idx_theta = jnp.concatenate([idx_theta, idx_theta], axis=-1)  # (T, n_elem)

@@ -137,6 +137,7 @@ def speculative_generate(
 
 
 _spec_cache: dict = {}
+_prefill_cache: dict = {}
 
 
 def _compiled_speculative(cfg, draft_cfg, T_prompt, max_new, T_max, K, quantized, dtype_str):
@@ -150,28 +151,37 @@ def _compiled_speculative(cfg, draft_cfg, T_prompt, max_new, T_max, K, quantized
     more than the verify forward it saves on a remote TPU)."""
     import dataclasses
 
-    key = (
+    cfg_key = (
         tuple(sorted(dataclasses.asdict(cfg).items())),
         tuple(sorted(dataclasses.asdict(draft_cfg).items())),
-        T_prompt, max_new, T_max, K, quantized, dtype_str,
     )
+    # prefill does not depend on max_new: cache it separately so serving
+    # callers varying max_new_tokens only recompile the decode loop
+    pre_key = (*cfg_key, T_prompt, T_max, K, quantized, dtype_str)
+    key = (*pre_key, max_new)
     cached = _spec_cache.get(key)
-    if cached is not None:
-        return cached
+    prefill = _prefill_cache.get(pre_key)
+    if cached is not None and prefill is not None:
+        return prefill, cached
     if len(_spec_cache) >= 16:
         _spec_cache.pop(next(iter(_spec_cache)))
+    if len(_prefill_cache) >= 16:
+        _prefill_cache.pop(next(iter(_prefill_cache)))
 
     cos, sin = build_rope_cache(cfg, T_max)
     cos_d, sin_d = build_rope_cache(draft_cfg, T_max)
 
-    @partial(jax.jit, donate_argnums=(2, 3))
-    def prefill(params, draft_params, tcache, dcache, prompt):
-        tlogits, tcache = forward_with_cache(
-            params, prompt, 0, tcache, cos, sin, cfg, quantized=quantized)
-        _, dcache = forward_with_cache(
-            draft_params, prompt, 0, dcache, cos_d, sin_d, draft_cfg, quantized=quantized)
-        first = jnp.argmax(tlogits[:, -1], axis=-1).astype(jnp.int32)
-        return tcache, dcache, first
+    if prefill is None:
+        @partial(jax.jit, donate_argnums=(2, 3))
+        def prefill(params, draft_params, tcache, dcache, prompt):
+            tlogits, tcache = forward_with_cache(
+                params, prompt, 0, tcache, cos, sin, cfg, quantized=quantized)
+            _, dcache = forward_with_cache(
+                draft_params, prompt, 0, dcache, cos_d, sin_d, draft_cfg, quantized=quantized)
+            first = jnp.argmax(tlogits[:, -1], axis=-1).astype(jnp.int32)
+            return tcache, dcache, first
+
+        _prefill_cache[pre_key] = prefill
 
     step = _spec_step(cfg, draft_cfg, cos, sin, cos_d, sin_d, K, quantized)
 
@@ -197,5 +207,5 @@ def _compiled_speculative(cfg, draft_cfg, T_prompt, max_new, T_max, K, quantized
         _, _, buf, _, _, _ = jax.lax.while_loop(cond, body, init)
         return buf[:max_new]
 
-    _spec_cache[key] = (prefill, decode_all)
+    _spec_cache[key] = decode_all
     return prefill, decode_all

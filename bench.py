@@ -582,7 +582,8 @@ def decode_benchmark(on_tpu: bool):
     dt = max(time.perf_counter() - t0 - floor, 1e-9)
     results["speculative"] = N / dt
     log(f"decode[speculative B=1 K=4 draft={draft_cfg.n_layer}L] N={N}: "
-        f"{results['speculative']:,.0f} tokens/s")
+        f"{results['speculative']:,.0f} tokens/s "
+        f"({speculative_generate.last_tokens_per_round:.2f} tokens/round)")
 
     for name, q in (("fp", False), ("int8", True)):
         t0 = time.perf_counter()

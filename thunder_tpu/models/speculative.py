@@ -128,11 +128,15 @@ def speculative_generate(
     import warnings
 
     with warnings.catch_warnings():
-        # decode_all returns only tokens, so the donated caches cannot alias
-        # an output; donation still frees them for scratch (same pattern and
-        # rationale as generate.py's decode loop)
+        # decode_all returns only tokens/counters, so the donated caches
+        # cannot alias an output; donation still frees them for scratch
+        # (same pattern and rationale as generate.py's decode loop)
         warnings.filterwarnings("ignore", message="Some donated buffers were not usable")
-        out = decode_all(params, draft_params, tcache, dcache, cur)
+        out, n, rounds = decode_all(params, draft_params, tcache, dcache, cur)
+    #: tokens emitted per speculate/verify round of the last call (incl. the
+    #: prefill-seeded first token) — the acceptance diagnostic: K+1 means
+    #: every draft accepted, 1.0 means none were
+    speculative_generate.last_tokens_per_round = float(n) / max(float(rounds), 1.0)
     return jnp.concatenate([prompt, out[None, :]], axis=1)
 
 
@@ -196,16 +200,16 @@ def _compiled_speculative(cfg, draft_cfg, T_prompt, max_new, T_max, K, quantized
             return state[5] < max_new
 
         def body(state):
-            tcache, dcache, buf, cur, pos, n = state
+            tcache, dcache, buf, cur, pos, n, rounds = state
             tcache, dcache, emitted, n_emit, cur, pos = step(
                 params, draft_params, tcache, dcache, cur, pos)
             buf = jax.lax.dynamic_update_slice(buf, emitted, (n,))
-            return (tcache, dcache, buf, cur, pos, n + n_emit)
+            return (tcache, dcache, buf, cur, pos, n + n_emit, rounds + 1)
 
         init = (tcache, dcache, buf, first, jnp.asarray(T_prompt, jnp.int32),
-                jnp.asarray(1, jnp.int32))
-        _, _, buf, _, _, _ = jax.lax.while_loop(cond, body, init)
-        return buf[:max_new]
+                jnp.asarray(1, jnp.int32), jnp.asarray(0, jnp.int32))
+        _, _, buf, _, _, n, rounds = jax.lax.while_loop(cond, body, init)
+        return buf[:max_new], n, rounds
 
     _spec_cache[key] = decode_all
     return prefill, decode_all

@@ -53,3 +53,50 @@ class TestSpeculative:
         prompt = jnp.zeros((2, 4), jnp.int32)
         with pytest.raises(AssertionError, match="B=1"):
             speculative_generate(tp, dp, prompt, cfg, draft_cfg, 8)
+
+
+class TestSpeculativeSampling:
+    """temperature>0: the Leviathan acceptance must preserve the target
+    distribution exactly."""
+
+    def test_accept_tokens_preserves_target_distribution(self):
+        from thunder_tpu.models.speculative import _accept_tokens
+
+        V, K = 8, 1
+        pk = jax.random.PRNGKey(0)
+        p = jax.nn.softmax(jax.random.normal(pk, (V,)) * 1.5)
+        q = jax.nn.softmax(jax.random.normal(jax.random.fold_in(pk, 1), (V,)) * 1.5)
+        p_all = jnp.stack([p, p])  # (K+1, V); bonus row unused at K=1 reject
+        q_rows = q[None, :]
+
+        @jax.jit
+        def one(seed):
+            kd, ka = jax.random.split(jax.random.PRNGKey(seed))
+            draft = jax.random.categorical(kd, jnp.log(q))[None].astype(jnp.int32)
+            m, y = _accept_tokens(ka, draft, p_all, q_rows)
+            return jnp.where(m > 0, draft[0], y)  # the first emitted token
+
+        toks = jax.vmap(one)(jnp.arange(20000))
+        emp = np.bincount(np.asarray(toks), minlength=V) / 20000.0
+        tv = 0.5 * np.abs(emp - np.asarray(p)).sum()
+        assert tv < 0.02, (tv, emp, np.asarray(p))
+
+    def test_identical_draft_accepts_everything_under_sampling(self):
+        cfg, _, tp, _ = _models()
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 5), 0, cfg.vocab_size)
+        out = speculative_generate(tp, tp, prompt, cfg, cfg, 16, K=4,
+                                   temperature=0.8, key=jax.random.PRNGKey(3),
+                                   cache_dtype=jnp.float32)
+        assert out.shape == (1, 21)
+        # p == q → accept prob 1 → every round emits K+1 tokens
+        assert speculative_generate.last_tokens_per_round == pytest.approx(5.0)
+
+    def test_sampling_varies_with_key_and_stays_in_vocab(self):
+        cfg, draft_cfg, tp, dp = _models()
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 5), 0, cfg.vocab_size)
+        outs = [np.asarray(speculative_generate(
+            tp, dp, prompt, cfg, draft_cfg, 16, K=3, temperature=1.0,
+            key=jax.random.PRNGKey(s), cache_dtype=jnp.float32)) for s in (0, 1)]
+        assert not np.array_equal(outs[0], outs[1])
+        for o in outs:
+            assert (o >= 0).all() and (o < cfg.padded_vocab_size).all()

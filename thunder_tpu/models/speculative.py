@@ -34,51 +34,99 @@ from thunder_tpu.models.llama import Config, build_rope_cache
 __all__ = ["speculative_generate"]
 
 
-def _spec_step(cfg, draft_cfg, cos, sin, cos_d, sin_d, K, quantized):
+def _accept_tokens(key, drafts, p_all, q_rows):
+    """Speculative-sampling acceptance (Leviathan et al.): accept draft i
+    with prob min(1, p_i(x_i)/q_i(x_i)); at the first rejection m resample
+    from the normalized residual max(p_m - q_m, 0); if every draft is
+    accepted (m == K), sample the bonus token from p_K directly.
+
+    drafts (K,) int32; p_all (K+1, V) target probs; q_rows (K, V) draft
+    probs.  Returns (m, y): accepted-prefix length and the resampled/bonus
+    token.  Unit-tested for distribution preservation in
+    tests/test_speculative.py."""
+    K = drafts.shape[0]
+    V = p_all.shape[-1]
+    ku, kr = jax.random.split(key)
+    us = jax.random.uniform(ku, (K,))
+    p_x = jnp.take_along_axis(p_all[:K], drafts[:, None], axis=1)[:, 0]
+    q_x = jnp.take_along_axis(q_rows, drafts[:, None], axis=1)[:, 0]
+    accept = us < jnp.minimum(p_x / jnp.maximum(q_x, 1e-20), 1.0)
+    m = jnp.argmin(jnp.concatenate([accept, jnp.zeros((1,), bool)]).astype(jnp.int32))
+    # residual at the rejection position; q extends with a zero row so the
+    # all-accepted case (m == K) reduces to sampling the bonus from p_K
+    q_ext = jnp.concatenate([q_rows, jnp.zeros((1, V), q_rows.dtype)], axis=0)
+    res = jnp.maximum(p_all[m] - q_ext[m], 0.0)
+    total = jnp.sum(res)
+    # p <= q everywhere yet rejected can only happen through float rounding;
+    # fall back to the target row
+    res = jnp.where(total > 0, res / jnp.maximum(total, 1e-20), p_all[m])
+    y = jax.random.categorical(kr, jnp.log(jnp.maximum(res, 1e-38))).astype(jnp.int32)
+    return m, y
+
+
+def _spec_step(cfg, draft_cfg, cos, sin, cos_d, sin_d, K, quantized, temperature):
     """One speculate/verify iteration (traced inside decode_all's
     while_loop, so no jit of its own)."""
 
-    def step(params, draft_params, tcache, dcache, cur, pos):
+    def step(params, draft_params, tcache, dcache, cur, pos, key):
         # draft K tokens autoregressively (cheap model, small forwards).
         # K+1 scan iterations: the extra one consumes d_K and writes its K/V
         # at pos+K, so a fully-accepted round leaves no never-written hole in
         # the draft cache (a zero-K/V slot would silently steal softmax mass
         # from every later draft forward and decay the acceptance rate)
-        def dbody(carry, _):
+        key, kd = jax.random.split(key)
+
+        def dbody(carry, kk):
             tok, dpos, dc = carry
             dlogits, dc = forward_with_cache(
                 draft_params, tok[:, None], dpos, dc, cos_d, sin_d, draft_cfg,
                 quantized=quantized,
             )
-            nxt = jnp.argmax(dlogits[:, -1], axis=-1).astype(jnp.int32)
-            return (nxt, dpos + 1, dc), nxt
+            row = dlogits[0, -1]
+            if temperature == 0.0:
+                nxt = jnp.argmax(row, axis=-1).astype(jnp.int32)[None]
+                qrow = row  # unused in the greedy path
+            else:
+                # categorical on raw scaled logits == sampling softmax(row/T);
+                # qrow (the same softmax) feeds the min(1, p/q) acceptance
+                qrow = jax.nn.softmax(row / temperature)
+                nxt = jax.random.categorical(kk, row / temperature).astype(jnp.int32)[None]
+            return (nxt, dpos + 1, dc), (nxt[0], qrow)
 
-        (_, _, dcache2), drafts_x = jax.lax.scan(dbody, (cur, pos, dcache), None, length=K + 1)
-        drafts = drafts_x[:K].transpose(1, 0)  # (1, K); the K+1th output is unused
+        dks = jax.random.split(kd, K + 1)
+        (_, _, dcache2), (drafts_x, q_rows_x) = jax.lax.scan(
+            dbody, (cur, pos, dcache), dks)
+        drafts = drafts_x[:K][None, :]  # (1, K); the K+1th output is unused
 
         # verify: one target forward over [cur, d_1..d_K] = K+1 positions
         chunk = jnp.concatenate([cur[:, None], drafts], axis=1)  # (1, K+1)
         tlogits, tcache2 = forward_with_cache(
             params, chunk, pos, tcache, cos, sin, cfg, quantized=quantized,
         )
-        tgt_toks = jnp.argmax(tlogits, axis=-1).astype(jnp.int32)  # (1, K+1)
 
-        # accepted prefix length m = first draft that disagrees with the
-        # target's argmax; all-match → m = K and tgt_toks[K] is a bonus token
-        match = drafts[0] == tgt_toks[0, :K]  # (K,)
-        m = jnp.argmin(jnp.concatenate([match, jnp.zeros((1,), bool)]).astype(jnp.int32))
-        n_emit = m + 1  # accepted drafts + the target's correction/bonus token
+        if temperature == 0.0:
+            tgt_toks = jnp.argmax(tlogits, axis=-1).astype(jnp.int32)  # (1, K+1)
+            # accepted prefix length m = first draft that disagrees with the
+            # target's argmax; all-match → m = K, tgt_toks[K] is a bonus token
+            match = drafts[0] == tgt_toks[0, :K]  # (K,)
+            m = jnp.argmin(jnp.concatenate([match, jnp.zeros((1,), bool)]).astype(jnp.int32))
+            y = tgt_toks[0, m]
+        else:
+            p_all = jax.nn.softmax(tlogits[0] / temperature, axis=-1)  # (K+1, V)
+            key, ka = jax.random.split(key)
+            m, y = _accept_tokens(ka, drafts[0], p_all, q_rows_x[:K])
+        n_emit = m + 1  # accepted drafts + the resampled/correction/bonus token
 
-        # fixed-shape emission: emitted[i] = drafts[i] for i < m, target's
-        # token at i == m, garbage (masked by n_emit) above
+        # fixed-shape emission: emitted[i] = drafts[i] for i < m, y at i == m,
+        # garbage (masked by n_emit) above
         iota = jnp.arange(K + 1)
         emitted = jnp.where(
             iota < m,
             jnp.concatenate([drafts[0], jnp.zeros((1,), jnp.int32)]),
-            tgt_toks[0, m],
+            y,
         )
-        new_cur = tgt_toks[0, m][None]  # next iteration continues from the correction
-        return tcache2, dcache2, emitted, n_emit, new_cur, pos + n_emit
+        new_cur = y[None]  # next iteration continues from the emitted tail token
+        return tcache2, dcache2, emitted, n_emit, new_cur, pos + n_emit, key
 
     return step
 
@@ -93,11 +141,18 @@ def speculative_generate(
     *,
     K: int = 4,
     T_max: int | None = None,
+    temperature: float = 0.0,
+    key=None,
     quantized: bool = False,
     cache_dtype=None,
 ):
-    """Greedy speculative decoding; returns (B=1, T_prompt + max_new_tokens)
-    tokens identical to ``generate(params, ...)`` (temperature=0).
+    """Speculative decoding; returns (B=1, T_prompt + max_new_tokens) tokens.
+
+    ``temperature=0`` (greedy): output is token-identical to
+    ``generate(params, ...)``.  ``temperature>0``: full speculative SAMPLING
+    (Leviathan et al.) — drafts are accepted with prob min(1, p/q) and
+    rejections resample from the normalized residual, so the emitted
+    distribution is exactly the target model's (see ``_accept_tokens``).
 
     ``draft_params``/``draft_cfg``: the small proposal model (must share the
     tokenizer/vocab with the target).
@@ -118,13 +173,16 @@ def speculative_generate(
         "models decode via generate()"
     )
     dtype = cache_dtype if cache_dtype is not None else params["wte"].dtype
+    if key is None:
+        key = jax.random.PRNGKey(0)
     prefill, decode_all = _compiled_speculative(
-        cfg, draft_cfg, T_prompt, max_new_tokens, T_max, K, quantized, str(dtype)
+        cfg, draft_cfg, T_prompt, max_new_tokens, T_max, K, quantized, str(dtype),
+        float(temperature),
     )
 
     tcache = init_cache(cfg, 1, T_max, dtype=dtype)
     dcache = init_cache(draft_cfg, 1, T_max, dtype=dtype)
-    tcache, dcache, cur = prefill(params, draft_params, tcache, dcache, prompt)
+    tcache, dcache, first_logits = prefill(params, draft_params, tcache, dcache, prompt)
     import warnings
 
     with warnings.catch_warnings():
@@ -132,11 +190,11 @@ def speculative_generate(
         # cannot alias an output; donation still frees them for scratch
         # (same pattern and rationale as generate.py's decode loop)
         warnings.filterwarnings("ignore", message="Some donated buffers were not usable")
-        out, n, rounds = decode_all(params, draft_params, tcache, dcache, cur)
-    #: tokens emitted per speculate/verify round of the last call (incl. the
-    #: prefill-seeded first token) — the acceptance diagnostic: K+1 means
-    #: every draft accepted, 1.0 means none were
-    speculative_generate.last_tokens_per_round = float(n) / max(float(rounds), 1.0)
+        out, n, rounds = decode_all(params, draft_params, tcache, dcache, first_logits, key)
+    #: tokens emitted per speculate/verify round of the last call (the
+    #: prefill-seeded first token excluded) — the acceptance diagnostic:
+    #: K+1 means every draft accepted, 1.0 means none were
+    speculative_generate.last_tokens_per_round = float(n - 1) / max(float(rounds), 1.0)
     return jnp.concatenate([prompt, out[None, :]], axis=1)
 
 
@@ -144,7 +202,8 @@ _spec_cache: dict = {}
 _prefill_cache: dict = {}
 
 
-def _compiled_speculative(cfg, draft_cfg, T_prompt, max_new, T_max, K, quantized, dtype_str):
+def _compiled_speculative(cfg, draft_cfg, T_prompt, max_new, T_max, K, quantized, dtype_str,
+                          temperature=0.0):
     """Jitted (prefill, decode_all) pair cached per static configuration —
     params are arguments, so repeated serving calls (and weight updates)
     reuse the compiled programs (the _generate_cache pattern, generate.py).
@@ -162,7 +221,7 @@ def _compiled_speculative(cfg, draft_cfg, T_prompt, max_new, T_max, K, quantized
     # prefill does not depend on max_new: cache it separately so serving
     # callers varying max_new_tokens only recompile the decode loop
     pre_key = (*cfg_key, T_prompt, T_max, K, quantized, dtype_str)
-    key = (*pre_key, max_new)
+    key = (*pre_key, max_new, temperature)
     cached = _spec_cache.get(key)
     prefill = _prefill_cache.get(pre_key)
     if cached is not None and prefill is not None:
@@ -178,19 +237,27 @@ def _compiled_speculative(cfg, draft_cfg, T_prompt, max_new, T_max, K, quantized
     if prefill is None:
         @partial(jax.jit, donate_argnums=(2, 3))
         def prefill(params, draft_params, tcache, dcache, prompt):
+            # returns the last-position target logits so decode_all can draw
+            # the FIRST token in its own mode (argmax vs sample) — a greedy
+            # seed under temperature>0 would break distribution preservation
+            # at position 0
             tlogits, tcache = forward_with_cache(
                 params, prompt, 0, tcache, cos, sin, cfg, quantized=quantized)
             _, dcache = forward_with_cache(
                 draft_params, prompt, 0, dcache, cos_d, sin_d, draft_cfg, quantized=quantized)
-            first = jnp.argmax(tlogits[:, -1], axis=-1).astype(jnp.int32)
-            return tcache, dcache, first
+            return tcache, dcache, tlogits[:, -1]
 
         _prefill_cache[pre_key] = prefill
 
-    step = _spec_step(cfg, draft_cfg, cos, sin, cos_d, sin_d, K, quantized)
+    step = _spec_step(cfg, draft_cfg, cos, sin, cos_d, sin_d, K, quantized, temperature)
 
     @partial(jax.jit, donate_argnums=(2, 3))
-    def decode_all(params, draft_params, tcache, dcache, first):
+    def decode_all(params, draft_params, tcache, dcache, first_logits, rng):
+        rng, kf = jax.random.split(rng)
+        if temperature == 0.0:
+            first = jnp.argmax(first_logits, axis=-1).astype(jnp.int32)
+        else:
+            first = jax.random.categorical(kf, first_logits / temperature, axis=-1).astype(jnp.int32)
         # buffer holds the worst-case overshoot of the final round; each
         # round writes K+1 slots at offset n and only advances n by n_emit,
         # so the next round's write overwrites the round's garbage tail
@@ -200,15 +267,15 @@ def _compiled_speculative(cfg, draft_cfg, T_prompt, max_new, T_max, K, quantized
             return state[5] < max_new
 
         def body(state):
-            tcache, dcache, buf, cur, pos, n, rounds = state
-            tcache, dcache, emitted, n_emit, cur, pos = step(
-                params, draft_params, tcache, dcache, cur, pos)
+            tcache, dcache, buf, cur, pos, n, rounds, rng = state
+            tcache, dcache, emitted, n_emit, cur, pos, rng = step(
+                params, draft_params, tcache, dcache, cur, pos, rng)
             buf = jax.lax.dynamic_update_slice(buf, emitted, (n,))
-            return (tcache, dcache, buf, cur, pos, n + n_emit, rounds + 1)
+            return (tcache, dcache, buf, cur, pos, n + n_emit, rounds + 1, rng)
 
         init = (tcache, dcache, buf, first, jnp.asarray(T_prompt, jnp.int32),
-                jnp.asarray(1, jnp.int32), jnp.asarray(0, jnp.int32))
-        _, _, buf, _, _, n, rounds = jax.lax.while_loop(cond, body, init)
+                jnp.asarray(1, jnp.int32), jnp.asarray(0, jnp.int32), rng)
+        _, _, buf, _, _, n, rounds, _ = jax.lax.while_loop(cond, body, init)
         return buf[:max_new], n, rounds
 
     _spec_cache[key] = decode_all

@@ -1054,6 +1054,98 @@ class TestRunLogAndLookasides:
         np.testing.assert_allclose(np.asarray(out), np.log1p(np.exp(xv)), rtol=1e-5)
 
 
+class TestExceptionGroups:
+    """except* / ExceptionGroup (PEP 654) — CHECK_EG_MATCH splits groups,
+    PREP_RERAISE_STAR recombines unmatched parts."""
+
+    def test_except_star_splits_by_type(self):
+        def f():
+            hits = []
+            try:
+                raise ExceptionGroup("g", [ValueError("a"), TypeError("b"), ValueError("c")])
+            except* ValueError as e:
+                hits.append(("V", sorted(str(x) for x in e.exceptions)))
+            except* TypeError as e:
+                hits.append(("T", [str(x) for x in e.exceptions]))
+            return hits
+
+        res, _ = interpret(f)
+        assert res == [("V", ["a", "c"]), ("T", ["b"])]
+
+    def test_except_star_unmatched_rest_reraises(self):
+        def f():
+            try:
+                try:
+                    raise ExceptionGroup("g", [ValueError("a"), KeyError("k")])
+                except* ValueError:
+                    pass
+            except BaseException as e:
+                return (type(e).__name__, [type(x).__name__ for x in e.exceptions])
+            return "swallowed"
+
+        res, _ = interpret(f)
+        assert res == ("ExceptionGroup", ["KeyError"])
+
+    def test_except_star_naked_exception_wrapped(self):
+        def f():
+            out = None
+            try:
+                raise ValueError("naked")
+            except* ValueError as e:
+                out = (type(e).__name__, [str(x) for x in e.exceptions])
+            return out
+
+        res, _ = interpret(f)
+        assert res == ("ExceptionGroup", ["naked"])
+
+    def test_except_star_handler_raise_groups_with_rest(self):
+        def f():
+            try:
+                try:
+                    raise ExceptionGroup("g", [ValueError("a"), KeyError("k")])
+                except* ValueError:
+                    raise RuntimeError("from handler")
+            except BaseException as e:
+                kinds = sorted(type(x).__name__ for x in e.exceptions)
+                return (type(e).__name__, kinds)
+
+        res, _ = interpret(f)
+        assert res[0] == "ExceptionGroup"
+        assert "RuntimeError" in res[1] and any("KeyError" in k or "ExceptionGroup" in k for k in res[1])
+
+    def test_except_star_exceptiongroup_type_rejected(self):
+        def f():
+            try:
+                raise ExceptionGroup("g", [ValueError("a")])
+            except* ExceptionGroup:
+                pass
+
+        with pytest.raises(TypeError, match="not allowed"):
+            interpret(f)
+
+    def test_pep695_generic_function_and_alias(self):
+        def f(x):
+            def ident[T](v: T) -> T:
+                return v
+
+            type Pair[U] = tuple[U, U]
+            return (ident(x), ident.__type_params__[0].__name__, Pair.__name__)
+
+        res, _ = interpret(f, 41)
+        assert res == (41, "T", "Pair")
+
+    def test_fully_handled_group_continues(self):
+        def f():
+            try:
+                raise ExceptionGroup("g", [ValueError("a")])
+            except* ValueError:
+                pass
+            return "done"
+
+        res, _ = interpret(f)
+        assert res == "done"
+
+
 class TestAsync:
     """Coroutines / async generators in the interpreter (closes the last
     documented interpreter gap; the reference's 3.10/3.11 interpreter reaches

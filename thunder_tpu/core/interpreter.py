@@ -1566,6 +1566,28 @@ def _call_intrinsic_1(frame, ins, i):
             frame.push(v)
     elif ins.arg == 4:  # ASYNC_GEN_WRAP: tag a ``yield`` in an async generator
         frame.push(_AsyncGenWrapped(v))
+    # PEP 695 generic syntax (def f[T](...), type Alias[U] = ...)
+    elif ins.arg == 7:  # TYPEVAR
+        import typing
+
+        frame.push(typing.TypeVar(v))
+    elif ins.arg == 8:  # PARAMSPEC
+        import typing
+
+        frame.push(typing.ParamSpec(v))
+    elif ins.arg == 9:  # TYPEVARTUPLE
+        import typing
+
+        frame.push(typing.TypeVarTuple(v))
+    elif ins.arg == 10:  # SUBSCRIPT_GENERIC
+        import typing
+
+        frame.push(typing.Generic[v])
+    elif ins.arg == 11:  # TYPEALIAS: (name, type_params, value)
+        import typing
+
+        name, type_params, value = v
+        frame.push(typing.TypeAliasType(name, value, type_params=type_params or ()))
     else:
         raise InterpreterError(f"CALL_INTRINSIC_1 {ins.arg} is not supported")
 
@@ -1576,6 +1598,71 @@ def _load_build_class(frame, ins, i):
     # host builtin runs the MAKE_FUNCTION-synthesized body (a real function
     # over the original code object), so class creation is CPython-exact
     frame.push(_builtins.__build_class__)
+
+
+@register_opcode_handler("CHECK_EG_MATCH")
+def _check_eg_match(frame, ins, i):
+    # except* matching (PEP 654): pop match_type and the active exception,
+    # push (rest, match).  Group exceptions split; a naked exception that
+    # matches is wrapped into a group for the handler (CPython
+    # exception_group_match semantics)
+    typ = frame.pop()
+    exc = frame.pop()
+    for t in (typ if isinstance(typ, tuple) else (typ,)):
+        if isinstance(t, type) and issubclass(t, BaseExceptionGroup):
+            raise TypeError(
+                "catching ExceptionGroup with except* is not allowed. Use except instead."
+            )
+    if isinstance(exc, BaseExceptionGroup):
+        match, rest = exc.split(typ)
+    elif isinstance(exc, typ if isinstance(typ, tuple) else (typ,)):
+        wrap = ExceptionGroup if isinstance(exc, Exception) else BaseExceptionGroup
+        match, rest = wrap("", [exc]), None
+    else:
+        match, rest = None, exc
+    frame.push(rest)
+    frame.push(match)
+
+
+def _prep_reraise_star(orig: BaseException, excs: list):
+    """CALL_INTRINSIC_2 INTRINSIC_PREP_RERAISE_STAR: combine the unmatched
+    rest subgroups and handler-raised exceptions into the exception to
+    re-raise after an except* chain (None = fully handled).  Metadata
+    (cause/context/traceback) carries over from the original exception."""
+    res = [e for e in excs if e is not None]
+    if not res:
+        return None
+    if len(res) == 1:
+        out = res[0]
+    else:
+        wrap = ExceptionGroup if all(isinstance(e, Exception) for e in res) else BaseExceptionGroup
+        out = wrap("", res)
+        out.__cause__ = orig.__cause__
+        out.__context__ = orig.__context__
+    if out.__traceback__ is None:
+        out.__traceback__ = orig.__traceback__
+    return out
+
+
+@register_opcode_handler("CALL_INTRINSIC_2")
+def _call_intrinsic_2(frame, ins, i):
+    b = frame.pop()
+    a = frame.pop()
+    if ins.arg == 1:  # PREP_RERAISE_STAR(orig, excs_list)
+        frame.push(_prep_reraise_star(a, b))
+    elif ins.arg == 2:  # TYPEVAR_WITH_BOUND(name, bound)
+        import typing
+
+        frame.push(typing.TypeVar(a, bound=b))
+    elif ins.arg == 3:  # TYPEVAR_WITH_CONSTRAINTS(name, constraints)
+        import typing
+
+        frame.push(typing.TypeVar(a, *b))
+    elif ins.arg == 4:  # SET_FUNCTION_TYPE_PARAMS(fn, type_params)
+        a.__type_params__ = b
+        frame.push(a)
+    else:
+        raise InterpreterError(f"CALL_INTRINSIC_2 {ins.arg} is not supported")
 
 
 @register_opcode_handler("MAKE_FUNCTION")

@@ -16,8 +16,12 @@ for i in $(seq 1 400); do
     echo "$(date -u +%T) config_sweep rc=$?" >> "$LOG/queue.log"
     timeout 2400 python bench.py decode > "$LOG/decode.json" 2> "$LOG/decode.log"
     echo "$(date -u +%T) decode rc=$?" >> "$LOG/queue.log"
-    timeout 2400 python bench.py > BENCH_TPU.json 2> "$LOG/headline.log" && cp BENCH_TPU.json BENCH_r03_tpu.json
-    echo "$(date -u +%T) headline rc=$?" >> "$LOG/queue.log"
+    timeout 2400 python bench.py > "$LOG/headline.json.tmp" 2> "$LOG/headline.log"
+    hrc=$?
+    if [ $hrc -eq 0 ] && grep -q tokens "$LOG/headline.json.tmp"; then
+      mv "$LOG/headline.json.tmp" BENCH_TPU.json && cp BENCH_TPU.json BENCH_r03_tpu.json
+    fi
+    echo "$(date -u +%T) headline rc=$hrc" >> "$LOG/queue.log"
     timeout 2400 python bench.py sweep > "$LOG/sweep.log" 2>&1
     echo "$(date -u +%T) sweep rc=$? (BENCH_MICRO.json refreshed)" >> "$LOG/queue.log"
     echo "$(date -u +%T) queue done" >> "$LOG/queue.log"

@@ -1566,11 +1566,14 @@ def _call_intrinsic_1(frame, ins, i):
             frame.push(v)
     elif ins.arg == 4:  # ASYNC_GEN_WRAP: tag a ``yield`` in an async generator
         frame.push(_AsyncGenWrapped(v))
-    # PEP 695 generic syntax (def f[T](...), type Alias[U] = ...)
+    # PEP 695 generic syntax (def f[T](...), type Alias[U] = ...).  The
+    # compiler passes lazy compute-functions for bounds/constraints/alias
+    # values; the interpreter evaluates them eagerly (it does not model
+    # CPython's deferred evaluation)
     elif ins.arg == 7:  # TYPEVAR
         import typing
 
-        frame.push(typing.TypeVar(v))
+        frame.push(typing.TypeVar(v, infer_variance=True))
     elif ins.arg == 8:  # PARAMSPEC
         import typing
 
@@ -1583,10 +1586,12 @@ def _call_intrinsic_1(frame, ins, i):
         import typing
 
         frame.push(typing.Generic[v])
-    elif ins.arg == 11:  # TYPEALIAS: (name, type_params, value)
+    elif ins.arg == 11:  # TYPEALIAS: (name, type_params, value-or-compute-fn)
         import typing
 
         name, type_params, value = v
+        if callable(value) and not isinstance(value, type):
+            value = value()
         frame.push(typing.TypeAliasType(name, value, type_params=type_params or ()))
     else:
         raise InterpreterError(f"CALL_INTRINSIC_1 {ins.arg} is not supported")
@@ -1650,14 +1655,18 @@ def _call_intrinsic_2(frame, ins, i):
     a = frame.pop()
     if ins.arg == 1:  # PREP_RERAISE_STAR(orig, excs_list)
         frame.push(_prep_reraise_star(a, b))
-    elif ins.arg == 2:  # TYPEVAR_WITH_BOUND(name, bound)
+    elif ins.arg == 2:  # TYPEVAR_WITH_BOUND(name, bound-or-compute-fn)
         import typing
 
-        frame.push(typing.TypeVar(a, bound=b))
-    elif ins.arg == 3:  # TYPEVAR_WITH_CONSTRAINTS(name, constraints)
+        if callable(b) and not isinstance(b, type):
+            b = b()
+        frame.push(typing.TypeVar(a, bound=b, infer_variance=True))
+    elif ins.arg == 3:  # TYPEVAR_WITH_CONSTRAINTS(name, constraints-or-compute-fn)
         import typing
 
-        frame.push(typing.TypeVar(a, *b))
+        if callable(b) and not isinstance(b, tuple):
+            b = b()
+        frame.push(typing.TypeVar(a, *b, infer_variance=True))
     elif ins.arg == 4:  # SET_FUNCTION_TYPE_PARAMS(fn, type_params)
         a.__type_params__ = b
         frame.push(a)

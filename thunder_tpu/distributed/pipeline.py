@@ -221,6 +221,10 @@ def pp_gpt_loss(
         out, _ = jax.lax.scan(layer, xb, blocks_loc)
         return out
 
+    assert not config.bias, (
+        "pp_gpt_loss does not thread bias parameters yet; bias=True models "
+        "train via TrainStep modes"
+    )
     y = gpipe(stage_fn, params["blocks"], mbs, cos, sin, mesh=mesh, axis=axis)
     x = y.reshape(B, T, -1)
 

@@ -40,7 +40,8 @@ def _sp_attention(ap, x, cos_b, sin_b, cfg, *, axis: str, sp: int):
     # buffers and expands per block-attend step (ring_attend_shard)
     y = ring_attend_shard(q, k, v, axis=axis, sp=sp, causal=True)
     y = y.transpose(0, 2, 1, 3).reshape(B, T_loc, cfg.n_head * cfg.head_size)
-    return y @ ap["wo"].T
+    out = y @ ap["wo"].T
+    return out if "bo" not in ap else out + ap["bo"]
 
 
 def seq_parallel_gpt_loss(params, idx, targets, cos, sin, cfg, *, mesh: Mesh,
@@ -61,17 +62,19 @@ def seq_parallel_gpt_loss(params, idx, targets, cos, sin, cfg, *, mesh: Mesh,
     def body(params, idx_b, tgt_b, cos_b, sin_b):
         x = params["wte"][idx_b]  # (B, T_loc, C) — embedding lookup is local
         for bp in params["blocks"]:
-            n1 = _norm(x, bp["norm_1"], cfg)
+            n1 = _norm(x, bp["norm_1"], cfg, bp.get("norm_1_b"))
             h = attend_fn(bp["attn"], n1, cos_b, sin_b, cfg, axis=axis, sp=sp)
             if cfg.parallel_residual:
-                n2 = n1 if cfg.shared_attention_norm else _norm(x, bp["norm_2"], cfg)
+                n2 = n1 if cfg.shared_attention_norm else _norm(x, bp["norm_2"], cfg, bp.get("norm_2_b"))
                 x = x + h + _mlp(bp["mlp"], n2, cfg)
             else:
                 x = x + h
-                x = x + _mlp(bp["mlp"], _norm(x, bp["norm_2"], cfg), cfg)
-        x = _norm(x, params["ln_f"], cfg)
+                x = x + _mlp(bp["mlp"], _norm(x, bp["norm_2"], cfg, bp.get("norm_2_b")), cfg)
+        x = _norm(x, params["ln_f"], cfg, params.get("ln_f_b"))
         head = params["wte"] if cfg.tie_embeddings else params["lm_head"]
         logits = (x @ head.T).astype(jnp.float32)
+        if "lm_head_b" in params:
+            logits = logits + params["lm_head_b"].astype(jnp.float32)
         V = logits.shape[-1]
         logp = jax.nn.log_softmax(logits.reshape(-1, V), axis=-1)
         local = -jnp.take_along_axis(logp, tgt_b.reshape(-1, 1), axis=1).sum()

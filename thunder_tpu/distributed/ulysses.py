@@ -78,7 +78,8 @@ def _ulysses_attention(ap, x, cos_b, sin_b, cfg, *, axis: str, sp: int):
     q, k, v = _project_qkv(ap, x, cos_b, sin_b, cfg)
     y = ulysses_attend_shard(q, k, v, axis=axis, sp=sp, causal=True)
     y = y.transpose(0, 2, 1, 3).reshape(B, T_loc, cfg.n_head * cfg.head_size)
-    return y @ ap["wo"].T
+    out = y @ ap["wo"].T
+    return out if "bo" not in ap else out + ap["bo"]
 
 
 def ulysses_gpt_loss(params, idx, targets, cos, sin, cfg, *, mesh: Mesh, axis: str = "sp"):

@@ -37,15 +37,17 @@ from thunder_tpu.models.llama import Config, build_rope_cache
 __all__ = ["init_cache", "forward_with_cache", "generate"]
 
 
-def _linear(x, w, *, quantized=False):
+def _linear(x, w, b=None, *, quantized=False):
     if quantized:
         from thunder_tpu.executors.quantex import int8_linear
 
-        return int8_linear(x, w)
-    return x @ w.T
+        out = int8_linear(x, w)
+    else:
+        out = x @ w.T
+    return out if b is None else out + b
 
 
-def _norm(x, w, cfg: Config):
+def _norm(x, w, cfg: Config, b=None):
     xf = x.astype(jnp.float32)
     if cfg.norm_class == "RMSNorm":
         ms = jnp.mean(xf * xf, axis=-1, keepdims=True)
@@ -54,7 +56,10 @@ def _norm(x, w, cfg: Config):
         mu = jnp.mean(xf, axis=-1, keepdims=True)
         var = jnp.var(xf, axis=-1, keepdims=True)
         xf = (xf - mu) * jax.lax.rsqrt(var + cfg.norm_eps)
-    return (xf * w.astype(jnp.float32)).astype(x.dtype)
+    out = xf * w.astype(jnp.float32)
+    if b is not None:
+        out = out + b.astype(jnp.float32)
+    return out.astype(x.dtype)
 
 
 def _rope(x, cos, sin):
@@ -79,8 +84,14 @@ def _mlp(mp, x, cfg: Config, *, quantized=False):
             y = contrib if y is None else y + contrib
         return y
     if cfg.mlp_class == "LLaMAMLP":
-        return lin(jax.nn.silu(lin(x, mp["fc_1"])) * lin(x, mp["fc_2"]), mp["proj"])
-    return lin(jax.nn.gelu(lin(x, mp["fc"]), approximate=False), mp["proj"])
+        return lin(
+            jax.nn.silu(lin(x, mp["fc_1"], mp.get("fc_1_b"))) * lin(x, mp["fc_2"], mp.get("fc_2_b")),
+            mp["proj"], mp.get("proj_b"),
+        )
+    return lin(
+        jax.nn.gelu(lin(x, mp["fc"], mp.get("fc_b")), approximate=cfg.gelu_approximate == "tanh"),
+        mp["proj"], mp.get("proj_b"),
+    )
 
 
 def _project_qkv(ap, x, cos_t, sin_t, cfg: Config, *, lin=None):
@@ -91,9 +102,9 @@ def _project_qkv(ap, x, cos_t, sin_t, cfg: Config, *, lin=None):
         lin = _linear
     B, T, C = x.shape
     hs, nh, ng = cfg.head_size, cfg.n_head, cfg.n_query_groups
-    q = lin(x, ap["wq"]).reshape(B, T, nh, hs).transpose(0, 2, 1, 3)
-    k = lin(x, ap["wk"]).reshape(B, T, ng, hs).transpose(0, 2, 1, 3)
-    v = lin(x, ap["wv"]).reshape(B, T, ng, hs).transpose(0, 2, 1, 3)
+    q = lin(x, ap["wq"], ap.get("bq")).reshape(B, T, nh, hs).transpose(0, 2, 1, 3)
+    k = lin(x, ap["wk"], ap.get("bk")).reshape(B, T, ng, hs).transpose(0, 2, 1, 3)
+    v = lin(x, ap["wv"], ap.get("bv")).reshape(B, T, ng, hs).transpose(0, 2, 1, 3)
     n_elem = cfg.rope_n_elem
     if n_elem > 0:
         q_r = _rope(q[..., :n_elem], cos_t, sin_t)
@@ -213,7 +224,7 @@ def _attn_with_cache(ap, x, cos_t, sin_t, ck, cv, pos, cfg: Config, *, quantized
     w = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     y = jnp.einsum("bhqk,bhkd->bhqd", w, vv.astype(q.dtype))
     y = y.transpose(0, 2, 1, 3).reshape(B, T, nh * hs)
-    return lin(y, ap["wo"]), ck, cv
+    return lin(y, ap["wo"], ap.get("bo")), ck, cv
 
 
 def forward_with_cache(params, idx, pos, cache, cos_all, sin_all, cfg: Config, *, quantized=False):
@@ -228,7 +239,7 @@ def forward_with_cache(params, idx, pos, cache, cos_all, sin_all, cfg: Config, *
 
     new_k, new_v = [], []
     for l, bp in enumerate(params["blocks"]):
-        n1 = _norm(x, bp["norm_1"], cfg)
+        n1 = _norm(x, bp["norm_1"], cfg, bp.get("norm_1_b"))
         h, ck, cv = _attn_with_cache(
             bp["attn"], n1, cos_t, sin_t, cache["k"][l], cache["v"][l], pos, cfg,
             quantized=quantized,
@@ -236,16 +247,16 @@ def forward_with_cache(params, idx, pos, cache, cos_all, sin_all, cfg: Config, *
         new_k.append(ck)
         new_v.append(cv)
         if cfg.parallel_residual:
-            n2 = n1 if cfg.shared_attention_norm else _norm(x, bp["norm_2"], cfg)
+            n2 = n1 if cfg.shared_attention_norm else _norm(x, bp["norm_2"], cfg, bp.get("norm_2_b"))
             x = x + h + _mlp(bp["mlp"], n2, cfg, quantized=quantized)
         else:
             x = x + h
-            x = x + _mlp(bp["mlp"], _norm(x, bp["norm_2"], cfg), cfg, quantized=quantized)
+            x = x + _mlp(bp["mlp"], _norm(x, bp["norm_2"], cfg, bp.get("norm_2_b")), cfg, quantized=quantized)
 
     cache = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
-    x = _norm(x, params["ln_f"], cfg)
+    x = _norm(x, params["ln_f"], cfg, params.get("ln_f_b"))
     head = params["wte"] if cfg.tie_embeddings else params["lm_head"]
-    logits = (_linear(x, head, quantized=quantized)).astype(jnp.float32)
+    logits = (_linear(x, head, params.get("lm_head_b"), quantized=quantized)).astype(jnp.float32)
     return logits, cache
 
 

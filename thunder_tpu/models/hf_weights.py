@@ -25,14 +25,17 @@ import numpy as np
 
 from thunder_tpu.models.llama import Config
 
-__all__ = ["config_from_hf", "from_hf_state_dict"]
+__all__ = ["config_from_hf", "from_hf_state_dict", "from_gpt2_state_dict"]
 
 
 def config_from_hf(hf_config: Any, **overrides) -> Config:
-    """Builds a :class:`Config` from a HF ``LlamaConfig``/``MistralConfig``."""
+    """Builds a :class:`Config` from a HF ``LlamaConfig``/``MistralConfig``/
+    ``GPT2Config``."""
     mt = getattr(hf_config, "model_type", "llama")
+    if mt == "gpt2":
+        return _gpt2_config(hf_config, overrides)
     if mt not in ("llama", "mistral"):
-        raise ValueError(f"unsupported HF model_type {mt!r} (llama/mistral family only)")
+        raise ValueError(f"unsupported HF model_type {mt!r} (llama/mistral/gpt2 family only)")
     # reject config knobs the functional model does not implement — silent
     # acceptance would convert cleanly and return wrong logits
     scaling = getattr(hf_config, "rope_scaling", None)
@@ -79,6 +82,90 @@ def config_from_hf(hf_config: Any, **overrides) -> Config:
     )
     kw.update(overrides)
     return Config(**kw)
+
+
+def _gpt2_config(hf_config: Any, overrides: dict) -> Config:
+    act = getattr(hf_config, "activation_function", "gelu_new")
+    if act not in ("gelu_new", "gelu", "gelu_pytorch_tanh"):
+        raise ValueError(f"unsupported GPT-2 activation {act!r}")
+    # logit-changing attention variants the functional model does not
+    # implement: silent acceptance would convert cleanly and be wrong
+    if getattr(hf_config, "scale_attn_by_inverse_layer_idx", False):
+        raise ValueError("unsupported GPT2Config scale_attn_by_inverse_layer_idx=True")
+    if not getattr(hf_config, "scale_attn_weights", True):
+        raise ValueError("unsupported GPT2Config scale_attn_weights=False")
+    if getattr(hf_config, "add_cross_attention", False):
+        raise ValueError("unsupported GPT2Config add_cross_attention=True")
+    if getattr(hf_config, "reorder_and_upcast_attn", False):
+        raise ValueError("unsupported GPT2Config reorder_and_upcast_attn=True")
+    kw = dict(
+        name="hf-gpt2",
+        block_size=int(hf_config.n_positions),
+        vocab_size=int(hf_config.vocab_size),
+        padded_vocab_size=int(hf_config.vocab_size),
+        n_layer=int(hf_config.n_layer),
+        n_head=int(hf_config.n_head),
+        n_embd=int(hf_config.n_embd),
+        intermediate_size=int(getattr(hf_config, "n_inner", None) or 4 * hf_config.n_embd),
+        norm_eps=float(getattr(hf_config, "layer_norm_epsilon", 1e-5)),
+        rotary_percentage=0.0,
+        learned_pos_embedding=True,
+        norm_class="LayerNorm",
+        mlp_class="GptNeoxMLP",
+        tie_embeddings=True,
+        bias=True,
+        gelu_approximate="none" if act == "gelu" else "tanh",
+    )
+    kw.update(overrides)
+    return Config(**kw)
+
+
+def from_gpt2_state_dict(sd: Mapping[str, Any], cfg: Config, dtype=jnp.bfloat16) -> dict:
+    """Converts a HF ``GPT2LMHeadModel`` state dict.  GPT-2 stores Conv1D
+    weights as (in, out) — transposed vs nn.Linear — and packs q/k/v into one
+    ``c_attn``; both are undone here."""
+
+    def get(name: str) -> np.ndarray:
+        for k in (name, f"transformer.{name}"):
+            if k in sd:
+                return _to_np(sd[k])
+        raise KeyError(f"GPT-2 checkpoint is missing {name!r}")
+
+    C = cfg.n_embd
+    params: dict = {
+        "wte": jnp.asarray(_pad_vocab(get("wte.weight"), cfg.padded_vocab_size), dtype),
+        "wpe": jnp.asarray(get("wpe.weight"), dtype),
+        "ln_f": jnp.asarray(get("ln_f.weight"), dtype),
+        "ln_f_b": jnp.asarray(get("ln_f.bias"), dtype),
+        "blocks": [],
+    }
+    for i in range(cfg.n_layer):
+        p = f"h.{i}."
+        ca_w = get(p + "attn.c_attn.weight").T  # (3C, C)
+        ca_b = get(p + "attn.c_attn.bias")  # (3C,)
+        params["blocks"].append({
+            "norm_1": jnp.asarray(get(p + "ln_1.weight"), dtype),
+            "norm_1_b": jnp.asarray(get(p + "ln_1.bias"), dtype),
+            "attn": {
+                "wq": jnp.asarray(ca_w[:C], dtype),
+                "wk": jnp.asarray(ca_w[C:2 * C], dtype),
+                "wv": jnp.asarray(ca_w[2 * C:], dtype),
+                "bq": jnp.asarray(ca_b[:C], dtype),
+                "bk": jnp.asarray(ca_b[C:2 * C], dtype),
+                "bv": jnp.asarray(ca_b[2 * C:], dtype),
+                "wo": jnp.asarray(get(p + "attn.c_proj.weight").T, dtype),
+                "bo": jnp.asarray(get(p + "attn.c_proj.bias"), dtype),
+            },
+            "norm_2": jnp.asarray(get(p + "ln_2.weight"), dtype),
+            "norm_2_b": jnp.asarray(get(p + "ln_2.bias"), dtype),
+            "mlp": {
+                "fc": jnp.asarray(get(p + "mlp.c_fc.weight").T, dtype),
+                "fc_b": jnp.asarray(get(p + "mlp.c_fc.bias"), dtype),
+                "proj": jnp.asarray(get(p + "mlp.c_proj.weight").T, dtype),
+                "proj_b": jnp.asarray(get(p + "mlp.c_proj.bias"), dtype),
+            },
+        })
+    return params
 
 
 def _to_np(t) -> np.ndarray:

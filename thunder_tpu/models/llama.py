@@ -88,6 +88,9 @@ class Config:
     # logits in HBM; Liger-class fused_linear_cross_entropy).  Off by default
     # pending an on-TPU A/B against the XLA-fused plain path
     fused_head_ce: bool = False
+    # GPT-2 uses the tanh gelu approximation ("gelu_new"); torch/our default
+    # is the exact erf form
+    gelu_approximate: str = "none"
 
     def __post_init__(self):
         if isinstance(self.rope_scaling_llama3, dict):
@@ -106,6 +109,9 @@ class Config:
         if self.mlp_class == "LLaMAMoE":
             assert self.n_expert > 0, "LLaMAMoE requires n_expert > 0"
             assert 0 < self.n_expert_per_token <= self.n_expert
+            assert not self.bias, "bias is not supported for the MoE MLP"
+        if self.bias:
+            assert self.norm_class == "LayerNorm", "bias implies LayerNorm (GPT-2/NeoX style)"
 
     @property
     def rope_n_elem(self) -> int:
@@ -205,12 +211,19 @@ def init_params(config: Config, key: jax.Array | None = None, dtype=jnp.bfloat16
     n_keys = 3 + config.n_layer * (5 + 3 * max(1, config.n_expert))
     keys = iter(jax.random.split(key, n_keys))
 
+    def zeros(n):
+        return jnp.zeros((n,), dtype=dtype)
+
     params: dict[str, Any] = {
         "wte": (jax.random.normal(next(keys), (config.padded_vocab_size, config.n_embd),
                                   dtype=jnp.float32) * std).astype(dtype),
         "blocks": [],
         "ln_f": jnp.ones((config.n_embd,), dtype=dtype),
     }
+    if config.bias:
+        params["ln_f_b"] = zeros(config.n_embd)
+    if config.lm_head_bias:
+        params["lm_head_b"] = zeros(config.padded_vocab_size)
     if not config.tie_embeddings:
         params["lm_head"] = dense(next(keys), config.n_embd, config.padded_vocab_size)
     if config.learned_pos_embedding:
@@ -227,8 +240,15 @@ def init_params(config: Config, key: jax.Array | None = None, dtype=jnp.bfloat16
                 "wo": dense(next(keys), nh * hs, config.n_embd),
             },
         }
+        if config.bias:
+            block["norm_1_b"] = zeros(config.n_embd)
+            block["attn"].update(
+                bq=zeros(nh * hs), bk=zeros(ng * hs), bv=zeros(ng * hs), bo=zeros(config.n_embd)
+            )
         if not config.shared_attention_norm:
             block["norm_2"] = jnp.ones((config.n_embd,), dtype=dtype)
+            if config.bias:
+                block["norm_2_b"] = zeros(config.n_embd)
         if config.mlp_class == "LLaMAMoE":
             # experts stacked on a leading E dim: one array per weight kind, so
             # expert parallelism is a dim-0 sharding and the per-expert slices
@@ -251,11 +271,21 @@ def init_params(config: Config, key: jax.Array | None = None, dtype=jnp.bfloat16
                 "fc_2": dense(next(keys), config.n_embd, config.intermediate_size),
                 "proj": dense(next(keys), config.intermediate_size, config.n_embd),
             }
+            if config.bias:
+                block["mlp"].update(
+                    fc_1_b=zeros(config.intermediate_size),
+                    fc_2_b=zeros(config.intermediate_size),
+                    proj_b=zeros(config.n_embd),
+                )
         else:  # GptNeoxMLP
             block["mlp"] = {
                 "fc": dense(next(keys), config.n_embd, config.intermediate_size),
                 "proj": dense(next(keys), config.intermediate_size, config.n_embd),
             }
+            if config.bias:
+                block["mlp"].update(
+                    fc_b=zeros(config.intermediate_size), proj_b=zeros(config.n_embd)
+                )
         params["blocks"].append(block)
     return params
 
@@ -321,18 +351,18 @@ def apply_rope(x, cos, sin):
     return roped.to(x.dtype)
 
 
-def _norm(x, weight, config: Config):
+def _norm(x, weight, config: Config, bias=None):
     if config.norm_class == "RMSNorm":
         return ltorch.rms_norm(x, (config.n_embd,), weight, eps=config.norm_eps)
-    return ltorch.layer_norm(x, (config.n_embd,), weight, None, eps=config.norm_eps)
+    return ltorch.layer_norm(x, (config.n_embd,), weight, bias, eps=config.norm_eps)
 
 
 def attention(ap, x, cos, sin, config: Config):
     B, T, C = x.shape
     hs, nh, ng = config.head_size, config.n_head, config.n_query_groups
-    q = ltorch.linear(x, ap["wq"])  # (B, T, nh*hs)
-    k = ltorch.linear(x, ap["wk"])  # (B, T, ng*hs)
-    v = ltorch.linear(x, ap["wv"])
+    q = ltorch.linear(x, ap["wq"], ap.get("bq"))  # (B, T, nh*hs)
+    k = ltorch.linear(x, ap["wk"], ap.get("bk"))  # (B, T, ng*hs)
+    v = ltorch.linear(x, ap["wv"], ap.get("bv"))
 
     q = q.reshape(B, T, nh, hs).permute(0, 2, 1, 3)  # (B, nh, T, hs)
     k = k.reshape(B, T, ng, hs).permute(0, 2, 1, 3)  # (B, ng, T, hs)
@@ -355,7 +385,7 @@ def attention(ap, x, cos, sin, config: Config):
         q, k, v, is_causal=True, sliding_window=config.sliding_window
     )  # (B, nh, T, hs)
     y = y.permute(0, 2, 1, 3).reshape(B, T, nh * hs)
-    return ltorch.linear(y, ap["wo"])
+    return ltorch.linear(y, ap["wo"], ap.get("bo"))
 
 
 def moe_mlp(mp, x, config: Config):
@@ -389,18 +419,25 @@ def mlp(mp, x, config: Config):
     if config.mlp_class == "LLaMAMoE":
         return moe_mlp(mp, x, config)
     if config.mlp_class == "LLaMAMLP":
-        return ltorch.linear(ltorch.silu(ltorch.linear(x, mp["fc_1"])) * ltorch.linear(x, mp["fc_2"]), mp["proj"])
-    return ltorch.linear(ltorch.gelu(ltorch.linear(x, mp["fc"])), mp["proj"])
+        return ltorch.linear(
+            ltorch.silu(ltorch.linear(x, mp["fc_1"], mp.get("fc_1_b")))
+            * ltorch.linear(x, mp["fc_2"], mp.get("fc_2_b")),
+            mp["proj"], mp.get("proj_b"),
+        )
+    return ltorch.linear(
+        ltorch.gelu(ltorch.linear(x, mp["fc"], mp.get("fc_b")), approximate=config.gelu_approximate),
+        mp["proj"], mp.get("proj_b"),
+    )
 
 
 def block_forward(bp, x, cos, sin, config: Config):
-    n1 = _norm(x, bp["norm_1"], config)
+    n1 = _norm(x, bp["norm_1"], config, bp.get("norm_1_b"))
     h = attention(bp["attn"], n1, cos, sin, config)
     if config.parallel_residual:
-        n2 = n1 if config.shared_attention_norm else _norm(x, bp["norm_2"], config)
+        n2 = n1 if config.shared_attention_norm else _norm(x, bp["norm_2"], config, bp.get("norm_2_b"))
         return x + h + mlp(bp["mlp"], n2, config)
     x = x + h
-    return x + mlp(bp["mlp"], _norm(x, bp["norm_2"], config), config)
+    return x + mlp(bp["mlp"], _norm(x, bp["norm_2"], config, bp.get("norm_2_b")), config)
 
 
 def gpt_hidden(params, idx, cos, sin, config: Config):
@@ -411,14 +448,14 @@ def gpt_hidden(params, idx, cos, sin, config: Config):
         x = x + params["wpe"][:T]
     for bp in params["blocks"]:
         x = block_forward(bp, x, cos, sin, config)
-    return _norm(x, params["ln_f"], config)
+    return _norm(x, params["ln_f"], config, params.get("ln_f_b"))
 
 
 def gpt_forward(params, idx, cos, sin, config: Config):
     """Token ids (B, T) int32 → logits (B, T, padded_vocab_size)."""
     x = gpt_hidden(params, idx, cos, sin, config)
     head = params["wte"] if config.tie_embeddings else params["lm_head"]
-    return ltorch.linear(x, head)
+    return ltorch.linear(x, head, params.get("lm_head_b"))
 
 
 def gpt_loss(params, idx, targets, cos, sin, config: Config):

@@ -166,13 +166,17 @@ configs: list[Config] = [
            intermediate_size=14336),
     Config(name="CodeLlama-2-like", block_size=16384, vocab_size=32016, n_layer=32,
            n_head=32, n_embd=4096, intermediate_size=11008, rope_base=1000000),
+    # bias=True + tanh gelu: the REAL nanoGPT/GPT-2 architecture (reference
+    # nanogpt_model.py defaults bias=True) — checkpoint-compatible with
+    # models/hf_weights.from_gpt2_state_dict
     Config(name="nanogpt-debug", block_size=128, vocab_size=256, n_layer=2, n_head=4,
            n_embd=64, rotary_percentage=0.0, learned_pos_embedding=True,
            parallel_residual=False, norm_class="LayerNorm", mlp_class="GptNeoxMLP",
-           tie_embeddings=True),
+           tie_embeddings=True, bias=True, gelu_approximate="tanh"),
     Config(name="gpt2-124m", block_size=1024, vocab_size=50257, n_layer=12, n_head=12,
            n_embd=768, rotary_percentage=0.0, learned_pos_embedding=True,
-           norm_class="LayerNorm", mlp_class="GptNeoxMLP", tie_embeddings=True),
+           norm_class="LayerNorm", mlp_class="GptNeoxMLP", tie_embeddings=True,
+           bias=True, gelu_approximate="tanh"),
     Config(name="tiny-mistral-debug", block_size=128, vocab_size=256, n_layer=2, n_head=4,
            n_embd=64, n_query_groups=2, intermediate_size=176, sliding_window=32),
     Config(name="Mistral-7B-like", block_size=32768, vocab_size=32000, n_layer=32,

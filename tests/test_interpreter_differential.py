@@ -1,0 +1,372 @@
+"""Differential testing: every snippet runs natively AND through the
+bytecode interpreter; results must agree exactly (value or exception type).
+
+The reference polices its interpreter the same way at scale
+(thunder/tests/test_interpreter.py, 3,216 LoC of opcode-level behavior);
+this corpus concentrates the semantics that historically diverge:
+exception identity, finally/return interaction, scoping, iteration
+protocols, and operator dunders."""
+from __future__ import annotations
+
+import pytest
+
+from thunder_tpu.core.interpreter import interpret
+
+
+def _native(fn, *args):
+    try:
+        return ("ok", fn(*args))
+    except BaseException as e:
+        return ("raise", type(e).__name__, str(e))
+
+
+def _interpreted(fn, *args):
+    try:
+        return ("ok", interpret(fn, *args)[0])
+    except BaseException as e:
+        return ("raise", type(e).__name__, str(e))
+
+
+def check(fn, *args):
+    native = _native(fn, *args)
+    inter = _interpreted(fn, *args)
+    assert native == inter, f"native={native!r} interpreted={inter!r}"
+
+
+def snip_chained_comparison(x):
+    return 1 < x <= 5 < 10 != x
+
+
+def snip_walrus(x):
+    acc = []
+    while (y := x - len(acc)) > 0:
+        acc.append(y)
+    return acc
+
+
+def snip_starred_unpack(x):
+    a, *b, c = [x, x + 1, x + 2, x + 3]
+    first, (second, *rest) = (a, b)
+    return (a, b, c, first, second, rest)
+
+
+def snip_dict_merge(x):
+    d1 = {"a": x, "b": 2}
+    d2 = {"b": 3, "c": 4}
+    d1 |= d2
+    return (d1, {"z": 0} | d2, [*d1], {**d1, "a": 9})
+
+
+def snip_slice_zoo(x):
+    s = list(range(10))
+    return (s[x:], s[:x], s[::-1], s[1:8:2], s[-3:-1], "abcdef"[::2])
+
+
+def snip_finally_return(x):
+    def inner():
+        try:
+            return "try"
+        finally:
+            if x:
+                return "finally"
+
+    return inner()
+
+
+def snip_finally_swallows_exception(x):
+    def inner():
+        try:
+            raise ValueError("gone")
+        finally:
+            return "swallowed"  # noqa: B012
+
+    return inner()
+
+
+def snip_exception_identity(x):
+    try:
+        try:
+            raise KeyError("k")
+        except KeyError as e:
+            inner = e
+            raise
+    except KeyError as e2:
+        return inner is e2
+
+
+def snip_exception_context(x):
+    try:
+        try:
+            raise ValueError("first")
+        except ValueError:
+            raise TypeError("second")
+    except TypeError as e:
+        return (type(e.__context__).__name__, e.__suppress_context__)
+
+
+def snip_else_clauses(x):
+    out = []
+    for i in range(x):
+        if i == 99:
+            break
+    else:
+        out.append("for-else")
+    try:
+        pass
+    except Exception:
+        pass
+    else:
+        out.append("try-else")
+    while False:
+        pass
+    else:
+        out.append("while-else")
+    return out
+
+
+def snip_closure_rebinding(x):
+    fns = []
+    for i in range(3):
+        fns.append(lambda i=i: i * x)
+    late = [lambda: i for _ in range(2)]
+    return ([f() for f in fns], [f() for f in late])
+
+
+def snip_nonlocal_nested(x):
+    def outer():
+        count = x
+
+        def inc():
+            nonlocal count
+            count += 1
+            return count
+
+        inc()
+        inc()
+        return count
+
+    return outer()
+
+
+def snip_decorator_order(x):
+    trace = []
+
+    def deco(tag):
+        trace.append(f"build-{tag}")
+
+        def wrap(fn):
+            trace.append(f"apply-{tag}")
+
+            def inner(*a):
+                trace.append(f"call-{tag}")
+                return fn(*a)
+
+            return inner
+
+        return wrap
+
+    @deco("outer")
+    @deco("inner")
+    def f(v):
+        return v + 1
+
+    r = f(x)
+    return (r, trace)
+
+
+def snip_genexp_scoping(x):
+    data = [[1, 2], [3, 4]]
+    flat = [a * x for row in data for a in row if a != 3]
+    gen = (a + x for a in range(3))
+    total = sum(gen) + sum(gen)  # second sum sees exhausted gen
+    return (flat, total)
+
+
+def snip_iter_protocol(x):
+    class Count:
+        def __init__(self, n):
+            self.n = n
+            self.i = 0
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            if self.i >= self.n:
+                raise StopIteration
+            self.i += 1
+            return self.i
+
+    return [v * x for v in Count(4)]
+
+
+def snip_operator_dunders(x):
+    class V:
+        def __init__(self, v):
+            self.v = v
+
+        def __add__(self, o):
+            return V(self.v + o)
+
+        def __radd__(self, o):
+            return V(o * 10 + self.v)
+
+        def __iadd__(self, o):
+            self.v += 100 * o
+            return self
+
+        def __eq__(self, o):
+            return isinstance(o, V) and self.v == o.v
+
+        def __hash__(self):
+            return hash(self.v)
+
+    a = V(x)
+    b = a + 1
+    c = 2 + a
+    a += 1
+    return (a.v, b.v, c.v, V(3) == V(3), V(3) in {V(3)})
+
+
+def snip_string_formatting(x):
+    v = 3.14159
+    return (f"{x:04d}|{v:.2f}|{x!r}|{'pad':>6}|{x=}", "%05.1f|%s" % (v, x))
+
+
+def snip_try_in_loop_continue(x):
+    out = []
+    for i in range(x):
+        try:
+            if i % 2:
+                raise RuntimeError(str(i))
+            out.append(i)
+            continue
+        except RuntimeError:
+            out.append(-i)
+        finally:
+            out.append(99)
+    return out
+
+
+def snip_class_attribute_resolution(x):
+    class A:
+        val = 1
+
+        def get(self):
+            return self.val
+
+    class B(A):
+        val = 2
+
+    b = B()
+    b.val = x
+    return (A().get(), B().get(), b.get(), B.val, super(B, b).get.__name__)
+
+
+def snip_kwargs_spread(x):
+    def f(a, b=2, *args, c, d=4, **kw):
+        return (a, b, args, c, d, sorted(kw.items()))
+
+    return f(x, *range(2), c=9, e=5, **{"g": 7})
+
+
+def snip_delete_semantics(x):
+    d = {"a": 1, "b": 2}
+    del d["a"]
+    lst = [1, 2, 3, 4]
+    del lst[1:3]
+    v = x
+    del v
+    try:
+        return (d, lst, v)  # noqa: F821
+    except UnboundLocalError as e:
+        return (d, lst, "unbound")
+
+
+def snip_bool_shortcircuit(x):
+    calls = []
+
+    def t(tag, val):
+        calls.append(tag)
+        return val
+
+    r1 = t("a", 0) or t("b", x) or t("c", 5)
+    r2 = t("d", 1) and t("e", 0) and t("f", 9)
+    r3 = not t("g", [])
+    return (r1, r2, r3, calls)
+
+
+def snip_context_from_operation(x):
+    try:
+        try:
+            raise ValueError("first")
+        except ValueError:
+            return {}[x]
+    except KeyError as e:
+        return (type(e.__context__).__name__,)
+
+
+def snip_context_cycle_break(x):
+    try:
+        raise ValueError("A")
+    except ValueError as a:
+        try:
+            try:
+                raise TypeError("B")
+            except TypeError:
+                raise a
+        except ValueError as a2:
+            return (type(a2.__context__).__name__,
+                    a2.__context__.__context__ is None)
+
+
+def snip_unbound_free_variable(x):
+    def outer():
+        if x > 100:
+            a = 1  # noqa: F841
+
+        def inner():
+            try:
+                return a
+            except NameError:
+                return "caught-free"
+
+        return inner()
+
+    return outer()
+
+
+def snip_raise_non_exception(x):
+    try:
+        try:
+            raise ValueError("handled")
+        except ValueError:
+            raise x  # int: must become TypeError
+    except TypeError:
+        return "typeerror"
+
+
+def snip_matmul_divmod(x):
+    class M:
+        def __matmul__(self, o):
+            return ("matmul", o)
+
+        def __floordiv__(self, o):
+            return ("floordiv", o)
+
+    return (M() @ x, M() // x, divmod(17, x), 17 // x, 17 % x, -17 // x, -17 % x)
+
+
+ALL_SNIPPETS = [v for k, v in sorted(globals().items()) if k.startswith("snip_")]
+
+
+@pytest.mark.parametrize("fn", ALL_SNIPPETS, ids=lambda f: f.__name__)
+def test_differential(fn):
+    check(fn, 3)
+
+
+@pytest.mark.parametrize("fn", [snip_chained_comparison, snip_walrus, snip_slice_zoo,
+                                snip_try_in_loop_continue, snip_else_clauses])
+def test_differential_alt_arg(fn):
+    check(fn, 0)
+    check(fn, 7)

@@ -712,7 +712,7 @@ def _frame_loop(frame: Frame, instrs, exc_table):
                 # BaseException, not Exception: SystemExit/KeyboardInterrupt must
                 # still run finally blocks and reach `except BaseException:`
                 # handlers (the table entry exists for them like any other)
-                i = _unwind(frame, ins, exc_table, e)
+                i = _unwind(frame, ins, exc_table, _chain_context(frame, e))
                 continue
             if isinstance(res, _Return):
                 return res.value
@@ -830,9 +830,19 @@ def _load_fast(frame, ins, i):
     name = ins.argval
     if name not in frame.localsplus:
         if name in frame.cells:
-            frame.push(frame.cells[name].cell_contents)
+            try:
+                frame.push(frame.cells[name].cell_contents)
+            except ValueError:
+                raise UnboundLocalError(
+                    f"cannot access local variable {name!r} where it is not "
+                    "associated with a value"
+                ) from None
             return None
-        raise InterpreterError(f"local variable {name!r} referenced before assignment")
+        # user-catchable, like CPython — NOT InterpreterError (which handlers
+        # in interpreted code can never catch)
+        raise UnboundLocalError(
+            f"cannot access local variable {name!r} where it is not associated with a value"
+        )
     frame.push(frame.localsplus[name])
 
 
@@ -870,7 +880,7 @@ def _load_global(frame, ins, i):
     elif name in frame.builtins_:
         v = frame.builtins_[name]  # builtins are not guarded (stable)
     else:
-        raise InterpreterError(f"name {name!r} is not defined")
+        raise NameError(f"name {name!r} is not defined")
     if push_null:
         # 3.12 layout: NULL below the callable ([NULL, callable, args...])
         frame.push(_NULL)
@@ -892,7 +902,7 @@ def _load_name(frame, ins, i):
     elif name in frame.builtins_:
         frame.push(frame.builtins_[name])
     else:
-        raise InterpreterError(f"name {name!r} is not defined")
+        raise NameError(f"name {name!r} is not defined")
 
 
 @register_opcode_handler("LOAD_DEREF")
@@ -904,11 +914,23 @@ def _load_deref(frame, ins, i):
         if name in frame.localsplus:
             frame.push(frame.localsplus[name])
             return None
-        raise InterpreterError(f"free variable {name!r} referenced before assignment")
+        raise NameError(
+            f"cannot access free variable {name!r} where it is not associated "
+            "with a value in enclosing scope"
+        )
+    def contents():
+        try:
+            return cell.cell_contents
+        except ValueError:
+            raise NameError(
+                f"cannot access free variable {name!r} where it is not "
+                "associated with a value in enclosing scope"
+            ) from None
+
     if frame.depth == 0:
         # the ROOT function's closure is re-locatable via fn.__closure__
         rec = ProvenanceRecord(PseudoInst.LOAD_DEREF, key=name)
-        v = frame.ctx.record_read(rec, cell.cell_contents)
+        v = frame.ctx.record_read(rec, contents())
         frame.ctx.track(v, rec)
         frame.push(v)
     elif frame.fn_prov is not None and name in frame.code.co_freevars:
@@ -929,12 +951,12 @@ def _load_deref(frame, ins, i):
             ),
             key="cell_contents",
         )
-        v = frame.ctx.record_read(rec, cell.cell_contents)
+        v = frame.ctx.record_read(rec, contents())
         frame.ctx.track(v, rec)
         frame.push(v)
     else:
         # trace-local cell (MAKE_FUNCTION inside the traced code)
-        frame.push(cell.cell_contents)
+        frame.push(contents())
 
 
 @register_opcode_handler("STORE_DEREF")
@@ -1693,7 +1715,12 @@ def _load_closure(frame, ins, i):
     name = ins.argval
     cell = frame.cells.get(name)
     if cell is None:
-        cell = types.CellType(frame.localsplus.get(name))
+        # an unassigned local must become an EMPTY cell (reading it raises),
+        # not a cell holding None
+        if name in frame.localsplus:
+            cell = types.CellType(frame.localsplus[name])
+        else:
+            cell = types.CellType()
         frame.cells[name] = cell
     frame.push(cell)
 
@@ -1712,16 +1739,45 @@ def _import_from(frame, ins, i):
     frame.push(getattr(mod, ins.argval))
 
 
+def _chain_context(frame, exc: BaseException) -> BaseException:
+    """Implicit exception chaining (CPython _PyErr_SetObject): an exception
+    raised while another is being handled records it as __context__.  The
+    handled exception is thread-level VIRTUAL state (frame.current_exc /
+    ctx.exc_stack), so the host raise cannot do this for us; it is applied
+    centrally at the frame loop's dispatch catch.  Only fresh exceptions
+    (no context yet) chain — a propagating exception keeps the context it
+    was raised with — and re-raising an exception already in the current
+    chain breaks the inner link first, exactly like CPython's do_raise."""
+    if not isinstance(exc, BaseException):  # host raise makes the TypeError
+        return exc
+    cur = frame.current_exc
+    if cur is None and frame.ctx.exc_stack:
+        cur = frame.ctx.exc_stack[-1][1]
+    if cur is None or cur is exc or exc.__context__ is not None:
+        return exc
+    o = cur
+    while o is not None:  # break a would-be context cycle at its inner link
+        nxt = o.__context__
+        if nxt is exc:
+            o.__context__ = None
+            break
+        o = nxt
+    exc.__context__ = cur
+    return exc
+
+
 @register_opcode_handler("RAISE_VARARGS")
 def _raise_varargs(frame, ins, i):
     if ins.arg == 1:
         exc = frame.pop()
         if isinstance(exc, type) and issubclass(exc, BaseException):
             exc = exc()
-        raise exc
+        raise exc  # chaining happens centrally at the dispatch catch
     if ins.arg == 2:
         cause = frame.pop()
         exc = frame.pop()
+        if isinstance(exc, type) and issubclass(exc, BaseException):
+            exc = exc()
         raise exc from cause
     # bare raise: re-raise the active exception (CPython semantics).  The
     # active exception is thread-level state, not frame-level: a bare raise

@@ -357,6 +357,171 @@ def snip_matmul_divmod(x):
     return (M() @ x, M() // x, divmod(17, x), 17 // x, 17 % x, -17 // x, -17 % x)
 
 
+def snip_generator_throw_close(x):
+    log = []
+
+    def gen():
+        try:
+            yield 1
+            yield 2
+        except RuntimeError as e:
+            log.append(f"caught-{e}")
+            yield 99
+        finally:
+            log.append("cleanup")
+
+    g = gen()
+    a = next(g)
+    b = g.throw(RuntimeError("t"))
+    g.close()
+    return (a, b, log)
+
+
+def snip_generator_return_in_finally_close(x):
+    log = []
+
+    def gen():
+        try:
+            yield x
+        finally:
+            log.append("fin")
+
+    g = gen()
+    next(g)
+    g.close()
+    return log
+
+
+def snip_with_suppression(x):
+    class Suppress:
+        def __enter__(self):
+            return "r"
+
+        def __exit__(self, et, ev, tb):
+            return et is KeyError
+
+    out = []
+    with Suppress() as r:
+        out.append(r)
+        raise KeyError("suppressed")
+    out.append("after")
+    try:
+        with Suppress():
+            raise ValueError("not suppressed")
+    except ValueError:
+        out.append("escaped")
+    return out
+
+
+def snip_nested_with_order(x):
+    log = []
+
+    class CM:
+        def __init__(self, tag):
+            self.tag = tag
+
+        def __enter__(self):
+            log.append(f"enter-{self.tag}")
+            return self.tag
+
+        def __exit__(self, *exc):
+            log.append(f"exit-{self.tag}")
+            return False
+
+    with CM("a") as a, CM("b") as b:
+        log.append(f"body-{a}{b}")
+    return log
+
+
+def snip_getattr_fallback(x):
+    class A:
+        real = 1
+
+        def __getattr__(self, name):
+            if name == "virtual":
+                return x
+            raise AttributeError(name)
+
+    a = A()
+    try:
+        a.missing
+    except AttributeError:
+        missing = "missing-raises"
+    return (a.real, a.virtual, missing, getattr(a, "nope", "default"))
+
+
+def snip_property_and_setattr(x):
+    class P:
+        def __init__(self):
+            self._v = x
+
+        @property
+        def v(self):
+            return self._v * 2
+
+        @v.setter
+        def v(self, nv):
+            self._v = nv + 1
+
+    p = P()
+    before = p.v
+    p.v = 10
+    return (before, p._v, p.v)
+
+
+def snip_global_statement(x):
+    # note: writes go to the interpreter's shadow global store (deliberate
+    # trace-purity design), so the comparison stays within interpreted reads
+    # rather than round-tripping through the real module dict
+    global _G_DIFF_TEST
+    _G_DIFF_TEST = x
+
+    def reader():
+        return _G_DIFF_TEST
+
+    return reader()
+
+
+def snip_aug_assign_targets(x):
+    d = {"k": [1]}
+    d["k"] += [x]
+
+    class O:
+        a = 5
+
+    o = O()
+    o.a += x  # instance shadow, class untouched
+    lst = [[0], [1]]
+    lst[1] *= 2
+    return (d, o.a, O.a, lst)
+
+
+def snip_comparison_is_in(x):
+    s = "abc"
+    t = ("abc",)[0]
+    return (s is t, x in [1, 2, 3], x not in (9,), None is None, [] is not [])
+
+
+def snip_ternary_and_tuple_swap(x):
+    a, b = x, x + 1
+    a, b = b, a
+    c = "big" if a > 3 else "small"
+    (d, e), f = (a, b), c
+    return (a, b, c, d, e, f)
+
+
+def snip_bytes_and_encoding(x):
+    b = b"hel" + bytes([108, 111])
+    return (b.decode(), b[x], b[1:3], bytearray(b)[0], b"ab" * 2)
+
+
+def snip_frozenset_setops(x):
+    a = {1, 2, 3}
+    b = frozenset([2, 3, 4])
+    return (sorted(a & b), sorted(a | b), sorted(a - b), sorted(a ^ b),
+            a.issubset(a | b), x in a)
+
+
 ALL_SNIPPETS = [v for k, v in sorted(globals().items()) if k.startswith("snip_")]
 
 

@@ -62,6 +62,9 @@ def main(argv=None):
     ap.add_argument("--dtype", default="float32", choices=["float32", "bfloat16"])
     ap.add_argument("--lr", type=float, default=1e-3)
     ap.add_argument("--no-remat", action="store_true")
+    ap.add_argument("--remat", default=None, choices=["on", "off", "auto"],
+                    help="activation rematerialization; 'auto' pays recompute only "
+                         "when residuals would not fit device memory (overrides --no-remat)")
     ap.add_argument("--checkpoint-dir", default=None, help="save a checkpoint at the end (orbax)")
     args = ap.parse_args(argv)
 
@@ -166,7 +169,9 @@ def main(argv=None):
 
         train_step = dist.make_train_step(
             loss_fn, optimizer, mesh,
-            remat=not args.no_remat, zero3=(args.mode == "zero3"),
+            remat=({"on": True, "off": False, "auto": "auto"}[args.remat]
+                   if args.remat else not args.no_remat),
+            zero3=(args.mode == "zero3"),
             quant=args.quant, comm_combine_threshold_mb=args.comm_combine_mb,
             bucketer=llama.batch_bucketer(cfg) if args.bucket else None,
         )

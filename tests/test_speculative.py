@@ -48,11 +48,18 @@ class TestSpeculative:
             speculative_generate(tp, tp, prompt, cfg, cfg, 16, T_max=64,
                                  cache_dtype=jnp.float32)
 
-    def test_batch_gt_one_rejected(self):
+    @pytest.mark.parametrize("B", [2, 3])
+    def test_batched_token_exact_vs_greedy_generate(self, B):
+        """Per-row acceptance: every row must match its own greedy decode."""
         cfg, draft_cfg, tp, dp = _models()
-        prompt = jnp.zeros((2, 4), jnp.int32)
-        with pytest.raises(AssertionError, match="B=1"):
-            speculative_generate(tp, dp, prompt, cfg, draft_cfg, 8)
+        prompt = jax.random.randint(jax.random.PRNGKey(2), (B, 6), 0, cfg.vocab_size)
+        n = 15
+        out = speculative_generate(tp, dp, prompt, cfg, draft_cfg, n, K=3,
+                                   cache_dtype=jnp.float32)
+        for b in range(B):
+            ref = gen.generate(tp, prompt[b:b + 1], cfg, n, cache_dtype=jnp.float32)
+            np.testing.assert_array_equal(np.asarray(out[b:b + 1]), np.asarray(ref),
+                                          err_msg=f"row {b}")
 
 
 class TestSpeculativeSampling:

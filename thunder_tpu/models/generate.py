@@ -149,6 +149,11 @@ def init_cache(cfg: Config, B: int, T_max: int, dtype=jnp.bfloat16, *, mesh=None
     return {"k": zeros(), "v": zeros()}
 
 
+def _is_vec_pos(pos) -> bool:
+    """True when ``pos`` is per-row positions (B,) rather than one scalar."""
+    return getattr(pos, "ndim", 0) == 1
+
+
 def _expand_groups(kk, vv, nh):
     B, ng, Tc, hs = kk.shape
     if ng != nh:
@@ -176,14 +181,24 @@ def _attn_with_cache(ap, x, cos_t, sin_t, ck, cv, pos, cfg: Config, *, quantized
     Tc = ck.shape[2]
     W = cfg.sliding_window
     ring = W is not None and Tc == W
+    vec = _is_vec_pos(pos)
+    assert not (ring and vec), "per-row positions are not supported with a ring cache"
 
     if not ring:
-        ck = jax.lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), pos, axis=2)
-        cv = jax.lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), pos, axis=2)
+        if vec:
+            upd = jax.vmap(lambda c, u, p: jax.lax.dynamic_update_slice_in_dim(c, u, p, axis=1))
+            ck = upd(ck, k.astype(ck.dtype), pos)
+            cv = upd(cv, v.astype(cv.dtype), pos)
+        else:
+            ck = jax.lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), pos, axis=2)
+            cv = jax.lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), pos, axis=2)
         kk, vv = ck, cv
         # query at global position pos+t sees cache slots (pos+t-W, pos+t]
         j = jnp.arange(Tc)[None, None, None, :]
-        qpos = (pos + jnp.arange(T))[None, None, :, None]
+        if vec:
+            qpos = (pos[:, None] + jnp.arange(T)[None, :])[:, None, :, None]  # (B,1,T,1)
+        else:
+            qpos = (pos + jnp.arange(T))[None, None, :, None]
         keep = j <= qpos
         if W is not None:
             keep = jnp.logical_and(keep, j > qpos - W)
@@ -232,10 +247,20 @@ def forward_with_cache(params, idx, pos, cache, cos_all, sin_all, cfg: Config, *
     against/into ``cache``.  Returns (logits (B, T, V), updated cache)."""
     B, T = idx.shape
     x = params["wte"][idx]
+    vec = _is_vec_pos(pos)
     if cfg.learned_pos_embedding:
-        x = x + jax.lax.dynamic_slice_in_dim(params["wpe"], pos, T, axis=0)
-    cos_t = jax.lax.dynamic_slice_in_dim(cos_all, pos, T, axis=0)
-    sin_t = jax.lax.dynamic_slice_in_dim(sin_all, pos, T, axis=0)
+        if vec:
+            x = x + jax.vmap(
+                lambda p: jax.lax.dynamic_slice_in_dim(params["wpe"], p, T, axis=0))(pos)
+        else:
+            x = x + jax.lax.dynamic_slice_in_dim(params["wpe"], pos, T, axis=0)
+    if vec:
+        # (B, 1, T, n_elem): broadcasts against (B, nh, T, hs) inside _rope
+        cos_t = jax.vmap(lambda p: jax.lax.dynamic_slice_in_dim(cos_all, p, T, axis=0))(pos)[:, None]
+        sin_t = jax.vmap(lambda p: jax.lax.dynamic_slice_in_dim(sin_all, p, T, axis=0))(pos)[:, None]
+    else:
+        cos_t = jax.lax.dynamic_slice_in_dim(cos_all, pos, T, axis=0)
+        sin_t = jax.lax.dynamic_slice_in_dim(sin_all, pos, T, axis=0)
 
     new_k, new_v = [], []
     for l, bp in enumerate(params["blocks"]):

@@ -16,10 +16,12 @@ K/V entries sit at positions above the accepted prefix, where the next
 verify chunk either rewrites them or masks them out (queries attend slots
 ``<= qpos`` only), so no rewind is needed.
 
-Single sequence (B=1): acceptance length is data-dependent per sequence, so
-batched speculative decoding would need per-row positions the cache API
-deliberately does not have.  Sliding-window (ring-cache) models are not
-supported: the ring prefill requires chunks to start at position 0.
+Batched: every row carries its own position and acceptance length
+(``forward_with_cache`` accepts per-row (B,) positions), so rows advance at
+independent rates; rows that reach ``max_new_tokens`` freeze in place while
+slower rows catch up, and each row's output is exactly its own solo decode.
+Sliding-window (ring-cache) models are not supported: the ring prefill
+requires chunks to start at position 0.
 """
 from __future__ import annotations
 
@@ -65,68 +67,75 @@ def _accept_tokens(key, drafts, p_all, q_rows):
 
 
 def _spec_step(cfg, draft_cfg, cos, sin, cos_d, sin_d, K, quantized, temperature):
-    """One speculate/verify iteration (traced inside decode_all's
-    while_loop, so no jit of its own)."""
+    """One speculate/verify round over B independent rows (traced inside
+    decode_all's while_loop).  Positions are per-row (B,): each row accepts
+    its own prefix length, so rows advance at different rates."""
 
     def step(params, draft_params, tcache, dcache, cur, pos, key):
+        B = cur.shape[0]
+        key, kd = jax.random.split(key)
+
         # draft K tokens autoregressively (cheap model, small forwards).
         # K+1 scan iterations: the extra one consumes d_K and writes its K/V
         # at pos+K, so a fully-accepted round leaves no never-written hole in
         # the draft cache (a zero-K/V slot would silently steal softmax mass
         # from every later draft forward and decay the acceptance rate)
-        key, kd = jax.random.split(key)
-
         def dbody(carry, kk):
             tok, dpos, dc = carry
             dlogits, dc = forward_with_cache(
                 draft_params, tok[:, None], dpos, dc, cos_d, sin_d, draft_cfg,
                 quantized=quantized,
             )
-            row = dlogits[0, -1]
+            rows = dlogits[:, -1]  # (B, V)
             if temperature == 0.0:
-                nxt = jnp.argmax(row, axis=-1).astype(jnp.int32)[None]
-                qrow = row  # unused in the greedy path
+                nxt = jnp.argmax(rows, axis=-1).astype(jnp.int32)
+                qrows = rows  # unused in the greedy path
             else:
                 # categorical on raw scaled logits == sampling softmax(row/T);
-                # qrow (the same softmax) feeds the min(1, p/q) acceptance
-                qrow = jax.nn.softmax(row / temperature)
-                nxt = jax.random.categorical(kk, row / temperature).astype(jnp.int32)[None]
-            return (nxt, dpos + 1, dc), (nxt[0], qrow)
+                # qrows (the same softmax) feeds the min(1, p/q) acceptance
+                qrows = jax.nn.softmax(rows / temperature, axis=-1)
+                nxt = jax.vmap(jax.random.categorical)(
+                    jax.random.split(kk, B), rows / temperature
+                ).astype(jnp.int32)
+            return (nxt, dpos + 1, dc), (nxt, qrows)
 
         dks = jax.random.split(kd, K + 1)
         (_, _, dcache2), (drafts_x, q_rows_x) = jax.lax.scan(
             dbody, (cur, pos, dcache), dks)
-        drafts = drafts_x[:K][None, :]  # (1, K); the K+1th output is unused
+        drafts = drafts_x[:K].transpose(1, 0)  # (B, K); the K+1th output is unused
 
         # verify: one target forward over [cur, d_1..d_K] = K+1 positions
-        chunk = jnp.concatenate([cur[:, None], drafts], axis=1)  # (1, K+1)
+        chunk = jnp.concatenate([cur[:, None], drafts], axis=1)  # (B, K+1)
         tlogits, tcache2 = forward_with_cache(
             params, chunk, pos, tcache, cos, sin, cfg, quantized=quantized,
         )
 
         if temperature == 0.0:
-            tgt_toks = jnp.argmax(tlogits, axis=-1).astype(jnp.int32)  # (1, K+1)
+            tgt_toks = jnp.argmax(tlogits, axis=-1).astype(jnp.int32)  # (B, K+1)
             # accepted prefix length m = first draft that disagrees with the
-            # target's argmax; all-match → m = K, tgt_toks[K] is a bonus token
-            match = drafts[0] == tgt_toks[0, :K]  # (K,)
-            m = jnp.argmin(jnp.concatenate([match, jnp.zeros((1,), bool)]).astype(jnp.int32))
-            y = tgt_toks[0, m]
+            # target's argmax; all-match → m = K, tgt_toks[:, K] is a bonus
+            match = drafts == tgt_toks[:, :K]  # (B, K)
+            m = jnp.argmin(
+                jnp.concatenate([match, jnp.zeros((B, 1), bool)], axis=1).astype(jnp.int32),
+                axis=1,
+            )
+            y = jnp.take_along_axis(tgt_toks, m[:, None], axis=1)[:, 0]
         else:
-            p_all = jax.nn.softmax(tlogits[0] / temperature, axis=-1)  # (K+1, V)
+            p_all = jax.nn.softmax(tlogits / temperature, axis=-1)  # (B, K+1, V)
             key, ka = jax.random.split(key)
-            m, y = _accept_tokens(ka, drafts[0], p_all, q_rows_x[:K])
+            q_rows = q_rows_x[:K].transpose(1, 0, 2)  # (B, K, V)
+            m, y = jax.vmap(_accept_tokens)(jax.random.split(ka, B), drafts, p_all, q_rows)
         n_emit = m + 1  # accepted drafts + the resampled/correction/bonus token
 
-        # fixed-shape emission: emitted[i] = drafts[i] for i < m, y at i == m,
-        # garbage (masked by n_emit) above
-        iota = jnp.arange(K + 1)
+        # fixed-shape emission: emitted[b, i] = drafts[b, i] for i < m_b, y_b
+        # at i == m_b, garbage (masked by n_emit) above
+        iota = jnp.arange(K + 1)[None, :]
         emitted = jnp.where(
-            iota < m,
-            jnp.concatenate([drafts[0], jnp.zeros((1,), jnp.int32)]),
-            y,
+            iota < m[:, None],
+            jnp.concatenate([drafts, jnp.zeros((B, 1), jnp.int32)], axis=1),
+            y[:, None],
         )
-        new_cur = y[None]  # next iteration continues from the emitted tail token
-        return tcache2, dcache2, emitted, n_emit, new_cur, pos + n_emit, key
+        return tcache2, dcache2, emitted, n_emit, y, pos + n_emit, key
 
     return step
 
@@ -146,7 +155,7 @@ def speculative_generate(
     quantized: bool = False,
     cache_dtype=None,
 ):
-    """Speculative decoding; returns (B=1, T_prompt + max_new_tokens) tokens.
+    """Speculative decoding; returns (B, T_prompt + max_new_tokens) tokens.
 
     ``temperature=0`` (greedy): output is token-identical to
     ``generate(params, ...)``.  ``temperature>0``: full speculative SAMPLING
@@ -159,14 +168,14 @@ def speculative_generate(
     """
     prompt = jnp.asarray(prompt)
     B, T_prompt = prompt.shape
-    assert B == 1, "speculative decoding tracks one sequence's acceptance length (B=1)"
     assert max_new_tokens >= 0
     assert cfg.padded_vocab_size == draft_cfg.padded_vocab_size, "draft must share the vocab"
     if max_new_tokens == 0:
         return prompt
     if T_max is None:
         T_max = min(cfg.block_size, T_prompt + max_new_tokens + K + 1)
-    # the last verify chunk may reach K positions past the final emitted token
+    # the last verify chunk may reach K positions past the final emitted
+    # token (finished rows freeze in place while slower rows catch up)
     assert T_prompt + max_new_tokens + K <= T_max, "T_max too small for K-token speculation"
     assert _cache_len(cfg, T_max) == T_max and _cache_len(draft_cfg, T_max) == T_max, (
         "speculative decoding needs full (non-ring) caches; sliding-window "
@@ -180,8 +189,8 @@ def speculative_generate(
         float(temperature),
     )
 
-    tcache = init_cache(cfg, 1, T_max, dtype=dtype)
-    dcache = init_cache(draft_cfg, 1, T_max, dtype=dtype)
+    tcache = init_cache(cfg, B, T_max, dtype=dtype)
+    dcache = init_cache(draft_cfg, B, T_max, dtype=dtype)
     tcache, dcache, first_logits = prefill(params, draft_params, tcache, dcache, prompt)
     import warnings
 
@@ -191,11 +200,12 @@ def speculative_generate(
         # (same pattern and rationale as generate.py's decode loop)
         warnings.filterwarnings("ignore", message="Some donated buffers were not usable")
         out, n, rounds = decode_all(params, draft_params, tcache, dcache, first_logits, key)
-    #: tokens emitted per speculate/verify round of the last call (the
-    #: prefill-seeded first token excluded) — the acceptance diagnostic:
-    #: K+1 means every draft accepted, 1.0 means none were
-    speculative_generate.last_tokens_per_round = float(n - 1) / max(float(rounds), 1.0)
-    return jnp.concatenate([prompt, out[None, :]], axis=1)
+    #: mean over rows of (tokens emitted / that row's ACTIVE rounds), the
+    #: prefill-seeded first token excluded and emission clamped to max_new —
+    #: the acceptance diagnostic: K+1 means every draft accepted, 1.0 none
+    per_row = (jnp.minimum(n, max_new_tokens) - 1) / jnp.maximum(rounds, 1)
+    speculative_generate.last_tokens_per_round = float(jnp.mean(per_row))
+    return jnp.concatenate([prompt, out], axis=1)
 
 
 _spec_cache: dict = {}
@@ -253,30 +263,49 @@ def _compiled_speculative(cfg, draft_cfg, T_prompt, max_new, T_max, K, quantized
 
     @partial(jax.jit, donate_argnums=(2, 3))
     def decode_all(params, draft_params, tcache, dcache, first_logits, rng):
+        B = first_logits.shape[0]
         rng, kf = jax.random.split(rng)
         if temperature == 0.0:
             first = jnp.argmax(first_logits, axis=-1).astype(jnp.int32)
         else:
-            first = jax.random.categorical(kf, first_logits / temperature, axis=-1).astype(jnp.int32)
-        # buffer holds the worst-case overshoot of the final round; each
-        # round writes K+1 slots at offset n and only advances n by n_emit,
-        # so the next round's write overwrites the round's garbage tail
-        buf = jnp.zeros((max_new + K + 1,), jnp.int32).at[0].set(first[0])
+            first = jax.vmap(jax.random.categorical)(
+                jax.random.split(kf, B), first_logits / temperature
+            ).astype(jnp.int32)
+        # per-row buffers hold the worst-case overshoot of a row's final
+        # round; each round writes K+1 slots at offset n_b and advances n_b
+        # by its own n_emit, so the next round overwrites the garbage tail.
+        # Finished rows (n_b >= max_new) freeze: their pos/n stop advancing
+        # and their writes land in the trim region past max_new
+        buf = jnp.zeros((B, max_new + K + 1), jnp.int32).at[:, 0].set(first)
 
         def cond(state):
-            return state[5] < max_new
+            return jnp.min(state[5]) < max_new
 
         def body(state):
             tcache, dcache, buf, cur, pos, n, rounds, rng = state
-            tcache, dcache, emitted, n_emit, cur, pos, rng = step(
-                params, draft_params, tcache, dcache, cur, pos, rng)
-            buf = jax.lax.dynamic_update_slice(buf, emitted, (n,))
-            return (tcache, dcache, buf, cur, pos, n + n_emit, rounds + 1, rng)
+            # frozen rows still run the lockstep forwards; clamp their chunk
+            # start so every cache write/rope slice stays in bounds by
+            # construction (not by XLA's index clamping) — their results are
+            # discarded either way
+            pos_in = jnp.minimum(pos, T_max - K - 1)
+            tcache, dcache, emitted, n_emit, cur2, pos2, rng = step(
+                params, draft_params, tcache, dcache, cur, pos_in, rng)
+            pos2 = pos + (pos2 - pos_in)
+            done = n >= max_new
+            buf = jax.vmap(
+                lambda row, e, off: jax.lax.dynamic_update_slice(row, e, (off,))
+            )(buf, emitted, n)
+            cur = jnp.where(done, cur, cur2)
+            pos = jnp.where(done, pos, pos2)
+            n = jnp.where(done, n, n + n_emit)
+            rounds = rounds + (~done).astype(jnp.int32)  # per-row active rounds
+            return (tcache, dcache, buf, cur, pos, n, rounds, rng)
 
-        init = (tcache, dcache, buf, first, jnp.asarray(T_prompt, jnp.int32),
-                jnp.asarray(1, jnp.int32), jnp.asarray(0, jnp.int32), rng)
+        init = (tcache, dcache, buf, first,
+                jnp.full((B,), T_prompt, jnp.int32),
+                jnp.ones((B,), jnp.int32), jnp.zeros((B,), jnp.int32), rng)
         _, _, buf, _, _, n, rounds, _ = jax.lax.while_loop(cond, body, init)
-        return buf[:max_new], n, rounds
+        return buf[:, :max_new], n, rounds
 
     _spec_cache[key] = decode_all
     return prefill, decode_all

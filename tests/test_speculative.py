@@ -40,6 +40,20 @@ class TestSpeculative:
                                    cache_dtype=jnp.float32)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
+    def test_windowed_model_with_window_covering_tmax(self):
+        """sliding_window >= T_max keeps a full cache (the band cannot bind
+        inside it), so speculation runs and matches plain decode; binding
+        windows are ring caches, covered by the rejection test below."""
+        cfg = llama.Config.from_name("tiny-mistral-debug", sliding_window=64)
+        dcfg = llama.Config.from_name("tiny-mistral-debug", n_layer=1, sliding_window=64)
+        tp = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+        dp = llama.init_params(dcfg, jax.random.PRNGKey(7), dtype=jnp.float32)
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 6), 0, cfg.vocab_size)
+        ref = gen.generate(tp, prompt, cfg, 14, cache_dtype=jnp.float32)
+        out = speculative_generate(tp, dp, prompt, cfg, dcfg, 14, K=3,
+                                   cache_dtype=jnp.float32)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
     def test_rejects_ring_cache_models(self):
         cfg = llama.Config.from_name("tiny-mistral-debug", sliding_window=8)
         tp = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)

@@ -8,14 +8,8 @@ for i in $(seq 1 400); do
   out=$(timeout 180 python -c "import jax; print('UP', jax.default_backend())" 2>&1 | grep '^UP tpu')
   if [ -n "$out" ]; then
     echo "$(date -u +%T) TPU up (attempt $i)" >> "$LOG/queue.log"
-    timeout 2400 python tools/flash_tune.py  > "$LOG/flash_tune.log" 2>&1
-    echo "$(date -u +%T) flash_tune rc=$?" >> "$LOG/queue.log"
-    timeout 2400 python tools/quant_headline.py > "$LOG/quant_headline.log" 2>&1
-    echo "$(date -u +%T) quant_headline rc=$?" >> "$LOG/queue.log"
-    timeout 2400 python tools/config_sweep.py > "$LOG/config_sweep.log" 2>&1
-    echo "$(date -u +%T) config_sweep rc=$?" >> "$LOG/queue.log"
-    timeout 2400 python bench.py decode > "$LOG/decode.json" 2> "$LOG/decode.log"
-    echo "$(date -u +%T) decode rc=$?" >> "$LOG/queue.log"
+    # driver-critical artifacts FIRST: a brief tunnel window must refresh
+    # the headline and sweep before optional experiments burn it
     timeout 2400 python bench.py > "$LOG/headline.json.tmp" 2> "$LOG/headline.log"
     hrc=$?
     if [ $hrc -eq 0 ] && grep -q tokens "$LOG/headline.json.tmp"; then
@@ -24,6 +18,14 @@ for i in $(seq 1 400); do
     echo "$(date -u +%T) headline rc=$hrc" >> "$LOG/queue.log"
     timeout 2400 python bench.py sweep > "$LOG/sweep.log" 2>&1
     echo "$(date -u +%T) sweep rc=$? (BENCH_MICRO.json refreshed)" >> "$LOG/queue.log"
+    timeout 2400 python tools/config_sweep.py > "$LOG/config_sweep.log" 2>&1
+    echo "$(date -u +%T) config_sweep rc=$?" >> "$LOG/queue.log"
+    timeout 2400 python bench.py decode > "$LOG/decode.json" 2> "$LOG/decode.log"
+    echo "$(date -u +%T) decode rc=$?" >> "$LOG/queue.log"
+    timeout 2400 python tools/flash_tune.py  > "$LOG/flash_tune.log" 2>&1
+    echo "$(date -u +%T) flash_tune rc=$?" >> "$LOG/queue.log"
+    timeout 2400 python tools/quant_headline.py > "$LOG/quant_headline.log" 2>&1
+    echo "$(date -u +%T) quant_headline rc=$?" >> "$LOG/queue.log"
     echo "$(date -u +%T) queue done" >> "$LOG/queue.log"
     exit 0
   fi

@@ -681,6 +681,19 @@ def _unwind(frame: Frame, ins, exc_table, e: BaseException) -> int:
     return frame.jump_to_offset(entry.target)
 
 
+# per-code-object handler resolution: one list indexed by instruction, built
+# once — removes the opname attribute access + dict hash from the hot loop
+_resolved_handlers: dict = {}
+
+
+def _handlers_for(code, instrs):
+    hs = _resolved_handlers.get(code)
+    if hs is None:
+        hs = [_handlers.get(ins.opname) for ins in instrs]
+        _resolved_handlers[code] = hs
+    return hs
+
+
 def _frame_loop(frame: Frame, instrs, exc_table):
     # For NON-generator frames an escaping user StopIteration is smuggled out
     # in a carrier (the try wraps the whole loop below) — _frame_loop is a
@@ -692,16 +705,21 @@ def _frame_loop(frame: Frame, instrs, exc_table):
         i = 0
         n = len(instrs)
         ctx_log = frame.ctx
+        log = ctx_log.log
+        log_limit = ctx_log.log_limit
         co_name = frame.code.co_name
         depth = frame.depth
+        handlers = _handlers_for(frame.code, instrs)
         while i < n:
             ins = instrs[i]
-            op = ins.opname
-            ctx_log.record("op", depth, co_name, op, ins.argrepr)
-            h = _handlers.get(op)
+            # skip tuple construction once truncated; <= (not <) because at
+            # len == limit record() still appends its truncation MARKER
+            if len(log) <= log_limit:
+                ctx_log.record("op", depth, co_name, ins.opname, ins.argrepr)
+            h = handlers[i]
             if h is None:
                 raise InterpreterError(
-                    f"opcode {op} is not supported by the bytecode interpreter yet "
+                    f"opcode {ins.opname} is not supported by the bytecode interpreter yet "
                     f"(in {frame.code.co_name}); use the functional frontend or mark the callee opaque"
                 )
             try:

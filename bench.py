@@ -177,12 +177,16 @@ def _resolve_backend() -> str:
     """Return the JAX backend name, surviving flaky TPU init.
 
     Round 1's bench died at backend init; round 2's two 240 s probes gave up
-    too early and fell back to a CPU smoke.  Now: probe in a SUBPROCESS with
-    a hard timeout (in-process init can hang ~25 min and JAX caches a failed
-    backend for the process lifetime), retrying with backoff until
-    ``THUNDER_TPU_BENCH_MAX_WAIT_S`` (default 2400 s) is spent; every attempt
-    is recorded in ``tpu_attempts`` (merged into the JSON artifact).  Only
-    then force CPU (smoke mode) so a diagnostic number is still produced.
+    too early and fell back to a CPU smoke; round 3's 2400 s default outlived
+    the DRIVER's ~20 min window entirely (BENCH_r03.json: rc=124, no output).
+    Now: probe in a SUBPROCESS with a hard timeout (in-process init can hang
+    ~25 min and JAX caches a failed backend for the process lifetime),
+    retrying with backoff until ``THUNDER_TPU_BENCH_MAX_WAIT_S`` (default
+    600 s — the probe must leave the driver window room for the CPU-fallback
+    run; set the env higher for patient builder-side runs) is spent; every
+    attempt is recorded in ``tpu_attempts`` (merged into the JSON artifact).
+    Only then force CPU (smoke mode) so a diagnostic number is still
+    produced, with the latest committed TPU result embedded as ``last_tpu``.
     """
     if os.environ.get("THUNDER_TPU_BENCH_FORCE_CPU"):
         from thunder_tpu._platform import force_cpu
@@ -191,7 +195,7 @@ def _resolve_backend() -> str:
         return jax.default_backend()
     import subprocess
 
-    budget = float(os.environ.get("THUNDER_TPU_BENCH_MAX_WAIT_S", "2400"))
+    budget = float(os.environ.get("THUNDER_TPU_BENCH_MAX_WAIT_S", "600"))
     t_start = time.monotonic()
     attempt = 0
     sleep_s = 30.0
@@ -202,7 +206,7 @@ def _resolve_backend() -> str:
         try:
             probe = subprocess.run(
                 [sys.executable, "-c", "import jax; print(jax.default_backend())"],
-                timeout=min(600, max(60, budget - (time.monotonic() - t_start))),
+                timeout=min(300, max(60, budget - (time.monotonic() - t_start))),
                 capture_output=True,
                 text=True,
             )
@@ -269,66 +273,22 @@ def mfu(tokens_per_sec: float, cfg: llama.Config, T: int, backend: str) -> float
 #
 
 
-_FETCH_FLOOR = None
+# tunnel-proof timing primitives live in the benchmark library (shared with
+# the per-op/per-block/per-model benchmark classes); aliased here for the
+# harness tests and historical call sites
+from thunder_tpu.benchmarks import timing as _timing
 
-
-def _sync(x):
-    """Force execution by fetching one element to the host.
-
-    On the tunneled axon TPU backend ``jax.block_until_ready`` returns
-    without waiting (measured: a B=8 H=32 T=2048 SDPA "completed" in 50us,
-    20x the chip's peak FLOPS).  Only an actual device->host transfer
-    round-trips, so timing loops must end with a real fetch.  Execution is
-    in-order per device, so fetching the last output fences the whole loop.
-    """
-    leaf = next(l for l in jax.tree_util.tree_leaves(x) if hasattr(l, "dtype"))
-    return float(jnp.reshape(leaf, (-1,))[0].astype(jnp.float32))
-
-
-def _fetch_floor():
-    """Median cost of a tiny compute+fetch — the tunnel round-trip latency
-    (~84 ms over axon, ~us on local backends), subtracted from loop times."""
-    global _FETCH_FLOOR
-    if _FETCH_FLOOR is None:
-        xs = jnp.zeros((8,), jnp.float32)
-        _sync(xs + 1.0)
-        ts = []
-        for i in range(5):
-            t0 = time.perf_counter()
-            _sync(xs + float(i))
-            ts.append(time.perf_counter() - t0)
-        _FETCH_FLOOR = sorted(ts)[len(ts) // 2]
-    return _FETCH_FLOOR
+_sync = _timing.sync
+_fetch_floor = _timing.fetch_floor
 
 
 def _time_fn(fn, *args, iters=20):
-    out = fn(*args)
-    _sync(out)  # compile + warm
-    floor = _fetch_floor()
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    _sync(out)
-    dt = time.perf_counter() - t0 - floor
-    per = max(dt / iters, 1e-9)
-    if dt < 5 * floor:  # fetch floor dominates: redo with enough iterations
-        iters = min(max(iters, int(10 * floor / per)), 2000)
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            out = fn(*args)
-        _sync(out)
-        dt = time.perf_counter() - t0 - floor
-        if dt < 0.5 * floor:  # fetch-floor jitter swamped the signal even at max iters
-            log(f"_time_fn: measurement unreliable (loop {dt*1e3:.1f} ms vs floor "
-                f"{floor*1e3:.1f} ms at {iters} iters)")
-            return float("nan")
-        per = max(dt / iters, 1e-9)
-    return per
+    return _timing.time_fn(fn, *args, iters=iters)
 
 
 def _best_ms(fn, *args, reps=3):
-    """Best-of-reps wall time in ms — rides out tunnel cold-start drift.
-    NaN (unreliable) reps are dropped; all-NaN returns NaN."""
+    # goes through the module-level _time_fn (not _timing.best_ms) so tests
+    # can monkeypatch the per-rep measurement
     vals = [v for v in (_time_fn(fn, *args) for _ in range(reps)) if v == v]
     return min(vals) * 1e3 if vals else float("nan")
 
@@ -502,6 +462,75 @@ def sweep_benchmarks(on_tpu: bool, out_path: str = "BENCH_MICRO.json"):
     return results
 
 
+def blocks_benchmarks(on_tpu: bool, out_path: str = "BENCH_BLOCKS.json"):
+    """Per-op + per-block + per-model benchmark classes (the reference's
+    reusable benchmark library tier, benchmarks/__init__.py:50-460), written
+    to a committed JSON artifact."""
+    from thunder_tpu.benchmarks import all_benchmarks, run_benchmark
+
+    rows = []
+    for b in all_benchmarks(on_tpu):
+        try:
+            r = run_benchmark(b)
+            rows.append(r.row())
+            log(f"blocks {b.tier}/{b.name}: thunder {r.thunder_ms:.3f} ms"
+                + (f" vs jax {r.baseline_ms:.3f} ms ({r.speedup}x)" if r.baseline_ms else ""))
+        except Exception as e:
+            rows.append({"name": b.name, "tier": b.tier, "error": str(e)[-200:]})
+            log(f"blocks {b.tier}/{b.name}: ERROR {e}")
+    artifact = {"backend": jax.default_backend(), "rows": rows}
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=1)
+    log(f"blocks artifact written to {out_path}")
+    return rows
+
+
+def scaling_table(out_path: str = "BENCH_SCALING.json"):
+    """Distributed scaling table on the virtual CPU mesh: tokens/s at
+    1/2/4/8 devices × ddp/fsdp/tp (the reference's multiprocess distributed
+    benchmark runner analog, benchmarks/__init__.py:584-698 — torchrun
+    spawns there; one process + virtual mesh here).  CPU tokens/s say
+    nothing about ICI — the table's value is the TREND (does throughput
+    scale with the mesh?) and CI-policing the sharded step at every size."""
+    from jax.sharding import PartitionSpec as P
+
+    from thunder_tpu._platform import force_cpu
+
+    force_cpu(8)
+    from thunder_tpu import distributed as dist
+
+    cfg = llama.Config.from_name("tiny-llama-debug")
+    B, T, steps = 16, 64, 4
+    idx = jax.random.randint(jax.random.PRNGKey(1), (B, T), 0, cfg.vocab_size)
+    tgt = jax.random.randint(jax.random.PRNGKey(2), (B, T), 0, cfg.vocab_size)
+    cos, sin = llama.build_rope_cache(cfg, T)
+    table: dict[str, dict[str, float]] = {}
+    for mode in ("ddp", "fsdp", "tp"):
+        table[mode] = {}
+        for n in (1, 2, 4, 8):
+            axes = {"tp": {"tp": n}, "fsdp": {"fsdp": n}, "ddp": {"dp": n}}[mode]
+            bspec = P() if mode == "tp" else P(next(iter(axes)))
+            mesh = dist.make_mesh(axes, devices=jax.devices()[:n])
+            place = {"ddp": dist.ddp, "fsdp": dist.fsdp, "tp": dist.tp_fsdp}[mode]
+            params = place(llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32), mesh)
+            step = dist.make_train_step(
+                lambda p, i, t, c, s: llama.gpt_loss(p, i, t, c, s, cfg),
+                optax.adamw(1e-3), mesh, batch_specs=(bspec, bspec, P(), P()),
+            )
+            opt = step.init_optimizer_state(params)
+            params, opt, loss = step(params, opt, idx, tgt, cos, sin)  # compile
+            _sync(loss)
+            dt_s, _ = time_steps(lambda p, o: step(p, o, idx, tgt, cos, sin), steps, params, opt)
+            table[mode][str(n)] = round(B * T * steps / dt_s, 1)
+            log(f"scaling {mode} x{n}: {table[mode][str(n)]:,.0f} tokens/s (cpu smoke)")
+    artifact = {"backend": jax.default_backend(), "note": "virtual-mesh CPU smoke; trend only",
+                "shapes": {"B": B, "T": T, "cfg": cfg.name}, "table": table}
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=1)
+    log(f"scaling artifact written to {out_path}")
+    return table
+
+
 def dist_throughput_smoke():
     """Virtual-mesh distributed throughput (8 CPU devices): a correctness-
     speed SMOKE (clearly labeled — CPU tokens/s say nothing about ICI), the
@@ -611,7 +640,25 @@ def main():
             "unit": "tokens/s", "vs_baseline": 1.0, "modes": r,
         }))
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "scaling":
+        # virtual-mesh scaling table: forces 8 CPU devices itself, no TPU probe
+        t = scaling_table()
+        best = max(v for row in t.values() for v in row.values())
+        print(json.dumps({
+            "metric": "dist_scaling_table_cpu_smoke", "value": best,
+            "unit": "tokens/s", "vs_baseline": 1.0, "table": t,
+        }))
+        return
     on_tpu = _resolve_backend() == "tpu"
+    if len(sys.argv) > 1 and sys.argv[1] == "blocks":
+        rows = blocks_benchmarks(on_tpu)
+        ok = [r["speedup"] for r in rows if isinstance(r.get("speedup"), (int, float))]
+        print(json.dumps({
+            "metric": "blocks_geomean_speedup_vs_jax",
+            "value": round(float(np.prod(ok) ** (1 / len(ok))), 3) if ok else 0.0,
+            "unit": "x", "vs_baseline": 1.0, "n": len(rows),
+        }))
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "micro":
         micro_benchmarks(on_tpu)
         print(json.dumps({"metric": "micro", "value": 1.0, "unit": "ok", "vs_baseline": 1.0}))
@@ -680,6 +727,8 @@ def main():
         "backend": backend,
         "tpu_attempts": _all_attempts(),
     }
+    if backend != "tpu":
+        report["last_tpu"] = _last_tpu_result()
     if exercise_tpu_path:
         # extrapolate to the 32-layer 7B: per-token FLOPs scale with the layer
         # count (embedding/head amortize), so tokens/s_7B ≈ tokens/s_4L ×
@@ -688,6 +737,19 @@ def main():
         scale = model_flops_per_token(cfg, T) / model_flops_per_token(full, T)
         report["extrapolated_7b_tokens_per_sec"] = round(compiled_tps * scale, 1)
     print(json.dumps(report))
+
+
+def _last_tpu_result():
+    """Latest committed real-TPU headline (BENCH_TPU.json), embedded into any
+    non-TPU artifact so a tunnel-down driver run is never information-free
+    (VERDICT r3 #1: BENCH_r03.json parsed to null while the real numbers sat
+    in a separately committed file)."""
+    try:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_TPU.json")
+        with open(path) as f:
+            return json.load(f)
+    except Exception:
+        return None
 
 
 def _all_attempts() -> list:
@@ -711,5 +773,6 @@ if __name__ == "__main__":
             "value": 0.0,
             "unit": "tokens/s",
             "vs_baseline": 0.0,
+            "last_tpu": _last_tpu_result(),
         }))
         sys.exit(1)

@@ -1,0 +1,249 @@
+"""Reusable benchmark-class library (reference ``thunder/benchmarks/__init__.py:50-460``).
+
+The reference ships ~25 benchmark classes sharing one contract — a
+``Benchmark`` with a name, a ``make_batch`` (sample inputs), and an ``fn``
+to time — plus harness functions that run any of them under any executor
+and report wallclock stats.  The TPU-native analog here keeps that contract
+but times with the tunnel-proof methodology (a real device→host fetch is
+the only reliable fence over the axon tunnel; ``timing.time_fn``) and
+compares the thunder_tpu pipeline against stock ``jax.jit`` instead of
+torch eager.
+
+Tiers (mirroring the reference's spread):
+- per-op      — gelu, cross_entropy, rms_norm, sdpa, swiglu (``op_benchmarks``)
+- per-block   — MLP, causal self-attention, full transformer block
+  (``block_benchmarks``; reference LitGPTMLP/CSA/Block classes, :584-698)
+- per-model   — the llama family train step (``model_benchmarks``)
+
+Every class is importable and pytest-runnable (``tests/test_benchmarks.py``)
+and drivable standalone via ``python bench.py blocks``.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from thunder_tpu.benchmarks.timing import best_ms, fetch_floor, sync, time_fn
+
+__all__ = [
+    "Benchmark",
+    "BenchmarkResult",
+    "run_benchmark",
+    "op_benchmarks",
+    "block_benchmarks",
+    "model_benchmarks",
+    "all_benchmarks",
+]
+
+
+@dataclasses.dataclass
+class Benchmark:
+    """One timeable workload: ``fn(*make_batch())`` under the thunder_tpu
+    jit, ``baseline_fn`` (same math, plain jax) under stock ``jax.jit``."""
+
+    name: str
+    fn: Callable  # thunder_tpu-level callable (ltorch ops)
+    baseline_fn: Callable | None  # plain-jax same-math callable (None: reuse fn)
+    make_batch: Callable[[], tuple]  # () -> args
+    tier: str = "op"  # op | block | model
+
+
+@dataclasses.dataclass
+class BenchmarkResult:
+    name: str
+    tier: str
+    thunder_ms: float
+    baseline_ms: float | None
+    speedup: float | None  # baseline / thunder
+
+    def row(self) -> dict:
+        out = {"name": self.name, "tier": self.tier,
+               "thunder_ms": round(self.thunder_ms, 4)}
+        if self.baseline_ms is not None:
+            out["jax_ms"] = round(self.baseline_ms, 4)
+            out["speedup"] = round(self.speedup, 3) if self.speedup else None
+        return out
+
+
+def run_benchmark(b: Benchmark, *, reps: int = 3) -> BenchmarkResult:
+    """Times ``b`` (thunder pipeline vs stock jax.jit), pairwise-interleaved
+    per rep with per-side min — the tunneled backend drifts by whole
+    percents between loops (measured r3), so each rep times both sides
+    back-to-back and min() rides the drift out."""
+    import thunder_tpu as tt
+
+    args = b.make_batch()
+    tfn = tt.jit(b.fn)
+    jfn = jax.jit(b.baseline_fn) if b.baseline_fn is not None else None
+    t_vals, j_vals = [], []
+    for _ in range(reps):
+        t = time_fn(tfn, *args)
+        if t == t:
+            t_vals.append(t)
+        if jfn is not None:
+            j = time_fn(jfn, *args)
+            if j == j:
+                j_vals.append(j)
+    t_ms = min(t_vals) * 1e3 if t_vals else float("nan")
+    j_ms = min(j_vals) * 1e3 if j_vals else None
+    speedup = (j_ms / t_ms) if (j_ms and t_ms == t_ms and t_ms > 0) else None
+    return BenchmarkResult(b.name, b.tier, t_ms, j_ms, speedup)
+
+
+#
+# Shape presets: "tpu" = the headline-scale shapes (v5e, bf16), "cpu" = toy
+# dims for CI (the classes themselves are shape-agnostic)
+#
+
+
+def _shapes(on_tpu: bool) -> dict:
+    if on_tpu:
+        return dict(B=8, H=32, T=2048, hs=128, C=4096, V=32000, I=11008, dt=jnp.bfloat16)
+    return dict(B=2, H=2, T=128, hs=32, C=128, V=512, I=344, dt=jnp.float32)
+
+
+def op_benchmarks(on_tpu: bool) -> list[Benchmark]:
+    """Per-op tier (reference targets.py:402-700 op benchmarks)."""
+    import thunder_tpu.torch as ltorch
+
+    s = _shapes(on_tpu)
+    B, T, C, V, I, dt = s["B"], s["T"], s["C"], s["V"], s["I"], s["dt"]
+    key = jax.random.PRNGKey(0)
+    k = lambda i: jax.random.fold_in(key, i)
+    N = B * T
+
+    def batch_rows():
+        return (jax.random.normal(k(0), (N, C), dtype=dt),)
+
+    def batch_ce():
+        return (jax.random.normal(k(1), (N, V), dtype=jnp.float32),
+                jax.random.randint(k(2), (N,), 0, V))
+
+    def batch_norm():
+        return (jax.random.normal(k(0), (N, C), dtype=dt), jnp.ones((C,), dtype=dt))
+
+    def batch_mlp():
+        return (jax.random.normal(k(0), (N, C), dtype=dt),
+                jax.random.normal(k(3), (I, C), dtype=dt) * 0.02,
+                jax.random.normal(k(4), (I, C), dtype=dt) * 0.02,
+                jax.random.normal(k(5), (C, I), dtype=dt) * 0.02)
+
+    def plain_ce(l, t):
+        lse = jax.nn.logsumexp(l, axis=-1)
+        return (lse - jnp.take_along_axis(l, t[:, None], axis=1)[:, 0]).mean()
+
+    def plain_rms(a, w):
+        af = a.astype(jnp.float32)
+        ms = jnp.mean(af * af, axis=-1, keepdims=True)
+        return ((af * jax.lax.rsqrt(ms + 1e-5)) * w.astype(jnp.float32)).astype(a.dtype)
+
+    import functools
+
+    return [
+        Benchmark("gelu", lambda a: ltorch.gelu(a),
+                  functools.partial(jax.nn.gelu, approximate=False), batch_rows),
+        Benchmark("cross_entropy", lambda l, t: ltorch.cross_entropy(l, t), plain_ce, batch_ce),
+        Benchmark("rms_norm", lambda a, w: ltorch.rms_norm(a, (C,), w), plain_rms, batch_norm),
+        Benchmark("swiglu_mlp",
+                  lambda x, a, b, c: ltorch.linear(ltorch.silu(ltorch.linear(x, a)) * ltorch.linear(x, b), c),
+                  lambda x, a, b, c: (jax.nn.silu(x @ a.T) * (x @ b.T)) @ c.T, batch_mlp),
+    ]
+
+
+def block_benchmarks(on_tpu: bool) -> list[Benchmark]:
+    """Per-block tier: MLP / causal self-attention / full transformer block
+    through the framework vs the hand-written jax mirror (reference
+    LitGPTMLP / LitGPTCSA / LitGPTBlock benchmark classes)."""
+    from thunder_tpu.models import llama
+
+    s = _shapes(on_tpu)
+    B, dt = s["B"], s["dt"]
+    if on_tpu:
+        cfg = llama.Config.from_name("Llama-2-7b-hf", n_layer=1)
+    else:
+        cfg = llama.Config.from_name("tiny-llama-debug", n_layer=1)
+    T = min(s["T"], cfg.block_size)
+    key = jax.random.PRNGKey(0)
+    params = llama.init_params(cfg, key, dtype=dt)
+    bp = params["blocks"][0]
+    cos, sin = llama.build_rope_cache(cfg, T, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.fold_in(key, 9), (B, T, cfg.n_embd), dtype=dt)
+
+    # the hand-written jax mirrors (same math as models/llama, no tracing)
+    def jax_rms(h, w):
+        hf = h.astype(jnp.float32)
+        ms = jnp.mean(hf * hf, axis=-1, keepdims=True)
+        return ((hf * jax.lax.rsqrt(ms + cfg.norm_eps)) * w.astype(jnp.float32)).astype(h.dtype)
+
+    def jax_rope(h, cos_, sin_):
+        half = h.shape[-1] // 2
+        rotated = jnp.concatenate([-h[..., half:], h[..., :half]], axis=-1)
+        return (h * cos_ + rotated * sin_).astype(h.dtype)
+
+    def jax_csa(ap, h):
+        Bl, Tl, Cl = h.shape
+        hs, nh, ng = cfg.head_size, cfg.n_head, cfg.n_query_groups
+        q = (h @ ap["wq"].T).reshape(Bl, Tl, nh, hs).transpose(0, 2, 1, 3)
+        kk = (h @ ap["wk"].T).reshape(Bl, Tl, ng, hs).transpose(0, 2, 1, 3)
+        v = (h @ ap["wv"].T).reshape(Bl, Tl, ng, hs).transpose(0, 2, 1, 3)
+        q, kk = jax_rope(q, cos, sin), jax_rope(kk, cos, sin)
+        if ng != nh:
+            kk = jnp.repeat(kk, nh // ng, axis=1)
+            v = jnp.repeat(v, nh // ng, axis=1)
+        sres = (q @ kk.transpose(0, 1, 3, 2)).astype(jnp.float32) / (hs ** 0.5)
+        mask = jnp.tril(jnp.ones((Tl, Tl), dtype=bool))
+        sres = jnp.where(mask, sres, -jnp.inf)
+        y = (jax.nn.softmax(sres, axis=-1).astype(q.dtype) @ v)
+        y = y.transpose(0, 2, 1, 3).reshape(Bl, Tl, nh * hs)
+        return y @ ap["wo"].T
+
+    def jax_mlp(mp, h):
+        return (jax.nn.silu(h @ mp["fc_1"].T) * (h @ mp["fc_2"].T)) @ mp["proj"].T
+
+    def jax_block(bp_, h):
+        a = h + jax_csa(bp_["attn"], jax_rms(h, bp_["norm_1"]))
+        return a + jax_mlp(bp_["mlp"], jax_rms(a, bp_["norm_2"]))
+
+    # cos/sin travel as explicit args: the thunder jit proxies ARGUMENTS —
+    # a closed-over concrete jax array inside ltorch ops is "not number-like"
+    return [
+        Benchmark("block_mlp", lambda mp, h: llama.mlp(mp, h, cfg),
+                  jax_mlp, lambda: (bp["mlp"], x), tier="block"),
+        Benchmark("block_csa",
+                  lambda ap, h, c, s: llama.attention(ap, h, c, s, cfg),
+                  lambda ap, h, c, s: jax_csa(ap, h), lambda: (bp["attn"], x, cos, sin),
+                  tier="block"),
+        Benchmark("transformer_block",
+                  lambda bp_, h, c, s: llama.block_forward(bp_, h, c, s, cfg),
+                  lambda bp_, h, c, s: jax_block(bp_, h), lambda: (bp, x, cos, sin),
+                  tier="block"),
+    ]
+
+
+def model_benchmarks(on_tpu: bool) -> list[Benchmark]:
+    """Per-model tier: full llama forward+loss (the headline's fwd leg)."""
+    from thunder_tpu.models import llama
+
+    s = _shapes(on_tpu)
+    B, dt = s["B"], s["dt"]
+    cfg = (llama.Config.from_name("Llama-2-7b-hf", n_layer=2) if on_tpu
+           else llama.Config.from_name("tiny-llama-debug"))
+    T = min(s["T"], cfg.block_size)
+    key = jax.random.PRNGKey(0)
+    params = llama.init_params(cfg, key, dtype=dt)
+    idx = jax.random.randint(jax.random.fold_in(key, 1), (B, T), 0, cfg.vocab_size)
+    tgt = jax.random.randint(jax.random.fold_in(key, 2), (B, T), 0, cfg.vocab_size)
+    cos, sin = llama.build_rope_cache(cfg, T, dtype=jnp.float32)
+
+    return [
+        Benchmark(f"{cfg.name}_loss",
+                  lambda p, i, t, c, s: llama.gpt_loss(p, i, t, c, s, cfg),
+                  None, lambda: (params, idx, tgt, cos, sin), tier="model"),
+    ]
+
+
+def all_benchmarks(on_tpu: bool) -> list[Benchmark]:
+    return op_benchmarks(on_tpu) + block_benchmarks(on_tpu) + model_benchmarks(on_tpu)

@@ -12,9 +12,10 @@ outside the explicit arguments.
 Scope (deliberate, documented): the common Python subset model code uses —
 arithmetic, containers, control flow, comprehensions, nested function calls,
 closures, imports, try/except/finally (full 3.12 exception-table dispatch),
-``with`` blocks (incl. exception suppression), and generators (suspendable
-interpreted frames with send/throw/close, ``yield from``, genexprs, PEP-479).
-Async raises ``InterpreterError`` with a pointer to the escape hatch.
+``with`` blocks (incl. exception suppression), generators (suspendable
+interpreted frames with send/throw/close, ``yield from``, genexprs, PEP-479),
+and async (``async def``/``await``/``async for``/``async with``, natively
+interpreted as suspendable coroutine frames — see TestAsync).
 Targets CPython 3.12 bytecode.
 """
 from __future__ import annotations
@@ -24,6 +25,7 @@ import collections.abc as _abc
 import dis
 import inspect
 import types
+import weakref
 from dataclasses import dataclass, field
 from enum import Enum, auto
 from typing import Any, Callable
@@ -276,6 +278,72 @@ def _is_interpretable(fn) -> bool:
     return isinstance(fn, types.FunctionType) and fn.__code__ is not None
 
 
+def _provenance_builtin_call(ctx: "InterpreterCompileCtx", depth: int, fn, args, kwargs):
+    """Provenance-preserving interpretation of the builtins most likely to
+    reach guarded state: ``getattr``, ``operator.getitem``, and bound
+    ``dict.get`` (reference interpreter.py:1324-2200 interprets *through*
+    ~60 builtins for the same reason).  An opaque host call would lose the
+    access chain — a hyperparameter read via ``cfg.get("lr")`` could never
+    become a prologue guard, so mutating it would silently replay the stale
+    program.  Returns ``(handled, value)``."""
+    import operator
+
+    if kwargs:
+        return False, None
+    if fn is getattr and len(args) in (2, 3) and isinstance(args[1], str):
+        obj, name = args[0], args[1]
+        base_rec = ctx.prov_of(obj)
+        try:
+            v = getattr(obj, name)
+        except AttributeError:
+            if len(args) == 3:
+                if base_rec is not None:
+                    # absence observed: guard the base object (where
+                    # guardable) so adding the attribute retraces
+                    ctx.record_read(base_rec, obj)
+                return True, args[2]
+            raise
+        if base_rec is not None:
+            ctx.record("lookaside", depth, "builtins.getattr")
+            rec = ProvenanceRecord(PseudoInst.LOAD_ATTR, inputs=(base_rec,), key=name)
+            v = ctx.record_read(rec, v)
+            ctx.track(v, rec)
+        return True, v
+    if fn is operator.getitem and len(args) == 2:
+        obj, k = args
+        base_rec = ctx.prov_of(obj)
+        v = obj[k]
+        if base_rec is not None and isinstance(k, (int, str, bool)):
+            ctx.record("lookaside", depth, "operator.getitem")
+            rec = ProvenanceRecord(PseudoInst.BINARY_SUBSCR, inputs=(base_rec,), key=k)
+            v = ctx.record_read(rec, v)
+            ctx.track(v, rec)
+        return True, v
+    if (
+        isinstance(fn, types.BuiltinMethodType)
+        and fn.__name__ == "get"
+        and isinstance(getattr(fn, "__self__", None), dict)
+        and len(args) in (1, 2)
+        and isinstance(args[0], (int, str, bool))
+    ):
+        d = fn.__self__
+        base_rec = ctx.prov_of(d)
+        if args[0] not in d:
+            if base_rec is not None:
+                # a miss must also guard: inserting the key later retraces
+                # instead of replaying the baked default branch
+                ctx.record_read(base_rec, d)
+            return True, (args[1] if len(args) == 2 else None)
+        v = d[args[0]]
+        if base_rec is not None:
+            ctx.record("lookaside", depth, "dict.get")
+            rec = ProvenanceRecord(PseudoInst.BINARY_SUBSCR, inputs=(base_rec,), key=args[0])
+            v = ctx.record_read(rec, v)
+            ctx.track(v, rec)
+        return True, v
+    return False, None
+
+
 def _call_value(ctx: InterpreterCompileCtx, depth: int, fn, args, kwargs):
     """Calls ``fn``: lookasides substitute first, user Python functions
     recurse through the interpreter; everything else runs as an opaque host
@@ -293,6 +361,9 @@ def _call_value(ctx: InterpreterCompileCtx, depth: int, fn, args, kwargs):
     if la is not None:
         ctx.record("lookaside", depth, getattr(fn, "__qualname__", repr(fn)))
         return la(*args, **kwargs)
+    handled, v = _provenance_builtin_call(ctx, depth, fn, args, kwargs)
+    if handled:
+        return v
     if depth >= ctx.max_depth:
         return fn(*args, **kwargs)
     if isinstance(fn, types.MethodType) and _is_interpretable(fn.__func__) and fn.__func__ not in ctx.opaque:
@@ -682,8 +753,10 @@ def _unwind(frame: Frame, ins, exc_table, e: BaseException) -> int:
 
 
 # per-code-object handler resolution: one list indexed by instruction, built
-# once — removes the opname attribute access + dict hash from the hot loop
-_resolved_handlers: dict = {}
+# once — removes the opname attribute access + dict hash from the hot loop.
+# Weak keys: code objects of dynamically generated functions must not be
+# pinned forever in long-lived processes (ADVICE r3)
+_resolved_handlers: weakref.WeakKeyDictionary = weakref.WeakKeyDictionary()
 
 
 def _handlers_for(code, instrs):

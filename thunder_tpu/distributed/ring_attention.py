@@ -75,11 +75,14 @@ def ring_attention(
     axis: str = "sp",
     causal: bool = True,
     scale: float | None = None,
+    window: int | None = None,
 ):
     """Attention over sequence-sharded q/k/v.
 
     q, k, v: (B, H, T, hs) with T sharded over ``mesh[axis]`` (replicated
     over any other mesh axes).  Returns (B, H, T, hs) with the same layout.
+    ``window``: sliding-window band (attend to (q-window, q]); requires
+    ``causal`` — same semantics as the fused SDPA prim.
     """
     sp = mesh.shape[axis]
     B, H, T, hs = q.shape
@@ -87,21 +90,31 @@ def ring_attention(
     scale = scale if scale is not None else 1.0 / math.sqrt(hs)
 
     def body(qb, kb, vb):
-        return ring_attend_shard(qb, kb, vb, axis=axis, sp=sp, causal=causal, scale=scale)
+        return ring_attend_shard(qb, kb, vb, axis=axis, sp=sp, causal=causal, scale=scale,
+                                 window=window)
 
     spec = P(None, None, axis, None)
     fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
     return fn(q, k, v)
 
 
-def ring_attend_shard(qb, kb, vb, *, axis: str, sp: int, causal: bool = True, scale: float | None = None):
+def ring_attend_shard(qb, kb, vb, *, axis: str, sp: int, causal: bool = True,
+                      scale: float | None = None, window: int | None = None):
     """The in-shard ring: callable from INSIDE an existing ``shard_map`` over
     ``axis`` (sequence-parallel training composes this with the rest of the
     model in one shard_map).  qb: (B, H, T_local, hs); kb/vb: (B, Hk,
     T_local, hs) with ``H % Hk == 0`` — GQA K/V rotate around the ring at
     their *grouped* size (``Hk`` heads) and expand per step only for the
     block matmuls, so ICI traffic and resident K/V stay at the grouped
-    footprint."""
+    footprint.
+
+    ``window``: sliding-window band — a key at global position k is visible
+    to query q iff ``q - window < k <= q`` (the fused SDPA prim's
+    semantics); masks come from global positions so the band holds across
+    ring shards."""
+    assert window is None or (causal and int(window) > 0), (
+        f"ring attention: window={window} requires causal=True and window > 0"
+    )
     B, H, t_loc, hs = qb.shape
     Hk = kb.shape[1]
     assert H % Hk == 0, f"query heads {H} must be a multiple of kv heads {Hk}"
@@ -130,6 +143,8 @@ def ring_attend_shard(qb, kb, vb, *, axis: str, sp: int, causal: bool = True, sc
         k_pos = cur_src * t_loc + jnp.arange(t_loc)
         if causal:
             mask = k_pos[None, :] <= q_pos[:, None]
+            if window is not None:
+                mask = mask & (k_pos[None, :] > q_pos[:, None] - window)
         else:
             mask = jnp.ones((t_loc, t_loc), dtype=bool)
         blk = _block_attend(qb, expand(cur_k), expand(cur_v), mask, scale)

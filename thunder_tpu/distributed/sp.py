@@ -38,7 +38,8 @@ def _sp_attention(ap, x, cos_b, sin_b, cfg, *, axis: str, sp: int):
     q, k, v = _project_qkv(ap, x, cos_b, sin_b, cfg)
     # GQA K/V stay at their grouped head count: the ring rotates the small
     # buffers and expands per block-attend step (ring_attend_shard)
-    y = ring_attend_shard(q, k, v, axis=axis, sp=sp, causal=True)
+    y = ring_attend_shard(q, k, v, axis=axis, sp=sp, causal=True,
+                          window=cfg.sliding_window)
     y = y.transpose(0, 2, 1, 3).reshape(B, T_loc, cfg.n_head * cfg.head_size)
     out = y @ ap["wo"].T
     return out if "bo" not in ap else out + ap["bo"]

@@ -35,14 +35,20 @@ from thunder_tpu.models.generate import _mlp, _norm, _project_qkv
 __all__ = ["ulysses_attend_shard", "ulysses_gpt_loss"]
 
 
-def ulysses_attend_shard(q, k, v, *, axis: str, sp: int, causal: bool = True):
+def ulysses_attend_shard(q, k, v, *, axis: str, sp: int, causal: bool = True,
+                         window: int | None = None):
     """Full-sequence attention from sequence-sharded q/k/v via two
     all_to_alls (runs under shard_map).
 
     q: (B, H, T_loc, hs); k/v: (B, G, T_loc, hs) with GQA groups expanded to
     H when G doesn't divide over ``sp``.  Returns (B, H, T_loc, hs) with the
-    same sequence sharding as the inputs.
+    same sequence sharding as the inputs.  ``window``: sliding-window band
+    (attend to (q-window, q]); requires ``causal`` — the attention here is
+    full-sequence per head group, so the band is a plain local mask.
     """
+    assert window is None or (causal and int(window) > 0), (
+        f"ulysses attention: window={window} requires causal=True and window > 0"
+    )
     B, H, T_loc, hs = q.shape
     G = k.shape[1]
     if G != H and G % sp != 0:
@@ -66,7 +72,11 @@ def ulysses_attend_shard(q, k, v, *, axis: str, sp: int, causal: bool = True):
     s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh, preferred_element_type=jnp.float32)
     s = s / (hs ** 0.5)
     if causal:
-        s = jnp.where(jnp.tril(jnp.ones((T, T), dtype=bool)), s, -jnp.inf)
+        keep = jnp.tril(jnp.ones((T, T), dtype=bool))
+        if window is not None:
+            col = jnp.arange(T)
+            keep = keep & (col[None, :] > col[:, None] - window)
+        s = jnp.where(keep, s, -jnp.inf)
     o = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1).astype(vh.dtype), vh)
 
     # head-sharded → seq-sharded: split sequence, gather heads
@@ -76,7 +86,8 @@ def ulysses_attend_shard(q, k, v, *, axis: str, sp: int, causal: bool = True):
 def _ulysses_attention(ap, x, cos_b, sin_b, cfg, *, axis: str, sp: int):
     B, T_loc, C = x.shape
     q, k, v = _project_qkv(ap, x, cos_b, sin_b, cfg)
-    y = ulysses_attend_shard(q, k, v, axis=axis, sp=sp, causal=True)
+    y = ulysses_attend_shard(q, k, v, axis=axis, sp=sp, causal=True,
+                             window=cfg.sliding_window)
     y = y.transpose(0, 2, 1, 3).reshape(B, T_loc, cfg.n_head * cfg.head_size)
     out = y @ ap["wo"].T
     return out if "bo" not in ap else out + ap["bo"]

@@ -112,6 +112,11 @@ class Config:
             assert not self.bias, "bias is not supported for the MoE MLP"
         if self.bias:
             assert self.norm_class == "LayerNorm", "bias implies LayerNorm (GPT-2/NeoX style)"
+        assert not (self.lm_head_bias and self.fused_head_ce), (
+            "fused_head_ce computes logits inside the fused prim and has no "
+            "bias input — it would silently drop lm_head_b; disable one of "
+            "lm_head_bias / fused_head_ce"
+        )
 
     @property
     def rope_n_elem(self) -> int:

@@ -176,7 +176,14 @@ def speculative_generate(
         T_max = min(cfg.block_size, T_prompt + max_new_tokens + K + 1)
     # the last verify chunk may reach K positions past the final emitted
     # token (finished rows freeze in place while slower rows catch up)
-    assert T_prompt + max_new_tokens + K <= T_max, "T_max too small for K-token speculation"
+    assert T_prompt + max_new_tokens + K <= T_max, (
+        f"T_max={T_max} too small for K-token speculation: the cache must hold "
+        f"T_prompt+max_new_tokens+K = {T_prompt}+{max_new_tokens}+{K} = "
+        f"{T_prompt + max_new_tokens + K} positions (the verify chunk can "
+        f"overshoot the last emitted token by K). A request that fits plain "
+        f"generate() exactly (T_prompt+max_new == block_size) needs K fewer "
+        f"new tokens, a smaller K, or a larger T_max/block_size."
+    )
     assert _cache_len(cfg, T_max) == T_max and _cache_len(draft_cfg, T_max) == T_max, (
         "speculative decoding needs full (non-ring) caches; sliding-window "
         "models decode via generate()"

@@ -370,13 +370,20 @@ class TrainStep:
             updates, new_opt_state = self.optimizer.update(grads, opt_state, params)
             return optax.apply_updates(params, updates), new_opt_state
 
-        def step(params, opt_state, *batch):
-            loss, grads = value_and_grad_fn(params, *batch)
-            new_params, new_opt_state = apply_gradients(params, opt_state, grads)
-            return new_params, new_opt_state, loss
-
         # shardings: params/opt from their current placement; batch from specs
         param_sh = jax.tree_util.tree_map(lambda x: x.sharding, params)
+
+        def step(params, opt_state, *batch):
+            loss, grads = value_and_grad_fn(params, *batch)
+            # pin each grad to its param's sharding HERE: SPMD then resolves
+            # the data-axes partial-sum straight into the param layout (one
+            # reduce-scatter/all-reduce) instead of propagating a layout the
+            # optimizer update can't transition from without a full
+            # rematerialization (spmd_partitioner.cc:652 warnings on the GQA
+            # kv grads under a dp×fsdp×tp mesh)
+            grads = jax.lax.with_sharding_constraint(grads, param_sh)
+            new_params, new_opt_state = apply_gradients(params, opt_state, grads)
+            return new_params, new_opt_state, loss
         opt_sh = jax.tree_util.tree_map(
             lambda x: x.sharding if isinstance(x, jax.Array) else None, opt_state
         )

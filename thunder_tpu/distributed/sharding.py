@@ -162,7 +162,14 @@ _LLAMA_RULES = [
     # MLP: up/gate column-parallel; down row-parallel
     (r"mlp/fc(_[12])?$", P("tp", "fsdp")),
     (r"mlp/proj$", P("fsdp", "tp")),
-    # embeddings / head: vocab dim over tp, embd over fsdp
+    # embeddings / head: vocab dim over tp, embd over fsdp.  Do NOT shard
+    # the embd (feature) dim instead: XLA SPMD mis-partitions the embedding
+    # gather/scatter on a feature-sharded table — measured on the 8-device
+    # mesh: P(None, "tp") corrupts even the FORWARD loss (5.5664 vs 5.5758),
+    # P(None, ("tp", "fsdp")) corrupts the wte grad by 5e-2 abs.  Vocab
+    # sharding is exact (grad diff 2e-8 vs single-device); its backward
+    # scatter hazard is retired by computing the embedding grad as a
+    # one-hot matmul under a mesh (jaxex._embedding_backward_impl).
     (r"^wte$", P("tp", "fsdp")),
     (r"^lm_head$", P("tp", "fsdp")),
     # norm scales: replicated (tiny)

@@ -415,8 +415,27 @@ def _embedding_backward_impl(grad, indices, num_weights, padding_idx):
     num_weights = int(num_weights)
     flat_idx = indices.reshape(-1)
     flat_grad = grad.reshape(-1, grad.shape[-1])
-    out = jnp.zeros((num_weights, grad.shape[-1]), dtype=grad.dtype)
-    out = out.at[flat_idx].add(flat_grad)
+    from thunder_tpu.executors.pallasex import _mesh_var
+
+    mesh = _mesh_var.get()
+    if mesh is not None and mesh.size > 1:
+        # One-hot matmul instead of scatter-add under a multi-device mesh:
+        # the (V, N)·(N, C) contraction partitions like any other matmul
+        # (data-sharded N → grad all-reduce) and rides the MXU.  XLA's
+        # scatter partitioner on this pattern either replicates the whole
+        # (N, C) update matrix (spmd_partitioner.cc:652 "involuntary full
+        # rematerialization" when the vocab dim is sharded) or produces a
+        # numerically WRONG sum (measured 5e-2 vs an f64 reference when the
+        # embd dim is sharded).  Single-device keeps the cheaper scatter —
+        # the matmul costs 2·N·V·C real FLOPs.
+        oh = (flat_idx[:, None] == jnp.arange(num_weights)[None, :])
+        out = jax.lax.dot_general(
+            oh.astype(flat_grad.dtype), flat_grad,
+            (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        ).astype(grad.dtype)
+    else:
+        out = jnp.zeros((num_weights, grad.shape[-1]), dtype=grad.dtype)
+        out = out.at[flat_idx].add(flat_grad)
     if padding_idx is not None and padding_idx >= 0:
         out = out.at[int(padding_idx)].set(0)
     return out

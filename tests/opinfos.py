@@ -24,7 +24,7 @@ def _t(shape, dtype=np.float32, *, low=None, high=None, positive=False, small=Fa
     """Random sample tensor. ``positive`` keeps values in (0.1, 2); ``small``
     keeps |x| < 0.9 (for atanh/acos-style domains)."""
     if dtype in (np.int32, np.int64):
-        lo = 0 if low is None else low
+        lo = (1 if positive else 0) if low is None else low
         hi = 10 if high is None else high
         return rng.integers(lo, hi, shape).astype(dtype)
     if dtype == np.bool_:
@@ -48,20 +48,49 @@ class OpInfo:
     sample: Callable  # dtype -> tuple of numpy arrays / python scalars
     supports_grad: bool = True
     supports_bf16: bool = True
+    supports_f16: bool = True  # forward in float16 (vs torch f16 reference)
+    supports_int: bool = False  # forward in int32 (exact comparison)
     rtol: float = 1e-5
     atol: float = 1e-6
     bf16_rtol: float = 2e-2
     bf16_atol: float = 2e-2
+    f16_rtol: float = 2e-2
+    f16_atol: float = 2e-2
     grad_rtol: float | None = None  # defaults to rtol
     grad_atol: float | None = None
     grad_argnums: tuple | None = None  # default: every float32 ndarray arg
+    #: () -> [(args, expected_exception_type(s), message_substring)] — the
+    #: negative-testing axis (reference opinfos carry error_input_generators
+    #: next to sample generators, thunder/tests/opinfos.py:315).  Every op
+    #: gets at least the default non-tensor-input case (see ``add``).
+    error_inputs: Callable | None = None
 
 
 opinfos: list[OpInfo] = []
 
 
+def _default_error_inputs(sample):
+    """Default negative case: the first tensor argument replaced by a
+    non-tensor — the op must fail loudly, not trace garbage.  AttributeError
+    is accepted alongside ValueError/TypeError: ops whose meta reads
+    ``.ndim``/``.shape`` before dtype validation surface the rejection as a
+    Python-level attribute failure."""
+    def gen():
+        args = list(sample(np.float32))
+        for i, a in enumerate(args):
+            if isinstance(a, np.ndarray):
+                args[i] = "not-a-tensor"
+                break
+        return [(tuple(args), (ValueError, TypeError, AttributeError), "")]
+
+    return gen
+
+
 def add(name, op, torch_ref, sample, **kw):
-    opinfos.append(OpInfo(name, op, torch_ref, sample, **kw))
+    info = OpInfo(name, op, torch_ref, sample, **kw)
+    if info.error_inputs is None:
+        info.error_inputs = _default_error_inputs(sample)
+    opinfos.append(info)
 
 
 #
@@ -490,3 +519,96 @@ add(
     "norm_inf", lambda a: ltorch.norm(a, float("inf"), 1),
     lambda a: torch.norm(a, float("inf"), 1), lambda dt: (_t((4, 5), dt),), supports_grad=False,
 )
+
+
+#
+# Targeted error inputs (reference error_input_generators,
+# thunder/tests/opinfos.py:315): shape/dim/domain violations must raise the
+# framework's documented exception types — RuntimeError for shape math,
+# IndexError for out-of-range dims, TypeError for dtype-rule violations.
+#
+
+_by_name = {o.name: o for o in opinfos}
+
+
+def _set_errors(name, gen):
+    _by_name[name].error_inputs = gen
+
+
+_set_errors("add", lambda: [
+    ((_t((4, 5)), _t((3, 7))), RuntimeError, "broadcast"),
+    (("nope", _t((4, 5))), (ValueError, TypeError), ""),
+])
+_set_errors("sub", lambda: [((_t((4, 5)), _t((3, 7))), RuntimeError, "broadcast")])
+_set_errors("mul", lambda: [((_t((4, 5)), _t((3, 7))), RuntimeError, "broadcast")])
+_set_errors("matmul", lambda: [
+    ((_t((4, 5)), _t((3, 7))), RuntimeError, "matmul"),
+    ((_t((4, 5)), "w"), (ValueError, TypeError), ""),
+])
+_set_errors("mm", lambda: [((_t((4, 5)), _t((3, 7))), RuntimeError, "")])
+_set_errors("bmm", lambda: [((_t((2, 4, 5)), _t((3, 5, 6))), RuntimeError, "")])
+# dim/shape cases below account for what the registered op lambdas bake in:
+# softmax/reductions use dim=1 → rank-1 input puts it out of range; reshape
+# targets (2, 10) → numel 24 can't; glu needs an even last dim; topk asks
+# k=3 → a size-2 dim can't
+_set_errors("softmax", lambda: [((_t((5,)),), IndexError, "out of range")])
+_set_errors("log_softmax", lambda: [((_t((5,)),), IndexError, "out of range")])
+_set_errors("sum_dim", lambda: [((_t((5,)),), IndexError, "out of range")])
+_set_errors("mean", lambda: [((_t((5,)),), IndexError, "out of range")])
+_set_errors("amax", lambda: [((_t((5,)),), IndexError, "out of range")])
+_set_errors("cumsum", lambda: [((_t((5,)),), IndexError, "out of range")])
+_set_errors("reshape", lambda: [((_t((4, 6)),), RuntimeError, "reshape")])
+_set_errors("cat", lambda: [((_t((3, 4)), _t((5, 4))), RuntimeError, "")])
+_set_errors("stack", lambda: [((_t((3, 4)), _t((3, 5))), RuntimeError, "")])
+_set_errors("permute", lambda: [((_t((2, 3)),), IndexError, "out of range")])
+_set_errors("transpose", lambda: [((_t((3,)),), IndexError, "out of range")])
+_set_errors("expand", lambda: [((_t((2, 3, 2)),), RuntimeError, "")])
+_set_errors("gather", lambda: [((_t((4, 6)), _t((4, 3), np.float32)), (TypeError, RuntimeError), "")])
+_set_errors("index_select", lambda: [((_t((4, 6)), _t((3,), np.float32)), (TypeError, RuntimeError), "indices")])
+_set_errors("scatter_add", lambda: [
+    ((_t((4, 6)), _t((4, 3), np.int32, high=6), _t((2, 2))), (RuntimeError, ValueError), ""),
+])
+_set_errors("bitwise_and", lambda: [((_t((4, 5)), _t((4, 5))), TypeError, "dtype")])
+_set_errors("bitwise_or", lambda: [((_t((4, 5)), _t((4, 5))), TypeError, "dtype")])
+_set_errors("bitwise_xor", lambda: [((_t((4, 5)), _t((4, 5))), TypeError, "dtype")])
+_set_errors("linear", lambda: [((_t((4, 5)), _t((6, 7)), None), RuntimeError, "")])
+_set_errors("cross_entropy", lambda: [
+    ((_t((6, 9)), _t((4,), np.int32, high=9)), RuntimeError, ""),
+])
+_set_errors("layer_norm", lambda: [
+    ((_t((4, 5)), _t((7,)), _t((7,))), RuntimeError, ""),
+])
+_set_errors("embedding", lambda: [((_t((4, 3)), _t((10, 5))), (TypeError, RuntimeError), "integer")])
+_set_errors("glu", lambda: [((_t((4, 5)),), RuntimeError, "")])
+_set_errors("topk", lambda: [((_t((4, 2)),), RuntimeError, "")])
+_set_errors("where", lambda: [
+    ((_t((4, 5), np.bool_), _t((3, 7)), _t((4, 5))), RuntimeError, "broadcast"),
+])
+_set_errors("getitem_int", lambda: [((_t((1, 6)),), IndexError, "out of range")])
+# dropout_p0's registered op bakes p=0.0 (identity — no reachable error), so
+# its negative case uses a custom callable (4-tuple form): p outside [0, 1)
+_set_errors("dropout_p0", lambda: [
+    (lambda a: ltorch.dropout(a, -0.5), (_t((4, 5)),), RuntimeError, "dropout p"),
+])
+
+
+#
+# Integer-dtype forward coverage (exact comparison): ops whose int32 result
+# is well-defined and matched by torch (reference opinfos carry int dtype
+# lists per op; here membership in this set turns the axis on).
+#
+
+_INT_OPS = {
+    "abs", "neg", "sign", "add", "sub", "mul", "floor_divide", "remainder",
+    "fmod", "maximum", "minimum", "eq", "ne", "ge", "gt", "le", "lt",
+    "where", "tril", "triu", "reshape", "permute", "transpose", "squeeze",
+    "unsqueeze", "flatten", "cat", "stack", "split", "chunk", "expand",
+    "movedim", "flip", "narrow", "roll", "tile", "broadcast_to",
+    "getitem_basic", "getitem_int", "sum", "sum_dim", "sum_keepdim", "prod",
+    "amax", "amin", "max_dim", "min_dim", "argmax", "argmin", "cumsum",
+    "sort", "argsort", "topk", "index_select", "gather", "take_along_dim",
+    "clamp",
+}
+for _o in opinfos:
+    if _o.name in _INT_OPS:
+        _o.supports_int = True

@@ -1,9 +1,12 @@
-"""The op-correctness matrix: op × dtype(f32/bf16) × (forward | grad).
+"""The op-correctness matrix: op × dtype(f32/bf16/f16/i32) × (forward | grad
+| error-inputs).
 
 Instantiation analog of the reference's ``@ops`` decorator
 (``thunder/tests/framework.py:304``) driving its OpInfo DB
 (``tests/opinfos.py:315``) — forward outputs and gradients are compared
-against torch references for every op in ``tests/opinfos.py``.
+against torch references for every op in ``tests/opinfos.py``, and every
+op's error-input generator must raise the documented exception type (the
+reference's error_input_generator axis).
 """
 import numpy as np
 import pytest
@@ -15,6 +18,8 @@ from opinfos import OpInfo, opinfos
 
 _f32_ids = [o.name for o in opinfos]
 _bf16_infos = [o for o in opinfos if o.supports_bf16]
+_f16_infos = [o for o in opinfos if o.supports_f16 and o.supports_bf16]
+_int_infos = [o for o in opinfos if o.supports_int]
 _grad_infos = [o for o in opinfos if o.supports_grad]
 
 
@@ -51,6 +56,47 @@ def test_forward_bf16(info: OpInfo):
     np.testing.assert_allclose(
         _to_np(got), _to_np(ref), rtol=info.bf16_rtol, atol=info.bf16_atol
     )
+
+
+@pytest.mark.parametrize("info", _f16_infos, ids=[o.name for o in _f16_infos])
+def test_forward_f16(info: OpInfo):
+    samples = info.sample(np.float32)
+    targs = [_to_torch_f16(s) for s in samples]
+    got = tt.jit(info.op)(*targs)
+    try:
+        ref = info.torch_ref(*[_to_torch_f16(s) for s in samples])
+    except RuntimeError as e:
+        pytest.skip(f"torch cpu has no f16 reference: {e}")
+    np.testing.assert_allclose(
+        _to_np(got), _to_np(ref), rtol=info.f16_rtol, atol=info.f16_atol
+    )
+
+
+def _to_torch_f16(x):
+    if isinstance(x, np.ndarray):
+        t = torch.from_numpy(x.copy())
+        return t.to(torch.float16) if t.dtype == torch.float32 else t
+    return x
+
+
+@pytest.mark.parametrize("info", _int_infos, ids=[o.name for o in _int_infos])
+def test_forward_i32(info: OpInfo):
+    samples = info.sample(np.int32)
+    got = tt.jit(info.op)(*[_to_torch(s) for s in samples])
+    ref = info.torch_ref(*[_to_torch(s) for s in samples])
+    np.testing.assert_array_equal(np.asarray(_to_np(got)), _to_np(ref))
+
+
+@pytest.mark.parametrize("info", opinfos, ids=_f32_ids)
+def test_error_inputs(info: OpInfo):
+    cases = info.error_inputs()
+    assert cases, f"{info.name}: empty error-input generator"
+    for case in cases:
+        # 4-tuple form carries a custom callable (ops whose registered
+        # lambda bakes the offending argument away, e.g. dropout's p)
+        fn, (args, exc_type, match) = (info.op, case) if len(case) == 3 else (case[0], case[1:])
+        with pytest.raises(exc_type, match=match if match else None):
+            tt.jit(fn)(*args)
 
 
 @pytest.mark.parametrize("info", _grad_infos, ids=[o.name for o in _grad_infos])

@@ -856,10 +856,47 @@ def _ce_kernel(logits_ref, tgt_ref, loss_ref, lse_ref, m_s, s_s, p_s, *, BN, BV)
         loss_ref[...] = lse - p_s[...]
 
 
+@functools.lru_cache(maxsize=1)
+def _tuning() -> dict:
+    """Measured kernel tuning, committed by tools/kernel_tune.py from a real
+    TPU run (VERDICT r3 #2: a kernel that loses to XLA must win or yield).
+    Keys: ``ce.bn`` / ``ce.bv_cap`` (block geometry), ``ce.claim`` (False =
+    the checker defers to the XLA lowering)."""
+    import json
+
+    path = os.environ.get(
+        "THUNDER_TPU_PALLAS_TUNING",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "pallas_tuning.json"),
+    )
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except Exception:
+        return {}
+
+
 def _ce_blocks(n: int, v: int) -> tuple[int, int] | None:
-    bn = next((b for b in (256, 128, 64, 32, 16, 8) if n % b == 0), None)
-    bv = next((b for b in (2048, 1024, 512, 256, 128) if v % b == 0), None)
-    if bn is None or bv is None:
+    tuned = _tuning().get("ce", {})
+    bn = next((b for b in (tuned.get("bn", 256), 256, 128, 64, 32, 16, 8)
+               if isinstance(b, int) and b > 0 and n % b == 0), None)
+    if bn is None:
+        return None
+    # Widest lane-aligned (×128) divisor of v under a VMEM budget: wider
+    # vocab tiles mean fewer grid steps and longer DMA bursts.  Round 3 lost
+    # 3% to XLA at V=32000 because the old power-of-two divisor list picked
+    # BV=256; 32000 = 128·250 admits BV=3200 under the same budget.
+    bv_cap = int(tuned.get("bv_cap", 4096))
+    budget = 4 * 1024 * 1024  # f32 block bytes; pallas double-buffers on top
+    bv = None
+    for k in range(min(v, bv_cap) // 128, 0, -1):
+        b = k * 128
+        if v % b == 0 and bn * b * 4 <= budget:
+            bv = b
+            break
+    if bv is None:
+        # no lane-aligned divisor: decline so the checker yields to XLA —
+        # a sub-lane (64-wide) tile is structurally likely to lose, the
+        # exact regression class the win-or-yield rule exists to prevent
         return None
     return bn, bv
 
@@ -973,6 +1010,8 @@ _ce_op = ex.register_operator(
 
 
 def _ce_checker(logits, target):
+    if not _tuning().get("ce", {}).get("claim", True):
+        return False  # measured loss to XLA on TPU: yield (tools/kernel_tune.py)
     try:
         from thunder_tpu.core import dtypes as _dt
 

@@ -1,32 +1,23 @@
 #!/bin/bash
-# Serial TPU experiment queue: waits for the flaky tunnel, then runs each
-# experiment alone (two concurrent clients wedge the tunnel — measured).
+# TPU tunnel watcher: probes until the flaky tunnel is up, then runs the
+# experiment list (tools/tpu_run_queue.sh, re-read at that moment so it can
+# be edited while this loop sleeps).  One TPU client at a time — two
+# concurrent processes wedge the tunnel (measured, round 3) — so the whole
+# probe+run loop holds an exclusive flock: a second watcher instance exits
+# immediately instead of racing the first to the tunnel window.
 cd /root/repo
 LOG=tpu_experiments
 mkdir -p "$LOG"
-for i in $(seq 1 400); do
+exec 9>/tmp/tpu_watcher.lock
+if ! flock -n 9; then
+  echo "$(date -u +%T) another watcher holds /tmp/tpu_watcher.lock; exiting" >> "$LOG/queue.log"
+  exit 0
+fi
+for i in $(seq 1 700); do
   out=$(timeout 180 python -c "import jax; print('UP', jax.default_backend())" 2>&1 | grep '^UP tpu')
   if [ -n "$out" ]; then
     echo "$(date -u +%T) TPU up (attempt $i)" >> "$LOG/queue.log"
-    # driver-critical artifacts FIRST: a brief tunnel window must refresh
-    # the headline and sweep before optional experiments burn it
-    timeout 2400 python bench.py > "$LOG/headline.json.tmp" 2> "$LOG/headline.log"
-    hrc=$?
-    if [ $hrc -eq 0 ] && grep -q tokens "$LOG/headline.json.tmp"; then
-      mv "$LOG/headline.json.tmp" BENCH_TPU.json && cp BENCH_TPU.json BENCH_r03_tpu.json
-    fi
-    echo "$(date -u +%T) headline rc=$hrc" >> "$LOG/queue.log"
-    timeout 2400 python bench.py sweep > "$LOG/sweep.log" 2>&1
-    echo "$(date -u +%T) sweep rc=$? (BENCH_MICRO.json refreshed)" >> "$LOG/queue.log"
-    timeout 2400 python tools/config_sweep.py > "$LOG/config_sweep.log" 2>&1
-    echo "$(date -u +%T) config_sweep rc=$?" >> "$LOG/queue.log"
-    timeout 2400 python bench.py decode > "$LOG/decode.json" 2> "$LOG/decode.log"
-    echo "$(date -u +%T) decode rc=$?" >> "$LOG/queue.log"
-    timeout 2400 python tools/flash_tune.py  > "$LOG/flash_tune.log" 2>&1
-    echo "$(date -u +%T) flash_tune rc=$?" >> "$LOG/queue.log"
-    timeout 2400 python tools/quant_headline.py > "$LOG/quant_headline.log" 2>&1
-    echo "$(date -u +%T) quant_headline rc=$?" >> "$LOG/queue.log"
-    echo "$(date -u +%T) queue done" >> "$LOG/queue.log"
+    bash tools/tpu_run_queue.sh
     exit 0
   fi
   echo "$(date -u +%T) attempt=$i tunnel down" >> "$LOG/queue.log"

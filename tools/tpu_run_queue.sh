@@ -1,0 +1,47 @@
+#!/bin/bash
+# Round-4 TPU experiment list, run ONCE per tunnel window by tpu_queue.sh.
+# Kept separate from the watcher loop so it can be edited while the watcher
+# sleeps — the watcher re-reads this file at the moment the tunnel comes up.
+# Order: driver-critical artifacts FIRST (a brief window must refresh the
+# headline + depth curve + sweep before optional experiments burn it).
+cd /root/repo
+LOG=tpu_experiments
+mkdir -p "$LOG"
+
+echo "$(date -u +%T) run_queue start" >> "$LOG/queue.log"
+
+# 1. headline (BENCH_TPU.json refresh) — patient budget, we know the tunnel is up
+THUNDER_TPU_BENCH_MAX_WAIT_S=120 timeout 2400 python bench.py > "$LOG/headline.json.tmp" 2> "$LOG/headline.log"
+hrc=$?
+if [ $hrc -eq 0 ] && grep -q tokens "$LOG/headline.json.tmp"; then
+  mv "$LOG/headline.json.tmp" BENCH_TPU.json && cp BENCH_TPU.json BENCH_r04_tpu.json
+fi
+echo "$(date -u +%T) headline rc=$hrc" >> "$LOG/queue.log"
+
+# 2. depth-scaling curve (VERDICT r3 #3: validate the 7B extrapolation)
+if [ -f tools/depth_curve.py ]; then
+  timeout 3000 python tools/depth_curve.py > "$LOG/depth_curve.log" 2>&1
+  echo "$(date -u +%T) depth_curve rc=$?" >> "$LOG/queue.log"
+fi
+
+# 3. pallas kernel tuning (VERDICT r3 #2: CE/rms/swiglu win-or-yield)
+if [ -f tools/kernel_tune.py ]; then
+  timeout 3000 python tools/kernel_tune.py > "$LOG/kernel_tune.log" 2>&1
+  echo "$(date -u +%T) kernel_tune rc=$?" >> "$LOG/queue.log"
+fi
+
+# 4. per-op sweep (BENCH_MICRO.json refresh — after tuning so defaults reflect it)
+THUNDER_TPU_BENCH_MAX_WAIT_S=120 timeout 2400 python bench.py sweep > "$LOG/sweep.log" 2>&1
+echo "$(date -u +%T) sweep rc=$? (BENCH_MICRO.json refreshed)" >> "$LOG/queue.log"
+
+# 5. decode benchmark
+THUNDER_TPU_BENCH_MAX_WAIT_S=120 timeout 2400 python bench.py decode > "$LOG/decode.json" 2> "$LOG/decode.log"
+echo "$(date -u +%T) decode rc=$?" >> "$LOG/queue.log"
+
+# 6. block-tier benchmarks (bench.py blocks mode, if built by then)
+if python bench.py --help 2>/dev/null | grep -q blocks || grep -q '"blocks"' bench.py; then
+  THUNDER_TPU_BENCH_MAX_WAIT_S=120 timeout 2400 python bench.py blocks > "$LOG/blocks.json" 2> "$LOG/blocks.log"
+  echo "$(date -u +%T) blocks rc=$?" >> "$LOG/queue.log"
+fi
+
+echo "$(date -u +%T) run_queue done" >> "$LOG/queue.log"

@@ -66,7 +66,8 @@ class TestHarnessTargets:
         artifact = json.loads(out.read_text())
         assert artifact["backend"] == "cpu"
         assert set(results) == {"gelu", "cross_entropy", "rms_norm", "sdpa_causal",
-                                "swiglu_mlp", "sdpa_grad", "ce_grad"}
+                                "swiglu_mlp", "sdpa_grad", "ce_grad",
+                                "sdpa_decode", "ce_decode"}
         measured = [r for r in results.values() if "error" not in r]
         # every case must measure on CPU — an {'error': ...} entry here means
         # the harness (not the tunnel) regressed

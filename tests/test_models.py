@@ -184,11 +184,16 @@ def test_nanogpt_style_config_traces_and_trains():
     assert losses[-1] < losses[0], losses
 
 
-def test_nanogpt_generate_matches_full_forward():
+@pytest.mark.parametrize(
+    "name", ["nanogpt-debug", "tiny-gemma-debug", "tiny-falcon-debug", "tiny-pythia-debug"]
+)
+def test_generate_matches_full_forward(name):
+    """KV-cache decode must agree with the full forward for every family —
+    polices the _mlp/_norm/embedding-scale mirrors in models/generate.py."""
     import thunder_tpu as tt
     from thunder_tpu.models import generate as gen
 
-    cfg = llama.Config.from_name("nanogpt-debug")
+    cfg = llama.Config.from_name(name)
     params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
     prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 4), 0, cfg.vocab_size)
 
@@ -201,3 +206,27 @@ def test_nanogpt_generate_matches_full_forward():
 
     out = gen.generate(params, prompt, cfg, 5, cache_dtype=jnp.float32)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(toks))
+
+
+@pytest.mark.parametrize("name", ["tiny-gemma-debug", "tiny-falcon-debug", "tiny-pythia-debug"])
+def test_new_family_traces_and_trains(name):
+    """Gemma (gelu-gated MLP, tied + scaled embeddings), Falcon (MQA +
+    parallel residual + shared attention norm), Pythia/NeoX (biased
+    LayerNorm, partial rotary): the families the reference's litgpt zoo
+    covers beyond llama (reference tests/litgpt_model.py:7-118)."""
+    import optax
+
+    from thunder_tpu import distributed as dist
+
+    cfg, params, idx, tgt, cos, sin = _setup(name, B=4, T=32)
+    mesh = dist.make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    step = dist.make_train_step(
+        lambda p, i, t, c, s: llama.gpt_loss(p, i, t, c, s, cfg), optax.adam(1e-2), mesh
+    )
+    o = step.init_optimizer_state(params)
+    losses = []
+    p = params
+    for _ in range(3):
+        p, o, loss = step(p, o, idx, tgt, cos, sin)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], (name, losses)

@@ -163,6 +163,8 @@ def ep_gpt_loss(params, idx, targets, cos, sin, cfg, *, mesh: Mesh, axis: str = 
         return y @ ap["wo"].T
 
     x = params["wte"][idx]
+    if cfg.scale_embedding:
+        x = x * (cfg.n_embd ** 0.5)  # weak-typed scalar: stays in x.dtype
     for bp in params["blocks"]:
         n1 = _norm(x, bp["norm_1"], cfg)
         h = dense_attn(bp["attn"], n1)

@@ -207,6 +207,8 @@ def pp_gpt_loss(
 
     # embed replicated, reshape to the microbatch stream
     x = params["wte"][idx]  # (B, T, C)
+    if config.scale_embedding:
+        x = x * (config.n_embd ** 0.5)  # weak-typed scalar: stays in x.dtype
     if config.learned_pos_embedding:
         x = x + params["wpe"][:T]
     mbs = x.reshape(n_micro, mb, T, x.shape[-1])

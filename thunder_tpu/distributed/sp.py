@@ -62,6 +62,8 @@ def seq_parallel_gpt_loss(params, idx, targets, cos, sin, cfg, *, mesh: Mesh,
 
     def body(params, idx_b, tgt_b, cos_b, sin_b):
         x = params["wte"][idx_b]  # (B, T_loc, C) — embedding lookup is local
+        if cfg.scale_embedding:
+            x = x * (cfg.n_embd ** 0.5)  # weak-typed scalar: stays in x.dtype
         for bp in params["blocks"]:
             n1 = _norm(x, bp["norm_1"], cfg, bp.get("norm_1_b"))
             h = attend_fn(bp["attn"], n1, cos_b, sin_b, cfg, axis=axis, sp=sp)

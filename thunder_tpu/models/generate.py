@@ -88,6 +88,12 @@ def _mlp(mp, x, cfg: Config, *, quantized=False):
             jax.nn.silu(lin(x, mp["fc_1"], mp.get("fc_1_b"))) * lin(x, mp["fc_2"], mp.get("fc_2_b")),
             mp["proj"], mp.get("proj_b"),
         )
+    if cfg.mlp_class == "GemmaMLP":
+        return lin(
+            jax.nn.gelu(lin(x, mp["fc_1"], mp.get("fc_1_b")), approximate=cfg.gelu_approximate == "tanh")
+            * lin(x, mp["fc_2"], mp.get("fc_2_b")),
+            mp["proj"], mp.get("proj_b"),
+        )
     return lin(
         jax.nn.gelu(lin(x, mp["fc"], mp.get("fc_b")), approximate=cfg.gelu_approximate == "tanh"),
         mp["proj"], mp.get("proj_b"),
@@ -247,6 +253,8 @@ def forward_with_cache(params, idx, pos, cache, cos_all, sin_all, cfg: Config, *
     against/into ``cache``.  Returns (logits (B, T, V), updated cache)."""
     B, T = idx.shape
     x = params["wte"][idx]
+    if cfg.scale_embedding:
+        x = x * (cfg.n_embd ** 0.5)  # weak-typed scalar: multiply stays in x.dtype
     vec = _is_vec_pos(pos)
     if cfg.learned_pos_embedding:
         if vec:

@@ -59,8 +59,10 @@ class Config:
     bias: bool = False
     norm_eps: float = 1e-5
     intermediate_size: int | None = None
-    mlp_class: str = "LLaMAMLP"  # or "GptNeoxMLP"
+    mlp_class: str = "LLaMAMLP"  # or "GptNeoxMLP" / "GemmaMLP" / "LLaMAMoE"
     norm_class: str = "RMSNorm"  # or "LayerNorm"
+    # Gemma style: hidden states scaled by sqrt(n_embd) after the embedding
+    scale_embedding: bool = False
     rope_base: int = 10000
     rope_condense_ratio: float = 1.0
     shared_attention_norm: bool = False
@@ -182,6 +184,28 @@ configs: list[Config] = [
            n_embd=768, rotary_percentage=0.0, learned_pos_embedding=True,
            norm_class="LayerNorm", mlp_class="GptNeoxMLP", tie_embeddings=True,
            bias=True, gelu_approximate="tanh"),
+    # Gemma family: gelu-gated MLP, tied embeddings, sqrt(d) embedding scale
+    Config(name="tiny-gemma-debug", block_size=128, vocab_size=256, n_layer=2, n_head=4,
+           n_embd=64, intermediate_size=176, mlp_class="GemmaMLP", gelu_approximate="tanh",
+           tie_embeddings=True, scale_embedding=True),
+    Config(name="Gemma-7b-like", block_size=8192, vocab_size=256000, n_layer=28, n_head=16,
+           n_embd=3072, head_size=256, intermediate_size=24576, mlp_class="GemmaMLP",
+           gelu_approximate="tanh", tie_embeddings=True, scale_embedding=True),
+    # Falcon family: MQA, parallel residual with one shared attention norm
+    Config(name="tiny-falcon-debug", block_size=128, vocab_size=256, n_layer=2, n_head=4,
+           n_embd=64, n_query_groups=1, intermediate_size=256, parallel_residual=True,
+           shared_attention_norm=True, norm_class="LayerNorm", mlp_class="GptNeoxMLP"),
+    Config(name="Falcon-7b-like", block_size=2048, vocab_size=65024, n_layer=32, n_head=71,
+           n_embd=4544, n_query_groups=1, intermediate_size=18176, parallel_residual=True,
+           shared_attention_norm=True, norm_class="LayerNorm", mlp_class="GptNeoxMLP"),
+    # Pythia / GPT-NeoX family: parallel residual, biased LayerNorm+linears,
+    # partial rotary
+    Config(name="tiny-pythia-debug", block_size=128, vocab_size=256, n_layer=2, n_head=4,
+           n_embd=64, intermediate_size=256, parallel_residual=True, norm_class="LayerNorm",
+           mlp_class="GptNeoxMLP", bias=True, rotary_percentage=0.25),
+    Config(name="Pythia-6.9b-like", block_size=2048, vocab_size=50254, n_layer=32, n_head=32,
+           n_embd=4096, intermediate_size=16384, parallel_residual=True, norm_class="LayerNorm",
+           mlp_class="GptNeoxMLP", bias=True, rotary_percentage=0.25),
     Config(name="tiny-mistral-debug", block_size=128, vocab_size=256, n_layer=2, n_head=4,
            n_embd=64, n_query_groups=2, intermediate_size=176, sliding_window=32),
     Config(name="Mistral-7B-like", block_size=32768, vocab_size=32000, n_layer=32,
@@ -274,7 +298,7 @@ def init_params(config: Config, key: jax.Array | None = None, dtype=jnp.bfloat16
                 "fc_2": stacked(config.n_embd, config.intermediate_size),
                 "proj": stacked(config.intermediate_size, config.n_embd),
             }
-        elif config.mlp_class == "LLaMAMLP":
+        elif config.mlp_class in ("LLaMAMLP", "GemmaMLP"):
             block["mlp"] = {
                 "fc_1": dense(next(keys), config.n_embd, config.intermediate_size),
                 "fc_2": dense(next(keys), config.n_embd, config.intermediate_size),
@@ -433,6 +457,14 @@ def mlp(mp, x, config: Config):
             * ltorch.linear(x, mp["fc_2"], mp.get("fc_2_b")),
             mp["proj"], mp.get("proj_b"),
         )
+    if config.mlp_class == "GemmaMLP":
+        # gated MLP with a gelu gate (litgpt GemmaMLP: LLaMAMLP with gelu)
+        return ltorch.linear(
+            ltorch.gelu(ltorch.linear(x, mp["fc_1"], mp.get("fc_1_b")),
+                        approximate=config.gelu_approximate)
+            * ltorch.linear(x, mp["fc_2"], mp.get("fc_2_b")),
+            mp["proj"], mp.get("proj_b"),
+        )
     return ltorch.linear(
         ltorch.gelu(ltorch.linear(x, mp["fc"], mp.get("fc_b")), approximate=config.gelu_approximate),
         mp["proj"], mp.get("proj_b"),
@@ -452,6 +484,8 @@ def block_forward(bp, x, cos, sin, config: Config):
 def gpt_hidden(params, idx, cos, sin, config: Config):
     """Token ids (B, T) int32 → final hidden states (B, T, C) (pre-head)."""
     x = ltorch.embedding(idx, params["wte"])
+    if config.scale_embedding:
+        x = x * (config.n_embd ** 0.5)
     if config.learned_pos_embedding:
         T = idx.shape[1]
         x = x + params["wpe"][:T]

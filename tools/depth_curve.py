@@ -28,8 +28,11 @@ import optax
 import bench
 from thunder_tpu.models import llama
 
+# headline batch geometry — shared by measure_depth and the fit in main()
+B, T = 2, 2048
 
-def measure_depth(n_layer: int, B: int = 2, T: int = 2048, steps: int = 10) -> dict:
+
+def measure_depth(n_layer: int, steps: int = 10) -> dict:
     """Tokens/s for the 7B slice at ``n_layer`` layers (bench methodology:
     donated chained steps, fetch-fenced, best of two loops)."""
     cfg = llama.Config.from_name("Llama-2-7b-hf", n_layer=n_layer)
@@ -76,7 +79,6 @@ def main():
         a, b = np.polyfit(L, t, 1)
         resid_pct = float(np.max(np.abs((a * L + b) - t) / t) * 100)
         t32 = a * 32 + b
-        B, T = 2, 2048
         pred_7b_tps = B * T / (t32 / 1e3)
         full = llama.Config.from_name("Llama-2-7b-hf")
         out.update(

@@ -14,15 +14,17 @@ echo "$(date -u +%T) run_queue start" >> "$LOG/queue.log"
 THUNDER_TPU_BENCH_MAX_WAIT_S=120 timeout 2400 python bench.py > "$LOG/headline.json.tmp" 2> "$LOG/headline.log"
 hrc=$?
 if [ $hrc -eq 0 ] && grep -q tokens "$LOG/headline.json.tmp"; then
-  mv "$LOG/headline.json.tmp" BENCH_TPU.json && cp BENCH_TPU.json BENCH_r04_tpu.json
+  mv "$LOG/headline.json.tmp" BENCH_TPU.json
 fi
 echo "$(date -u +%T) headline rc=$hrc" >> "$LOG/queue.log"
 
-# 2. depth-scaling curve (VERDICT r3 #3: validate the 7B extrapolation)
+# 2. depth-scaling curve (VERDICT r3 #3: validate the 7B extrapolation);
+# merges its results into BENCH_TPU.json, so the round snapshot copies AFTER
 if [ -f tools/depth_curve.py ]; then
   timeout 3000 python tools/depth_curve.py > "$LOG/depth_curve.log" 2>&1
   echo "$(date -u +%T) depth_curve rc=$?" >> "$LOG/queue.log"
 fi
+cp BENCH_TPU.json BENCH_r04_tpu.json 2>/dev/null
 
 # 3. pallas kernel tuning (VERDICT r3 #2: CE/rms/swiglu win-or-yield)
 if [ -f tools/kernel_tune.py ]; then
@@ -38,10 +40,8 @@ echo "$(date -u +%T) sweep rc=$? (BENCH_MICRO.json refreshed)" >> "$LOG/queue.lo
 THUNDER_TPU_BENCH_MAX_WAIT_S=120 timeout 2400 python bench.py decode > "$LOG/decode.json" 2> "$LOG/decode.log"
 echo "$(date -u +%T) decode rc=$?" >> "$LOG/queue.log"
 
-# 6. block-tier benchmarks (bench.py blocks mode, if built by then)
-if python bench.py --help 2>/dev/null | grep -q blocks || grep -q '"blocks"' bench.py; then
-  THUNDER_TPU_BENCH_MAX_WAIT_S=120 timeout 2400 python bench.py blocks > "$LOG/blocks.json" 2> "$LOG/blocks.log"
-  echo "$(date -u +%T) blocks rc=$?" >> "$LOG/queue.log"
-fi
+# 6. block-tier benchmarks
+THUNDER_TPU_BENCH_MAX_WAIT_S=120 timeout 2400 python bench.py blocks > "$LOG/blocks.json" 2> "$LOG/blocks.log"
+echo "$(date -u +%T) blocks rc=$?" >> "$LOG/queue.log"
 
 echo "$(date -u +%T) run_queue done" >> "$LOG/queue.log"

@@ -222,3 +222,95 @@ class TestGPT2Weights:
         assert np.isfinite(float(loss))
         gb = grads["blocks"][0]["attn"]["bq"]
         assert np.abs(np.asarray(gb)).sum() > 0  # bias grads actually flow
+
+
+class TestHFGemmaWeights:
+    def test_gemma_logit_parity(self):
+        """Gemma: gelu-gated MLP, sqrt(d)-scaled tied embeddings, RMSNorm
+        with the (1 + w) offset folded in at load time."""
+        hf_cfg = transformers.GemmaConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=176,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            head_dim=16, max_position_embeddings=128, rms_norm_eps=1e-6,
+            hidden_act="gelu_pytorch_tanh",
+        )
+        torch.manual_seed(0)
+        m = transformers.GemmaForCausalLM(hf_cfg).eval()
+        cfg = config_from_hf(m.config)
+        assert cfg.mlp_class == "GemmaMLP" and cfg.scale_embedding and cfg.tie_embeddings
+        params = from_hf_state_dict(m.state_dict(), cfg, dtype=jnp.float32)
+        idx = np.random.default_rng(3).integers(0, 256, (2, 16))
+        with torch.no_grad():
+            ref = m(torch.from_numpy(idx)).logits.numpy()
+        ours = _logits_ours(cfg, params, idx)
+        np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=3e-4)
+
+
+class TestHFNeoXWeights:
+    def test_pythia_logit_parity(self):
+        """GPT-NeoX/Pythia: per-head-interleaved fused qkv, partial rotary,
+        parallel residual, biased LayerNorm everywhere."""
+        from thunder_tpu.models.hf_weights import from_gpt_neox_state_dict
+
+        hf_cfg = transformers.GPTNeoXConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=256,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=128, rotary_pct=0.25,
+            use_parallel_residual=True, hidden_act="gelu",
+        )
+        torch.manual_seed(0)
+        m = transformers.GPTNeoXForCausalLM(hf_cfg).eval()
+        cfg = config_from_hf(m.config)
+        assert cfg.parallel_residual and cfg.bias and cfg.rotary_percentage == 0.25
+        params = from_gpt_neox_state_dict(m.state_dict(), cfg, dtype=jnp.float32)
+        idx = np.random.default_rng(4).integers(0, 256, (2, 16))
+        with torch.no_grad():
+            ref = m(torch.from_numpy(idx)).logits.numpy()
+        ours = _logits_ours(cfg, params, idx)
+        np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=3e-4)
+
+
+class TestHFFalconWeights:
+    def test_falcon_7b_style_logit_parity(self):
+        """Falcon 7B layout: MQA, parallel residual, ONE shared layernorm,
+        grouped fused qkv, norm biases without linear biases."""
+        from thunder_tpu.models.hf_weights import from_falcon_state_dict
+
+        hf_cfg = transformers.FalconConfig(
+            vocab_size=256, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, multi_query=True, parallel_attn=True,
+            new_decoder_architecture=False, bias=False, alibi=False,
+            max_position_embeddings=128,
+        )
+        torch.manual_seed(0)
+        m = transformers.FalconForCausalLM(hf_cfg).eval()
+        cfg = config_from_hf(m.config)
+        assert cfg.n_query_groups == 1 and cfg.shared_attention_norm
+        params = from_falcon_state_dict(m.state_dict(), cfg, dtype=jnp.float32)
+        idx = np.random.default_rng(5).integers(0, 256, (2, 16))
+        with torch.no_grad():
+            ref = m(torch.from_numpy(idx)).logits.numpy()
+        ours = _logits_ours(cfg, params, idx)
+        np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=3e-4)
+
+    def test_falcon_new_arch_logit_parity(self):
+        """Falcon 40B-style new decoder architecture: GQA with separate
+        ln_attn/ln_mlp."""
+        from thunder_tpu.models.hf_weights import from_falcon_state_dict
+
+        hf_cfg = transformers.FalconConfig(
+            vocab_size=256, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, num_kv_heads=2, parallel_attn=True,
+            new_decoder_architecture=True, bias=False, alibi=False,
+            max_position_embeddings=128,
+        )
+        torch.manual_seed(0)
+        m = transformers.FalconForCausalLM(hf_cfg).eval()
+        cfg = config_from_hf(m.config)
+        assert cfg.n_query_groups == 2 and not cfg.shared_attention_norm
+        params = from_falcon_state_dict(m.state_dict(), cfg, dtype=jnp.float32)
+        idx = np.random.default_rng(6).integers(0, 256, (2, 16))
+        with torch.no_grad():
+            ref = m(torch.from_numpy(idx)).logits.numpy()
+        ours = _logits_ours(cfg, params, idx)
+        np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=3e-4)

@@ -1,10 +1,11 @@
-"""Load HuggingFace Llama/Mistral-family checkpoints into the functional
-param pytree.
+"""Load HuggingFace checkpoints into the functional param pytree.
 
 The reference gets real checkpoints through LitGPT's converters; here the
-mapping is direct: HF ``LlamaForCausalLM``/``MistralForCausalLM`` state
-dicts share our weight layout (rotate-half rope, separate q/k/v, SwiGLU
-MLP), so conversion is a key rename plus vocab padding — no transposes.
+mapping is direct per family: Llama/Mistral/Gemma state dicts share our
+weight layout (rotate-half rope, separate q/k/v, gated MLP) so conversion
+is a key rename plus vocab padding; GPT-2 undoes Conv1D transposes and the
+packed c_attn; GPT-NeoX/Pythia and Falcon unpack their fused
+query_key_value layouts (per-head interleaved and grouped respectively).
 Logit parity against ``transformers`` is pinned in
 ``tests/test_hf_weights.py``.
 
@@ -25,7 +26,13 @@ import numpy as np
 
 from thunder_tpu.models.llama import Config
 
-__all__ = ["config_from_hf", "from_hf_state_dict", "from_gpt2_state_dict"]
+__all__ = [
+    "config_from_hf",
+    "from_hf_state_dict",
+    "from_gpt2_state_dict",
+    "from_gpt_neox_state_dict",
+    "from_falcon_state_dict",
+]
 
 
 def config_from_hf(hf_config: Any, **overrides) -> Config:
@@ -34,8 +41,15 @@ def config_from_hf(hf_config: Any, **overrides) -> Config:
     mt = getattr(hf_config, "model_type", "llama")
     if mt == "gpt2":
         return _gpt2_config(hf_config, overrides)
-    if mt not in ("llama", "mistral"):
-        raise ValueError(f"unsupported HF model_type {mt!r} (llama/mistral/gpt2 family only)")
+    if mt == "gpt_neox":
+        return _gpt_neox_config(hf_config, overrides)
+    if mt == "falcon":
+        return _falcon_config(hf_config, overrides)
+    if mt not in ("llama", "mistral", "gemma"):
+        raise ValueError(
+            f"unsupported HF model_type {mt!r} "
+            "(llama/mistral/gemma/gpt2/gpt_neox/falcon family only)"
+        )
     # reject config knobs the functional model does not implement — silent
     # acceptance would convert cleanly and return wrong logits
     scaling = getattr(hf_config, "rope_scaling", None)
@@ -57,10 +71,23 @@ def config_from_hf(hf_config: Any, **overrides) -> Config:
         if getattr(hf_config, knob, False):
             raise ValueError(f"unsupported HF config {knob}=True: the functional model has no biases")
     act = getattr(hf_config, "hidden_act", "silu")
-    if act not in ("silu", "swish"):
-        raise ValueError(f"unsupported hidden_act {act!r}: the LLaMAMLP path is SwiGLU (silu)")
+    if mt == "gemma":
+        # gemma: gelu-gated MLP, tied + sqrt(d)-scaled embeddings; the
+        # RMSNorm (1 + w) offset folds into the weights at load time
+        if act not in ("gelu", "gelu_pytorch_tanh"):
+            raise ValueError(f"unsupported gemma hidden_act {act!r}")
+        gemma_kw = dict(
+            mlp_class="GemmaMLP",
+            gelu_approximate="tanh" if act == "gelu_pytorch_tanh" else "none",
+            scale_embedding=True,
+        )
+    else:
+        if act not in ("silu", "swish"):
+            raise ValueError(f"unsupported hidden_act {act!r}: the LLaMAMLP path is SwiGLU (silu)")
+        gemma_kw = {}
     kw = dict(
         name=f"hf-{mt}",
+        **gemma_kw,
         block_size=int(hf_config.max_position_embeddings),
         vocab_size=int(hf_config.vocab_size),
         padded_vocab_size=int(hf_config.vocab_size),  # HF head is exactly vocab-sized
@@ -120,17 +147,176 @@ def _gpt2_config(hf_config: Any, overrides: dict) -> Config:
     return Config(**kw)
 
 
+def _gpt_neox_config(hf_config: Any, overrides: dict) -> Config:
+    """GPT-NeoX / Pythia: biased LayerNorm + linears, partial rotary,
+    parallel residual (reference zoo's pythia rows)."""
+    act = getattr(hf_config, "hidden_act", "gelu")
+    if act not in ("gelu", "gelu_new", "gelu_pytorch_tanh"):
+        raise ValueError(f"unsupported gpt_neox hidden_act {act!r}")
+    if not getattr(hf_config, "use_parallel_residual", True):
+        raise ValueError("unsupported GPTNeoXConfig use_parallel_residual=False")
+    kw = dict(
+        name="hf-gpt_neox",
+        block_size=int(hf_config.max_position_embeddings),
+        vocab_size=int(hf_config.vocab_size),
+        padded_vocab_size=int(hf_config.vocab_size),
+        n_layer=int(hf_config.num_hidden_layers),
+        n_head=int(hf_config.num_attention_heads),
+        n_embd=int(hf_config.hidden_size),
+        intermediate_size=int(hf_config.intermediate_size),
+        norm_eps=float(getattr(hf_config, "layer_norm_eps", 1e-5)),
+        rotary_percentage=float(getattr(hf_config, "rotary_pct", 0.25)),
+        rope_base=int(getattr(hf_config, "rotary_emb_base", None)
+                      or getattr(hf_config, "rope_theta", 10000)),
+        parallel_residual=True,
+        norm_class="LayerNorm",
+        mlp_class="GptNeoxMLP",
+        bias=True,
+        tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings", False)),
+        gelu_approximate="none" if act == "gelu" else "tanh",
+    )
+    kw.update(overrides)
+    return Config(**kw)
+
+
+def from_gpt_neox_state_dict(sd: Mapping[str, Any], cfg: Config, dtype=jnp.bfloat16) -> dict:
+    """Converts a HF ``GPTNeoXForCausalLM`` state dict.  NeoX fuses q/k/v
+    into one ``query_key_value`` with a PER-HEAD interleave —
+    (nh, 3, hs, C) — undone here."""
+    get = _getter(sd, "gpt_neox.", "GPT-NeoX")
+    C, nh = cfg.n_embd, cfg.n_head
+    hs = cfg.head_size
+    params: dict = {
+        "wte": jnp.asarray(_pad_vocab(get("embed_in.weight"), cfg.padded_vocab_size), dtype),
+        "ln_f": jnp.asarray(get("final_layer_norm.weight"), dtype),
+        "ln_f_b": jnp.asarray(get("final_layer_norm.bias"), dtype),
+        "blocks": [],
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = jnp.asarray(
+            _pad_vocab(_to_np(sd["embed_out.weight"]), cfg.padded_vocab_size), dtype)
+    for i in range(cfg.n_layer):
+        p = f"layers.{i}."
+        qkv_w = get(p + "attention.query_key_value.weight").reshape(nh, 3, hs, C)
+        qkv_b = get(p + "attention.query_key_value.bias").reshape(nh, 3, hs)
+        params["blocks"].append({
+            "norm_1": jnp.asarray(get(p + "input_layernorm.weight"), dtype),
+            "norm_1_b": jnp.asarray(get(p + "input_layernorm.bias"), dtype),
+            "attn": {
+                "wq": jnp.asarray(qkv_w[:, 0].reshape(nh * hs, C), dtype),
+                "wk": jnp.asarray(qkv_w[:, 1].reshape(nh * hs, C), dtype),
+                "wv": jnp.asarray(qkv_w[:, 2].reshape(nh * hs, C), dtype),
+                "bq": jnp.asarray(qkv_b[:, 0].reshape(nh * hs), dtype),
+                "bk": jnp.asarray(qkv_b[:, 1].reshape(nh * hs), dtype),
+                "bv": jnp.asarray(qkv_b[:, 2].reshape(nh * hs), dtype),
+                "wo": jnp.asarray(get(p + "attention.dense.weight"), dtype),
+                "bo": jnp.asarray(get(p + "attention.dense.bias"), dtype),
+            },
+            "norm_2": jnp.asarray(get(p + "post_attention_layernorm.weight"), dtype),
+            "norm_2_b": jnp.asarray(get(p + "post_attention_layernorm.bias"), dtype),
+            "mlp": {
+                "fc": jnp.asarray(get(p + "mlp.dense_h_to_4h.weight"), dtype),
+                "fc_b": jnp.asarray(get(p + "mlp.dense_h_to_4h.bias"), dtype),
+                "proj": jnp.asarray(get(p + "mlp.dense_4h_to_h.weight"), dtype),
+                "proj_b": jnp.asarray(get(p + "mlp.dense_4h_to_h.bias"), dtype),
+            },
+        })
+    return params
+
+
+def _falcon_config(hf_config: Any, overrides: dict) -> Config:
+    """Falcon: MQA/GQA, parallel residual with one shared attention norm
+    (7B layout); rotary over the full head."""
+    if not getattr(hf_config, "parallel_attn", True):
+        raise ValueError("unsupported FalconConfig parallel_attn=False")
+    if getattr(hf_config, "alibi", False):
+        raise ValueError("unsupported FalconConfig alibi=True (rope only)")
+    if getattr(hf_config, "bias", False):
+        # HF gates falcon's linear biases on config.bias; the converter reads
+        # no linear-bias keys, so accepting would silently drop them
+        raise ValueError("unsupported FalconConfig bias=True")
+    new_arch = bool(getattr(hf_config, "new_decoder_architecture", False))
+    # Falcon2-11B ships new_decoder_architecture with ONE layernorm
+    # (num_ln_in_parallel_attn=1) — that is exactly the shared-norm layout
+    n_ln = int(getattr(hf_config, "num_ln_in_parallel_attn", None) or (2 if new_arch else 1))
+    if new_arch:
+        ng = int(getattr(hf_config, "num_kv_heads", None) or hf_config.num_attention_heads)
+    else:
+        ng = 1 if getattr(hf_config, "multi_query", True) else int(hf_config.num_attention_heads)
+    kw = dict(
+        name="hf-falcon",
+        block_size=int(hf_config.max_position_embeddings),
+        vocab_size=int(hf_config.vocab_size),
+        padded_vocab_size=int(hf_config.vocab_size),
+        n_layer=int(hf_config.num_hidden_layers),
+        n_head=int(hf_config.num_attention_heads),
+        n_embd=int(hf_config.hidden_size),
+        n_query_groups=ng,
+        intermediate_size=int(getattr(hf_config, "ffn_hidden_size", None)
+                              or 4 * hf_config.hidden_size),
+        norm_eps=float(getattr(hf_config, "layer_norm_epsilon", 1e-5)),
+        rope_base=int(getattr(hf_config, "rope_theta", 10000)),
+        parallel_residual=True,
+        shared_attention_norm=n_ln == 1,
+        norm_class="LayerNorm",
+        mlp_class="GptNeoxMLP",
+        tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings", True)),
+        gelu_approximate="none",
+    )
+    kw.update(overrides)
+    return Config(**kw)
+
+
+def from_falcon_state_dict(sd: Mapping[str, Any], cfg: Config, dtype=jnp.bfloat16) -> dict:
+    """Converts a HF ``FalconForCausalLM`` state dict.  Falcon fuses q/k/v
+    into ``query_key_value`` grouped as (ng, nh/ng + 2, hs, C) — each KV
+    group's queries ride with its k and v — undone here.  LayerNorms carry
+    biases even though the linears do not; the pytree is the source of
+    truth, so the norm biases load without a global ``bias`` flag."""
+    get = _getter(sd, "transformer.", "Falcon")
+    C, nh, ng, hs = cfg.n_embd, cfg.n_head, cfg.n_query_groups, cfg.head_size
+    per_g = nh // ng
+    params: dict = {
+        "wte": jnp.asarray(_pad_vocab(get("word_embeddings.weight"), cfg.padded_vocab_size), dtype),
+        "ln_f": jnp.asarray(get("ln_f.weight"), dtype),
+        "ln_f_b": jnp.asarray(get("ln_f.bias"), dtype),
+        "blocks": [],
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = jnp.asarray(
+            _pad_vocab(_to_np(sd["lm_head.weight"]), cfg.padded_vocab_size), dtype)
+    for i in range(cfg.n_layer):
+        p = f"h.{i}."
+        qkv = get(p + "self_attention.query_key_value.weight").reshape(ng, per_g + 2, hs, C)
+        block: dict = {
+            "attn": {
+                "wq": jnp.asarray(qkv[:, :per_g].reshape(nh * hs, C), dtype),
+                "wk": jnp.asarray(qkv[:, per_g].reshape(ng * hs, C), dtype),
+                "wv": jnp.asarray(qkv[:, per_g + 1].reshape(ng * hs, C), dtype),
+                "wo": jnp.asarray(get(p + "self_attention.dense.weight"), dtype),
+            },
+            "mlp": {
+                "fc": jnp.asarray(get(p + "mlp.dense_h_to_4h.weight"), dtype),
+                "proj": jnp.asarray(get(p + "mlp.dense_4h_to_h.weight"), dtype),
+            },
+        }
+        if cfg.shared_attention_norm:
+            block["norm_1"] = jnp.asarray(get(p + "input_layernorm.weight"), dtype)
+            block["norm_1_b"] = jnp.asarray(get(p + "input_layernorm.bias"), dtype)
+        else:  # new decoder architecture: separate attention/mlp norms
+            block["norm_1"] = jnp.asarray(get(p + "ln_attn.weight"), dtype)
+            block["norm_1_b"] = jnp.asarray(get(p + "ln_attn.bias"), dtype)
+            block["norm_2"] = jnp.asarray(get(p + "ln_mlp.weight"), dtype)
+            block["norm_2_b"] = jnp.asarray(get(p + "ln_mlp.bias"), dtype)
+        params["blocks"].append(block)
+    return params
+
+
 def from_gpt2_state_dict(sd: Mapping[str, Any], cfg: Config, dtype=jnp.bfloat16) -> dict:
     """Converts a HF ``GPT2LMHeadModel`` state dict.  GPT-2 stores Conv1D
     weights as (in, out) — transposed vs nn.Linear — and packs q/k/v into one
     ``c_attn``; both are undone here."""
-
-    def get(name: str) -> np.ndarray:
-        for k in (name, f"transformer.{name}"):
-            if k in sd:
-                return _to_np(sd[k])
-        raise KeyError(f"GPT-2 checkpoint is missing {name!r}")
-
+    get = _getter(sd, "transformer.", "GPT-2")
     C = cfg.n_embd
     params: dict = {
         "wte": jnp.asarray(_pad_vocab(get("wte.weight"), cfg.padded_vocab_size), dtype),
@@ -168,6 +354,18 @@ def from_gpt2_state_dict(sd: Mapping[str, Any], cfg: Config, dtype=jnp.bfloat16)
     return params
 
 
+def _getter(sd: Mapping[str, Any], prefix: str, family: str):
+    """Key lookup with the family's optional container prefix."""
+
+    def get(name: str) -> np.ndarray:
+        for k in (name, f"{prefix}{name}"):
+            if k in sd:
+                return _to_np(sd[k])
+        raise KeyError(f"{family} checkpoint is missing {name!r}")
+
+    return get
+
+
 def _to_np(t) -> np.ndarray:
     if hasattr(t, "detach"):  # torch tensor
         t = t.detach().to("cpu")
@@ -193,26 +391,28 @@ def from_hf_state_dict(sd: Mapping[str, Any], cfg: Config, dtype=jnp.bfloat16) -
 
     Handles the optional ``model.`` prefix, vocab padding to
     ``cfg.padded_vocab_size``, and tied embeddings (no ``lm_head.weight``)."""
+    get = _getter(sd, "model.", "HF")
 
-    def get(name: str) -> np.ndarray:
-        for k in (name, f"model.{name}"):
-            if k in sd:
-                return _to_np(sd[k])
-        raise KeyError(f"HF checkpoint is missing {name!r}")
+    # gemma's RMSNorm computes x_norm * (1 + w): fold the unit offset into
+    # the stored weights so models/llama's plain w-multiply norm matches
+    off = 1.0 if cfg.mlp_class == "GemmaMLP" else 0.0
+
+    def norm(name: str) -> jnp.ndarray:
+        return jnp.asarray(get(name).astype(np.float32) + off, dtype)
 
     wte = _pad_vocab(get("embed_tokens.weight"), cfg.padded_vocab_size)
     blocks = []
     for i in range(cfg.n_layer):
         p = f"layers.{i}."
         blocks.append({
-            "norm_1": jnp.asarray(get(p + "input_layernorm.weight"), dtype),
+            "norm_1": norm(p + "input_layernorm.weight"),
             "attn": {
                 "wq": jnp.asarray(get(p + "self_attn.q_proj.weight"), dtype),
                 "wk": jnp.asarray(get(p + "self_attn.k_proj.weight"), dtype),
                 "wv": jnp.asarray(get(p + "self_attn.v_proj.weight"), dtype),
                 "wo": jnp.asarray(get(p + "self_attn.o_proj.weight"), dtype),
             },
-            "norm_2": jnp.asarray(get(p + "post_attention_layernorm.weight"), dtype),
+            "norm_2": norm(p + "post_attention_layernorm.weight"),
             "mlp": {
                 "fc_1": jnp.asarray(get(p + "mlp.gate_proj.weight"), dtype),
                 "fc_2": jnp.asarray(get(p + "mlp.up_proj.weight"), dtype),
@@ -222,7 +422,7 @@ def from_hf_state_dict(sd: Mapping[str, Any], cfg: Config, dtype=jnp.bfloat16) -
     params = {
         "wte": jnp.asarray(wte, dtype),
         "blocks": blocks,
-        "ln_f": jnp.asarray(get("norm.weight"), dtype),
+        "ln_f": norm("norm.weight"),
     }
     if not cfg.tie_embeddings:
         head = sd.get("lm_head.weight")

@@ -30,7 +30,7 @@ from thunder_tpu.core.options import (
 from thunder_tpu.core.autocast import autocast
 from thunder_tpu.core.batching import jvp, vmap
 from thunder_tpu.core.trace import TraceCtx, TraceResults, set_execution_callback_file
-from thunder_tpu.core.transform_common import cse, dce
+from thunder_tpu.core.transform_common import absorb_ce_widening_converts, cse, dce
 from thunder_tpu.extend import resolve_executors
 from thunder_tpu.functional import trace_from_fn
 
@@ -253,6 +253,10 @@ def _compile(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict) -> Ca
     cs.last_traces.append(computation_trace)
     computation_trace = cse(computation_trace)
     cs.last_traces.append(computation_trace)
+    absorbed = absorb_ce_widening_converts(computation_trace)
+    if absorbed is not computation_trace:  # no-op returns the input unchanged
+        computation_trace = absorbed
+        cs.last_traces.append(computation_trace)
 
     # user/distributed transforms (trace -> trace)
     for transform in cd.transforms:

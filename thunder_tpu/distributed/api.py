@@ -288,13 +288,16 @@ class TrainStep:
         import thunder_tpu as ttpu
         from thunder_tpu.core import dtypes as ttd
         from thunder_tpu.core.proxies import TensorProxy
-        from thunder_tpu.core.transform_common import cse, dce
+        from thunder_tpu.core.transform_common import absorb_ce_widening_converts, cse, dce
         from thunder_tpu.core.transforms import forward_and_backward_from_trace
         from thunder_tpu.functional import trace_from_fn
 
         trace_results = trace_from_fn(self.loss_fn, (params, *batch), {}, grad_argnums=(0,))
         comp = dce(trace_results.computation_trace)
         comp = cse(comp)
+        # before the fw/bw split so the backward rule sees the half-precision
+        # logits directly (its dlogits cast back to logits.dtype covers it)
+        comp = absorb_ce_widening_converts(comp)
         comp.args = trace_results.computation_trace.args
         fw_trace, bw_trace = forward_and_backward_from_trace(comp)
         do_remat = self.remat if isinstance(self.remat, bool) else self._auto_remat(

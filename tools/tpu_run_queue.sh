@@ -44,13 +44,11 @@ echo "$(date -u +%T) decode rc=$?" >> "$LOG/queue.log"
 THUNDER_TPU_BENCH_MAX_WAIT_S=120 timeout 2400 python bench.py blocks > "$LOG/blocks.json" 2> "$LOG/blocks.log"
 echo "$(date -u +%T) blocks rc=$?" >> "$LOG/queue.log"
 
-echo "$(date -u +%T) run_queue done" >> "$LOG/queue.log"
-
-# 7. optional round-3 experiment tools, if the window is still alive
+# 7. optional experiment tools, if the window is still alive
 for t in flash_tune config_sweep quant_headline; do
   if [ -f "tools/$t.py" ]; then
     timeout 2400 python "tools/$t.py" > "$LOG/$t.log" 2>&1
     echo "$(date -u +%T) $t rc=$?" >> "$LOG/queue.log"
   fi
 done
-echo "$(date -u +%T) full queue done" >> "$LOG/queue.log"
+echo "$(date -u +%T) run_queue done" >> "$LOG/queue.log"

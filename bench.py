@@ -441,6 +441,13 @@ def sweep_benchmarks(on_tpu: bool, out_path: str = "BENCH_MICRO.json"):
         jax.jit(plain_sdpa_decode), (q1, kk, v))
     cases["ce_decode"] = (
         tt.jit(lambda l, t: ltorch.cross_entropy(l, t)), jax.jit(plain_ce), (logits1, tgt1))
+    # the production CE shape: half-precision logits with the f32 cast in
+    # the program — the absorb pass feeds the kernel bf16 directly, XLA
+    # fuses its own cast, so both sides move half the bytes
+    logits_h = jax.random.normal(k2(12), (N, V), dtype=dt)
+    cases["cross_entropy_halfp"] = (
+        tt.jit(lambda l, t: ltorch.cross_entropy(l.to(ltorch.float32), t)),
+        jax.jit(lambda l, t: plain_ce(l.astype(jnp.float32), t)), (logits_h, tgt))
 
     results = {}
     for name, (tfn, jfn, args) in cases.items():

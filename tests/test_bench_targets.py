@@ -67,7 +67,7 @@ class TestHarnessTargets:
         assert artifact["backend"] == "cpu"
         assert set(results) == {"gelu", "cross_entropy", "rms_norm", "sdpa_causal",
                                 "swiglu_mlp", "sdpa_grad", "ce_grad",
-                                "sdpa_decode", "ce_decode"}
+                                "sdpa_decode", "ce_decode", "cross_entropy_halfp"}
         measured = [r for r in results.values() if "error" not in r]
         # every case must measure on CPU — an {'error': ...} entry here means
         # the harness (not the tunnel) regressed

@@ -522,6 +522,47 @@ def snip_frozenset_setops(x):
             a.issubset(a | b), x in a)
 
 
+def snip_builtin_getattr(x):
+    class Box:
+        pass
+
+    b = Box()
+    b.v = x
+    out = [getattr(b, "v"), getattr(b, "missing", -1), getattr(b, "v", 99)]
+    try:
+        getattr(b, "missing")
+    except AttributeError as e:
+        out.append(type(e).__name__)
+    out.append(getattr([1, 2, x], "count")(x))
+    return out
+
+
+def snip_builtin_dict_get(x):
+    d = {"a": x, 1: "one", True: "true-wins"}
+    return [
+        d.get("a"), d.get("b"), d.get("b", 7), d.get(1), d.get(0),
+        {}.get("anything", x), d.get("a", None),
+    ]
+
+
+def snip_operator_getitem(x):
+    import operator
+
+    seq = [x, x + 1, x + 2]
+    d = {"k": x}
+    out = [operator.getitem(seq, 1), operator.getitem(d, "k"),
+           operator.getitem(seq, slice(0, 2)), operator.getitem((4, 5), -1)]
+    try:
+        operator.getitem(seq, 10)
+    except IndexError as e:
+        out.append(type(e).__name__)
+    try:
+        operator.getitem(d, "nope")
+    except KeyError as e:
+        out.append(type(e).__name__)
+    return out
+
+
 ALL_SNIPPETS = [v for k, v in sorted(globals().items()) if k.startswith("snip_")]
 
 

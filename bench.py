@@ -503,6 +503,10 @@ def blocks_benchmarks(on_tpu: bool, out_path: str = "BENCH_BLOCKS.json"):
             rows.append({"name": b.name, "tier": b.tier, "error": str(e)[-200:]})
             log(f"blocks {b.tier}/{b.name}: ERROR {e}")
     artifact = {"backend": jax.default_backend(), "rows": rows}
+    if artifact["backend"] != "tpu":
+        artifact["note"] = ("CPU smoke: validates the harness only — CPU op timings "
+                            "say nothing about TPU kernels (pallas runs in interpret "
+                            "mode); the committed TPU run overwrites this file")
     with open(out_path, "w") as f:
         json.dump(artifact, f, indent=1)
     log(f"blocks artifact written to {out_path}")

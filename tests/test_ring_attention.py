@@ -16,13 +16,16 @@ from thunder_tpu.models import llama
 rng = np.random.default_rng(23)
 
 
-def _ref_attention(q, k, v, causal, scale=None):
+def _ref_attention(q, k, v, causal, scale=None, window=None):
     hs = q.shape[-1]
     scale = scale or 1.0 / np.sqrt(hs)
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
     if causal:
         T = q.shape[2]
         mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+        if window is not None:
+            col = jnp.arange(T)
+            mask = mask & (col[None, :] > col[:, None] - window)
         s = jnp.where(mask, s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), p.dtype.type(1) * v).astype(q.dtype)
@@ -92,6 +95,26 @@ def test_self_attention_layer():
     y = _ref_attention(q, k, v, True).transpose(0, 2, 1, 3).reshape(B, T, C)
     ref = y @ wo.T
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("window", [1, 8, 9, 10, 24])
+def test_sliding_window_exact_and_skips_far_steps(window):
+    """The band must match a dense banded softmax exactly, AND fully-masked
+    ring steps must disappear at trace time: window=8 over t_loc=8 shards
+    needs 2 resident blocks (1 k/v rotation), not the full 8-step ring."""
+    q, k, v = _qkv(T=64)  # sp=8 -> t_loc=8
+    mesh = dist.make_mesh({"sp": 8})
+    got = ring_attention(q, k, v, mesh=mesh, causal=True, window=window)
+    ref = _ref_attention(q, k, v, True, window=window)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-6)
+
+    t_loc = 8
+    expected_steps = min(8, 1 if window <= 1 else (window - 2) // t_loc + 2)
+    jaxpr = str(jax.make_jaxpr(
+        lambda q, k, v: ring_attention(q, k, v, mesh=mesh, causal=True, window=window)
+    )(q, k, v))
+    # one k + one v ppermute per rotation; the last step does not rotate
+    assert jaxpr.count("ppermute") == 2 * (expected_steps - 1), (window, expected_steps)
 
 
 def test_long_sequence_under_jit():

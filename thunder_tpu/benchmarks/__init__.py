@@ -49,6 +49,7 @@ class Benchmark:
     baseline_fn: Callable | None  # plain-jax same-math callable (None: reuse fn)
     make_batch: Callable[[], tuple]  # () -> args
     tier: str = "op"  # op | block | model
+    prejitted: bool = False  # fns already compiled (tt.grad / jax.grad pairs)
 
 
 @dataclasses.dataclass
@@ -76,8 +77,11 @@ def run_benchmark(b: Benchmark, *, reps: int = 3) -> BenchmarkResult:
     import thunder_tpu as tt
 
     args = b.make_batch()
-    tfn = tt.jit(b.fn)
-    jfn = jax.jit(b.baseline_fn) if b.baseline_fn is not None else None
+    tfn = b.fn if b.prejitted else tt.jit(b.fn)
+    if b.baseline_fn is None:
+        jfn = None
+    else:
+        jfn = b.baseline_fn if b.prejitted else jax.jit(b.baseline_fn)
     t_vals, j_vals = [], []
     for _ in range(reps):
         t = time_fn(tfn, *args)
@@ -209,7 +213,7 @@ def block_benchmarks(on_tpu: bool) -> list[Benchmark]:
 
     # cos/sin travel as explicit args: the thunder jit proxies ARGUMENTS —
     # a closed-over concrete jax array inside ltorch ops is "not number-like"
-    return [
+    benches = [
         Benchmark("block_mlp", lambda mp, h: llama.mlp(mp, h, cfg),
                   jax_mlp, lambda: (bp["mlp"], x), tier="block"),
         Benchmark("block_csa",
@@ -221,6 +225,28 @@ def block_benchmarks(on_tpu: bool) -> list[Benchmark]:
                   lambda bp_, h, c, s: jax_block(bp_, h), lambda: (bp, x, cos, sin),
                   tier="block"),
     ]
+
+    # fwd+bwd tier (the reference benchmarks backward too): grads of a
+    # scalarized block loss wrt the block params, framework VJP vs jax.grad
+    import thunder_tpu as tt
+
+    def t_block_loss(bp_, h, c, s):
+        out = llama.block_forward(bp_, h, c, s, cfg)
+        import thunder_tpu.torch as ltorch
+
+        return ltorch.sum(out * out)
+
+    def j_block_loss(bp_, h, c, s):
+        out = jax_block(bp_, h)
+        return jnp.sum((out * out).astype(jnp.float32))
+
+    benches.append(Benchmark(
+        "transformer_block_grad",
+        tt.grad(t_block_loss, argnums=0),
+        jax.jit(jax.grad(j_block_loss, argnums=0)),
+        lambda: (bp, x, cos, sin), tier="block", prejitted=True,
+    ))
+    return benches
 
 
 def model_benchmarks(on_tpu: bool) -> list[Benchmark]:

@@ -488,6 +488,39 @@ class TestGeneralJit:
         finally:
             MODULE_CFG.pop("warmup", None)
 
+    def test_len_builtin_guards_container(self):
+        """len() on guarded state must guard the container: growing it
+        retraces instead of replaying the baked length."""
+        def f(x):
+            if len(MODULE_LIST) == 2:
+                return x * MODULE_LIST[1]
+            return x * 100.0
+
+        x = rng.standard_normal((4,)).astype(np.float32)
+        jfn = tt.jit(f, interpretation="bytecode")
+        np.testing.assert_allclose(np.asarray(jfn(x)), x * 3.0, rtol=1e-6)
+        try:
+            MODULE_LIST.append(5.0)
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 100.0, rtol=1e-6)
+            assert tt.cache_misses(jfn) == 2
+        finally:
+            MODULE_LIST.pop()
+
+    def test_list_element_guard_retraces(self):
+        def f(x):
+            return x * MODULE_LIST[0]
+
+        x = rng.standard_normal((4,)).astype(np.float32)
+        jfn = tt.jit(f, interpretation="bytecode")
+        np.testing.assert_allclose(np.asarray(jfn(x)), x * 1.0, rtol=1e-6)
+        old = MODULE_LIST[0]
+        try:
+            MODULE_LIST[0] = 4.0
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 4.0, rtol=1e-6)
+            assert tt.cache_misses(jfn) == 2
+        finally:
+            MODULE_LIST[0] = old
+
     def test_operator_getitem_preserves_provenance(self):
         import operator
 

@@ -53,6 +53,7 @@ class PseudoInst(Enum):
     LOAD_ATTR = auto()
     BINARY_SUBSCR = auto()
     LOAD_DEREF = auto()
+    LEN = auto()
     CONSTANT = auto()
     OPAQUE = auto()
 
@@ -93,6 +94,9 @@ class ProvenanceRecord:
         if self.inst is PseudoInst.BINARY_SUBSCR and self.inputs:
             base = self.inputs[0].path()
             return None if base is None else base + (("item", self.key),)
+        if self.inst is PseudoInst.LEN and self.inputs:
+            base = self.inputs[0].path()
+            return None if base is None else base + (("len", None),)
         return None
 
 
@@ -309,6 +313,18 @@ def _provenance_builtin_call(ctx: "InterpreterCompileCtx", depth: int, fn, args,
             v = ctx.record_read(rec, v)
             ctx.track(v, rec)
         return True, v
+    if fn is len and len(args) == 1:
+        obj = args[0]
+        base_rec = ctx.prov_of(obj)
+        n = len(obj)
+        if base_rec is not None:
+            # a LENGTH guard (prologue check_len), NOT a container-value
+            # guard: scratch lists mutated mid-call (HF's out_cls_cell
+            # pattern) would otherwise bake post-mutation contents
+            ctx.record("lookaside", depth, "builtins.len")
+            rec = ProvenanceRecord(PseudoInst.LEN, inputs=(base_rec,))
+            n = ctx.record_read(rec, n)
+        return True, n
     if fn is operator.getitem and len(args) == 2:
         obj, k = args
         base_rec = ctx.prov_of(obj)

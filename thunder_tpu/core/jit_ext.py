@@ -41,6 +41,11 @@ _GUARDABLE = (int, float, bool, str, bytes, type(None))
 def _guardable(v) -> bool:
     if isinstance(v, _GUARDABLE):
         return True
+    # tuples only, NOT lists: a whole-list value guard would alias mutable
+    # scratch containers that traced code writes mid-call (HF's
+    # out_cls_cell = [None] pattern), baking post-mutation contents.  List
+    # state still guards at the right granularity — elements via the
+    # subscript chain, lengths via check_len (PseudoInst.LEN).
     if isinstance(v, tuple) and all(isinstance(e, _GUARDABLE) for e in v):
         return True
     # small all-primitive dicts guard as literal-likes (match-statement
@@ -186,6 +191,11 @@ def build_state_prologue(prologue_trace, fn: Callable, cap: StateCapture, dtype_
         return out
 
     for path, value in cap.guards.items():
+        if path[-1][0] == "len":
+            # length guard: re-read the CONTAINER and check len() — the
+            # container itself is not value-guarded (see _guardable)
+            prims.check_len(unpack(path[:-1]), value)
+            continue
         leaf = unpack(path)
         if isinstance(value, str):
             prims.check_string_value(leaf, value)

@@ -89,14 +89,45 @@ def tune_ce(N: int = 16384, V: int = 32000, dtype=jnp.bfloat16) -> dict:
     return decision
 
 
+def tune_embedding_bwd(N: int = 4096, V: int = 32000, C: int = 4096) -> dict:
+    """Scatter-add vs one-hot matmul for the embedding gradient at the
+    headline shape, single chip.  The matmul is the only correct choice
+    under a mesh (XLA mis-partitions the scatter — see
+    jaxex._embedding_backward_impl); single-device the scatter is assumed
+    cheaper, which this measures instead of assumes."""
+    key = jax.random.PRNGKey(0)
+    idx = jax.random.randint(key, (N,), 0, V)
+    g = jax.random.normal(jax.random.fold_in(key, 1), (N, C), dtype=jnp.bfloat16)
+
+    def scatter(g, idx):
+        out = jnp.zeros((V, C), dtype=g.dtype)
+        return out.at[idx].add(g)
+
+    def onehot(g, idx):
+        oh = (idx[:, None] == jnp.arange(V)[None, :])
+        return jax.lax.dot_general(
+            oh.astype(g.dtype), g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(g.dtype)
+
+    s_ms = bench._best_ms(jax.jit(scatter), g, idx, reps=3)
+    o_ms = bench._best_ms(jax.jit(onehot), g, idx, reps=3)
+    print(f"embedding bwd N={N} V={V} C={C}: scatter {s_ms:.3f} ms, "
+          f"one-hot matmul {o_ms:.3f} ms", file=sys.stderr)
+    return {"shape": [N, V, C], "scatter_ms": round(s_ms, 4),
+            "onehot_ms": round(o_ms, 4),
+            "single_device_winner": "onehot" if o_ms < s_ms else "scatter"}
+
+
 def main():
     if jax.default_backend() != "tpu":
         print(json.dumps({"error": "kernel tuning needs the TPU"}))
         return 1
     decision = tune_ce()
+    decision["embedding_bwd"] = tune_embedding_bwd()
     with open(os.path.abspath(TUNING_PATH), "w") as f:
         json.dump(decision, f, indent=1)
-    print(json.dumps(decision["ce"]["measured"] | {"claim": decision["ce"]["claim"]}))
+    print(json.dumps(decision["ce"]["measured"] | {"claim": decision["ce"]["claim"],
+                                                   "embedding_bwd": decision["embedding_bwd"]}))
     return 0
 
 

@@ -719,14 +719,18 @@ def main():
         # THUNDER_TPU_BENCH_EXERCISE_TPU_PATH runs this exact code path on
         # CPU at toy dims — a pre-flight so the flaky-TPU window is never
         # spent discovering a bench bug
+        # THUNDER_TPU_BENCH_FUSED_CE=1 flips the head to the fused
+        # linear+CE prim (no materialized logits) — an A/B lever for tunnel
+        # sessions; tools/config_sweep.py measures the same toggle
+        fused = {"fused_head_ce": True} if os.environ.get("THUNDER_TPU_BENCH_FUSED_CE") else {}
         if on_tpu:
-            cfg = llama.Config.from_name("Llama-2-7b-hf", n_layer=4)
+            cfg = llama.Config.from_name("Llama-2-7b-hf", n_layer=4, **fused)
             B, T = 2, 2048
             steps, baseline_steps = 10, 10
         else:
             cfg = llama.Config.from_name(
                 "Llama-2-7b-hf", n_layer=2, n_embd=256, n_head=4, intermediate_size=688,
-                vocab_size=512,
+                vocab_size=512, **fused,
             )
             B, T = 2, 256
             steps, baseline_steps = 3, 3

@@ -21,12 +21,13 @@ and drivable standalone via ``python bench.py blocks``.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Sequence
+import functools
+from typing import Callable
 
 import jax
 import jax.numpy as jnp
 
-from thunder_tpu.benchmarks.timing import best_ms, fetch_floor, sync, time_fn
+from thunder_tpu.benchmarks.timing import time_fn
 
 __all__ = [
     "Benchmark",
@@ -144,8 +145,6 @@ def op_benchmarks(on_tpu: bool) -> list[Benchmark]:
         ms = jnp.mean(af * af, axis=-1, keepdims=True)
         return ((af * jax.lax.rsqrt(ms + 1e-5)) * w.astype(jnp.float32)).astype(a.dtype)
 
-    import functools
-
     return [
         Benchmark("gelu", lambda a: ltorch.gelu(a),
                   functools.partial(jax.nn.gelu, approximate=False), batch_rows),
@@ -229,11 +228,10 @@ def block_benchmarks(on_tpu: bool) -> list[Benchmark]:
     # fwd+bwd tier (the reference benchmarks backward too): grads of a
     # scalarized block loss wrt the block params, framework VJP vs jax.grad
     import thunder_tpu as tt
+    import thunder_tpu.torch as ltorch
 
     def t_block_loss(bp_, h, c, s):
         out = llama.block_forward(bp_, h, c, s, cfg)
-        import thunder_tpu.torch as ltorch
-
         return ltorch.sum(out * out)
 
     def j_block_loss(bp_, h, c, s):

@@ -9,6 +9,7 @@ measured fetch-floor latency (~84 ms over axon, ~µs locally) is subtracted.
 """
 from __future__ import annotations
 
+import sys
 import time
 
 import jax
@@ -69,8 +70,6 @@ def time_fn(fn, *args, iters: int = 20) -> float:
         sync(out)
         dt = time.perf_counter() - t0 - floor
         if dt < 0.5 * floor:  # fetch-floor jitter swamped the signal even at max iters
-            import sys
-
             print(
                 f"time_fn: measurement unreliable (loop {dt*1e3:.1f} ms vs floor "
                 f"{floor*1e3:.1f} ms at {iters} iters)",

@@ -24,6 +24,7 @@ import builtins as _builtins
 import collections.abc as _abc
 import dis
 import inspect
+import operator
 import types
 import weakref
 from dataclasses import dataclass, field
@@ -290,8 +291,6 @@ def _provenance_builtin_call(ctx: "InterpreterCompileCtx", depth: int, fn, args,
     access chain — a hyperparameter read via ``cfg.get("lr")`` could never
     become a prologue guard, so mutating it would silently replay the stale
     program.  Returns ``(handled, value)``."""
-    import operator
-
     if kwargs:
         return False, None
     if fn is getattr and len(args) in (2, 3) and isinstance(args[1], str):

@@ -23,10 +23,10 @@ import jax.numpy as jnp
 import bench
 from thunder_tpu.executors import jaxex, pallasex
 
-TUNING_PATH = os.path.join(
+TUNING_PATH = os.path.abspath(os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "..", "thunder_tpu", "executors",
     "pallas_tuning.json",
-)
+))
 
 
 def _time_ce(fn, logits, target):
@@ -124,7 +124,7 @@ def main():
         return 1
     decision = tune_ce()
     decision["embedding_bwd"] = tune_embedding_bwd()
-    with open(os.path.abspath(TUNING_PATH), "w") as f:
+    with open(TUNING_PATH, "w") as f:
         json.dump(decision, f, indent=1)
     print(json.dumps(decision["ce"]["measured"] | {"claim": decision["ce"]["claim"],
                                                    "embedding_bwd": decision["embedding_bwd"]}))

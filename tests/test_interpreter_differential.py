@@ -563,6 +563,31 @@ def snip_operator_getitem(x):
     return out
 
 
+def snip_iteration_builtins(x):
+    seq = [x, x + 1, x + 2]
+    out = [list(enumerate(seq, 1)), list(zip(seq, "abc")), sorted(seq, reverse=True),
+           list(reversed(seq)), list(map(abs, seq)), [e for e in filter(None, [0, x, None, 1])]]
+    out.append(sum(seq))
+    out.append(max(seq, default=-1))
+    out.append(min([], default=-7))
+    return out
+
+
+def snip_string_formatting(x):
+    name = "w"
+    return [f"{x:.2f}|{name!r}|{x:>8}", "%d-%s" % (x, name), "{:05d}".format(x),
+            "-".join(str(i) for i in range(x % 4)), name * 3, f"{x=}"]
+
+
+def snip_unpack_in_calls(x):
+    def g(a, b, *rest, k=0, **kw):
+        return (a, b, rest, k, tuple(sorted(kw.items())))
+
+    args = [x, x + 1, x + 2]
+    kw = {"k": 5, "z": 9}
+    return [g(*args), g(*args, **kw), g(0, *args[:1], m=1)]
+
+
 ALL_SNIPPETS = [v for k, v in sorted(globals().items()) if k.startswith("snip_")]
 
 

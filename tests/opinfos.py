@@ -591,6 +591,16 @@ _set_errors("dropout_p0", lambda: [
     (lambda a: ltorch.dropout(a, -0.5), (_t((4, 5)),), RuntimeError, "dropout p"),
 ])
 
+_set_errors("conv2d", lambda: [
+    ((_t((2, 3, 8, 8)), _t((4, 5, 3, 3)), None), (ValueError, RuntimeError), ""),
+])
+_set_errors("sdpa", lambda: [
+    ((_t((2, 2, 4, 8)), _t((2, 2, 4, 16)), _t((2, 2, 4, 16))), RuntimeError, "head dims"),
+])
+_set_errors("group_norm", lambda: [
+    ((_t((3, 5, 6)), _t((5,)), _t((5,))), RuntimeError, "divisible"),
+])
+
 
 #
 # Integer-dtype forward coverage (exact comparison): ops whose int32 result

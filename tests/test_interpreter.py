@@ -25,6 +25,9 @@ class _Hyper:
 
 MODULE_OBJ = _Hyper(2.0)
 MODULE_LIST = [1.0, 3.0]
+# deliberately NOT _guardable (holds a non-primitive value): absence guards
+# must work on it even though a whole-dict value guard cannot
+MODULE_BIG_CFG = {"obj": _Hyper(1.0), "lr": 0.5}
 
 
 class TestInterpreterCore:
@@ -487,6 +490,225 @@ class TestGeneralJit:
             assert tt.cache_misses(jfn) == 2
         finally:
             MODULE_CFG.pop("warmup", None)
+
+    def test_dict_get_miss_on_unguardable_dict_retraces(self):
+        """A .get() MISS on a dict that is NOT value-guardable (holds
+        non-primitives) must emit a dedicated absence guard (check_absent):
+        inserting the key later retraces instead of replaying the baked
+        default branch (ADVICE r4: the whole-dict guard silently no-opped
+        here)."""
+        def f(x):
+            return x * MODULE_BIG_CFG.get("warmup", 1)
+
+        x = rng.standard_normal((4,)).astype(np.float32)
+        jfn = tt.jit(f, interpretation="bytecode")
+        np.testing.assert_allclose(np.asarray(jfn(x)), x * 1, rtol=1e-6)
+        src = tt.last_prologue_traces(jfn)[-1].python()
+        assert "check_contains" in src, src
+        try:
+            MODULE_BIG_CFG["warmup"] = 6
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 6, rtol=1e-6)
+            assert tt.cache_misses(jfn) == 2
+        finally:
+            MODULE_BIG_CFG.pop("warmup", None)
+
+    def test_getattr_default_miss_guards_absence(self):
+        """getattr(obj, name, default) taking the default branch must guard
+        the ABSENCE: adding the attribute later retraces."""
+        def f(x):
+            return x * getattr(MODULE_OBJ, "warmup_scale", 1.0)
+
+        x = rng.standard_normal((4,)).astype(np.float32)
+        jfn = tt.jit(f, interpretation="bytecode")
+        np.testing.assert_allclose(np.asarray(jfn(x)), x * 1.0, rtol=1e-6)
+        src = tt.last_prologue_traces(jfn)[-1].python()
+        assert "check_contains" in src, src
+        try:
+            MODULE_OBJ.warmup_scale = 3.0
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 3.0, rtol=1e-6)
+            assert tt.cache_misses(jfn) == 2
+        finally:
+            del MODULE_OBJ.warmup_scale
+
+    def test_contains_op_guards_membership(self):
+        """`key in d` branches on guarded state must guard MEMBERSHIP both
+        ways: inserting an absent key (or removing a present one) retraces
+        instead of replaying the baked branch."""
+        def f(x):
+            y = x * 2 if "warmup" in MODULE_BIG_CFG else x
+            return y * 3 if "lr" in MODULE_BIG_CFG else y
+
+        x = rng.standard_normal((4,)).astype(np.float32)
+        jfn = tt.jit(f, interpretation="bytecode")
+        np.testing.assert_allclose(np.asarray(jfn(x)), x * 3, rtol=1e-6)
+        src = tt.last_prologue_traces(jfn)[-1].python()
+        assert src.count("check_contains") >= 2, src
+        lr = MODULE_BIG_CFG["lr"]
+        try:
+            MODULE_BIG_CFG["warmup"] = 1
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 6, rtol=1e-6)
+            assert tt.cache_misses(jfn) == 2
+            MODULE_BIG_CFG.pop("lr")
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 2, rtol=1e-6)
+            assert tt.cache_misses(jfn) == 3
+        finally:
+            MODULE_BIG_CFG.pop("warmup", None)
+            MODULE_BIG_CFG["lr"] = lr
+
+    def test_hasattr_guards_membership(self):
+        """hasattr() — the common spelling of branch-on-attr-presence — must
+        guard the observed membership both ways."""
+        def f(x):
+            if hasattr(MODULE_OBJ, "bonus"):
+                return x * MODULE_OBJ.bonus
+            return x * MODULE_OBJ.scale
+
+        x = rng.standard_normal((4,)).astype(np.float32)
+        jfn = tt.jit(f, interpretation="bytecode")
+        np.testing.assert_allclose(np.asarray(jfn(x)), x * 2.0, rtol=1e-6)
+        src = tt.last_prologue_traces(jfn)[-1].python()
+        assert "check_contains" in src, src
+        try:
+            MODULE_OBJ.bonus = 7.0
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 7.0, rtol=1e-6)
+            assert tt.cache_misses(jfn) == 2
+        finally:
+            del MODULE_OBJ.bonus
+        # removal falls back to the first still-valid cached entry: a HIT
+        np.testing.assert_allclose(np.asarray(jfn(x)), x * 2.0, rtol=1e-6)
+        assert tt.cache_misses(jfn) == 2
+
+    def test_unguardable_value_read_guards_presence(self):
+        """A dict.get/getitem HIT whose value cannot be value-guarded (an
+        arbitrary object) must still guard PRESENCE: deleting the key later
+        retraces instead of replaying the baked present-branch.  When a
+        descendant leaf guard already unpacks THROUGH the key (raising →
+        retrace), the explicit check_contains is subsumed and dropped."""
+        def f(x):
+            obj = MODULE_BIG_CFG.get("obj")
+            if obj is None:
+                return x * 100.0
+            return x * obj.scale
+
+        x = rng.standard_normal((4,)).astype(np.float32)
+        jfn = tt.jit(f, interpretation="bytecode")
+        np.testing.assert_allclose(np.asarray(jfn(x)), x * 1.0, rtol=1e-6)
+        src = tt.last_prologue_traces(jfn)[-1].python()
+        # the obj.scale value guard unpacks through ['obj'] — the membership
+        # guard is redundant with that chain and must be dropped
+        assert "check_contains" not in src, src
+        assert "unpack_getitem(coll0, 'obj')" in src, src
+        obj = MODULE_BIG_CFG["obj"]
+        try:
+            del MODULE_BIG_CFG["obj"]
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 100.0, rtol=1e-6)
+            assert tt.cache_misses(jfn) == 2
+        finally:
+            MODULE_BIG_CFG["obj"] = obj
+
+    def test_presence_guard_without_descendant_unpack(self):
+        """When NOTHING unpacks through the key (the hit value is only
+        branched on, never read into a leaf guard), the explicit
+        check_contains(present) must survive and deletion must retrace."""
+        def f(x):
+            return x * 100.0 if MODULE_BIG_CFG.get("obj") is None else x * 1.0
+
+        x = rng.standard_normal((4,)).astype(np.float32)
+        jfn = tt.jit(f, interpretation="bytecode")
+        np.testing.assert_allclose(np.asarray(jfn(x)), x * 1.0, rtol=1e-6)
+        src = tt.last_prologue_traces(jfn)[-1].python()
+        assert "check_contains" in src, src
+        obj = MODULE_BIG_CFG["obj"]
+        try:
+            del MODULE_BIG_CFG["obj"]
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 100.0, rtol=1e-6)
+            assert tt.cache_misses(jfn) == 2
+        finally:
+            MODULE_BIG_CFG["obj"] = obj
+
+    def test_eafp_subscript_miss_guards_absence(self):
+        """`try: d[k] except KeyError:` (EAFP) on guarded state must guard
+        the miss: inserting the key later retraces instead of replaying the
+        baked handler branch."""
+        def f(x):
+            try:
+                s = MODULE_BIG_CFG["warmup"]
+            except KeyError:
+                s = 1.0
+            return x * s
+
+        x = rng.standard_normal((4,)).astype(np.float32)
+        jfn = tt.jit(f, interpretation="bytecode")
+        np.testing.assert_allclose(np.asarray(jfn(x)), x * 1.0, rtol=1e-6)
+        src = tt.last_prologue_traces(jfn)[-1].python()
+        assert "check_contains" in src, src
+        try:
+            MODULE_BIG_CFG["warmup"] = 5.0
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 5.0, rtol=1e-6)
+            assert tt.cache_misses(jfn) == 2
+        finally:
+            MODULE_BIG_CFG.pop("warmup", None)
+
+    def test_tuple_key_membership_guards(self):
+        """All-primitive tuple keys are guardable: `(k, i) in d` and
+        d.get((k, i)) misses must retrace when the key appears."""
+        def f(x):
+            return x * 2 if ("w", 0) in MODULE_BIG_CFG else x
+
+        x = rng.standard_normal((4,)).astype(np.float32)
+        jfn = tt.jit(f, interpretation="bytecode")
+        np.testing.assert_allclose(np.asarray(jfn(x)), x * 1.0, rtol=1e-6)
+        try:
+            MODULE_BIG_CFG[("w", 0)] = 1
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 2.0, rtol=1e-6)
+            assert tt.cache_misses(jfn) == 2
+        finally:
+            MODULE_BIG_CFG.pop(("w", 0), None)
+
+    def test_eafp_attr_miss_guards_absence(self):
+        """`try: o.a except AttributeError:` (EAFP) on guarded state must
+        guard the miss: adding the attribute later retraces."""
+        def f(x):
+            try:
+                s = MODULE_OBJ.warmup2
+            except AttributeError:
+                s = 1.0
+            return x * s
+
+        x = rng.standard_normal((4,)).astype(np.float32)
+        jfn = tt.jit(f, interpretation="bytecode")
+        np.testing.assert_allclose(np.asarray(jfn(x)), x * 1.0, rtol=1e-6)
+        src = tt.last_prologue_traces(jfn)[-1].python()
+        assert "check_contains" in src, src
+        try:
+            MODULE_OBJ.warmup2 = 5.0
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 5.0, rtol=1e-6)
+            assert tt.cache_misses(jfn) == 2
+        finally:
+            if hasattr(MODULE_OBJ, "warmup2"):
+                del MODULE_OBJ.warmup2
+
+    def test_sequence_membership_not_subsumed_by_index_unpack(self):
+        """`v in lst` tests VALUES; an unpack through lst[v] (v as INDEX)
+        must NOT subsume the membership guard — they are different
+        namespaces.  Mutating the list so membership flips retraces."""
+        def f(x):
+            y = x * 10 if 1 in MODULE_LIST else x
+            return y * MODULE_LIST[1]
+
+        x = rng.standard_normal((4,)).astype(np.float32)
+        jfn = tt.jit(f, interpretation="bytecode")
+        # MODULE_LIST == [1.0, 3.0]; 1 == 1.0 → membership True
+        np.testing.assert_allclose(np.asarray(jfn(x)), x * 30.0, rtol=1e-6)
+        src = tt.last_prologue_traces(jfn)[-1].python()
+        assert "check_contains" in src, src
+        old = MODULE_LIST[0]
+        try:
+            MODULE_LIST[0] = 7.0  # membership of 1 now False
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 3.0, rtol=1e-6)
+            assert tt.cache_misses(jfn) == 2
+        finally:
+            MODULE_LIST[0] = old
 
     def test_len_builtin_guards_container(self):
         """len() on guarded state must guard the container: growing it

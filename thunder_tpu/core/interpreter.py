@@ -55,6 +55,12 @@ class PseudoInst(Enum):
     BINARY_SUBSCR = auto()
     LOAD_DEREF = auto()
     LEN = auto()
+    ABSENT_ITEM = auto()  # key observed missing (dict.get miss / `in` False)
+    ABSENT_ATTR = auto()  # attribute observed missing (getattr/hasattr miss)
+    PRESENT_ITEM = auto()  # dict key observed present (`in` True / .get hit)
+    PRESENT_ATTR = auto()  # attribute observed present (hasattr / attr read)
+    ABSENT_MEMBER = auto()  # VALUE observed absent via `in` on a sequence
+    PRESENT_MEMBER = auto()  # VALUE observed present via `in` on a sequence
     CONSTANT = auto()
     OPAQUE = auto()
 
@@ -98,6 +104,24 @@ class ProvenanceRecord:
         if self.inst is PseudoInst.LEN and self.inputs:
             base = self.inputs[0].path()
             return None if base is None else base + (("len", None),)
+        if self.inst is PseudoInst.ABSENT_ITEM and self.inputs:
+            base = self.inputs[0].path()
+            return None if base is None else base + (("absent_item", self.key),)
+        if self.inst is PseudoInst.ABSENT_ATTR and self.inputs:
+            base = self.inputs[0].path()
+            return None if base is None else base + (("absent_attr", self.key),)
+        if self.inst is PseudoInst.PRESENT_ITEM and self.inputs:
+            base = self.inputs[0].path()
+            return None if base is None else base + (("present_item", self.key),)
+        if self.inst is PseudoInst.PRESENT_ATTR and self.inputs:
+            base = self.inputs[0].path()
+            return None if base is None else base + (("present_attr", self.key),)
+        if self.inst is PseudoInst.ABSENT_MEMBER and self.inputs:
+            base = self.inputs[0].path()
+            return None if base is None else base + (("absent_member", self.key),)
+        if self.inst is PseudoInst.PRESENT_MEMBER and self.inputs:
+            base = self.inputs[0].path()
+            return None if base is None else base + (("present_member", self.key),)
         return None
 
 
@@ -283,6 +307,47 @@ def _is_interpretable(fn) -> bool:
     return isinstance(fn, types.FunctionType) and fn.__code__ is not None
 
 
+# values the prologue can guard BY VALUE (mirror of jit_ext's _GUARDABLE
+# leaves); reads producing anything else get a membership guard instead so
+# the key/attr DISAPPEARING later still retraces.  Also the key types a
+# guard path can carry (hashable, repr-safe literals).
+_PRIMITIVE = (int, float, bool, str, bytes, type(None))
+
+
+def _guardable_key(k) -> bool:
+    # key shapes a guard path can carry: hashable, repr-safe literals —
+    # primitives plus all-primitive tuples (a common dict-key shape)
+    return isinstance(k, _PRIMITIVE) or (
+        isinstance(k, tuple) and all(isinstance(e, _PRIMITIVE) for e in k)
+    )
+
+
+def _tracked_read(ctx: "InterpreterCompileCtx", base_rec, key, value, *, is_attr: bool, container=None):
+    """Records a provenance-preserving attr/item read.  When the value
+    itself cannot become a value guard (arbitrary object, tensor), also
+    records a PRESENT membership guard — the dual of the miss-side absence
+    guards: without it, `del d[k]` / `del o.a` after tracing would silently
+    replay the baked present-branch.  Item guards are dict-only (`in` on a
+    sequence tests VALUES, not indices); attr guards skip names resolved on
+    the CLASS (methods/descriptors — effectively static) and module
+    attributes, which keeps the per-call prologue free of hasattr noise for
+    every method access.  Returns the (possibly substituted) value."""
+    inst = PseudoInst.LOAD_ATTR if is_attr else PseudoInst.BINARY_SUBSCR
+    rec = ProvenanceRecord(inst, inputs=(base_rec,), key=key)
+    value = ctx.record_read(rec, value)
+    ctx.track(value, rec)
+    if isinstance(value, _PRIMITIVE):
+        return value
+    if is_attr:
+        if isinstance(container, types.ModuleType) or hasattr(type(container), key):
+            return value
+    elif not isinstance(container, dict):
+        return value
+    pinst = PseudoInst.PRESENT_ATTR if is_attr else PseudoInst.PRESENT_ITEM
+    ctx.record_read(ProvenanceRecord(pinst, inputs=(base_rec,), key=key), True)
+    return value
+
+
 def _provenance_builtin_call(ctx: "InterpreterCompileCtx", depth: int, fn, args, kwargs):
     """Provenance-preserving interpretation of the builtins most likely to
     reach guarded state: ``getattr``, ``operator.getitem``, and bound
@@ -299,19 +364,31 @@ def _provenance_builtin_call(ctx: "InterpreterCompileCtx", depth: int, fn, args,
         try:
             v = getattr(obj, name)
         except AttributeError:
+            if base_rec is not None:
+                # absence observed: emit a dedicated absent-attr guard
+                # (prologue check_absent) so ADDING the attribute later
+                # retraces — a whole-object value guard would only work for
+                # _guardable containers, silently missing e.g. config objects
+                rec = ProvenanceRecord(PseudoInst.ABSENT_ATTR, inputs=(base_rec,), key=name)
+                ctx.record_read(rec, True)
             if len(args) == 3:
-                if base_rec is not None:
-                    # absence observed: guard the base object (where
-                    # guardable) so adding the attribute retraces
-                    ctx.record_read(base_rec, obj)
                 return True, args[2]
             raise
         if base_rec is not None:
             ctx.record("lookaside", depth, "builtins.getattr")
-            rec = ProvenanceRecord(PseudoInst.LOAD_ATTR, inputs=(base_rec,), key=name)
-            v = ctx.record_read(rec, v)
-            ctx.track(v, rec)
+            v = _tracked_read(ctx, base_rec, name, v, is_attr=True, container=obj)
         return True, v
+    if fn is hasattr and len(args) == 2 and isinstance(args[1], str):
+        # the most common spelling of branch-on-attribute-presence: guard
+        # the observed membership so adding/removing the attr retraces
+        obj, name = args
+        found = hasattr(obj, name)
+        base_rec = ctx.prov_of(obj)
+        if base_rec is not None:
+            ctx.record("lookaside", depth, "builtins.hasattr")
+            inst = PseudoInst.PRESENT_ATTR if found else PseudoInst.ABSENT_ATTR
+            ctx.record_read(ProvenanceRecord(inst, inputs=(base_rec,), key=name), True)
+        return True, found
     if fn is len and len(args) == 1:
         obj = args[0]
         base_rec = ctx.prov_of(obj)
@@ -327,34 +404,40 @@ def _provenance_builtin_call(ctx: "InterpreterCompileCtx", depth: int, fn, args,
     if fn is operator.getitem and len(args) == 2:
         obj, k = args
         base_rec = ctx.prov_of(obj)
-        v = obj[k]
-        if base_rec is not None and isinstance(k, (int, str, bool)):
+        try:
+            v = obj[k]
+        except (KeyError, IndexError):
+            # EAFP miss: guard the observed absence (dict-only) so inserting
+            # the key later retraces instead of replaying the handler branch
+            if base_rec is not None and isinstance(obj, dict) and _guardable_key(k):
+                ctx.record_read(ProvenanceRecord(PseudoInst.ABSENT_ITEM, inputs=(base_rec,), key=k), True)
+            raise
+        if base_rec is not None and _guardable_key(k):
             ctx.record("lookaside", depth, "operator.getitem")
-            rec = ProvenanceRecord(PseudoInst.BINARY_SUBSCR, inputs=(base_rec,), key=k)
-            v = ctx.record_read(rec, v)
-            ctx.track(v, rec)
+            v = _tracked_read(ctx, base_rec, k, v, is_attr=False, container=obj)
         return True, v
     if (
         isinstance(fn, types.BuiltinMethodType)
         and fn.__name__ == "get"
         and isinstance(getattr(fn, "__self__", None), dict)
         and len(args) in (1, 2)
-        and isinstance(args[0], (int, str, bool))
+        and _guardable_key(args[0])
     ):
         d = fn.__self__
         base_rec = ctx.prov_of(d)
         if args[0] not in d:
             if base_rec is not None:
-                # a miss must also guard: inserting the key later retraces
-                # instead of replaying the baked default branch
-                ctx.record_read(base_rec, d)
+                # a miss must also guard: a dedicated absent-key guard
+                # (prologue check_absent) retraces when the key is INSERTED
+                # later, on any dict — a whole-dict value guard would only
+                # cover small all-primitive dicts (_guardable)
+                rec = ProvenanceRecord(PseudoInst.ABSENT_ITEM, inputs=(base_rec,), key=args[0])
+                ctx.record_read(rec, True)
             return True, (args[1] if len(args) == 2 else None)
         v = d[args[0]]
         if base_rec is not None:
             ctx.record("lookaside", depth, "dict.get")
-            rec = ProvenanceRecord(PseudoInst.BINARY_SUBSCR, inputs=(base_rec,), key=args[0])
-            v = ctx.record_read(rec, v)
-            ctx.track(v, rec)
+            v = _tracked_read(ctx, base_rec, args[0], v, is_attr=False, container=d)
         return True, v
     return False, None
 
@@ -1081,11 +1164,17 @@ def _load_attr(frame, ins, i):
     name = ins.argval
     is_method = bool(ins.arg & 1)
     base_rec = frame.ctx.prov_of(obj)
-    v = getattr(obj, name)
+    try:
+        v = getattr(obj, name)
+    except AttributeError:
+        # EAFP miss (`try: o.a except AttributeError:`): guard the observed
+        # absence so adding the attribute later retraces instead of
+        # replaying the baked handler branch
+        if base_rec is not None:
+            frame.ctx.record_read(ProvenanceRecord(PseudoInst.ABSENT_ATTR, inputs=(base_rec,), key=name), True)
+        raise
     if base_rec is not None:
-        rec = ProvenanceRecord(PseudoInst.LOAD_ATTR, inputs=(base_rec,), key=name)
-        v = frame.ctx.record_read(rec, v)
-        frame.ctx.track(v, rec)
+        v = _tracked_read(frame.ctx, base_rec, name, v, is_attr=True, container=obj)
     if is_method:
         # getattr already bound the method, so use the plain-call layout
         # ([NULL, callable]) — CALL accepts either convention
@@ -1320,11 +1409,17 @@ def _binary_subscr(frame, ins, i):
     k = frame.pop()
     obj = frame.pop()
     base_rec = frame.ctx.prov_of(obj)
-    v = obj[k]
-    if base_rec is not None and isinstance(k, (int, str, bool)):
-        rec = ProvenanceRecord(PseudoInst.BINARY_SUBSCR, inputs=(base_rec,), key=k)
-        v = frame.ctx.record_read(rec, v)
-        frame.ctx.track(v, rec)
+    try:
+        v = obj[k]
+    except (KeyError, IndexError):
+        # EAFP miss (`try: d[k] except KeyError:`): guard the observed
+        # absence (dict-only) so inserting the key later retraces instead
+        # of replaying the baked handler branch
+        if base_rec is not None and isinstance(obj, dict) and _guardable_key(k):
+            frame.ctx.record_read(ProvenanceRecord(PseudoInst.ABSENT_ITEM, inputs=(base_rec,), key=k), True)
+        raise
+    if base_rec is not None and _guardable_key(k):
+        v = _tracked_read(frame.ctx, base_rec, k, v, is_attr=False, container=obj)
     frame.push(v)
 
 
@@ -1416,7 +1511,24 @@ def _is_op(frame, ins, i):
 def _contains_op(frame, ins, i):
     b = frame.pop()
     a = frame.pop()
-    frame.push((a not in b) if ins.arg else (a in b))
+    found = a in b
+    # membership on guarded state is a branch condition: guard the observed
+    # presence/absence of the key so inserting (or removing) it retraces
+    # instead of replaying the baked branch
+    if _guardable_key(a):
+        base_rec = frame.ctx.prov_of(b)
+        if base_rec is not None:
+            # dict `in` tests KEYS (same namespace as getitem/unpack, so the
+            # guard can be subsumed by an unpack through the key); sequence
+            # `in` tests VALUES — a distinct *_member step that unpacks
+            # through an INDEX must never subsume
+            if isinstance(b, dict):
+                inst = PseudoInst.PRESENT_ITEM if found else PseudoInst.ABSENT_ITEM
+            else:
+                inst = PseudoInst.PRESENT_MEMBER if found else PseudoInst.ABSENT_MEMBER
+            rec = ProvenanceRecord(inst, inputs=(base_rec,), key=a)
+            frame.ctx.record_read(rec, True)
+    frame.push((not found) if ins.arg else found)
 
 
 @register_opcode_handler("POP_TOP")

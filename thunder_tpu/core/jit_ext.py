@@ -190,11 +190,42 @@ def build_state_prologue(prologue_trace, fn: Callable, cap: StateCapture, dtype_
             unpacked[path] = out
         return out
 
+    # steps the prologue will already unpack THROUGH: unpack_attr/getitem
+    # raising there (→ retrace) covers the member vanishing, so a present_*
+    # membership guard on the same step is redundant noise
+    _PSEUDO_STEPS = (
+        "len", "absent_item", "absent_attr", "present_item", "present_attr",
+        "absent_member", "present_member",
+    )
+    unpack_covered: set[tuple] = set()
+    for p in list(cap.guards) + list(cap.tensors):
+        real = p[:-1] if p[-1][0] in _PSEUDO_STEPS else p
+        for i in range(1, len(real) + 1):
+            unpack_covered.add(real[:i])
+
     for path, value in cap.guards.items():
         if path[-1][0] == "len":
             # length guard: re-read the CONTAINER and check len() — the
             # container itself is not value-guarded (see _guardable)
             prims.check_len(unpack(path[:-1]), value)
+            continue
+        if path[-1][0] in _PSEUDO_STEPS and path[-1][0] != "len":
+            # membership guard: the traced program baked a branch on
+            # key/attr/value presence (dict.get / getattr-default / hasattr /
+            # `in`, or a read whose value cannot be value-guarded) — re-read
+            # the container and check membership is UNCHANGED, so inserting
+            # (or removing) the key/attr retraces
+            step, key = path[-1]
+            kind = "attr" if step.endswith("_attr") else "item"
+            present = step.startswith("present")
+            # subsumption (an unpack through the same step already raises →
+            # retraces when the member vanishes) applies only where the
+            # membership namespace IS the getitem namespace: dict keys and
+            # attrs.  Sequence `in` (*_member) tests VALUES, not indices —
+            # an unpack through lst[v] proves nothing about `v in lst`.
+            if present and not step.endswith("_member") and path[:-1] + ((kind, key),) in unpack_covered:
+                continue
+            prims.check_contains(unpack(path[:-1]), key, kind, present)
             continue
         leaf = unpack(path)
         if isinstance(value, str):

@@ -67,6 +67,7 @@ class PrimIDs(Enum):
     CHECK_STRING_VALUE = auto()
     CHECK_INSTANCE = auto()
     CHECK_LEN = auto()
+    CHECK_CONTAINS = auto()
     CHECK_LITERAL_LIKE = auto()
     CHECK_NONE = auto()
     # Utility
@@ -1678,6 +1679,30 @@ check_len = make_prim(
     "check_len",
     meta=lambda x, length: None,
     python_impl=_check_len_impl,
+    tags=(OpTags.CHECK_OP, OpTags.DONT_DCE),
+)
+
+
+def _check_contains_impl(x, key, kind, expect):
+    found = hasattr(x, key) if kind == "attr" else key in x
+    if found != expect:
+        what = "Attribute" if kind == "attr" else "Key"
+        state = "disappeared from" if expect else "appeared in"
+        raise RuntimeError(f"{what} {key!r} {state} input (membership changed since trace time)")
+    return None
+
+
+# membership guard for branches baked on key/attribute presence — dict.get
+# and 3-arg getattr misses (expect=False: the key APPEARING later must
+# retrace) and `in` tests either way.  A whole-container value guard only
+# works for small all-primitive dicts (_guardable); this checks exactly the
+# observed membership on any container (kind: "item" `in` test, "attr"
+# hasattr test)
+check_contains = make_prim(
+    PrimIDs.CHECK_CONTAINS,
+    "check_contains",
+    meta=lambda x, key, kind, expect: None,
+    python_impl=_check_contains_impl,
     tags=(OpTags.CHECK_OP, OpTags.DONT_DCE),
 )
 

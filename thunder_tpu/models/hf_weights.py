@@ -394,11 +394,16 @@ def from_hf_state_dict(sd: Mapping[str, Any], cfg: Config, dtype=jnp.bfloat16) -
     get = _getter(sd, "model.", "HF")
 
     # gemma's RMSNorm computes x_norm * (1 + w): fold the unit offset into
-    # the stored weights so models/llama's plain w-multiply norm matches
+    # the stored weights so models/llama's plain w-multiply norm matches.
+    # The folded (1 + w) multiplier stays in float32 — rounding it to bf16
+    # would cost ~2^-8 relative precision on the *whole* scale (w sits near
+    # 0, so bf16(1 + w) loses what bf16(w) alone keeps); rms_norm upcasts
+    # weights to its f32 computation dtype, so f32 storage is free.
     off = 1.0 if cfg.mlp_class == "GemmaMLP" else 0.0
+    norm_dtype = jnp.float32 if off else dtype
 
     def norm(name: str) -> jnp.ndarray:
-        return jnp.asarray(get(name).astype(np.float32) + off, dtype)
+        return jnp.asarray(get(name).astype(np.float32) + off, norm_dtype)
 
     wte = _pad_vocab(get("embed_tokens.weight"), cfg.padded_vocab_size)
     blocks = []

@@ -1,5 +1,5 @@
 #!/bin/bash
-# Round-4 TPU experiment list, run ONCE per tunnel window by tpu_queue.sh.
+# Round-5 TPU experiment list, run ONCE per tunnel window by tpu_queue.sh.
 # Kept separate from the watcher loop so it can be edited while the watcher
 # sleeps — the watcher re-reads this file at the moment the tunnel comes up.
 # Order: driver-critical artifacts FIRST (a brief window must refresh the
@@ -13,10 +13,12 @@ echo "$(date -u +%T) run_queue start" >> "$LOG/queue.log"
 # 1. headline (BENCH_TPU.json refresh) — patient budget, we know the tunnel is up
 THUNDER_TPU_BENCH_MAX_WAIT_S=120 timeout 2400 python bench.py > "$LOG/headline.json.tmp" 2> "$LOG/headline.log"
 hrc=$?
-if [ $hrc -eq 0 ] && grep -q tokens "$LOG/headline.json.tmp"; then
+headline_ok=0
+if [ $hrc -eq 0 ] && grep -q tokens "$LOG/headline.json.tmp" && ! grep -q cpu_smoke "$LOG/headline.json.tmp"; then
   mv "$LOG/headline.json.tmp" BENCH_TPU.json
+  headline_ok=1
 fi
-echo "$(date -u +%T) headline rc=$hrc" >> "$LOG/queue.log"
+echo "$(date -u +%T) headline rc=$hrc ok=$headline_ok" >> "$LOG/queue.log"
 
 # 2. depth-scaling curve (VERDICT r3 #3: validate the 7B extrapolation);
 # merges its results into BENCH_TPU.json, so the round snapshot copies AFTER
@@ -24,7 +26,11 @@ if [ -f tools/depth_curve.py ]; then
   timeout 3000 python tools/depth_curve.py > "$LOG/depth_curve.log" 2>&1
   echo "$(date -u +%T) depth_curve rc=$?" >> "$LOG/queue.log"
 fi
-cp BENCH_TPU.json BENCH_r04_tpu.json 2>/dev/null
+# snapshot ONLY when this window's headline run succeeded — an unconditional
+# copy would mislabel a stale previous-round BENCH_TPU.json as this round's
+if [ "$headline_ok" = 1 ]; then
+  cp BENCH_TPU.json BENCH_r05_tpu.json 2>/dev/null
+fi
 
 # 3. pallas kernel tuning (VERDICT r3 #2: CE/rms/swiglu win-or-yield)
 if [ -f tools/kernel_tune.py ]; then
@@ -44,8 +50,13 @@ echo "$(date -u +%T) decode rc=$?" >> "$LOG/queue.log"
 THUNDER_TPU_BENCH_MAX_WAIT_S=120 timeout 2400 python bench.py blocks > "$LOG/blocks.json" 2> "$LOG/blocks.log"
 echo "$(date -u +%T) blocks rc=$?" >> "$LOG/queue.log"
 
+# (no scaling step: bench.py scaling forces a virtual CPU mesh by design —
+# one real chip cannot produce a TPU scaling table, so running it here would
+# only burn tunnel-window time re-generating the same CPU artifact)
+
 # 7. optional experiment tools, if the window is still alive
-for t in flash_tune config_sweep quant_headline; do
+# (mixtral_decode = milestone E headline: Mixtral-8x7B-arch int8 decode)
+for t in mixtral_decode flash_tune config_sweep quant_headline; do
   if [ -f "tools/$t.py" ]; then
     timeout 2400 python "tools/$t.py" > "$LOG/$t.log" 2>&1
     echo "$(date -u +%T) $t rc=$?" >> "$LOG/queue.log"

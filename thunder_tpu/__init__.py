@@ -124,6 +124,13 @@ def jit(
             **compile_options,
         )
 
+    # persistent XLA compilation cache: every process compiling the same
+    # HLO reuses the on-disk artifact (nvFuser serde-cache analog) — lazy
+    # so a plain import never mutates jax config
+    from thunder_tpu.core import compile_cache
+
+    compile_cache.ensure_enabled()
+
     cd = CompileData(
         fn=fn,
         executors_list=resolve_executors(executors),

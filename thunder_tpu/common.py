@@ -41,6 +41,15 @@ class CompileStats:
 
         self.interpreter_cache: list[CacheEntry] = []
 
+    @property
+    def persistent_cache(self) -> dict:
+        """Process-wide persistent XLA compilation-cache counters (hits =
+        programs loaded from disk instead of compiled, incl. by previous
+        processes; see core/compile_cache.py)."""
+        from thunder_tpu.core import compile_cache
+
+        return compile_cache.stats()
+
 
 class CompileData:
     """Everything the compilation pipeline needs to know about one jit call."""

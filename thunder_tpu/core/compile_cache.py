@@ -1,0 +1,108 @@
+"""Persistent XLA compilation cache (reference analog: nvFuser's serialized
+fusion cache, ``thunder/executors/nvfuserex_impl.py:527-568``, env
+``ENABLE_NVFUSER_SERIALIZATION``).
+
+Every process that compiles the same HLO reuses the on-disk artifact instead
+of recompiling — on this project that converts a scarce TPU tunnel window
+from minutes of compilation into seconds of execution, and makes repeated
+bench/CLI invocations start warm.
+
+Enabled lazily at the first ``thunder_tpu.jit``/``TrainStep`` construction
+(so plain ``import thunder_tpu`` never mutates jax config).  Controls:
+
+- ``THUNDER_TPU_COMPILATION_CACHE`` — ``off``/``0`` disables entirely;
+  otherwise a directory path overriding the default
+  ``<repo-root>/.jax_cache``.
+- ``THUNDER_TPU_CACHE_MIN_COMPILE_S`` — minimum compile seconds before an
+  entry is persisted (default 0: persist everything; TPU programs all cross
+  any threshold, and tiny CPU programs are cheap to store).
+
+Cross-process hit/miss counters come from jax's monitoring events
+(``/jax/compilation_cache/cache_hits``/``cache_misses``) and surface via
+``stats()`` / ``thunder_tpu.compile_stats``.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+__all__ = ["enable", "ensure_enabled", "stats", "cache_dir"]
+
+_lock = threading.Lock()
+_enabled_dir: str | None = None
+_listener_registered = False
+_counts = {"persistent_cache_hits": 0, "persistent_cache_misses": 0}
+
+
+def _default_dir() -> str:
+    root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(root, ".jax_cache")
+
+
+def _on_event(name: str, **kwargs) -> None:
+    if name == "/jax/compilation_cache/cache_hits":
+        _counts["persistent_cache_hits"] += 1
+    elif name == "/jax/compilation_cache/cache_misses":
+        _counts["persistent_cache_misses"] += 1
+
+
+def enable(directory: str | None = None) -> str | None:
+    """Points jax's persistent compilation cache at ``directory`` (resolved
+    against the env override / repo default when None) and registers the
+    hit/miss counter.  Returns the active directory, or None when disabled
+    via ``THUNDER_TPU_COMPILATION_CACHE=off``.  Idempotent."""
+    global _enabled_dir, _listener_registered
+    with _lock:
+        env = os.environ.get("THUNDER_TPU_COMPILATION_CACHE", "").strip()
+        if env.lower() in ("off", "0", "false", "disabled"):
+            return None
+        directory = directory or (env or None) or _default_dir()
+        if _enabled_dir == directory:
+            return _enabled_dir
+
+        import jax
+
+        os.makedirs(directory, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", directory)
+        try:
+            min_s = float(os.environ.get("THUNDER_TPU_CACHE_MIN_COMPILE_S", "0"))
+        except ValueError:
+            min_s = 0.0
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", min_s)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        if not _listener_registered:
+            jax.monitoring.register_event_listener(_on_event)
+            _listener_registered = True
+        _enabled_dir = directory
+        return _enabled_dir
+
+
+def ensure_enabled() -> str | None:
+    """Lazy default-on hook used by jit/TrainStep: enables the cache at its
+    default location unless already configured or switched off.
+
+    Skipped when the platform is forced to CPU (tests, smokes) and no
+    explicit cache dir was requested: XLA:CPU logs a loud AOT
+    machine-feature mismatch on every cached load (pseudo-features like
+    prefer-no-scatter), and CPU warm-starts are not what the cache is for —
+    the scarce-TPU-window case is.  The platform check reads jax config
+    only (never ``jax.devices()``, which can hang on a dead tunnel)."""
+    if _enabled_dir is not None:
+        return _enabled_dir
+    if not os.environ.get("THUNDER_TPU_COMPILATION_CACHE", "").strip():
+        import jax
+
+        if getattr(jax.config, "jax_platforms", None) == "cpu":
+            return None
+    return enable()
+
+
+def cache_dir() -> str | None:
+    return _enabled_dir
+
+
+def stats() -> dict:
+    """Process-wide persistent-cache counters: ``persistent_cache_hits`` is
+    programs loaded from disk instead of compiled (cross-process reuse),
+    ``persistent_cache_misses`` is fresh compilations written to the cache."""
+    return dict(_counts, dir=_enabled_dir)

@@ -205,6 +205,9 @@ class TrainStep:
         comm_combine_threshold_mb: float | None = None,
         bucketer: Callable | None = None,
     ):
+        from thunder_tpu.core import compile_cache
+
+        compile_cache.ensure_enabled()  # warm-start repeat processes
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.mesh = mesh

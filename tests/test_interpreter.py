@@ -28,6 +28,7 @@ MODULE_LIST = [1.0, 3.0]
 # deliberately NOT _guardable (holds a non-primitive value): absence guards
 # must work on it even though a whole-dict value guard cannot
 MODULE_BIG_CFG = {"obj": _Hyper(1.0), "lr": 0.5}
+MODULE_TUPLE_CFG = {("a", 0): 0.1, ("b", 1): 0.2}
 
 
 class TestInterpreterCore:
@@ -742,6 +743,231 @@ class TestGeneralJit:
             assert tt.cache_misses(jfn) == 2
         finally:
             MODULE_LIST[0] = old
+
+    def test_for_loop_over_list_guards_elements(self):
+        """Iterating tracked state unrolls the loop, so elements AND length
+        must guard: mutating an element or appending retraces."""
+        def f(x):
+            acc = x * 0.0
+            for w in MODULE_LIST:
+                acc = acc + x * w
+            return acc
+
+        x = rng.standard_normal((4,)).astype(np.float32)
+        jfn = tt.jit(f, interpretation="bytecode")
+        np.testing.assert_allclose(np.asarray(jfn(x)), x * 4.0, rtol=1e-6)
+        old = MODULE_LIST[1]
+        try:
+            MODULE_LIST[1] = 9.0
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 10.0, rtol=1e-6)
+            assert tt.cache_misses(jfn) == 2
+            MODULE_LIST.append(5.0)
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 15.0, rtol=1e-6)
+            assert tt.cache_misses(jfn) == 3
+        finally:
+            MODULE_LIST[:] = [1.0, old]
+
+    @pytest.mark.parametrize("fold,expect", [
+        (sorted, lambda xs: sorted(xs)[-1]),
+        (min, min),
+        (max, max),
+        (sum, sum),
+    ])
+    def test_fold_builtins_guard_elements(self, fold, expect):
+        """sorted/min/max/sum over tracked state must guard the elements:
+        mutating one retraces (reference interprets through ~60 builtins)."""
+        def f(x):
+            v = fold(MODULE_LIST)
+            if fold is sorted:
+                v = v[-1]
+            return x * v
+
+        x = rng.standard_normal((4,)).astype(np.float32)
+        jfn = tt.jit(f, interpretation="bytecode")
+        np.testing.assert_allclose(np.asarray(jfn(x)), x * expect([1.0, 3.0]), rtol=1e-6)
+        old = MODULE_LIST[0]
+        try:
+            MODULE_LIST[0] = 8.0
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * expect([8.0, 3.0]), rtol=1e-6)
+            assert tt.cache_misses(jfn) == 2
+        finally:
+            MODULE_LIST[0] = old
+
+    def test_any_all_guard_elements(self):
+        def f(x):
+            if any(w > 2.0 for w in [v for v in MODULE_LIST]):
+                return x * 2.0
+            return x
+
+        # the genexp arg is a comprehension over the tracked list, so the
+        # element reads happen at iteration; mutation must retrace
+        x = rng.standard_normal((4,)).astype(np.float32)
+        jfn = tt.jit(f, interpretation="bytecode")
+        np.testing.assert_allclose(np.asarray(jfn(x)), x * 2.0, rtol=1e-6)  # 3.0 > 2
+        old = MODULE_LIST[1]
+        try:
+            MODULE_LIST[1] = 0.5
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 1.0, rtol=1e-6)
+            assert tt.cache_misses(jfn) == 2
+        finally:
+            MODULE_LIST[1] = old
+
+    def test_enumerate_guards_elements(self):
+        def f(x):
+            acc = x * 0.0
+            for i, w in enumerate(MODULE_LIST):
+                acc = acc + x * w * (i + 1)
+            return acc
+
+        x = rng.standard_normal((4,)).astype(np.float32)
+        jfn = tt.jit(f, interpretation="bytecode")
+        np.testing.assert_allclose(np.asarray(jfn(x)), x * 7.0, rtol=1e-6)  # 1*1 + 3*2
+        old = MODULE_LIST[0]
+        try:
+            MODULE_LIST[0] = 2.0
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 8.0, rtol=1e-6)
+            assert tt.cache_misses(jfn) == 2
+        finally:
+            MODULE_LIST[0] = old
+
+    def test_zip_guards_elements(self):
+        def f(x):
+            acc = x * 0.0
+            for w, s in zip(MODULE_LIST, [10.0, 100.0]):
+                acc = acc + x * w * s
+            return acc
+
+        x = rng.standard_normal((4,)).astype(np.float32)
+        jfn = tt.jit(f, interpretation="bytecode")
+        np.testing.assert_allclose(np.asarray(jfn(x)), x * 310.0, rtol=1e-6)
+        old = MODULE_LIST[0]
+        try:
+            MODULE_LIST[0] = 2.0
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 320.0, rtol=1e-6)
+            assert tt.cache_misses(jfn) == 2
+        finally:
+            MODULE_LIST[0] = old
+
+    def test_dict_iteration_guards_keys_and_values(self):
+        """for k, v in cfg.items(): unrolls over the key order — inserting a
+        key, changing a value, or reordering keys must retrace."""
+        def f(x):
+            acc = x * 0.0
+            for k, v in MODULE_CFG.items():
+                if k == "depth":
+                    acc = acc + x * v
+            return acc
+
+        x = rng.standard_normal((4,)).astype(np.float32)
+        jfn = tt.jit(f, interpretation="bytecode")
+        np.testing.assert_allclose(np.asarray(jfn(x)), x * 2.0, rtol=1e-6)
+        src = tt.last_prologue_traces(jfn)[-1].python()
+        assert "check_keys" in src, src
+        try:
+            MODULE_CFG["extra"] = 1
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 2.0, rtol=1e-6)
+            assert tt.cache_misses(jfn) == 2  # key set changed → retrace
+            old = MODULE_CFG["depth"]
+            MODULE_CFG["depth"] = 4
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 4.0, rtol=1e-6)
+            assert tt.cache_misses(jfn) == 3  # value changed → retrace
+        finally:
+            MODULE_CFG.pop("extra", None)
+            MODULE_CFG["depth"] = 2
+
+    def test_fold_builtin_kwargs_variant_still_guards(self):
+        """sorted(xs, reverse=True) is not interpreted (kwargs variant) but
+        must STILL record element guards before running opaque — mutation
+        retraces either way."""
+        def f(x):
+            return x * sorted(MODULE_LIST, reverse=True)[0]
+
+        x = rng.standard_normal((4,)).astype(np.float32)
+        jfn = tt.jit(f, interpretation="bytecode")
+        np.testing.assert_allclose(np.asarray(jfn(x)), x * 3.0, rtol=1e-6)
+        old = MODULE_LIST[0]
+        try:
+            MODULE_LIST[0] = 7.0
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 7.0, rtol=1e-6)
+            assert tt.cache_misses(jfn) == 2
+        finally:
+            MODULE_LIST[0] = old
+
+    def test_dict_view_set_algebra_works(self):
+        """keys()/items() on tracked dicts return REAL view objects (set
+        algebra must keep working), and the walk still guards."""
+        def f(x):
+            if MODULE_CFG.keys() & {"depth", "nothere"}:
+                return x * MODULE_CFG["depth"]
+            return x
+
+        x = rng.standard_normal((4,)).astype(np.float32)
+        jfn = tt.jit(f, interpretation="bytecode")
+        np.testing.assert_allclose(np.asarray(jfn(x)), x * 2.0, rtol=1e-6)
+        old = MODULE_CFG["depth"]
+        try:
+            MODULE_CFG["depth"] = 5
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 5.0, rtol=1e-6)
+            assert tt.cache_misses(jfn) == 2
+        finally:
+            MODULE_CFG["depth"] = old
+
+    def test_tuple_keyed_dict_items_walk_guards_values(self):
+        """Tuple-keyed dicts walked via items() guard per-key values (keys
+        are guardable paths): mutating one retraces."""
+        def f(x):
+            acc = x * 0.0
+            for k, v in MODULE_TUPLE_CFG.items():
+                acc = acc + x * v
+            return acc
+
+        x = rng.standard_normal((4,)).astype(np.float32)
+        jfn = tt.jit(f, interpretation="bytecode")
+        np.testing.assert_allclose(np.asarray(jfn(x)), x * 0.3, rtol=1e-5)
+        old = MODULE_TUPLE_CFG[("a", 0)]
+        try:
+            MODULE_TUPLE_CFG[("a", 0)] = 1.0
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 1.2, rtol=1e-5)
+            assert tt.cache_misses(jfn) == 2
+        finally:
+            MODULE_TUPLE_CFG[("a", 0)] = old
+
+    def test_isinstance_guards_class(self):
+        """isinstance() on a guarded object bakes the class into the branch:
+        swapping the object for another class must retrace."""
+        def f(x):
+            if isinstance(MODULE_BIG_CFG["obj"], _Hyper):
+                return x * MODULE_BIG_CFG["obj"].scale
+            return x * 50.0
+
+        x = rng.standard_normal((4,)).astype(np.float32)
+        jfn = tt.jit(f, interpretation="bytecode")
+        np.testing.assert_allclose(np.asarray(jfn(x)), x * 1.0, rtol=1e-6)
+        src = tt.last_prologue_traces(jfn)[-1].python()
+        assert "check_type_name" in src, src
+        obj = MODULE_BIG_CFG["obj"]
+        try:
+            MODULE_BIG_CFG["obj"] = object()
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 50.0, rtol=1e-6)
+            assert tt.cache_misses(jfn) == 2
+        finally:
+            MODULE_BIG_CFG["obj"] = obj
+
+    def test_str_method_on_guarded_value_retraces(self):
+        """str values guard at READ time, so methods on them are computed on
+        a guarded constant: changing the string retraces the method result."""
+        def f(x):
+            return x * 2.0 if MODULE_CFG["act"].upper() == "TANH" else x
+
+        x = rng.standard_normal((4,)).astype(np.float32)
+        jfn = tt.jit(f, interpretation="bytecode")
+        np.testing.assert_allclose(np.asarray(jfn(x)), x * 2.0, rtol=1e-6)
+        try:
+            MODULE_CFG["act"] = "gelu"
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 1.0, rtol=1e-6)
+            assert tt.cache_misses(jfn) == 2
+        finally:
+            MODULE_CFG["act"] = "tanh"
 
     def test_operator_getitem_preserves_provenance(self):
         import operator

@@ -61,6 +61,8 @@ class PseudoInst(Enum):
     PRESENT_ATTR = auto()  # attribute observed present (hasattr / attr read)
     ABSENT_MEMBER = auto()  # VALUE observed absent via `in` on a sequence
     PRESENT_MEMBER = auto()  # VALUE observed present via `in` on a sequence
+    KEYS = auto()  # dict key tuple observed (iteration / keys()/items())
+    TYPE_NAME = auto()  # object class observed via isinstance()
     CONSTANT = auto()
     OPAQUE = auto()
 
@@ -122,6 +124,12 @@ class ProvenanceRecord:
         if self.inst is PseudoInst.PRESENT_MEMBER and self.inputs:
             base = self.inputs[0].path()
             return None if base is None else base + (("present_member", self.key),)
+        if self.inst is PseudoInst.KEYS and self.inputs:
+            base = self.inputs[0].path()
+            return None if base is None else base + (("keys", None),)
+        if self.inst is PseudoInst.TYPE_NAME and self.inputs:
+            base = self.inputs[0].path()
+            return None if base is None else base + (("type_name", None),)
         return None
 
 
@@ -348,14 +356,99 @@ def _tracked_read(ctx: "InterpreterCompileCtx", base_rec, key, value, *, is_attr
     return value
 
 
+def _read_elements(ctx: "InterpreterCompileCtx", obj, *, primitive_only: bool = False) -> list | None:
+    """Eagerly reads a TRACKED list/tuple's elements with provenance — a
+    LEN guard plus one per-element read (value guards for primitives,
+    proxification for tensors) — so iterating or folding external state
+    retraces when any element (or the length) changes.  Returns the
+    (possibly substituted) elements, or None when obj is untracked or not a
+    sequence.  ``primitive_only`` peeks BEFORE recording anything and bails
+    on non-primitive content: host folds (sorted/min/...) must compute on
+    real values, and proxifying tensors only to discard them would leave
+    dead unpack chains in the prologue."""
+    base_rec = ctx.prov_of(obj)
+    if base_rec is None or not isinstance(obj, (list, tuple)):
+        return None
+    if primitive_only and not all(isinstance(e, _PRIMITIVE) for e in obj):
+        return None
+    n = len(obj)
+    ctx.record_read(ProvenanceRecord(PseudoInst.LEN, inputs=(base_rec,)), n)
+    return [
+        _tracked_read(ctx, base_rec, idx, obj[idx], is_attr=False, container=obj)
+        for idx in range(n)
+    ]
+
+
+def _read_keys(ctx: "InterpreterCompileCtx", d: dict) -> list | None:
+    """Records a KEYS read for a TRACKED dict — the key tuple (set AND
+    order) becomes a prologue check_keys guard, since iteration unrolls in
+    key order.  Falls back to a LEN guard when keys are not guardable.
+    Returns the key list, or None when d is untracked."""
+    base_rec = ctx.prov_of(d)
+    if base_rec is None:
+        return None
+    keys = list(d.keys())
+    if all(_guardable_key(k) for k in keys):
+        ctx.record_read(ProvenanceRecord(PseudoInst.KEYS, inputs=(base_rec,)), tuple(keys))
+    else:
+        ctx.record_read(ProvenanceRecord(PseudoInst.LEN, inputs=(base_rec,)), len(d))
+    return keys
+
+
+def _read_dict_values(ctx: "InterpreterCompileCtx", d: dict, keys: list) -> list:
+    base_rec = ctx.prov_of(d)
+    return [
+        _tracked_read(ctx, base_rec, k, d[k], is_attr=False, container=d)
+        if _guardable_key(k)
+        else d[k]
+        for k in keys
+    ]
+
+
+# container-folding builtins interpreted through when fed a tracked sequence
+# of PRIMITIVES (host semantics are only safe on real values — tensor-proxy
+# elements fall through to the opaque path like before)
+_FOLD_BUILTINS = {sorted, min, max, any, all, sum, list, tuple, reversed}
+
+
 def _provenance_builtin_call(ctx: "InterpreterCompileCtx", depth: int, fn, args, kwargs):
     """Provenance-preserving interpretation of the builtins most likely to
-    reach guarded state: ``getattr``, ``operator.getitem``, and bound
-    ``dict.get`` (reference interpreter.py:1324-2200 interprets *through*
-    ~60 builtins for the same reason).  An opaque host call would lose the
-    access chain — a hyperparameter read via ``cfg.get("lr")`` could never
-    become a prologue guard, so mutating it would silently replay the stale
-    program.  Returns ``(handled, value)``."""
+    reach guarded state: ``getattr``/``hasattr``, ``operator.getitem``,
+    bound ``dict.get``/``keys``/``values``/``items``, ``isinstance``, the
+    container-folding builtins (``sorted``/``min``/``max``/``any``/``all``/
+    ``sum``/``list``/``tuple``/``reversed``) and ``enumerate``/``zip``
+    (reference interpreter.py:1324-2200 interprets *through* ~60 builtins
+    for the same reason).  An opaque host call would lose the access chain —
+    a hyperparameter read via ``cfg.get("lr")`` or ``max(SCHEDULE)`` could
+    never become a prologue guard, so mutating it would silently replay the
+    stale program.  Returns ``(handled, value)``."""
+    # container-walking builtins come BEFORE the kwargs bail: a variant we
+    # don't interpret (sorted(xs, reverse=True), sum(xs, start), enumerate
+    # start=) must still RECORD the element guards, then run opaque on the
+    # raw container — the host result stays consistent because the guards
+    # pin exactly the values it computes on
+    try:
+        is_fold = fn in _FOLD_BUILTINS
+    except TypeError:  # unhashable callable
+        is_fold = False
+    if (is_fold or fn is enumerate) and args:
+        will_handle = not kwargs and (len(args) == 1 if is_fold else len(args) <= 2)
+        elems = _read_elements(ctx, args[0], primitive_only=is_fold or not will_handle)
+        if elems is None or not will_handle:
+            return False, None
+        ctx.record("lookaside", depth, f"builtins.{fn.__name__}")
+        return True, (fn(elems) if is_fold else enumerate(elems, *args[1:]))
+    if fn is zip and args:
+        will_handle = not kwargs
+        mapped, any_tracked = [], False
+        for a in args:
+            elems = _read_elements(ctx, a, primitive_only=not will_handle)
+            mapped.append(a if elems is None else elems)
+            any_tracked = any_tracked or elems is not None
+        if not any_tracked or not will_handle:
+            return False, None
+        ctx.record("lookaside", depth, "builtins.zip")
+        return True, zip(*mapped)
     if kwargs:
         return False, None
     if fn is getattr and len(args) in (2, 3) and isinstance(args[1], str):
@@ -439,6 +532,39 @@ def _provenance_builtin_call(ctx: "InterpreterCompileCtx", depth: int, fn, args,
             ctx.record("lookaside", depth, "dict.get")
             v = _tracked_read(ctx, base_rec, args[0], v, is_attr=False, container=d)
         return True, v
+    if (
+        isinstance(fn, types.BuiltinMethodType)
+        and fn.__name__ in ("keys", "values", "items")
+        and isinstance(getattr(fn, "__self__", None), dict)
+        and not args
+    ):
+        d = fn.__self__
+        keys = _read_keys(ctx, d)
+        if keys is None:
+            return False, None
+        ctx.record("lookaside", depth, f"dict.{fn.__name__}")
+        # return REAL view objects over a guarded snapshot so dict-view set
+        # algebra (cfg.keys() & {...}, a.items() - b.items()) keeps working
+        snap = dict(zip(keys, _read_dict_values(ctx, d, keys)))
+        return True, getattr(snap, fn.__name__)()
+    if fn is isinstance and len(args) == 2:
+        from thunder_tpu.core.proxies import Proxy
+
+        obj = args[0]
+        if isinstance(obj, Proxy):
+            # trace-time proxies are not the runtime values: guarding their
+            # class would fail every post-trace prologue (retrace loop)
+            return False, None
+        res = isinstance(obj, args[1])
+        base_rec = ctx.prov_of(obj)
+        if base_rec is not None and not isinstance(obj, _PRIMITIVE):
+            # the branch baked on this object's CLASS: swapping it for an
+            # instance of another class must retrace (guarded by qualified
+            # type name — repr-safe in generated prologue source)
+            ctx.record("lookaside", depth, "builtins.isinstance")
+            name = f"{type(obj).__module__}.{type(obj).__qualname__}"
+            ctx.record_read(ProvenanceRecord(PseudoInst.TYPE_NAME, inputs=(base_rec,)), name)
+        return True, res
     return False, None
 
 
@@ -1725,8 +1851,21 @@ def _get_iter(frame, ins, i):
         # iterate the leading dim (torch semantics) — static shape, so the
         # loop unrolls at trace time
         frame.push(iter([v[j] for j in range(v.shape[0])]))
-    else:
-        frame.push(iter(v))
+        return
+    # iterating TRACKED state unrolls the loop over the observed contents,
+    # so the contents must guard: per-element reads + len for sequences,
+    # the key tuple (set + order) for dicts — otherwise `for x in CFG_LIST`
+    # bakes stale elements with no retrace
+    elems = _read_elements(frame.ctx, v)
+    if elems is not None:
+        frame.push(iter(elems))
+        return
+    if isinstance(v, dict):
+        keys = _read_keys(frame.ctx, v)
+        if keys is not None:
+            frame.push(iter(keys))
+            return
+    frame.push(iter(v))
 
 
 @register_opcode_handler("FOR_ITER")

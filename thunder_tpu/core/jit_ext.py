@@ -45,8 +45,9 @@ def _guardable(v) -> bool:
     # scratch containers that traced code writes mid-call (HF's
     # out_cls_cell = [None] pattern), baking post-mutation contents.  List
     # state still guards at the right granularity — elements via the
-    # subscript chain, lengths via check_len (PseudoInst.LEN).
-    if isinstance(v, tuple) and all(isinstance(e, _GUARDABLE) for e in v):
+    # subscript chain, lengths via check_len (PseudoInst.LEN).  Nested
+    # tuples allowed (dict-key tuples inside a KEYS guard value).
+    if isinstance(v, tuple) and all(isinstance(e, _GUARDABLE) or _guardable(e) for e in v):
         return True
     # small all-primitive dicts guard as literal-likes (match-statement
     # subjects: a failed `case {"k": _}` must retrace when the dict changes)
@@ -195,7 +196,7 @@ def build_state_prologue(prologue_trace, fn: Callable, cap: StateCapture, dtype_
     # membership guard on the same step is redundant noise
     _PSEUDO_STEPS = (
         "len", "absent_item", "absent_attr", "present_item", "present_attr",
-        "absent_member", "present_member",
+        "absent_member", "present_member", "keys", "type_name",
     )
     unpack_covered: set[tuple] = set()
     for p in list(cap.guards) + list(cap.tensors):
@@ -208,6 +209,16 @@ def build_state_prologue(prologue_trace, fn: Callable, cap: StateCapture, dtype_
             # length guard: re-read the CONTAINER and check len() — the
             # container itself is not value-guarded (see _guardable)
             prims.check_len(unpack(path[:-1]), value)
+            continue
+        if path[-1][0] == "keys":
+            # dict-iteration guard: key set AND order must be unchanged
+            # (iteration unrolled over the observed keys)
+            prims.check_keys(unpack(path[:-1]), value)
+            continue
+        if path[-1][0] == "type_name":
+            # isinstance() observation: the object's class is baked into
+            # the traced branch
+            prims.check_type_name(unpack(path[:-1]), value)
             continue
         if path[-1][0] in _PSEUDO_STEPS and path[-1][0] != "len":
             # membership guard: the traced program baked a branch on

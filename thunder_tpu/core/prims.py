@@ -68,6 +68,8 @@ class PrimIDs(Enum):
     CHECK_INSTANCE = auto()
     CHECK_LEN = auto()
     CHECK_CONTAINS = auto()
+    CHECK_KEYS = auto()
+    CHECK_TYPE_NAME = auto()
     CHECK_LITERAL_LIKE = auto()
     CHECK_NONE = auto()
     # Utility
@@ -1703,6 +1705,46 @@ check_contains = make_prim(
     "check_contains",
     meta=lambda x, key, kind, expect: None,
     python_impl=_check_contains_impl,
+    tags=(OpTags.CHECK_OP, OpTags.DONT_DCE),
+)
+
+
+def _check_keys_impl(x, keys):
+    actual = tuple(x.keys())
+    if actual != keys:
+        raise RuntimeError(f"Dict keys changed: expected {keys!r}, got {actual!r}")
+    return None
+
+
+# key-SET-and-ORDER guard for traced dict iteration (for k in d / d.items()):
+# the loop unrolled over the observed keys, so any membership OR insertion-
+# order change must retrace — per-key membership checks alone would miss a
+# reorder
+check_keys = make_prim(
+    PrimIDs.CHECK_KEYS,
+    "check_keys",
+    meta=lambda x, keys: None,
+    python_impl=_check_keys_impl,
+    tags=(OpTags.CHECK_OP, OpTags.DONT_DCE),
+)
+
+
+def _check_type_name_impl(x, name):
+    actual = f"{type(x).__module__}.{type(x).__qualname__}"
+    if actual != name:
+        raise RuntimeError(f"Input class changed: expected {name}, got {actual}")
+    return None
+
+
+# class-identity guard for isinstance() observations on guarded objects: the
+# traced branch baked the isinstance result, so swapping the object for one
+# of a different class must retrace.  Compared by qualified NAME (repr-safe
+# in generated prologue source) rather than by class object
+check_type_name = make_prim(
+    PrimIDs.CHECK_TYPE_NAME,
+    "check_type_name",
+    meta=lambda x, name: None,
+    python_impl=_check_type_name_impl,
     tags=(OpTags.CHECK_OP, OpTags.DONT_DCE),
 )
 

@@ -932,6 +932,46 @@ class TestGeneralJit:
         finally:
             MODULE_TUPLE_CFG[("a", 0)] = old
 
+    def test_fold_over_dict_guards_keys(self):
+        """sorted/min over a tracked DICT walks its keys: inserting a key
+        must retrace, same as direct iteration."""
+        def f(x):
+            return x * 2.0 if sorted(MODULE_BIG_CFG)[0] == "lr" else x
+
+        x = rng.standard_normal((4,)).astype(np.float32)
+        jfn = tt.jit(f, interpretation="bytecode")
+        # keys: lr, obj → sorted[0] == 'lr'
+        np.testing.assert_allclose(np.asarray(jfn(x)), x * 2.0, rtol=1e-6)
+        try:
+            MODULE_BIG_CFG["aa"] = 1
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 1.0, rtol=1e-6)
+            assert tt.cache_misses(jfn) == 2
+        finally:
+            MODULE_BIG_CFG.pop("aa", None)
+
+    def test_dict_keys_view_does_not_guard_values(self):
+        """cfg.keys() observes only the KEY SET: on a dict that is not
+        whole-value-guardable, mutating a value must NOT retrace (spurious
+        value guards would cost a recompile per call), but a key-set change
+        must."""
+        def f(x):
+            return x * 2.0 if "lr" in MODULE_BIG_CFG.keys() else x
+
+        x = rng.standard_normal((4,)).astype(np.float32)
+        jfn = tt.jit(f, interpretation="bytecode")
+        np.testing.assert_allclose(np.asarray(jfn(x)), x * 2.0, rtol=1e-6)
+        old = MODULE_BIG_CFG["lr"]
+        try:
+            MODULE_BIG_CFG["lr"] = 99.0  # value change, key set unchanged
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 2.0, rtol=1e-6)
+            assert tt.cache_misses(jfn) == 1, "keys() must not value-guard"
+            MODULE_BIG_CFG["extra"] = 1  # key-set change → retrace
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 2.0, rtol=1e-6)
+            assert tt.cache_misses(jfn) == 2
+        finally:
+            MODULE_BIG_CFG["lr"] = old
+            MODULE_BIG_CFG.pop("extra", None)
+
     def test_isinstance_guards_class(self):
         """isinstance() on a guarded object bakes the class into the branch:
         swapping the object for another class must retrace."""

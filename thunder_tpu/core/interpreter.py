@@ -427,22 +427,34 @@ def _provenance_builtin_call(ctx: "InterpreterCompileCtx", depth: int, fn, args,
     # start=) must still RECORD the element guards, then run opaque on the
     # raw container — the host result stays consistent because the guards
     # pin exactly the values it computes on
+    def read_seq(obj, *, primitive_only: bool):
+        # the iterable view the builtins consume: elements for sequences,
+        # KEYS for dicts (iteration/folds over a dict walk its keys) — the
+        # dict case guards via check_keys, same as _get_iter
+        if isinstance(obj, dict):
+            if ctx.prov_of(obj) is None:
+                return None
+            return _read_keys(ctx, obj)
+        return _read_elements(ctx, obj, primitive_only=primitive_only)
+
     try:
         is_fold = fn in _FOLD_BUILTINS
     except TypeError:  # unhashable callable
         is_fold = False
     if (is_fold or fn is enumerate) and args:
         will_handle = not kwargs and (len(args) == 1 if is_fold else len(args) <= 2)
-        elems = _read_elements(ctx, args[0], primitive_only=is_fold or not will_handle)
+        elems = read_seq(args[0], primitive_only=is_fold or not will_handle)
         if elems is None or not will_handle:
             return False, None
+        if is_fold and not all(isinstance(e, _PRIMITIVE) for e in elems):
+            return False, None  # host folds need real values (dict keys are)
         ctx.record("lookaside", depth, f"builtins.{fn.__name__}")
         return True, (fn(elems) if is_fold else enumerate(elems, *args[1:]))
     if fn is zip and args:
         will_handle = not kwargs
         mapped, any_tracked = [], False
         for a in args:
-            elems = _read_elements(ctx, a, primitive_only=not will_handle)
+            elems = read_seq(a, primitive_only=not will_handle)
             mapped.append(a if elems is None else elems)
             any_tracked = any_tracked or elems is not None
         if not any_tracked or not will_handle:
@@ -544,7 +556,12 @@ def _provenance_builtin_call(ctx: "InterpreterCompileCtx", depth: int, fn, args,
             return False, None
         ctx.record("lookaside", depth, f"dict.{fn.__name__}")
         # return REAL view objects over a guarded snapshot so dict-view set
-        # algebra (cfg.keys() & {...}, a.items() - b.items()) keeps working
+        # algebra (cfg.keys() & {...}, a.items() - b.items()) keeps working.
+        # keys() observes only the KEY SET — reading values there would
+        # value-guard (and proxify) data the program never touched, causing
+        # spurious retraces and dead prologue unpacks
+        if fn.__name__ == "keys":
+            return True, dict.fromkeys(keys).keys()
         snap = dict(zip(keys, _read_dict_values(ctx, d, keys)))
         return True, getattr(snap, fn.__name__)()
     if fn is isinstance and len(args) == 2:

@@ -493,6 +493,18 @@ def blocks_benchmarks(on_tpu: bool, out_path: str = "BENCH_BLOCKS.json"):
     from thunder_tpu.benchmarks import all_benchmarks, run_benchmark
 
     rows = []
+    artifact = {"backend": jax.default_backend(), "rows": rows}
+    if artifact["backend"] != "tpu":
+        artifact["note"] = ("CPU smoke: validates the harness only — CPU op timings "
+                            "say nothing about TPU kernels (pallas runs in interpret "
+                            "mode); the committed TPU run overwrites this file")
+
+    def flush():
+        # written after EVERY row: a tunnel window dying (or the queue's
+        # timeout firing) mid-grid must keep the rows already measured
+        with open(out_path, "w") as f:
+            json.dump(artifact, f, indent=1)
+
     for b in all_benchmarks(on_tpu):
         try:
             r = run_benchmark(b)
@@ -502,13 +514,8 @@ def blocks_benchmarks(on_tpu: bool, out_path: str = "BENCH_BLOCKS.json"):
         except Exception as e:
             rows.append({"name": b.name, "tier": b.tier, "error": str(e)[-200:]})
             log(f"blocks {b.tier}/{b.name}: ERROR {e}")
-    artifact = {"backend": jax.default_backend(), "rows": rows}
-    if artifact["backend"] != "tpu":
-        artifact["note"] = ("CPU smoke: validates the harness only — CPU op timings "
-                            "say nothing about TPU kernels (pallas runs in interpret "
-                            "mode); the committed TPU run overwrites this file")
-    with open(out_path, "w") as f:
-        json.dump(artifact, f, indent=1)
+        flush()
+    flush()
     log(f"blocks artifact written to {out_path}")
     return rows
 

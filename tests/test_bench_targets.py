@@ -89,7 +89,11 @@ class TestHarnessTargets:
         artifact = json.loads(out.read_text())
         assert artifact["backend"] == "cpu"
         tiers = {r["tier"] for r in rows}
-        assert tiers == {"op", "block", "model"}, rows
+        assert tiers == {"op", "block", "model", "ablation"}, rows
+        # the model tier must span the zoo: every family benches loss+grad
+        model_names = {r["name"] for r in rows if r["tier"] == "model"}
+        for fam in ("llama2", "gpt2", "mistral_sw", "gemma", "falcon", "pythia", "moe"):
+            assert f"{fam}_loss" in model_names and f"{fam}_grad" in model_names, model_names
         for r in rows:
             assert "error" not in r, r
             assert r["thunder_ms"] > 0, r
@@ -149,6 +153,24 @@ class TestHarnessTargets:
         # committed real-TPU headline rides along (VERDICT r3 #1)
         assert report["last_tpu"] is not None
         assert report["last_tpu"]["value"] > 0
+
+    def test_mixtral_decode_smoke_subprocess(self):
+        """Milestone E tool (tools/mixtral_decode.py): the --smoke path runs
+        the same routing/int8-decode/depth-fit code on toy sizes, so a
+        broken tool can't sit in the TPU queue waiting to waste a window."""
+        import os
+        import subprocess
+
+        tool = Path(bench.__file__).parent / "tools" / "mixtral_decode.py"
+        proc = subprocess.run(
+            [sys.executable, str(tool), "--smoke"],
+            capture_output=True, text=True, timeout=900, env=dict(os.environ),
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert out["smoke"] is True
+        assert out["fit"]["predicted_8x7b_tokens_per_sec"] > 0
+        assert all("error" not in r for r in out["int8"])
 
     def test_default_probe_budget_fits_driver_window(self):
         """The driver kills bench.py at ~20 min; the probe budget must leave

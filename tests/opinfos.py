@@ -602,6 +602,225 @@ _set_errors("group_norm", lambda: [
 ])
 
 
+# ---- round-5 widening (VERDICT r4 #5): op-specific cases for the rest of
+# the database.  Messages below are the framework's ACTUAL raise sites
+# (probed), so a message regression fails the matrix, not just the type.
+
+def _unary_str(name):
+    """Unary/activation ops: a string input is rejected by the tensor
+    type-check with the specific 'is not number-like' proxication error —
+    tightened from the default 3-way exception union."""
+    _set_errors(name, lambda: [(("not-a-tensor",), ValueError, "not number-like")])
+
+
+for _n in (
+    "abs", "acos", "acosh", "asin", "asinh", "atan", "atanh", "ceil", "cos",
+    "cosh", "digamma", "erf", "erfc", "erfinv", "exp", "exp2", "expm1",
+    "floor", "lgamma", "log", "log10", "log1p", "log2", "neg", "reciprocal",
+    "round", "rsqrt", "sigmoid", "sign", "sin", "sinh", "sqrt", "tan",
+    "tanh", "trunc", "isfinite", "isnan", "logical_not", "square", "frac",
+    "relu", "relu6", "leaky_relu", "silu", "mish", "softplus", "elu",
+    "selu", "celu", "hardtanh", "hardswish", "hardsigmoid", "logsigmoid",
+    "tanhshrink",
+):
+    _unary_str(_n)
+
+# ops whose meta touches the input before proxication reject differently:
+# `to` converts the string (float() ValueError), nan_to_num reads .dtype
+_set_errors("type_convert", lambda: [
+    (("not-a-tensor",), ValueError, "could not convert"),
+])
+_set_errors("nan_to_num", lambda: [
+    (("not-a-tensor",), AttributeError, "no attribute 'dtype'"),
+])
+
+# gelu validates its approximate mode (torch parity: unknown mode raises)
+_set_errors("gelu", lambda: [
+    (lambda a: ltorch.gelu(a, approximate="quick"), (_t((4, 5)),), RuntimeError, "approximate"),
+    (("not-a-tensor",), ValueError, "not number-like"),
+])
+_set_errors("gelu_tanh", lambda: [
+    (lambda a: ltorch.gelu(a, approximate="quick"), (_t((4, 5)),), RuntimeError, "approximate"),
+])
+
+
+def _bcast_err(name, op=None):
+    """Binary elementwise ops: mismatched non-broadcastable shapes raise the
+    shared broadcast error."""
+    fn = op or getattr(ltorch, name)
+    _set_errors(name, lambda: [
+        (fn, (_t((4, 5)), _t((3, 7))), RuntimeError, "broadcast"),
+    ])
+
+
+for _n in (
+    "true_divide", "pow", "atan2", "fmod", "remainder", "maximum",
+    "minimum", "copysign", "eq", "ne", "ge", "gt", "le", "lt",
+    "floor_divide", "hypot", "logaddexp", "heaviside",
+):
+    _bcast_err(_n)
+_bcast_err("add_broadcast", ltorch.add)
+_bcast_err("add_alpha", ltorch.add)
+_set_errors("logical_and", lambda: [
+    ((_t((4, 5), np.bool_), _t((3, 7), np.bool_)), RuntimeError, "broadcast"),
+])
+_set_errors("logical_or", lambda: [
+    ((_t((4, 5), np.bool_), _t((3, 7), np.bool_)), RuntimeError, "broadcast"),
+])
+_set_errors("lerp", lambda: [((_t((4, 5)), _t((3, 5)), _t((4, 5))), RuntimeError, "broadcast")])
+_set_errors("mse_loss", lambda: [((_t((4, 5)), _t((3, 5))), RuntimeError, "broadcast")])
+_set_errors("l1_loss", lambda: [((_t((4, 5)), _t((3, 5))), RuntimeError, "broadcast")])
+_set_errors("smooth_l1_loss", lambda: [((_t((4, 5)), _t((3, 5))), RuntimeError, "broadcast")])
+_set_errors("masked_fill", lambda: [
+    (lambda a, m: ltorch.masked_fill(a, m, 3.0), (_t((4, 5)), _t((3, 7), np.bool_)),
+     RuntimeError, "broadcast"),
+])
+_set_errors("clamp", lambda: [
+    (lambda a: ltorch.clamp(a, None, None), (_t((4, 5)),), RuntimeError, "clamp"),
+])
+_set_errors("addcmul", lambda: [((_t((4, 5)), _t((3, 7)), _t((4, 5))), RuntimeError, "broadcast")])
+_set_errors("addcdiv", lambda: [((_t((4, 5)), _t((3, 7)), _t((4, 5))), RuntimeError, "broadcast")])
+_set_errors("cosine_similarity", lambda: [
+    (lambda a, b: ltorch.cosine_similarity(a, b, dim=3), (_t((4, 5)), _t((4, 5))),
+     IndexError, "out of range"),
+])
+
+
+def _dim_oob(name, fn):
+    """Dim-taking ops: an out-of-range dim raises IndexError with the
+    canonicalizer's message."""
+    _set_errors(name, lambda: [(fn, (_t((4, 5)),), IndexError, "out of range")])
+
+
+_dim_oob("squeeze", lambda a: ltorch.squeeze(a, 5))
+_dim_oob("unsqueeze", lambda a: ltorch.unsqueeze(a, 7))
+_dim_oob("sum_keepdim", lambda a: ltorch.sum(a, 3, True))
+_dim_oob("sum", lambda a: ltorch.sum(a, 3))
+_dim_oob("prod", lambda a: ltorch.prod(a, 3))
+_dim_oob("amin", lambda a: ltorch.amin(a, 3))
+_dim_oob("max_dim", lambda a: ltorch.max(a, 3))
+_dim_oob("min_dim", lambda a: ltorch.min(a, 3))
+_dim_oob("var", lambda a: ltorch.var(a, 3))
+_dim_oob("std", lambda a: ltorch.std(a, 3))
+_dim_oob("var_mean", lambda a: ltorch.var_mean(a, 3))
+_dim_oob("argmax", lambda a: ltorch.argmax(a, 3))
+_dim_oob("argmin", lambda a: ltorch.argmin(a, 3))
+_dim_oob("sort", lambda a: ltorch.sort(a, 3))
+_dim_oob("argsort", lambda a: ltorch.argsort(a, 3))
+_dim_oob("any", lambda a: ltorch.any_(a, 3))
+_dim_oob("all", lambda a: ltorch.all_(a, 3))
+_dim_oob("logsumexp", lambda a: ltorch.logsumexp(a, 3))
+_dim_oob("normalize", lambda a: ltorch.normalize(a, dim=4))
+_dim_oob("cumprod", lambda a: ltorch.cumprod(a, 3))
+_dim_oob("norm_1_dim", lambda a: ltorch.norm(a, 1, 3))
+_dim_oob("norm_inf", lambda a: ltorch.norm(a, float("inf"), 3))
+_dim_oob("norm_2", lambda a: ltorch.norm(a, 2, 3))
+_dim_oob("roll", lambda a: ltorch.roll(a, 2, 4))
+_dim_oob("flip", lambda a: ltorch.flip(a, (3,)))
+_dim_oob("movedim", lambda a: ltorch.movedim(a, 0, 5))
+_dim_oob("take_along_dim", lambda a: ltorch.take_along_dim(
+    a, _t((4, 3), np.int32, high=5), 5))
+_dim_oob("repeat_interleave", lambda a: ltorch.repeat_interleave(a, 3, 4))
+_dim_oob("getitem_basic", lambda a: a[:, :, :, 0])
+_dim_oob("getitem_neg_stride_none", lambda a: a[:, :, :, 0])
+
+# shape-math violations with op-specific messages (probed raise sites)
+_set_errors("flatten", lambda: [
+    (lambda a: ltorch.flatten(a, 2, 1), (_t((2, 3, 4)),), RuntimeError, "start_dim > end_dim"),
+])
+_set_errors("narrow", lambda: [
+    (lambda a: ltorch.narrow(a, 1, 4, 5), (_t((3, 6)),), RuntimeError, "bad indices"),
+])
+_set_errors("unfold", lambda: [
+    (lambda a: ltorch.unfold(a, 1, 9, 1), (_t((3, 6)),), RuntimeError, "size 9 > dim size 6"),
+])
+_set_errors("tile", lambda: [
+    (lambda a: ltorch.tile(a, (2, -1)), (_t((3, 4)),), RuntimeError, "invalid length"),
+])
+_set_errors("broadcast_to", lambda: [
+    (lambda a: ltorch.broadcast_to(a, (4, 5)), (_t((3, 2)),), RuntimeError, "cannot broadcast"),
+])
+_set_errors("split", lambda: [
+    (lambda a: ltorch.split(a, 0, 1), (_t((3, 6)),), (RuntimeError, ValueError, ZeroDivisionError), ""),
+])
+_set_errors("chunk", lambda: [
+    (lambda a: ltorch.chunk(a, 0, 1), (_t((3, 6)),), RuntimeError, "chunks > 0"),
+])
+_set_errors("tril", lambda: [((_t((5,)),), RuntimeError, "at least 2 dims")])
+_set_errors("triu", lambda: [((_t((5,)),), RuntimeError, "at least 2 dims")])
+_set_errors("pad", lambda: [
+    (lambda a: ltorch.nn_pad(a, (1, 2, 3)), (_t((3, 4)),), RuntimeError, "pairs"),
+])
+_set_errors("one_hot", lambda: [
+    (lambda i: ltorch.one_hot(i, -2), (_t((4, 3), np.int32, high=5),), RuntimeError, "invalid length"),
+])
+_set_errors("index_add", lambda: [
+    (lambda a, s: ltorch.index_add(a, 1, np.array([0, 2], np.int32), s),
+     (_t((4, 6)), _t((4, 3))), (ValueError, RuntimeError), ""),
+])
+
+# matmul-family shape violations (the matmul checker's own message)
+_set_errors("matmul_batched", lambda: [
+    ((_t((2, 4, 5)), _t((2, 6, 7))), RuntimeError, "matmul"),
+])
+_set_errors("mv", lambda: [((_t((4, 5)), _t((6,))), RuntimeError, "matmul")])
+_set_errors("dot", lambda: [
+    ((_t((3, 4)), _t((3, 4))), RuntimeError, "expected 1D"),
+    ((_t((5,)), _t((6,))), RuntimeError, "broadcast"),
+])
+_set_errors("outer", lambda: [((_t((3, 4)), _t((5,))), RuntimeError, "")])
+_set_errors("addmm", lambda: [
+    (lambda c, a, b: ltorch.addmm(c, a, b), (_t((4, 6)), _t((4, 5)), _t((7, 6))),
+     RuntimeError, "matmul"),
+])
+_set_errors("baddbmm", lambda: [
+    (lambda c, a, b: ltorch.baddbmm(c, a, b), (_t((2, 3, 5)), _t((2, 3, 4)), _t((2, 5, 5))),
+     RuntimeError, "matmul"),
+])
+_set_errors("einsum_ij_jk", lambda: [
+    (lambda a, b: ltorch.einsum("ij,jk->ix", a, b), (_t((4, 5)), _t((5, 6))),
+     ValueError, "did not appear"),
+    (lambda a, b: ltorch.einsum("ij,jk->ik", a, b), (_t((4, 5)), _t((6, 7))),
+     ValueError, "does not match"),
+])
+_set_errors("einsum_attention", lambda: [
+    (lambda q, k: ltorch.einsum("bhqd,bhkd->bhqk", q, k),
+     (_t((2, 2, 3, 4)), _t((2, 2, 5, 8))), ValueError, "does not match"),
+])
+
+# NN-op shape/mode violations
+_set_errors("rms_norm", lambda: [
+    (lambda a, w: ltorch.rms_norm(a, (5,), w), (_t((4, 5)), _t((7,))),
+     RuntimeError, "broadcast"),
+])
+_set_errors("batch_norm_eval", lambda: [
+    (lambda a, m, v: ltorch.batch_norm(a, m, v, None, None, training=False),
+     (_t((3, 4, 5)), _t((6,)), _t((6,), positive=True)), RuntimeError, "reshape"),
+])
+_set_errors("conv1d", lambda: [
+    ((_t((2, 3, 10)), _t((4, 5, 3))), (ValueError, RuntimeError), ""),
+])
+_set_errors("max_pool2d", lambda: [
+    (lambda a: ltorch.max_pool2d(a, 8), (_t((2, 3, 4, 4)),), RuntimeError, "larger than"),
+])
+_set_errors("avg_pool2d", lambda: [
+    (lambda a: ltorch.avg_pool2d(a, 8), (_t((2, 3, 4, 4)),), RuntimeError, "larger than"),
+])
+_set_errors("interpolate_nearest", lambda: [
+    (lambda a: ltorch.interpolate(a, scale_factor=2.0, mode="cubic"),
+     (_t((2, 3, 4, 4)),), RuntimeError, "unknown mode"),
+])
+_set_errors("nll_loss", lambda: [
+    ((_t((6, 9)), _t((4,), np.int32, high=9)), (ValueError, RuntimeError, AttributeError), ""),
+])
+_set_errors("sdpa_causal", lambda: [
+    (lambda q, k, v: ltorch.scaled_dot_product_attention(q, k, v, is_causal=True),
+     (_t((2, 2, 4, 8)), _t((2, 2, 4, 16)), _t((2, 2, 4, 16))), RuntimeError, "head dims"),
+])
+_set_errors("clamp_min", lambda: [(("not-a-tensor",), ValueError, "not number-like")])
+_set_errors("clamp_max", lambda: [(("not-a-tensor",), ValueError, "not number-like")])
+
+
 #
 # Integer-dtype forward coverage (exact comparison): ops whose int32 result
 # is well-defined and matched by torch (reference opinfos carry int dtype

@@ -190,12 +190,14 @@ def where(pred, a, b):
 
 @torchsymbol(_tfn("clamp"), is_method=True)
 def clamp(a, min=None, max=None):
+    check(min is not None or max is not None,
+          lambda: "clamp: at least one of min or max must not be None")
     return clang.clamp(a, min, max)
 
 
 @torchsymbol(_tfn("clip"))
 def clip(a, min=None, max=None):
-    return clang.clamp(a, min, max)
+    return clamp(a, min, max)
 
 
 @torchsymbol(_tfn("masked_fill"), is_method=True)
@@ -420,6 +422,8 @@ def split(a, split_size_or_sections, dim=0):
 
 @torchsymbol(_tfn("chunk"), is_method=True)
 def chunk(a, chunks, dim=0):
+    check(isinstance(chunks, (int, NumberProxy)) and chunks > 0,
+          lambda: f"chunk expects chunks > 0, got {chunks}")
     return clang.chunk(a, chunks, dim)
 
 
@@ -904,6 +908,8 @@ def mish(a, inplace=False):
 
 @torchsymbol(_tfn("nn", "functional", "gelu"))
 def gelu(a, approximate: str = "none"):
+    check(approximate in ("none", "tanh"),
+          lambda: f"gelu: approximate must be 'none' or 'tanh', got {approximate!r}")
     if approximate == "tanh":
         inner = clang.mul(
             math.sqrt(2.0 / math.pi), clang.add(a, clang.mul(0.044715, clang.mul(a, clang.mul(a, a))))

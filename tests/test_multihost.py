@@ -87,9 +87,17 @@ def test_two_process_dp_train_step(tmp_path):
         os.environ,
         THUNDER_TPU_COORD=addr,
         THUNDER_TPU_REPO=str(Path(__file__).resolve().parent.parent),
+        # Gloo (the CPU cross-process collective transport) picks its
+        # interface from the hostname, which may resolve to an unreachable
+        # address in sandboxes — both processes are on this machine, so pin
+        # loopback explicitly
+        GLOO_SOCKET_IFNAME="lo",
     )
-    # the conftest-forced single-process device count must not leak in
-    env.pop("XLA_FLAGS", None)
+    # the conftest-forced single-process device count must not leak in;
+    # proxy vars can hijack the loopback coordinator connection
+    for var in ("XLA_FLAGS", "http_proxy", "https_proxy", "HTTP_PROXY",
+                "HTTPS_PROXY", "all_proxy", "ALL_PROXY"):
+        env.pop(var, None)
     procs = [
         subprocess.Popen(
             [sys.executable, str(script), str(i)],

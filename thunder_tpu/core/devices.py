@@ -196,27 +196,40 @@ def from_jax_device(jd) -> Device:
 
 
 def to_jax_device(d: Device | str):
-    """Device → concrete jax.Device."""
+    """Device → concrete jax.Device.  The index is a jax device ID: matched
+    by ``.id`` first (multi-controller processes see global ids like
+    cpu:2048 that are NOT list positions), with a positional fallback for
+    user-written specs like "cpu:1" in single-process runs."""
     import jax
 
     d = to_device(d)
     if d.devicetype == DeviceType.CPU:
-        return jax.devices("cpu")[d.index]
-    devs = jax.devices()
-    accel = [x for x in devs if x.platform != "cpu"]
-    pool = accel if accel else devs
+        pool = jax.devices("cpu")
+    else:
+        devs = jax.devices()
+        accel = [x for x in devs if x.platform != "cpu"]
+        pool = accel if accel else devs
+    for x in pool:
+        if x.id == d.index:
+            return x
     check(d.index < len(pool), lambda: f"Device index {d.index} out of range ({len(pool)} devices)")
     return pool[d.index]
 
 
 def default_device() -> Device:
-    """The first accelerator if present, else cpu."""
+    """The first LOCAL accelerator if present, else the first local cpu.
+
+    Local, not global: in multi-controller runs a process's arrays live on
+    its own devices, whose global ids are nonzero on processes > 0 —
+    defaulting factory ops to device id 0 there makes every trace fail the
+    same-device check against concrete inputs."""
     import jax
 
-    for jd in jax.devices():
+    local = jax.local_devices()
+    for jd in local:
         if jd.platform != "cpu":
             return from_jax_device(jd)
-    return cpu
+    return from_jax_device(local[0]) if local else cpu
 
 
 def available_device_types() -> tuple[DeviceType, ...]:

@@ -172,6 +172,39 @@ class TestHarnessTargets:
         assert out["fit"]["predicted_8x7b_tokens_per_sec"] > 0
         assert all("error" not in r for r in out["int8"])
 
+    def test_kernel_tune_smoke_subprocess(self):
+        """tools/kernel_tune.py --smoke: the CE geometry sweep + decision
+        format at toy dims on CPU, WITHOUT touching the committed tuning
+        file — a tool that crashes would waste a scarce TPU window."""
+        import os
+        import subprocess
+
+        tool = Path(bench.__file__).parent / "tools" / "kernel_tune.py"
+        tuning = Path(bench.__file__).parent / "thunder_tpu" / "executors" / "pallas_tuning.json"
+        before = tuning.read_bytes() if tuning.exists() else None
+        proc = subprocess.run(
+            [sys.executable, str(tool), "--smoke"],
+            capture_output=True, text=True, timeout=900, env=dict(os.environ),
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert out["smoke"] is True and out["ce_rows"] >= 1
+        after = tuning.read_bytes() if tuning.exists() else None
+        assert after == before, "smoke must not write/alter the tuning file"
+
+    def test_all_queue_tools_compile(self):
+        """Every tool the TPU queue can invoke must at least byte-compile:
+        the TPU-only ones (depth_curve, flash_tune, ...) probe the tunnel at
+        import/main and cannot EXECUTE in CI, but a syntax error must not
+        lurk until a window opens."""
+        import py_compile
+
+        tools_dir = Path(bench.__file__).parent / "tools"
+        tools = sorted(tools_dir.glob("*.py"))
+        assert len(tools) >= 6, tools
+        for t in tools:
+            py_compile.compile(str(t), doraise=True)
+
     def test_default_probe_budget_fits_driver_window(self):
         """The driver kills bench.py at ~20 min; the probe budget must leave
         room for the CPU-fallback run (round 3's 2400 s default produced a

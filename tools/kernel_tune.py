@@ -17,6 +17,17 @@ import tempfile
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+SMOKE = "--smoke" in sys.argv
+
+if SMOKE:
+    # interpret mode makes _pallas_available() true on CPU so the sweep
+    # times the REAL Pallas CE kernel (interpreted), not the XLA fallback —
+    # otherwise a broken kernel would still pass the smoke
+    os.environ["THUNDER_TPU_PALLAS_INTERPRET"] = "1"
+    from thunder_tpu._platform import force_cpu
+
+    force_cpu()
+
 import jax
 import jax.numpy as jnp
 
@@ -119,6 +130,20 @@ def tune_embedding_bwd(N: int = 4096, V: int = 32000, C: int = 4096) -> dict:
 
 
 def main():
+    if SMOKE:
+        # CI plumbing check at toy dims on CPU (pallas interpret mode):
+        # exercises the geometry sweep + decision format WITHOUT touching
+        # the committed tuning file — a tool that crashes here would
+        # otherwise sit in the TPU queue waiting to waste a window
+        decision = tune_ce(N=256, V=512, dtype=jnp.float32)
+        decision["embedding_bwd"] = tune_embedding_bwd(N=64, V=128, C=32)
+        assert decision["ce"]["measured"]["rows"], "no CE geometries measured"
+        eb = decision["embedding_bwd"]
+        assert eb["scatter_ms"] > 0 and eb["onehot_ms"] > 0, eb
+        assert eb["scatter_ms"] == eb["scatter_ms"] and eb["onehot_ms"] == eb["onehot_ms"], eb
+        print(json.dumps({"smoke": True, "ce_rows": len(decision["ce"]["measured"]["rows"]),
+                          "embedding_bwd": decision["embedding_bwd"]}))
+        return 0
     if jax.default_backend() != "tpu":
         print(json.dumps({"error": "kernel tuning needs the TPU"}))
         return 1

@@ -588,6 +588,46 @@ def snip_unpack_in_calls(x):
     return [g(*args), g(*args, **kw), g(0, *args[:1], m=1)]
 
 
+_WALK_GLOBAL_LIST = [3.0, 1.0, 2.0]
+_WALK_GLOBAL_DICT = {"b": 2, "a": 1, ("t", 0): 3}
+_WALK_GLOBAL_OBJ = type("_W", (), {"x": 5})()
+
+
+def snip_container_walk_builtins(x):
+    # the round-5 provenance lookasides must preserve exact host semantics
+    # on TRACKED state: ordering, laziness-visible shapes, view set-algebra
+    lst = _WALK_GLOBAL_LIST
+    d = _WALK_GLOBAL_DICT
+    out = [
+        sorted(lst), sorted(lst, reverse=True), min(lst), max(lst), sum(lst),
+        list(reversed(lst)), tuple(lst), any(v > 2 for v in lst), all(lst),
+        list(enumerate(lst, 10)), list(zip(lst, "abc", strict=False)),
+        sorted(d, key=str), list(d.keys()), list(d.values()),
+        sorted(d.items(), key=str), d.keys() & {"a", "zz"},
+        ("a" in d, "zz" in d, 1.0 in lst, 9 in lst, ("t", 0) in d),
+        isinstance(_WALK_GLOBAL_OBJ, object), hasattr(_WALK_GLOBAL_OBJ, "x"),
+        hasattr(_WALK_GLOBAL_OBJ, "y"), getattr(_WALK_GLOBAL_OBJ, "y", x),
+    ]
+    for i, v in enumerate(lst):
+        out.append((i, v * x))
+    for k in d:
+        out.append(k)
+    return out
+
+
+def snip_walk_eafp(x):
+    d = _WALK_GLOBAL_DICT
+    try:
+        v = d["missing"]
+    except KeyError:
+        v = x
+    try:
+        w = _WALK_GLOBAL_OBJ.missing
+    except AttributeError:
+        w = x + 1
+    return (v, w, d.get("missing", -1), d.get("a"))
+
+
 ALL_SNIPPETS = [v for k, v in sorted(globals().items()) if k.startswith("snip_")]
 
 

@@ -139,8 +139,7 @@ def main():
         decision["embedding_bwd"] = tune_embedding_bwd(N=64, V=128, C=32)
         assert decision["ce"]["measured"]["rows"], "no CE geometries measured"
         eb = decision["embedding_bwd"]
-        assert eb["scatter_ms"] > 0 and eb["onehot_ms"] > 0, eb
-        assert eb["scatter_ms"] == eb["scatter_ms"] and eb["onehot_ms"] == eb["onehot_ms"], eb
+        assert eb["scatter_ms"] > 0 and eb["onehot_ms"] > 0, eb  # nan > 0 is False
         print(json.dumps({"smoke": True, "ce_rows": len(decision["ce"]["measured"]["rows"]),
                           "embedding_bwd": decision["embedding_bwd"]}))
         return 0

@@ -241,10 +241,11 @@ def _resolve_backend() -> str:
 # params + 12·L·T·d_attn for attention scores/values) against peak chip FLOPs
 #
 
-_PEAK_BF16_FLOPS = {
-    "tpu": 197e12,  # v5e chip, bf16
-    "cpu": 1e12,    # nominal; CPU smoke MFU is meaningless but well-defined
-}
+# single source of truth for hardware peaks: thunder_tpu.examine.HW_PEAKS
+# (v5e bf16 MXU + HBM stream; cpu nominal so smoke MFU stays well-defined)
+from thunder_tpu.examine import HW_PEAKS as _HW_PEAKS
+
+_PEAK_BF16_FLOPS = {k: v[0] for k, v in _HW_PEAKS.items()}
 
 
 def model_flops_per_token(cfg: llama.Config, T: int) -> float:

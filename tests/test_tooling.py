@@ -15,6 +15,43 @@ rng = np.random.default_rng(21)
 
 
 class TestExamine:
+    def test_cost_analysis_plain_fn(self):
+        """XLA cost-model introspection: FLOPs/bytes from the compiled
+        program, roofline estimate at explicit peaks."""
+        from thunder_tpu.examine import cost_analysis
+
+        def f(a, b):
+            return (a @ b).sum()
+
+        a = np.ones((64, 64), np.float32)
+        out = cost_analysis(f, a, a)
+        # 64^3 MACs = 2*64^3 - boundary flops; XLA reports ~2*64^3
+        assert out["flops"] >= 2 * 64**3 * 0.9
+        assert out["bytes_accessed"] >= 2 * 64 * 64 * 4
+        assert out["arithmetic_intensity"] > 1
+        out2 = cost_analysis(f, a, a, flops_per_sec=1e12, bytes_per_sec=1e9)
+        assert out2["roofline_seconds"] == max(out2["compute_seconds"], out2["memory_seconds"])
+        assert out2["bound"] in ("compute", "memory")
+
+    def test_cost_analysis_thunder_trace(self):
+        """The documented thunder path: analyze the execution trace's
+        python_callable."""
+        import numpy as np
+
+        import thunder_tpu as tt
+        import thunder_tpu.torch as ltorch
+        from thunder_tpu.examine import cost_analysis
+
+        def f(a, b):
+            return ltorch.sum(ltorch.matmul(a, b))
+
+        a = np.ones((32, 32), np.float32)
+        jfn = tt.jit(f)
+        jfn(a, a)
+        trace = tt.last_traces(jfn)[-1]
+        out = cost_analysis(trace.python_callable(), a, a)
+        assert out["flops"] >= 2 * 32**3 * 0.9, out
+
     def test_supported_function(self, capsys):
         from thunder_tpu.examine import examine
 

@@ -11,7 +11,7 @@ from __future__ import annotations
 import collections
 from typing import Any, Callable
 
-__all__ = ["examine", "get_fusions", "get_fusion_symbols", "memory_estimate"]
+__all__ = ["examine", "get_fusions", "get_fusion_symbols", "memory_estimate", "cost_analysis"]
 
 
 def _collect_torch_functions(fn, args, kwargs):
@@ -168,3 +168,58 @@ def memory_estimate(trace) -> dict[str, int]:
                 cur += b
         peak = max(peak, cur)
     return {"input_bytes": inputs, "output_bytes": outputs, "peak_bytes_estimate": peak}
+
+
+# hardware peaks (bf16 FLOP/s, HBM bytes/s) keyed by jax backend — the ONE
+# source of truth for roofline/MFU math (bench.py imports this).  TPU row is
+# the v5e chip; the cpu row is nominal so smoke MFU stays well-defined.
+HW_PEAKS: dict[str, tuple[float, float]] = {
+    "tpu": (197e12, 819e9),
+    "cpu": (1e12, 100e9),
+}
+
+
+def cost_analysis(fn: Callable, *args, flops_per_sec: float | None = None,
+                  bytes_per_sec: float | None = None) -> dict:
+    """XLA's OWN cost model for ``fn`` at ``args``: FLOPs, HBM bytes
+    accessed, arithmetic intensity, and a roofline step-time estimate at the
+    hardware peaks (defaulted per backend; v5e for TPU).
+
+    ``fn`` must be jax-traceable at ``args`` — a plain jax/numpy callable,
+    or a thunder execution trace's ``python_callable()``
+    (``tt.last_traces(jfn)[-1].python_callable()``).  This is the
+    introspection behind the depth-fit extrapolations: the cost model sees
+    the exact compiled program, not an analytic FLOPs formula.
+
+    Roofline keys (``roofline_seconds``/``compute_seconds``/
+    ``memory_seconds``/``bound``) are present whenever both peaks resolve —
+    explicitly passed, or defaulted from ``HW_PEAKS`` for the backend.
+    """
+    import jax
+
+    compiled = jax.jit(fn).lower(*args).compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, list):  # older jax returns one entry per device program
+        ca = ca[0] if ca else {}
+    flops = float(ca.get("flops", 0.0))
+    bytes_accessed = float(ca.get("bytes accessed", 0.0))
+    out = {
+        "flops": flops,
+        "bytes_accessed": bytes_accessed,
+        "arithmetic_intensity": (flops / bytes_accessed) if bytes_accessed else None,
+    }
+    peak = HW_PEAKS.get(jax.default_backend())
+    if flops_per_sec is None and peak is not None:
+        flops_per_sec = peak[0]
+    if bytes_per_sec is None and peak is not None:
+        bytes_per_sec = peak[1]
+    if flops_per_sec is not None and bytes_per_sec is not None:
+        t_compute = flops / flops_per_sec
+        t_memory = bytes_accessed / bytes_per_sec
+        out.update(
+            roofline_seconds=max(t_compute, t_memory),
+            compute_seconds=t_compute,
+            memory_seconds=t_memory,
+            bound="compute" if t_compute >= t_memory else "memory",
+        )
+    return out

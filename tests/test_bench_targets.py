@@ -172,6 +172,22 @@ class TestHarnessTargets:
         assert out["fit"]["predicted_8x7b_tokens_per_sec"] > 0
         assert all("error" not in r for r in out["int8"])
 
+    def test_cost_mode_subprocess(self):
+        """`bench.py cost`: the analytic roofline companion must emit one
+        JSON line with a finite compute-bound tokens/s at headline shapes
+        (shape-only lowering — runs in seconds on CPU)."""
+        import os
+        import subprocess
+
+        proc = subprocess.run(
+            [sys.executable, str(Path(bench.__file__)), "cost"],
+            capture_output=True, text=True, timeout=600, env=dict(os.environ),
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert out["metric"] == "compute_roofline_tokens_per_sec"
+        assert out["value"] > 0 and out["fwd_bwd"]["flops"] > out["fwd"]["flops"] > 0
+
     def test_kernel_tune_smoke_subprocess(self):
         """tools/kernel_tune.py --smoke: the CE geometry sweep + decision
         format at toy dims on CPU, WITHOUT touching the committed tuning

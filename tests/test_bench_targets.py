@@ -208,6 +208,22 @@ class TestHarnessTargets:
         after = tuning.read_bytes() if tuning.exists() else None
         assert after == before, "smoke must not write/alter the tuning file"
 
+    def test_xla_flags_sweep_smoke_subprocess(self):
+        """tools/xla_flags_sweep.py --smoke: one config through the
+        CPU-fallback bench subprocess, asserting the stdout-parse contract
+        the TPU sweep relies on."""
+        import os
+        import subprocess
+
+        tool = Path(bench.__file__).parent / "tools" / "xla_flags_sweep.py"
+        proc = subprocess.run(
+            [sys.executable, str(tool), "--smoke"],
+            capture_output=True, text=True, timeout=900, env=dict(os.environ),
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert out["smoke"] is True and out["rows"][0]["tokens_per_sec"] > 0
+
     def test_all_queue_tools_compile(self):
         """Every tool the TPU queue can invoke must at least byte-compile:
         the TPU-only ones (depth_curve, flash_tune, ...) probe the tunnel at

@@ -55,8 +55,9 @@ echo "$(date -u +%T) blocks rc=$?" >> "$LOG/queue.log"
 # only burn tunnel-window time re-generating the same CPU artifact)
 
 # 7. optional experiment tools, if the window is still alive
-# (mixtral_decode = milestone E headline: Mixtral-8x7B-arch int8 decode)
-for t in mixtral_decode flash_tune config_sweep quant_headline; do
+# (mixtral_decode = milestone E headline; xla_flags_sweep LAST — it reruns
+# the full headline per flag set, ~15 min/config)
+for t in mixtral_decode flash_tune config_sweep quant_headline xla_flags_sweep; do
   if [ -f "tools/$t.py" ]; then
     timeout 2400 python "tools/$t.py" > "$LOG/$t.log" 2>&1
     echo "$(date -u +%T) $t rc=$?" >> "$LOG/queue.log"

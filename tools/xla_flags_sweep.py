@@ -1,0 +1,103 @@
+"""XLA flag A/B on the measured headline (single-chip perf levers).
+
+XLA flags bind at backend init, so each configuration runs ``bench.py`` in
+a FRESH subprocess with ``XLA_FLAGS`` set; the parsed headline tokens/s per
+flag set lands in ``tpu_experiments/xla_flags.json``.  The default config
+always runs first — if a flagged run beats it by >1%, the winning flags are
+a committable headline improvement (wired via env, not code).
+
+Swept: ``xla_tpu_scoped_vmem_limit_kib`` — the VMEM budget XLA gives fused
+regions; larger budgets let matmul fusions keep wider operands resident
+(known lever for MXU-bound programs), at the risk of spilling.
+
+``--smoke`` validates the subprocess plumbing + parsing with one config on
+the CPU-fallback bench path (no TPU needed).
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SMOKE = "--smoke" in sys.argv
+
+CONFIGS: list[tuple[str, str]] = [
+    ("default", ""),
+    ("vmem32m", "--xla_tpu_scoped_vmem_limit_kib=32768"),
+    ("vmem64m", "--xla_tpu_scoped_vmem_limit_kib=65536"),
+    ("vmem96m", "--xla_tpu_scoped_vmem_limit_kib=98304"),
+]
+
+
+def run_one(name: str, flags: str, *, budget_s: int) -> dict:
+    env = dict(os.environ, THUNDER_TPU_BENCH_MAX_WAIT_S=str(min(budget_s, 120)))
+    if SMOKE:
+        env["THUNDER_TPU_BENCH_EXERCISE_TPU_PATH"] = "1"
+        env["THUNDER_TPU_BENCH_MAX_WAIT_S"] = "1"
+    if flags:
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + flags).strip()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "bench.py")],
+            capture_output=True, text=True, timeout=budget_s, env=env, cwd=ROOT,
+        )
+    except subprocess.TimeoutExpired:
+        # one hung config (tunnel flap) must not lose the earlier rows
+        return {"name": name, "flags": flags, "error": f"timeout after {budget_s}s"}
+    if proc.returncode != 0:
+        return {"name": name, "flags": flags, "error": proc.stderr[-300:]}
+    try:
+        report = json.loads(proc.stdout.strip().splitlines()[-1])
+    except Exception as e:
+        return {"name": name, "flags": flags, "error": f"unparseable stdout: {e}"}
+    # carry metric/backend: a tunnel flap mid-sweep makes bench fall back to
+    # the CPU smoke number, which must never be compared against TPU rows
+    return {"name": name, "flags": flags,
+            "tokens_per_sec": report.get("value"), "unit": report.get("unit"),
+            "metric": report.get("metric"), "backend": report.get("backend"),
+            "mfu_pct": report.get("mfu_pct")}
+
+
+def _summarize(rows: list[dict]) -> dict:
+    out = {"rows": rows, "smoke": SMOKE}
+    ok = [r for r in rows if r.get("tokens_per_sec")]
+    if not SMOKE:
+        # only same-backend TPU rows are comparable
+        ok = [r for r in ok if "cpu_smoke" not in (r.get("metric") or "")]
+    if ok:
+        base = next((r for r in ok if r["name"] == "default"), ok[0])
+        best = max(ok, key=lambda r: r["tokens_per_sec"])
+        out["best"] = best["name"]
+        if base["tokens_per_sec"]:
+            out["best_vs_default"] = round(best["tokens_per_sec"] / base["tokens_per_sec"], 4)
+    return out
+
+
+def main() -> int:
+    # 4 configs × 510 s + overhead fits the queue's per-tool `timeout 2400`;
+    # the artifact is rewritten after EVERY config so a killed sweep keeps
+    # the rows already measured
+    budget = 240 if SMOKE else 510
+    configs = CONFIGS[:1] if SMOKE else CONFIGS
+    art = os.path.join(ROOT, "tpu_experiments", "xla_flags.json")
+    rows: list[dict] = []
+    for name, flags in configs:
+        row = run_one(name, flags, budget_s=budget)
+        rows.append(row)
+        print(f"{name}: {row}", file=sys.stderr, flush=True)
+        if not SMOKE:
+            os.makedirs(os.path.dirname(art), exist_ok=True)
+            with open(art, "w") as f:
+                json.dump(_summarize(rows), f, indent=1)
+
+    out = _summarize(rows)
+    if SMOKE:
+        assert [r for r in rows if r.get("tokens_per_sec")], rows
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -5,6 +5,12 @@
 # concurrent processes wedge the tunnel (measured, round 3) — so the whole
 # probe+run loop holds an exclusive flock: a second watcher instance exits
 # immediately instead of racing the first to the tunnel window.
+#
+# Multi-window (round 5): the watcher does NOT exit after one window.  A
+# queue run that was cut short by the tunnel dying (rc=2 from the probe
+# guard in tpu_run_queue.sh) re-arms immediately; a COMPLETE run (rc=0)
+# sleeps 2 h first so a stable tunnel doesn't burn chips re-measuring the
+# same artifacts back to back.
 cd /root/repo
 LOG=tpu_experiments
 mkdir -p "$LOG"
@@ -16,9 +22,20 @@ fi
 for i in $(seq 1 700); do
   out=$(timeout 180 python -c "import jax; print('UP', jax.default_backend())" 2>&1 | grep '^UP tpu')
   if [ -n "$out" ]; then
-    echo "$(date -u +%T) TPU up (attempt $i)" >> "$LOG/queue.log"
+    echo "$(date -u +%T) TPU up (attempt $i) — running queue" >> "$LOG/queue.log"
     bash tools/tpu_run_queue.sh
-    exit 0
+    rc=$?
+    echo "$(date -u +%T) run_queue rc=$rc" >> "$LOG/queue.log"
+    if [ $rc -eq 0 ]; then
+      echo "$(date -u +%T) complete run; cooling down 2h before re-arming" >> "$LOG/queue.log"
+      sleep 7200
+    elif [ $rc -ne 3 ]; then
+      # not the guard's tunnel-died code: the script itself failed (e.g. a
+      # live edit left a parse error) — back off instead of spinning
+      echo "$(date -u +%T) unexpected rc; backing off 10min" >> "$LOG/queue.log"
+      sleep 600
+    fi
+    continue
   fi
   echo "$(date -u +%T) attempt=$i tunnel down" >> "$LOG/queue.log"
   sleep 60

@@ -1,12 +1,33 @@
 #!/bin/bash
-# Round-5 TPU experiment list, run ONCE per tunnel window by tpu_queue.sh.
+# Round-5 TPU experiment list, run once per tunnel window by tpu_queue.sh.
 # Kept separate from the watcher loop so it can be edited while the watcher
 # sleeps — the watcher re-reads this file at the moment the tunnel comes up.
 # Order: driver-critical artifacts FIRST (a brief window must refresh the
 # headline + depth curve + sweep before optional experiments burn it).
+#
+# Between items a cheap liveness probe short-circuits the rest when the
+# tunnel has died (exit 2): without it, each remaining tool would hang on
+# backend init until its multi-thousand-second timeout — hours of dead
+# waiting — and the watcher would not know the window was cut short.
 cd /root/repo
 LOG=tpu_experiments
 mkdir -p "$LOG"
+
+up() {
+  timeout 120 python - <<'PY' >/dev/null 2>&1
+import jax, sys
+sys.exit(0 if jax.default_backend() == "tpu" else 1)
+PY
+}
+
+guard() {  # guard <label>: exit 3 (tunnel died, queue cut short) — a code
+  # DISTINCT from bash's own parse-error exit 2, so the watcher can tell a
+  # genuine tunnel death (fast re-arm) from a broken script (backoff)
+  if ! up; then
+    echo "$(date -u +%T) tunnel died before $1 — queue cut short" >> "$LOG/queue.log"
+    exit 3
+  fi
+}
 
 echo "$(date -u +%T) run_queue start" >> "$LOG/queue.log"
 
@@ -19,34 +40,44 @@ if [ $hrc -eq 0 ] && grep -q tokens "$LOG/headline.json.tmp" && ! grep -q cpu_sm
   headline_ok=1
 fi
 echo "$(date -u +%T) headline rc=$hrc ok=$headline_ok" >> "$LOG/queue.log"
+# snapshot the validated headline IMMEDIATELY (before any guard can cut the
+# queue short) — and refresh after depth_curve merges its fit in.  Only when
+# THIS window's headline succeeded: an unconditional copy would mislabel a
+# stale previous-round BENCH_TPU.json as this round's.
+if [ "$headline_ok" = 1 ]; then
+  cp BENCH_TPU.json BENCH_r05_tpu.json 2>/dev/null
+fi
 
 # 2. depth-scaling curve (VERDICT r3 #3: validate the 7B extrapolation);
-# merges its results into BENCH_TPU.json, so the round snapshot copies AFTER
+# merges its results into BENCH_TPU.json, so the round snapshot re-copies AFTER
+guard depth_curve
 if [ -f tools/depth_curve.py ]; then
   timeout 3000 python tools/depth_curve.py > "$LOG/depth_curve.log" 2>&1
   echo "$(date -u +%T) depth_curve rc=$?" >> "$LOG/queue.log"
 fi
-# snapshot ONLY when this window's headline run succeeded — an unconditional
-# copy would mislabel a stale previous-round BENCH_TPU.json as this round's
 if [ "$headline_ok" = 1 ]; then
   cp BENCH_TPU.json BENCH_r05_tpu.json 2>/dev/null
 fi
 
 # 3. pallas kernel tuning (VERDICT r3 #2: CE/rms/swiglu win-or-yield)
+guard kernel_tune
 if [ -f tools/kernel_tune.py ]; then
   timeout 3000 python tools/kernel_tune.py > "$LOG/kernel_tune.log" 2>&1
   echo "$(date -u +%T) kernel_tune rc=$?" >> "$LOG/queue.log"
 fi
 
 # 4. per-op sweep (BENCH_MICRO.json refresh — after tuning so defaults reflect it)
+guard sweep
 THUNDER_TPU_BENCH_MAX_WAIT_S=120 timeout 2400 python bench.py sweep > "$LOG/sweep.log" 2>&1
 echo "$(date -u +%T) sweep rc=$? (BENCH_MICRO.json refreshed)" >> "$LOG/queue.log"
 
 # 5. decode benchmark
+guard decode
 THUNDER_TPU_BENCH_MAX_WAIT_S=120 timeout 2400 python bench.py decode > "$LOG/decode.json" 2> "$LOG/decode.log"
 echo "$(date -u +%T) decode rc=$?" >> "$LOG/queue.log"
 
 # 6. block-tier benchmarks
+guard blocks
 THUNDER_TPU_BENCH_MAX_WAIT_S=120 timeout 2400 python bench.py blocks > "$LOG/blocks.json" 2> "$LOG/blocks.log"
 echo "$(date -u +%T) blocks rc=$?" >> "$LOG/queue.log"
 
@@ -56,11 +87,13 @@ echo "$(date -u +%T) blocks rc=$?" >> "$LOG/queue.log"
 
 # 7. optional experiment tools, if the window is still alive
 # (mixtral_decode = milestone E headline; xla_flags_sweep LAST — it reruns
-# the full headline per flag set, ~15 min/config)
+# the full headline per flag set, ~8.5 min/config budget)
 for t in mixtral_decode flash_tune config_sweep quant_headline xla_flags_sweep; do
+  guard "$t"
   if [ -f "tools/$t.py" ]; then
     timeout 2400 python "tools/$t.py" > "$LOG/$t.log" 2>&1
     echo "$(date -u +%T) $t rc=$?" >> "$LOG/queue.log"
   fi
 done
 echo "$(date -u +%T) run_queue done" >> "$LOG/queue.log"
+exit 0

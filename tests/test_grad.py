@@ -199,3 +199,17 @@ def test_generic_vjp_registry_bounded():
     for _ in range(5):
         ttpu.grad(loss, argnums=(0, 1))(x, w)  # fresh compile every call
     assert len(get_executor("jax").implmap) == size0
+
+
+def test_nested_compiled_call_raises_clearly():
+    """Calling a compiled function on proxies inside another trace (e.g.
+    tt.grad(tt.grad(f))) is unsupported — it must fail with the documented
+    NotImplementedError and workaround, not a confusing downstream error."""
+    import thunder_tpu.torch as ltorch
+
+    g1 = ttpu.grad(lambda x: ltorch.sum(x * x * x))
+    with pytest.raises(NotImplementedError, match="nested jit/grad composition"):
+        ttpu.grad(lambda x: ltorch.sum(g1(x)))(np.ones(4, np.float32))
+    # single-level use is unaffected
+    x = np.arange(1.0, 4.0, dtype=np.float32)
+    np.testing.assert_allclose(np.asarray(g1(x)), 3 * x**2, rtol=1e-6)

@@ -142,7 +142,29 @@ def jit(
     )
     cs = CompileStats()
 
+    from itertools import chain
+
+    from thunder_tpu.core.proxies import Proxy
+    from thunder_tpu.core.pytree import tree_flatten
+    from thunder_tpu.core.trace import get_tracectx
+
     def fn_(*args, **kwargs):
+        if get_tracectx() is not None and any(
+            isinstance(a, Proxy)
+            for a in chain(tree_flatten(args)[0], tree_flatten(kwargs)[0])
+        ):
+            # a compiled callable invoked ON PROXIES inside another trace —
+            # e.g. tt.grad(tt.grad(f)) — would run its prologue on symbolic
+            # values and silently produce garbage.  Higher-order composition
+            # is not supported (the reference has no nested-grad path
+            # either); fail with the workaround instead of a confusing
+            # downstream TypeError
+            raise NotImplementedError(
+                "a thunder_tpu-compiled function was called inside another "
+                "trace (nested jit/grad composition is unsupported) — "
+                "compose at the trace level instead: pass the original "
+                "Python function, e.g. tt.grad(lambda x: original_fn(x))"
+            )
         cs.calls += 1
         cs.last_trace_host_start = time.perf_counter_ns()
 

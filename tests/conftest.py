@@ -10,3 +10,21 @@ workaround.
 from thunder_tpu._platform import force_cpu
 
 force_cpu(8)
+
+
+# shared differential-testing harness (test_interpreter_differential.py and
+# test_interpreter_fuzz.py compare native vs interpreted with one contract)
+def diff_native(fn, *args):
+    try:
+        return ("ok", fn(*args))
+    except BaseException as e:
+        return ("raise", type(e).__name__, str(e))
+
+
+def diff_interpreted(fn, *args):
+    from thunder_tpu.core.interpreter import interpret
+
+    try:
+        return ("ok", interpret(fn, *args)[0])
+    except BaseException as e:
+        return ("raise", type(e).__name__, str(e))

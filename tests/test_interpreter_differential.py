@@ -10,21 +10,8 @@ from __future__ import annotations
 
 import pytest
 
-from thunder_tpu.core.interpreter import interpret
-
-
-def _native(fn, *args):
-    try:
-        return ("ok", fn(*args))
-    except BaseException as e:
-        return ("raise", type(e).__name__, str(e))
-
-
-def _interpreted(fn, *args):
-    try:
-        return ("ok", interpret(fn, *args)[0])
-    except BaseException as e:
-        return ("raise", type(e).__name__, str(e))
+from conftest import diff_interpreted as _interpreted
+from conftest import diff_native as _native
 
 
 def check(fn, *args):

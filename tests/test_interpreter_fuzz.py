@@ -15,8 +15,6 @@ import random
 
 import pytest
 
-from thunder_tpu.core.interpreter import interpret
-
 _NAMES = ["a", "b", "c"]
 _BIN = ["+", "-", "*", "//", "%", "&", "|", "^"]
 _CMP = ["<", "<=", ">", ">=", "==", "!="]
@@ -111,18 +109,8 @@ class _Gen:
         )
 
 
-def _run(fn, a, b):
-    try:
-        return ("ok", fn(a, b))
-    except BaseException as e:
-        return ("raise", type(e).__name__, str(e))
-
-
-def _run_interp(fn, a, b):
-    try:
-        return ("ok", interpret(fn, a, b)[0])
-    except BaseException as e:
-        return ("raise", type(e).__name__, str(e))
+from conftest import diff_interpreted as _run_interp  # noqa: E402
+from conftest import diff_native as _run  # noqa: E402
 
 
 @pytest.mark.parametrize("seed", range(300))

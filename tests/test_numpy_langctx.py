@@ -63,3 +63,22 @@ def test_grad_through_numpy_surface():
 
     v, g = tt.value_and_grad(loss)(a)
     np.testing.assert_allclose(np.asarray(g), np.cos(a) * a + np.sin(a), rtol=1e-5)
+
+
+def test_langctx_kwarg_numpy_dispatch():
+    """tt.jit(fn, langctx="numpy") (reference jit's langctx kwarg,
+    thunder/__init__.py:307): method dispatch resolves through the numpy
+    context (x.size = element COUNT, numpy semantics), dunders fall back to
+    the shared torch surface, and unknown languages fail at jit() time."""
+    a = rng.standard_normal((3, 4)).astype(np.float32)
+
+    def f(x):
+        return lnp.sqrt(lnp.abs(x)) + x.size
+
+    got = np.asarray(tt.jit(f, langctx="numpy")(a))
+    np.testing.assert_allclose(got, np.sqrt(np.abs(a)) + a.size, rtol=1e-5)
+
+    import pytest
+
+    with pytest.raises(LookupError, match="Unknown language context"):
+        tt.jit(f, langctx="not-a-language")

@@ -63,6 +63,7 @@ __all__ = [
 def jit(
     fn: Callable,
     *,
+    langctx: Any | None = None,
     executors: Sequence | None = None,
     cache: str | CACHE_OPTIONS | None = None,
     sharp_edges: str | SHARP_EDGES_OPTIONS | None = None,
@@ -114,6 +115,9 @@ def jit(
         # would bake the parameters in as constants and train nothing
         from thunder_tpu.torch_interop import ThunderModule
 
+        check(langctx is None, lambda: (
+            "langctx is not supported for torch.nn.Module inputs — the "
+            "interop path traces through the torch surface by construction"))
         return ThunderModule(
             fn,
             executors=executors,
@@ -130,6 +134,13 @@ def jit(
     from thunder_tpu.core import compile_cache
 
     compile_cache.ensure_enabled()
+
+    if langctx is not None:
+        # resolve eagerly so a typo fails at jit() time, not first call
+        # (reference jit's langctx kwarg, __init__.py:307)
+        from thunder_tpu.core.langctxs import resolve_language
+
+        compile_options["langctx"] = resolve_language(langctx)
 
     cd = CompileData(
         fn=fn,
@@ -267,6 +278,7 @@ def _compile(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict) -> Ca
             grad_argnums=grad_argnums,
             interpretation=cd.compile_options.get("interpretation"),
             symbolic_numbers=cd.cache_option is CACHE_OPTIONS.SYMBOLIC_VALUES,
+            language=cd.compile_options.get("langctx"),
         )
     cs.last_trace_tracing_stop = time.perf_counter_ns()
 

@@ -65,6 +65,22 @@ def register_langctx(id: Any, ctx: LanguageContext) -> LanguageContext:
 def resolve_language(id: Any) -> LanguageContext:
     if isinstance(id, LanguageContext):
         return id
+    if isinstance(id, str):
+        # string aliases ("torch", "numpy", ...) resolve through the enum;
+        # importing the module registers its context on first use.  Members
+        # with no module/registered context (prims) fall through to the
+        # registry lookup below and fail with the uniform LookupError.
+        try:
+            lang = Languages(id.lower())
+        except ValueError:
+            raise LookupError(f"Unknown language context {id!r}") from None
+        import importlib
+
+        try:
+            importlib.import_module(f"thunder_tpu.{lang.value}")
+        except ImportError:
+            pass
+        id = lang
     ctx = _langctx_registry.get(id)
     if ctx is None:
         raise LookupError(f"Unknown language context {id}")
@@ -102,9 +118,20 @@ def langctx(ctx: LanguageContext | Any):
 
 
 def resolve_method(id: str, *args, **kwargs) -> Callable | None:
-    """Returns the active language's implementation of method ``id`` or None."""
+    """Returns the active language's implementation of method ``id``.
+
+    A context that does not OVERRIDE a method falls back to the torch
+    surface (the framework's full method set): alternate languages
+    (numpy) register only the methods whose semantics differ, and proxy
+    dunders (`+`, `[]`, ...) keep working everywhere."""
     ctx = get_langctx()
     try:
         return ctx.get_method(id, *args, **kwargs)
     except AttributeError:
-        return None
+        pass
+    if ctx.name != "torch":
+        try:
+            return resolve_language(Languages.TORCH).get_method(id, *args, **kwargs)
+        except (AttributeError, LookupError):
+            return None
+    return None

@@ -91,6 +91,7 @@ def trace_from_fn(
     grad_argnums: tuple | None = None,
     interpretation: str | None = None,
     symbolic_numbers: bool = False,
+    language=None,
 ) -> TraceResults:
     """Runs ``fn`` over proxies, returning prologue/computation/epilogue traces.
 
@@ -182,7 +183,7 @@ def trace_from_fn(
 
     state_cap = None
     with tracectx(computation_trace):
-        with langctx(Languages.TORCH):
+        with langctx(language if language is not None else Languages.TORCH):
             if interpretation == "bytecode":
                 from thunder_tpu.core.jit_ext import interpret_with_state
 

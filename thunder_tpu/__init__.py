@@ -16,7 +16,25 @@ import time
 from typing import Any, Callable, Sequence
 
 from thunder_tpu import clang  # noqa: F401
+from thunder_tpu import numpy  # noqa: F401  (registers the numpy langctx)
 from thunder_tpu import torch as ltorch  # noqa: F401  (registers the torch langctx)
+
+# top-level dtype aliases (reference thunder/__init__.py exports these):
+# thunder_tpu.bfloat16 etc. work anywhere a dtype is accepted
+from thunder_tpu.core.dtypes import (  # noqa: F401
+    bfloat16,
+    bool8,
+    complex64,
+    complex128,
+    float16,
+    float32,
+    float64,
+    int8,
+    int16,
+    int32,
+    int64,
+    uint8,
+)
 from thunder_tpu.common import CacheEntry, CompileData, CompileStats
 from thunder_tpu.core import dtypes, prims
 from thunder_tpu.core.baseutils import check

@@ -113,6 +113,58 @@ from conftest import diff_interpreted as _run_interp  # noqa: E402
 from conftest import diff_native as _run  # noqa: E402
 
 
+def _gen_program(g: _Gen) -> str:
+    """A program whose core is a random GENERATOR: yields inside loops,
+    conditionals, and try/finally, plus `yield from` — the interpreter's
+    frame-suspension machinery under random composition."""
+    r = g.r
+    lines = []
+    for _ in range(r.randint(2, 4)):
+        k = r.randrange(5)
+        if k == 0:
+            lines.append(f"        yield {g.expr()}\n")
+        elif k == 1:
+            lines.append(f"        for _i in range({r.randint(1, 3)}):\n"
+                         f"            yield _i * ({g.expr()})\n")
+        elif k == 2:
+            lines.append(f"        if {g.expr()}:\n"
+                         f"            yield {g.expr()}\n"
+                         f"        else:\n"
+                         f"            yield {r.randint(-2, 2)}\n")
+        elif k == 3:
+            lines.append(f"        yield from range(abs({g.expr()}) % 3)\n")
+        else:
+            lines.append(f"        try:\n"
+                         f"            yield ({g.expr()}) // (n % 3)\n"
+                         f"        except ZeroDivisionError:\n"
+                         f"            yield -99\n")
+    body = "".join(lines)
+    take = r.randint(2, 6)
+    return (
+        "def f(a, b):\n"
+        "    c = a + b\n"
+        "    def g(n):\n"
+        f"{body}"
+        "    out = list(g(a))\n"
+        "    it = g(b)\n"
+        f"    head = [v for _, v in zip(range({take}), it)]\n"
+        "    return (out, head, sum(out) + sum(head))\n"
+    )
+
+
+@pytest.mark.parametrize("seed", range(150))
+def test_fuzz_generator_program(seed):
+    g = _Gen(seed + 50_000)
+    src = _gen_program(g)
+    ns: dict = {}
+    exec(src, ns)  # noqa: S102 - generated from the seeded grammar above
+    fn = ns["f"]
+    for a, b in ((3, 2), (0, 5), (-4, 7)):
+        native = _run(fn, a, b)
+        inter = _run_interp(fn, a, b)
+        assert native == inter, f"seed={seed} args=({a},{b})\n{src}\nnative={native!r}\ninterp={inter!r}"
+
+
 @pytest.mark.parametrize("seed", range(300))
 def test_fuzz_program(seed):
     src = _Gen(seed).program(n_stmts=4)

@@ -1,0 +1,8 @@
+"""Fixture module for cross-module guard tests: a helper whose functions
+read THIS module's globals (not the traced fn's)."""
+SCALE = 2.0
+CFG = {"k": 3.0}
+
+
+def scaled(x):
+    return x * SCALE + CFG["k"]

@@ -2114,3 +2114,33 @@ class TestAsync:
         x = np.ones((4,), dtype=np.float32)
         out = jfn(jnp.asarray(x))
         np.testing.assert_allclose(np.asarray(out), x * 2.0 + 1.0)
+
+
+class TestCrossModuleGuards:
+    def test_helper_module_globals_guard_and_track(self):
+        """Helpers from OTHER modules read their own globals; the prologue
+        must re-resolve them via sys.modules (a bare-name root against the
+        traced fn's globals raised KeyError before round 5) and retrace on
+        mutation."""
+        import _guard_helper_mod as hm
+
+        def f(x):
+            return hm.scaled(x) + 1.0
+
+        x = rng.standard_normal((4,)).astype(np.float32)
+        jfn = tt.jit(f, interpretation="bytecode")
+        old_scale, old_k = hm.SCALE, hm.CFG["k"]
+        try:
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 2.0 + 4.0, rtol=1e-6)
+            src = tt.last_prologue_traces(jfn)[-1].python()
+            assert "_guard_helper_mod" in src, src
+            hm.SCALE = 5.0
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 5.0 + 4.0, rtol=1e-6)
+            assert tt.cache_misses(jfn) == 2
+            hm.CFG["k"] = 7.0
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 5.0 + 8.0, rtol=1e-6)
+            assert tt.cache_misses(jfn) == 3
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 5.0 + 8.0, rtol=1e-6)
+            assert tt.cache_misses(jfn) == 3  # steady state: cache hit
+        finally:
+            hm.SCALE, hm.CFG["k"] = old_scale, old_k

@@ -25,6 +25,7 @@ import collections.abc as _abc
 import dis
 import inspect
 import operator
+import sys
 import types
 import weakref
 from dataclasses import dataclass, field
@@ -92,8 +93,13 @@ class ProvenanceRecord:
         """Root-relative access path as typed steps:
         (('globals', name), ('attr', a), ('item', k), ...) — or None when the
         value is not rooted at function state (so not re-locatable by a
-        prologue)."""
+        prologue).  Globals of OTHER modules (helper functions interpreted
+        through) root at ('gmod', module_name) and re-resolve via
+        sys.modules at prologue time."""
         if self.inst is PseudoInst.LOAD_GLOBAL:
+            if isinstance(self.key, tuple):  # (module_name, var_name)
+                modname, name = self.key
+                return (("gmod", modname), ("item", name))
             return (("globals", self.key),)
         if self.inst is PseudoInst.LOAD_DEREF:
             return (("closure", self.key),)
@@ -165,6 +171,9 @@ class InterpreterCompileCtx:
     # executed instruction plus ("call"/"lookaside"/"opaque", depth, name)
     # at call boundaries (reference's interpreter log, interpreter.py:6683)
     log: list = field(default_factory=list)
+    # the TRACED fn's globals dict — frames over OTHER modules qualify their
+    # global reads with the module name (see _global_record)
+    root_globals: dict | None = None
     log_limit: int = 200_000
 
     def record(self, *event):
@@ -1206,9 +1215,10 @@ def _load_global(frame, ins, i):
     push_null = bool(ins.arg & 1)
     if name in frame.globals_:
         v = frame.globals_[name]
-        rec = ProvenanceRecord(PseudoInst.LOAD_GLOBAL, key=name)
-        v = frame.ctx.record_read(rec, v)
-        frame.ctx.track(v, rec)
+        rec = _global_record(frame, name)
+        if rec is not None:
+            v = frame.ctx.record_read(rec, v)
+            frame.ctx.track(v, rec)
     elif name in frame.builtins_:
         v = frame.builtins_[name]  # builtins are not guarded (stable)
     else:
@@ -1235,6 +1245,25 @@ def _load_name(frame, ins, i):
         frame.push(frame.builtins_[name])
     else:
         raise NameError(f"name {name!r} is not defined")
+
+
+def _global_record(frame, name: str) -> "ProvenanceRecord | None":
+    """Provenance for a LOAD_GLOBAL.  The TRACED fn's own globals use the
+    bare-name root (the prologue holds that exact dict); globals of OTHER
+    interpreted modules (helpers called through) qualify with the module
+    name and re-resolve via sys.modules at prologue time.  A namespace the
+    prologue cannot re-locate (exec'd dict, mismatched __name__) records
+    nothing — unguarded rather than a guaranteed prologue KeyError."""
+    ctx = frame.ctx
+    if frame.globals_ is ctx.root_globals:
+        return ProvenanceRecord(PseudoInst.LOAD_GLOBAL, key=name)
+    modname = frame.globals_.get("__name__")
+    if (
+        isinstance(modname, str)
+        and getattr(sys.modules.get(modname), "__dict__", None) is frame.globals_
+    ):
+        return ProvenanceRecord(PseudoInst.LOAD_GLOBAL, key=(modname, name))
+    return None
 
 
 @register_opcode_handler("LOAD_DEREF")
@@ -2406,6 +2435,7 @@ def interpret(
         lookasides={**_default_lookasides, **(lookasides or {})},
     )
     ctx.track(fn, ProvenanceRecord(PseudoInst.INPUT_FN))
+    ctx.root_globals = fn.__globals__
     result = _run_function(ctx, fn, args, kwargs, depth=0)
     return result, ctx
 

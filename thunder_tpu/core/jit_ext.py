@@ -18,6 +18,8 @@ The TPU-native shape of the idea:
 """
 from __future__ import annotations
 
+import sys
+
 from typing import Any, Callable
 
 from thunder_tpu.core import prims
@@ -76,12 +78,31 @@ class StateCapture:
         return [p for _, p in self.tensors.values()]
 
 
+class _LiveModuleGlobals:
+    """Prologue-time resolver for helper-module globals: ``['pkg.mod']`` →
+    that module's LIVE ``__dict__`` via sys.modules, so guards re-read
+    current values on every call (a snapshot would freeze them)."""
+
+    def __getitem__(self, modname: str) -> dict:
+        mod = sys.modules.get(modname)
+        if mod is None:
+            raise KeyError(modname)
+        return mod.__dict__
+
+
 def _internal_root(fn: Callable, path: tuple) -> bool:
     """True when the access chain is rooted at a thunder_tpu-internal global
     (e.g. ``ThunderTracingMode._patch_depth`` read inside the torch-interop
     wrapper): framework tracing state is not program state — guarding it
     would pin trace-time-only values and fail every post-trace prologue."""
-    if not path or path[0][0] != "globals":
+    if not path:
+        return False
+    if path[0][0] == "gmod":
+        name = path[0][1]
+        return isinstance(name, str) and (
+            name == "thunder_tpu" or name.startswith("thunder_tpu.")
+        )
+    if path[0][0] != "globals":
         return False
     try:
         base = fn.__globals__.get(path[0][1])
@@ -147,7 +168,8 @@ def build_state_prologue(prologue_trace, fn: Callable, cap: StateCapture, dtype_
     closure = {}
     if fn.__closure__:
         closure = dict(zip(fn.__code__.co_freevars, fn.__closure__))
-    state = {"globals": fn.__globals__, "closure": closure}
+    state = {"globals": fn.__globals__, "closure": closure,
+             "gmod": _LiveModuleGlobals()}
 
     root = CollectionProxy(None, name="fn_state")
     b = prims.unpack_trivial.bind(root, name="fn_state", output=root, _call_ctx={"fn_state": state})
@@ -172,7 +194,7 @@ def build_state_prologue(prologue_trace, fn: Callable, cap: StateCapture, dtype_
         if out_proxy is None and path in unpacked:
             return unpacked[path]
         kind, key = path[-1]
-        if kind in ("globals", "closure"):
+        if kind in ("globals", "closure", "gmod"):
             coll = root_coll(kind)
             if kind == "closure":
                 cell = CollectionProxy(None)

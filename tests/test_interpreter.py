@@ -2144,3 +2144,30 @@ class TestCrossModuleGuards:
             assert tt.cache_misses(jfn) == 3  # steady state: cache hit
         finally:
             hm.SCALE, hm.CFG["k"] = old_scale, old_k
+
+    def test_in_function_imports_guard(self):
+        """In-function `from X import Y` / `import X` re-read module state
+        natively on EVERY call — the traced program must guard those reads
+        (both were silently baked before round 5)."""
+        import _guard_helper_mod as hm
+
+        def f(x):
+            from _guard_helper_mod import SCALE
+            import _guard_helper_mod as hm2
+            return x * SCALE + hm2.CFG["k"]
+
+        x = rng.standard_normal((4,)).astype(np.float32)
+        jfn = tt.jit(f, interpretation="bytecode")
+        old_scale, old_k = hm.SCALE, hm.CFG["k"]
+        try:
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 2.0 + 3.0, rtol=1e-6)
+            hm.SCALE = 9.0
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 9.0 + 3.0, rtol=1e-6)
+            assert tt.cache_misses(jfn) == 2
+            hm.CFG["k"] = 5.0
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 9.0 + 5.0, rtol=1e-6)
+            assert tt.cache_misses(jfn) == 3
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 9.0 + 5.0, rtol=1e-6)
+            assert tt.cache_misses(jfn) == 3  # steady state
+        finally:
+            hm.SCALE, hm.CFG["k"] = old_scale, old_k

@@ -64,6 +64,7 @@ class PseudoInst(Enum):
     PRESENT_MEMBER = auto()  # VALUE observed present via `in` on a sequence
     KEYS = auto()  # dict key tuple observed (iteration / keys()/items())
     TYPE_NAME = auto()  # object class observed via isinstance()
+    MODULE = auto()  # a module object (in-function import), root = sys.modules
     CONSTANT = auto()
     OPAQUE = auto()
 
@@ -136,6 +137,10 @@ class ProvenanceRecord:
         if self.inst is PseudoInst.TYPE_NAME and self.inputs:
             base = self.inputs[0].path()
             return None if base is None else base + (("type_name", None),)
+        if self.inst is PseudoInst.MODULE:
+            # resolves to the module OBJECT (sys.modules[name]) so attr
+            # steps use real getattr — PEP 562 module __getattr__ included
+            return (("gmodule", self.key),)
         return None
 
 
@@ -2133,13 +2138,25 @@ def _import_name(frame, ins, i):
     fromlist = frame.pop()
     level = frame.pop()
     mod = __import__(ins.argval, frame.globals_, None, fromlist, level)
+    # track the module so attribute reads off it guard: natively, an
+    # in-function import re-reads module state EVERY call — a baked value
+    # with no guard would replay stale after the module mutates
+    if isinstance(mod, types.ModuleType):
+        modname = getattr(mod, "__name__", None)
+        if isinstance(modname, str) and sys.modules.get(modname) is mod:
+            frame.ctx.track(mod, ProvenanceRecord(PseudoInst.MODULE, key=modname))
     frame.push(mod)
 
 
 @register_opcode_handler("IMPORT_FROM")
 def _import_from(frame, ins, i):
     mod = frame.stack[-1]
-    frame.push(getattr(mod, ins.argval))
+    name = ins.argval
+    v = getattr(mod, name)
+    base_rec = frame.ctx.prov_of(mod)
+    if base_rec is not None:
+        v = _tracked_read(frame.ctx, base_rec, name, v, is_attr=True, container=mod)
+    frame.push(v)
 
 
 def _chain_context(frame, exc: BaseException) -> BaseException:

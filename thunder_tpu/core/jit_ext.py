@@ -90,6 +90,18 @@ class _LiveModuleGlobals:
         return mod.__dict__
 
 
+class _LiveModules:
+    """Like _LiveModuleGlobals but yields the module OBJECT — MODULE-rooted
+    paths (in-function imports) take attr steps through real getattr, so
+    PEP 562 module-level __getattr__ keeps working."""
+
+    def __getitem__(self, modname: str):
+        mod = sys.modules.get(modname)
+        if mod is None:
+            raise KeyError(modname)
+        return mod
+
+
 def _internal_root(fn: Callable, path: tuple) -> bool:
     """True when the access chain is rooted at a thunder_tpu-internal global
     (e.g. ``ThunderTracingMode._patch_depth`` read inside the torch-interop
@@ -97,7 +109,7 @@ def _internal_root(fn: Callable, path: tuple) -> bool:
     would pin trace-time-only values and fail every post-trace prologue."""
     if not path:
         return False
-    if path[0][0] == "gmod":
+    if path[0][0] in ("gmod", "gmodule"):
         name = path[0][1]
         return isinstance(name, str) and (
             name == "thunder_tpu" or name.startswith("thunder_tpu.")
@@ -169,7 +181,7 @@ def build_state_prologue(prologue_trace, fn: Callable, cap: StateCapture, dtype_
     if fn.__closure__:
         closure = dict(zip(fn.__code__.co_freevars, fn.__closure__))
     state = {"globals": fn.__globals__, "closure": closure,
-             "gmod": _LiveModuleGlobals()}
+             "gmod": _LiveModuleGlobals(), "gmodule": _LiveModules()}
 
     root = CollectionProxy(None, name="fn_state")
     b = prims.unpack_trivial.bind(root, name="fn_state", output=root, _call_ctx={"fn_state": state})
@@ -194,7 +206,7 @@ def build_state_prologue(prologue_trace, fn: Callable, cap: StateCapture, dtype_
         if out_proxy is None and path in unpacked:
             return unpacked[path]
         kind, key = path[-1]
-        if kind in ("globals", "closure", "gmod"):
+        if kind in ("globals", "closure", "gmod", "gmodule"):
             coll = root_coll(kind)
             if kind == "closure":
                 cell = CollectionProxy(None)

@@ -17,6 +17,8 @@ import pytest
 
 import thunder_tpu as tt
 
+import _guard_helper_mod as _hm
+
 # module-level state the generated programs read (reset per test)
 STATE: dict = {}
 
@@ -36,8 +38,13 @@ def _fresh_state(r: random.Random) -> dict:
     }
 
 
-# access-pattern snippets; each evaluates to a float given STATE
+# access-pattern snippets; each evaluates to a float given STATE (HM is the
+# cross-module fixture: helper functions reading THEIR module's globals,
+# plus in-function imports — both guarded via sys.modules-rooted paths)
 _READS = [
+    "HM.scaled(1.0)",
+    "HM.SCALE",
+    "__import__('_guard_helper_mod').CFG['k']",
     "S['lr']",
     "S['depth'] * 1.0",
     "S.get('lr', 1.0)",
@@ -58,6 +65,8 @@ _READS = [
 
 # mutations applied between calls; guard machinery must retrace for each
 _MUTATIONS = [
+    lambda r: setattr(_hm, "SCALE", round(r.uniform(0.5, 2.0), 3)),
+    lambda r: _hm.CFG.__setitem__("k", float(r.randint(1, 5))),
     lambda r: STATE.__setitem__("lr", round(r.uniform(0.5, 2.0), 3)),
     lambda r: STATE.__setitem__("depth", r.randint(1, 4)),
     lambda r: STATE.__setitem__("warm", True),
@@ -80,7 +89,7 @@ def _make_fn(r: random.Random):
         "def f(x):\n"
         f"    return x * ({expr})\n"
     )
-    ns = {"S": STATE}
+    ns = {"S": STATE, "HM": _hm}
     exec(src, ns)  # noqa: S102 - assembled from the fixed read list above
     return ns["f"], src
 
@@ -90,6 +99,7 @@ def test_guard_fuzz(seed):
     r = random.Random(seed)
     STATE.clear()
     STATE.update(_fresh_state(r))
+    _hm.SCALE, _hm.CFG["k"] = 2.0, 3.0  # canonical baseline (mutations leak)
     fn, src = _make_fn(r)
     jfn = tt.jit(fn, interpretation="bytecode")
     x = np.arange(4, dtype=np.float32) + 1

@@ -2171,3 +2171,26 @@ class TestCrossModuleGuards:
             assert tt.cache_misses(jfn) == 3  # steady state
         finally:
             hm.SCALE, hm.CFG["k"] = old_scale, old_k
+
+    def test_os_environ_get_guards(self):
+        """Env-var reads through os.environ (a Mapping, not a dict) guard
+        like dict reads: setting the variable later retraces, removal falls
+        back to the still-valid first cache entry."""
+        import os
+
+        def f(x):
+            return x * (2.0 if os.environ.get("TT_GUARD_TEST_FLAG") else 1.0)
+
+        x = rng.standard_normal((4,)).astype(np.float32)
+        jfn = tt.jit(f, interpretation="bytecode")
+        os.environ.pop("TT_GUARD_TEST_FLAG", None)
+        try:
+            np.testing.assert_allclose(np.asarray(jfn(x)), x, rtol=1e-6)
+            os.environ["TT_GUARD_TEST_FLAG"] = "1"
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 2.0, rtol=1e-6)
+            assert tt.cache_misses(jfn) == 2
+            del os.environ["TT_GUARD_TEST_FLAG"]
+            np.testing.assert_allclose(np.asarray(jfn(x)), x, rtol=1e-6)
+            assert tt.cache_misses(jfn) == 2  # first entry valid again: hit
+        finally:
+            os.environ.pop("TT_GUARD_TEST_FLAG", None)

@@ -49,10 +49,15 @@ def to_printable(trace, x: Any) -> Printable:
         return x
     if baseutils.is_collection(x):
         leaves, spec = tree_flatten(x)
-        printables = tuple(to_printable(trace, l) for l in leaves)
-        from thunder_tpu.core.pytree import tree_unflatten
+        # a container subclass the pytree does not open (dict/tuple
+        # subclasses like HF configs) comes back as its own single leaf —
+        # recursing would loop forever; register it as an opaque context
+        # object instead
+        if not (len(leaves) == 1 and leaves[0] is x):
+            printables = tuple(to_printable(trace, l) for l in leaves)
+            from thunder_tpu.core.pytree import tree_unflatten
 
-        return tree_unflatten(printables, spec)
+            return tree_unflatten(printables, spec)
     from thunder_tpu.core import dtypes
     from thunder_tpu.core.devices import Device
 

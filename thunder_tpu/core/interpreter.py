@@ -336,6 +336,29 @@ def _is_interpretable(fn) -> bool:
 _PRIMITIVE = (int, float, bool, str, bytes, type(None))
 
 
+def _std_mapping_method(fn, names: tuple) -> bool:
+    """True when ``fn`` is a bound mapping method with STOCK semantics the
+    lookasides may emulate: a C method of a dict-like (dict, mappingproxy),
+    or the collections.abc.Mapping mixin itself.  A Python override with
+    custom behavior falls through to interpretation, which preserves its
+    semantics (and still guards the state it reads)."""
+    if getattr(fn, "__name__", None) not in names:
+        return False
+    if isinstance(fn, types.BuiltinMethodType):
+        return _is_mappinglike(getattr(fn, "__self__", None))
+    if isinstance(fn, types.MethodType):
+        std = getattr(_abc.Mapping, fn.__name__, None)
+        return fn.__func__ is std and _is_mappinglike(getattr(fn, "__self__", None))
+    return False
+
+
+def _is_mappinglike(obj) -> bool:
+    # containers whose `in`/getitem operate on KEYS: dicts and Mapping
+    # implementations (os.environ, ChainMap, ...).  Sequences test VALUES
+    # with `in`, so they are excluded from item-membership guards.
+    return isinstance(obj, (dict, _abc.Mapping))
+
+
 def _guardable_key(k) -> bool:
     # key shapes a guard path can carry: hashable, repr-safe literals —
     # primitives plus all-primitive tuples (a common dict-key shape)
@@ -349,8 +372,9 @@ def _tracked_read(ctx: "InterpreterCompileCtx", base_rec, key, value, *, is_attr
     itself cannot become a value guard (arbitrary object, tensor), also
     records a PRESENT membership guard — the dual of the miss-side absence
     guards: without it, `del d[k]` / `del o.a` after tracing would silently
-    replay the baked present-branch.  Item guards are dict-only (`in` on a
-    sequence tests VALUES, not indices); attr guards skip names resolved on
+    replay the baked present-branch.  Item guards cover mapping-like
+    containers (dicts, os.environ, ChainMap — `in` on a sequence tests
+    VALUES, not indices); attr guards skip names resolved on
     the CLASS (methods/descriptors — effectively static) and module
     attributes, which keeps the per-call prologue free of hasattr noise for
     every method access.  Returns the (possibly substituted) value."""
@@ -363,7 +387,7 @@ def _tracked_read(ctx: "InterpreterCompileCtx", base_rec, key, value, *, is_attr
     if is_attr:
         if isinstance(container, types.ModuleType) or hasattr(type(container), key):
             return value
-    elif not isinstance(container, dict):
+    elif not _is_mappinglike(container):
         return value
     pinst = PseudoInst.PRESENT_ATTR if is_attr else PseudoInst.PRESENT_ITEM
     ctx.record_read(ProvenanceRecord(pinst, inputs=(base_rec,), key=key), True)
@@ -526,9 +550,10 @@ def _provenance_builtin_call(ctx: "InterpreterCompileCtx", depth: int, fn, args,
         try:
             v = obj[k]
         except (KeyError, IndexError):
-            # EAFP miss: guard the observed absence (dict-only) so inserting
-            # the key later retraces instead of replaying the handler branch
-            if base_rec is not None and isinstance(obj, dict) and _guardable_key(k):
+            # EAFP miss: guard the observed absence (mapping-like only) so
+            # inserting the key later retraces instead of replaying the
+            # handler branch
+            if base_rec is not None and _is_mappinglike(obj) and _guardable_key(k):
                 ctx.record_read(ProvenanceRecord(PseudoInst.ABSENT_ITEM, inputs=(base_rec,), key=k), True)
             raise
         if base_rec is not None and _guardable_key(k):
@@ -536,9 +561,7 @@ def _provenance_builtin_call(ctx: "InterpreterCompileCtx", depth: int, fn, args,
             v = _tracked_read(ctx, base_rec, k, v, is_attr=False, container=obj)
         return True, v
     if (
-        isinstance(fn, types.BuiltinMethodType)
-        and fn.__name__ == "get"
-        and isinstance(getattr(fn, "__self__", None), dict)
+        _std_mapping_method(fn, ("get",))
         and len(args) in (1, 2)
         and _guardable_key(args[0])
     ):
@@ -558,12 +581,7 @@ def _provenance_builtin_call(ctx: "InterpreterCompileCtx", depth: int, fn, args,
             ctx.record("lookaside", depth, "dict.get")
             v = _tracked_read(ctx, base_rec, args[0], v, is_attr=False, container=d)
         return True, v
-    if (
-        isinstance(fn, types.BuiltinMethodType)
-        and fn.__name__ in ("keys", "values", "items")
-        and isinstance(getattr(fn, "__self__", None), dict)
-        and not args
-    ):
+    if _std_mapping_method(fn, ("keys", "values", "items")) and not args:
         d = fn.__self__
         keys = _read_keys(ctx, d)
         if keys is None:
@@ -1590,9 +1608,9 @@ def _binary_subscr(frame, ins, i):
         v = obj[k]
     except (KeyError, IndexError):
         # EAFP miss (`try: d[k] except KeyError:`): guard the observed
-        # absence (dict-only) so inserting the key later retraces instead
-        # of replaying the baked handler branch
-        if base_rec is not None and isinstance(obj, dict) and _guardable_key(k):
+        # absence (mapping-like only) so inserting the key later retraces
+        # instead of replaying the baked handler branch
+        if base_rec is not None and _is_mappinglike(obj) and _guardable_key(k):
             frame.ctx.record_read(ProvenanceRecord(PseudoInst.ABSENT_ITEM, inputs=(base_rec,), key=k), True)
         raise
     if base_rec is not None and _guardable_key(k):
@@ -1699,7 +1717,7 @@ def _contains_op(frame, ins, i):
             # guard can be subsumed by an unpack through the key); sequence
             # `in` tests VALUES — a distinct *_member step that unpacks
             # through an INDEX must never subsume
-            if isinstance(b, dict):
+            if _is_mappinglike(b):
                 inst = PseudoInst.PRESENT_ITEM if found else PseudoInst.ABSENT_ITEM
             else:
                 inst = PseudoInst.PRESENT_MEMBER if found else PseudoInst.ABSENT_MEMBER

@@ -49,12 +49,15 @@ def _guardable(v) -> bool:
     # state still guards at the right granularity — elements via the
     # subscript chain, lengths via check_len (PseudoInst.LEN).  Nested
     # tuples allowed (dict-key tuples inside a KEYS guard value).
-    if isinstance(v, tuple) and all(isinstance(e, _GUARDABLE) or _guardable(e) for e in v):
+    if type(v) is tuple and all(isinstance(e, _GUARDABLE) or _guardable(e) for e in v):
         return True
     # small all-primitive dicts guard as literal-likes (match-statement
     # subjects: a failed `case {"k": _}` must retrace when the dict changes)
+    # EXACT types only: a dict/tuple subclass (HF config, namedtuple) may
+    # carry custom semantics, and its baked literal repr would reconstruct
+    # the plain builtin anyway — subclass instances guard per-element
     if (
-        isinstance(v, dict)
+        type(v) is dict
         and len(v) <= 16
         and all(isinstance(k, _GUARDABLE) and isinstance(e, _GUARDABLE) for k, e in v.items())
     ):

@@ -4,6 +4,8 @@ Reference parity: ``thunder/core/interpreter.py`` (opcode-level behavior:
 control flow, comprehensions, closures, nested calls) and ``jit_ext.py``'s
 general jit (globals become guards, external tensors become unpacked inputs).
 """
+import sys
+
 import numpy as np
 import pytest
 
@@ -2194,3 +2196,32 @@ class TestCrossModuleGuards:
             assert tt.cache_misses(jfn) == 2  # first entry valid again: hit
         finally:
             os.environ.pop("TT_GUARD_TEST_FLAG", None)
+
+    def test_external_write_supersedes_read_guard(self):
+        """COUNTER[0] = COUNTER[0] + 1 on a tracked global: the trace-time
+        write supersedes the pre-write read guard (keeping it would fail the
+        fresh prologue immediately).  The side effect happens once at trace
+        time — constant-values semantics, like print() — and sharp_edges
+        surfaces it."""
+        import warnings
+
+        counter = {"n": 0}
+        MOD = sys.modules[__name__]
+        MOD.TT_WRITE_TEST_STATE = counter
+        try:
+            def f(x):
+                TT_WRITE_TEST_STATE["n"] = TT_WRITE_TEST_STATE["n"] + 1
+                return x * 2.0
+
+            x = rng.standard_normal((4,)).astype(np.float32)
+            jfn = tt.jit(f, interpretation="bytecode")
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 2.0, rtol=1e-6)
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 2.0, rtol=1e-6)
+            assert tt.cache_misses(jfn) == 1  # no self-invalidating guard
+            assert counter["n"] == 1  # effect ran once, at trace time
+            with warnings.catch_warnings(record=True) as w:
+                warnings.simplefilter("always")
+                tt.jit(f, interpretation="bytecode", sharp_edges="warn")(x)
+            assert any("write to external state" in str(i.message) for i in w)
+        finally:
+            del MOD.TT_WRITE_TEST_STATE

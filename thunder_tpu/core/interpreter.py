@@ -179,6 +179,12 @@ class InterpreterCompileCtx:
     # the TRACED fn's globals dict — frames over OTHER modules qualify their
     # global reads with the module name (see _global_record)
     root_globals: dict | None = None
+    # writes INTO tracked external state during tracing: (base_rec, kind,
+    # key) — kind "item"/"attr", key None when the key is not a guardable
+    # literal.  Deduplicated; the general jit prunes the read guards these
+    # writes supersede (a guard captured pre-write would fail its own
+    # prologue immediately)
+    writes: set = field(default_factory=set)
     log_limit: int = 200_000
 
     def record(self, *event):
@@ -1455,7 +1461,9 @@ def _delete_name(frame, ins, i):
 
 @register_opcode_handler("DELETE_ATTR")
 def _delete_attr(frame, ins, i):
-    delattr(frame.pop(), ins.argval)
+    obj = frame.pop()
+    delattr(obj, ins.argval)
+    _record_external_write(frame, obj, "attr", ins.argval)
 
 
 @register_opcode_handler("DELETE_DEREF")
@@ -1597,6 +1605,7 @@ def _store_attr(frame, ins, i):
             f"is not supported; pass the state as an explicit argument (epilogue handles those)"
         )
     setattr(obj, ins.argval, v)
+    _record_external_write(frame, obj, "attr", ins.argval)
 
 
 @register_opcode_handler("BINARY_SUBSCR")
@@ -1620,10 +1629,18 @@ def _binary_subscr(frame, ins, i):
 
 @register_opcode_handler("STORE_SUBSCR")
 def _store_subscr(frame, ins, i):
+    from thunder_tpu.core.proxies import Proxy
+
     k = frame.pop()
     obj = frame.pop()
     v = frame.pop()
+    if frame.ctx.prov_of(obj) is not None and isinstance(v, Proxy):
+        raise InterpreterError(
+            f"storing a traced tensor into external state ({frame.ctx.prov_of(obj)}[{k!r}]) "
+            f"is not supported; pass the state as an explicit argument (epilogue handles those)"
+        )
     obj[k] = v
+    _record_external_write(frame, obj, "item", k)  # after: a failed write is no write
 
 
 @register_opcode_handler("DELETE_SUBSCR")
@@ -1631,6 +1648,7 @@ def _delete_subscr(frame, ins, i):
     k = frame.pop()
     obj = frame.pop()
     del obj[k]
+    _record_external_write(frame, obj, "item", k)
 
 
 @register_opcode_handler("BINARY_SLICE")
@@ -2175,6 +2193,30 @@ def _import_from(frame, ins, i):
     if base_rec is not None:
         v = _tracked_read(frame.ctx, base_rec, name, v, is_attr=True, container=mod)
     frame.push(v)
+
+
+def _record_external_write(frame, obj, kind: str, key) -> None:
+    """A write into TRACKED external state happens once, at trace time (like
+    any Python side effect under constant-values caching) — record it so the
+    general jit drops the read guards it supersedes, and surface it through
+    the sharp-edges policy."""
+    base_rec = frame.ctx.prov_of(obj)
+    if base_rec is None:
+        return
+    entry = (base_rec, kind, key if kind == "attr" or _guardable_key(key) else None)
+    if entry in frame.ctx.writes:
+        return  # dedup: one record (and one sharp-edge report) per location
+    frame.ctx.writes.add(entry)
+    try:
+        from thunder_tpu.core.compile_data import get_compile_data
+        from thunder_tpu.core.sharp_edges import report_external_write
+
+        cd = get_compile_data()
+        if cd is not None:
+            report_external_write(cd.sharp_edges, f"{base_rec}[{key!r}]" if kind == "item"
+                                  else f"{base_rec}.{key}")
+    except ImportError:  # pragma: no cover
+        pass
 
 
 def _chain_context(frame, exc: BaseException) -> BaseException:

@@ -168,6 +168,43 @@ def interpret_with_state(fn: Callable, proxy_args: tuple, proxy_kwargs: dict):
         fn, *proxy_args, read_callback=read_cb, lookasides=lookasides, **proxy_kwargs
     )
     cap.interpreter_log = _ctx.log
+
+    # drop read guards superseded by trace-time WRITES to the same external
+    # location: the written value is produced by the program, not an input —
+    # keeping the pre-write guard would fail the fresh prologue immediately
+    # (e.g. the counter-increment pattern COUNTER[0] = COUNTER[0] + 1)
+    if _ctx.writes and cap.guards:
+        # pseudo guards that depend on the container's WHOLE population —
+        # any insert/delete invalidates them; keyed membership guards
+        # (absent_item etc.) only die when THEIR key was written
+        population = ("len", "keys", "absent_member", "present_member")
+        keyed = {"absent_item": "item", "present_item": "item",
+                 "absent_attr": "attr", "present_attr": "attr"}
+        for base_rec, kind, key in _ctx.writes:
+            base = base_rec.path()
+            if base is None:
+                continue
+            for path in list(cap.guards):
+                tainted = False
+                if key is not None:
+                    written = base + ((kind, key),)
+                    # the written value (and anything beneath it)
+                    tainted = path[: len(written)] == written
+                    # a keyed membership guard on the same key
+                    if (not tainted and len(path) == len(base) + 1
+                            and path[: len(base)] == base
+                            and keyed.get(path[-1][0]) == kind
+                            and path[-1][1] == key):
+                        tainted = True
+                # population guards die on any write to the container;
+                # an UNGUARDABLE key (non-primitive object) cannot equal a
+                # primitive guard key, so value guards survive those writes
+                if (not tainted and len(path) == len(base) + 1
+                        and path[: len(base)] == base
+                        and path[-1][0] in population):
+                    tainted = True
+                if tainted:
+                    del cap.guards[path]
     return result, cap
 
 

@@ -38,16 +38,33 @@ _PATCH_SITES = (
 )
 
 
+def _dispatch(policy: SHARP_EDGES_OPTIONS, msg: str, stacklevel: int = 3):
+    if policy is SHARP_EDGES_OPTIONS.ALLOW:
+        return
+    if policy is SHARP_EDGES_OPTIONS.ERROR:
+        raise SharpEdgeError(msg)
+    warnings.warn(msg, stacklevel=stacklevel)
+
+
 def _report(policy: SHARP_EDGES_OPTIONS, what: str):
-    msg = (
+    _dispatch(policy, (
         f"sharp edge: {what} called during tracing — its result will be baked "
         f"into the compiled program as a constant (it will NOT re-run on later "
         f"calls).  Pass sharp_edges='allow' to silence, or move the call "
         f"outside the jitted function."
-    )
-    if policy is SHARP_EDGES_OPTIONS.ERROR:
-        raise SharpEdgeError(msg)
-    warnings.warn(msg, stacklevel=3)
+    ))
+
+
+def report_external_write(policy: SHARP_EDGES_OPTIONS, where: str) -> None:
+    """Writes into tracked external state execute ONCE, at trace time (like
+    print() under constant-values caching) — warn/error per policy so the
+    user knows the side effect will not re-run per call."""
+    _dispatch(policy, (
+        f"sharp edge: write to external state {where} during tracing — the "
+        f"effect happens once, at trace time, and will NOT re-run on later "
+        f"calls.  Pass the container as an argument (epilogue writes those "
+        f"back per call) or move the write outside the jitted function."
+    ), stacklevel=4)
 
 
 @contextlib.contextmanager

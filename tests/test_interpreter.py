@@ -2197,6 +2197,31 @@ class TestCrossModuleGuards:
         finally:
             os.environ.pop("TT_GUARD_TEST_FLAG", None)
 
+    def test_method_mutation_refreshes_guards(self):
+        """list.append / dict.update on tracked state: the trace-time
+        mutation refreshes the captured guards (instead of failing its own
+        prologue), the side effect runs once, and LATER external mutations
+        still retrace (refresh keeps sensitivity, unlike pruning)."""
+        MOD = sys.modules[__name__]
+        MOD.TT_METHOD_MUT_HIST = [1.0]
+        try:
+            def f(x):
+                s = sum(TT_METHOD_MUT_HIST)
+                TT_METHOD_MUT_HIST.append(2.0)
+                return x * s
+
+            x = rng.standard_normal((4,)).astype(np.float32)
+            jfn = tt.jit(f, interpretation="bytecode")
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 1.0, rtol=1e-6)
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 1.0, rtol=1e-6)
+            assert tt.cache_misses(jfn) == 1
+            assert MOD.TT_METHOD_MUT_HIST == [1.0, 2.0]  # effect once
+            MOD.TT_METHOD_MUT_HIST.append(9.0)  # EXTERNAL mutation → retrace
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 12.0, rtol=1e-6)
+            assert tt.cache_misses(jfn) == 2
+        finally:
+            del MOD.TT_METHOD_MUT_HIST
+
     def test_external_write_supersedes_read_guard(self):
         """COUNTER[0] = COUNTER[0] + 1 on a tracked global: the trace-time
         write supersedes the pre-write read guard (keeping it would fail the

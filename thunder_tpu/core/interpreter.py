@@ -644,7 +644,9 @@ def _call_value(ctx: InterpreterCompileCtx, depth: int, fn, args, kwargs):
     if handled:
         return v
     if depth >= ctx.max_depth:
-        return fn(*args, **kwargs)
+        out = fn(*args, **kwargs)
+        _record_method_mutation(ctx, fn)
+        return out
     if isinstance(fn, types.MethodType) and _is_interpretable(fn.__func__) and fn.__func__ not in ctx.opaque:
         ctx.record("call", depth, getattr(fn, "__qualname__", repr(fn)))
         return _run_function(ctx, fn.__func__, (fn.__self__, *args), kwargs, depth + 1)
@@ -667,7 +669,36 @@ def _call_value(ctx: InterpreterCompileCtx, depth: int, fn, args, kwargs):
             return fn(*args, **kwargs)
         ctx.record("call", depth, getattr(fn, "__qualname__", repr(fn)))
         return _run_function(ctx, fn, args, kwargs, depth + 1)
-    return fn(*args, **kwargs)
+    out = fn(*args, **kwargs)
+    _record_method_mutation(ctx, fn)
+    return out
+
+
+# container methods that MUTATE their receiver: calling one on TRACKED
+# external state is a trace-time write — the guards captured before it must
+# be re-evaluated (jit_ext._refresh_tainted_guards), same as opcode writes
+_MUTATING_METHODS = frozenset({
+    "append", "extend", "insert", "remove", "clear", "sort", "reverse",
+    "pop", "popitem", "update", "setdefault", "add", "discard",
+    "difference_update", "intersection_update", "symmetric_difference_update",
+    "appendleft", "extendleft", "popleft", "rotate",
+    "__setitem__", "__delitem__", "__iadd__", "__ior__",
+})
+
+
+def _record_method_mutation(ctx: InterpreterCompileCtx, fn) -> None:
+    # bound dunders of builtin containers are MethodWrapperType, not
+    # BuiltinMethodType (type([].__setitem__) is method-wrapper)
+    if not isinstance(fn, (types.BuiltinMethodType, types.MethodType,
+                           types.MethodWrapperType)):
+        return
+    if getattr(fn, "__name__", None) not in _MUTATING_METHODS:
+        return
+    recv = getattr(fn, "__self__", None)
+    base_rec = ctx.prov_of(recv)
+    if base_rec is None:
+        return
+    _add_write(ctx, (base_rec, "method", fn.__name__), f"{base_rec}.{fn.__name__}(...)")
 
 
 def _bind_args(code: types.CodeType, fn: types.FunctionType | None, args: tuple, kwargs: dict) -> dict:
@@ -2204,17 +2235,23 @@ def _record_external_write(frame, obj, kind: str, key) -> None:
     if base_rec is None:
         return
     entry = (base_rec, kind, key if kind == "attr" or _guardable_key(key) else None)
-    if entry in frame.ctx.writes:
-        return  # dedup: one record (and one sharp-edge report) per location
-    frame.ctx.writes.add(entry)
+    _add_write(frame.ctx, entry,
+               f"{base_rec}[{key!r}]" if kind == "item" else f"{base_rec}.{key}")
+
+
+def _add_write(ctx: InterpreterCompileCtx, entry: tuple, desc: str) -> None:
+    """Dedups a trace-time external write and surfaces it once through the
+    sharp-edges policy (shared by opcode writes and mutating methods)."""
+    if entry in ctx.writes:
+        return
+    ctx.writes.add(entry)
     try:
         from thunder_tpu.core.compile_data import get_compile_data
         from thunder_tpu.core.sharp_edges import report_external_write
 
         cd = get_compile_data()
         if cd is not None:
-            report_external_write(cd.sharp_edges, f"{base_rec}[{key!r}]" if kind == "item"
-                                  else f"{base_rec}.{key}")
+            report_external_write(cd.sharp_edges, desc)
     except ImportError:  # pragma: no cover
         pass
 

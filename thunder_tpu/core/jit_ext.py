@@ -174,38 +174,87 @@ def interpret_with_state(fn: Callable, proxy_args: tuple, proxy_kwargs: dict):
     # keeping the pre-write guard would fail the fresh prologue immediately
     # (e.g. the counter-increment pattern COUNTER[0] = COUNTER[0] + 1)
     if _ctx.writes and cap.guards:
-        # pseudo guards that depend on the container's WHOLE population —
-        # any insert/delete invalidates them; keyed membership guards
-        # (absent_item etc.) only die when THEIR key was written
-        population = ("len", "keys", "absent_member", "present_member")
-        keyed = {"absent_item": "item", "present_item": "item",
-                 "absent_attr": "attr", "present_attr": "attr"}
-        for base_rec, kind, key in _ctx.writes:
-            base = base_rec.path()
-            if base is None:
-                continue
-            for path in list(cap.guards):
-                tainted = False
-                if key is not None:
-                    written = base + ((kind, key),)
-                    # the written value (and anything beneath it)
-                    tainted = path[: len(written)] == written
-                    # a keyed membership guard on the same key
-                    if (not tainted and len(path) == len(base) + 1
-                            and path[: len(base)] == base
-                            and keyed.get(path[-1][0]) == kind
-                            and path[-1][1] == key):
-                        tainted = True
-                # population guards die on any write to the container;
-                # an UNGUARDABLE key (non-primitive object) cannot equal a
-                # primitive guard key, so value guards survive those writes
-                if (not tainted and len(path) == len(base) + 1
-                        and path[: len(base)] == base
-                        and path[-1][0] in population):
-                    tainted = True
-                if tainted:
-                    del cap.guards[path]
+        _refresh_tainted_guards(fn, cap, _ctx.writes)
     return result, cap
+
+
+_PSEUDO_GUARD_STEPS = frozenset({
+    "len", "keys", "type_name", "absent_item", "absent_attr", "present_item",
+    "present_attr", "absent_member", "present_member",
+})
+
+
+def _resolve_steps(fn, steps):
+    """Re-reads the CURRENT value at an access path (the Python mirror of
+    the prologue's unpack chain).  Returns (found, value)."""
+    kind, key = steps[0]
+    try:
+        if kind == "globals":
+            obj = fn.__globals__[key]
+        elif kind == "closure":
+            cells = dict(zip(fn.__code__.co_freevars, fn.__closure__ or ()))
+            obj = cells[key].cell_contents
+        elif kind == "gmod":
+            obj = sys.modules[key].__dict__
+        elif kind == "gmodule":
+            obj = sys.modules[key]
+        else:
+            return False, None
+        for kind, key in steps[1:]:
+            obj = getattr(obj, key) if kind == "attr" else obj[key]
+        return True, obj
+    except Exception:
+        return False, None
+
+
+def _refresh_tainted_guards(fn, cap, writes) -> None:
+    """Trace-time writes into tracked containers changed state AFTER the
+    guards were captured, so the captured values would fail their own
+    prologue.  Every guard under a written container is RE-EVALUATED against
+    the post-trace state: value guards update to the current value (keeping
+    sensitivity to LATER external mutations), population/membership guards
+    recompute, and anything no longer readable (or whose observation
+    flipped) is dropped."""
+    bases = set()
+    for base_rec, _kind, _key in writes:
+        base = base_rec.path()
+        if base is not None:
+            bases.add(base)
+    if not bases:
+        return
+    for path in list(cap.guards):
+        if not any(path[: len(b)] == b for b in bases):
+            continue
+        step = path[-1][0]
+        if step in _PSEUDO_GUARD_STEPS:
+            found, container = _resolve_steps(fn, path[:-1])
+            if not found:
+                del cap.guards[path]
+                continue
+            try:
+                if step == "len":
+                    cap.guards[path] = len(container)
+                elif step == "keys":
+                    cap.guards[path] = tuple(container.keys())
+                elif step == "type_name":
+                    cap.guards[path] = (
+                        f"{type(container).__module__}.{type(container).__qualname__}")
+                else:
+                    key = path[-1][1]
+                    if step.endswith("_attr"):
+                        present = hasattr(container, key)
+                    else:
+                        present = key in container
+                    if present != step.startswith("present"):
+                        del cap.guards[path]  # observation flipped
+            except Exception:
+                del cap.guards[path]
+            continue
+        found, value = _resolve_steps(fn, path)
+        if found and _guardable(value):
+            cap.guards[path] = value
+        else:
+            del cap.guards[path]
 
 
 def build_state_prologue(prologue_trace, fn: Callable, cap: StateCapture, dtype_str_fn) -> list[TensorProxy]:

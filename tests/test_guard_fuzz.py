@@ -82,16 +82,32 @@ _MUTATIONS = [
 ]
 
 
+# trace-time WRITES into state the reads do NOT observe (so native
+# re-execution and the compiled replay stay numerically aligned); they still
+# exercise the round-5 write tracking — a pre-refresh guard would fail its
+# own prologue, and the native re-executions force retraces that must stay
+# correct
+_WRITES = [
+    "S['written'] = S.get('written', 0) + 1",
+    "S['aux'] = [1.0]",
+    "S.setdefault('scratch', 5)",
+    "S['flags'].pop('zz', None)",
+]
+
+
 def _make_fn(r: random.Random):
     terms = r.sample(_READS, k=r.randint(2, 4))
+    writes = r.sample(_WRITES, k=r.randint(0, 2))
+    body = "".join(f"    {w}\n" for w in writes)
     expr = " + ".join(terms)
     src = (
         "def f(x):\n"
+        f"{body}"
         f"    return x * ({expr})\n"
     )
     ns = {"S": STATE, "HM": _hm}
     exec(src, ns)  # noqa: S102 - assembled from the fixed read list above
-    return ns["f"], src
+    return ns["f"], src, bool(writes)
 
 
 @pytest.mark.parametrize("seed", range(60))
@@ -100,7 +116,7 @@ def test_guard_fuzz(seed):
     STATE.clear()
     STATE.update(_fresh_state(r))
     _hm.SCALE, _hm.CFG["k"] = 2.0, 3.0  # canonical baseline (mutations leak)
-    fn, src = _make_fn(r)
+    fn, src, has_writes = _make_fn(r)
     jfn = tt.jit(fn, interpretation="bytecode")
     x = np.arange(4, dtype=np.float32) + 1
 
@@ -116,8 +132,12 @@ def test_guard_fuzz(seed):
         r.choice(_MUTATIONS)(r)
         check(f"after mutation {step}")
     # steady state must not retrace forever: two identical calls, second
-    # must be a cache hit
+    # must be a cache hit.  Writing programs are exempt — the NATIVE
+    # re-execution in check() keeps mutating the written keys, so their
+    # guards legitimately retrace each round (and must stay correct, which
+    # the allclose above asserts).
     misses = tt.cache_misses(jfn)
     check("steady-1")
     check("steady-2")
-    assert tt.cache_misses(jfn) == misses, f"seed={seed}: retrace loop\n{src}"
+    if not has_writes:
+        assert tt.cache_misses(jfn) == misses, f"seed={seed}: retrace loop\n{src}"

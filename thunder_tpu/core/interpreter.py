@@ -602,6 +602,15 @@ def _provenance_builtin_call(ctx: "InterpreterCompileCtx", depth: int, fn, args,
             return True, dict.fromkeys(keys).keys()
         snap = dict(zip(keys, _read_dict_values(ctx, d, keys)))
         return True, getattr(snap, fn.__name__)()
+    if fn is __import__ and args:
+        # the functional spelling of import: track the module like the
+        # IMPORT_NAME opcode does, so reads off it guard
+        mod = __import__(*args)
+        if isinstance(mod, types.ModuleType):
+            modname = getattr(mod, "__name__", None)
+            if isinstance(modname, str) and sys.modules.get(modname) is mod:
+                ctx.track(mod, ProvenanceRecord(PseudoInst.MODULE, key=modname))
+        return True, mod
     if fn is isinstance and len(args) == 2:
         from thunder_tpu.core.proxies import Proxy
 

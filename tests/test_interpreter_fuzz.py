@@ -165,6 +165,60 @@ def test_fuzz_generator_program(seed):
         assert native == inter, f"seed={seed} args=({a},{b})\n{src}\nnative={native!r}\ninterp={inter!r}"
 
 
+def _class_program(g: _Gen) -> str:
+    """A program whose core is a random CLASS: __init__ state, a method or
+    property, optional inheritance with super(), operator dunders — the
+    interpreter's class-statement and descriptor machinery under random
+    composition."""
+    r = g.r
+    use_super = r.random() < 0.5
+    use_prop = r.random() < 0.5
+    dunder = r.choice(["__add__", "__mul__"])
+    base = (
+        "    class Base:\n"
+        f"        tag = {r.randint(1, 5)}\n"
+        "        def bump(self, v):\n"
+        f"            return v + self.tag + ({g.expr()})\n"
+    )
+    sup = ("            s = super().bump(v)\n" if use_super
+           else "            s = v\n")
+    prop = ("        @property\n"
+            "        def size(self):\n"
+            "            return self.n * 2\n" if use_prop
+            else "        size = 7\n")
+    return (
+        "def f(a, b):\n"
+        "    c = a ^ b\n"
+        f"{base}"
+        "    class C(Base):\n"
+        f"        def __init__(self, n):\n"
+        "            self.n = n\n"
+        "        def bump(self, v):\n"
+        f"{sup}"
+        f"            return s + ({g.expr()})\n"
+        f"{prop}"
+        f"        def {dunder}(self, o):\n"
+        "            return self.n + o\n"
+        "    obj = C(abs(a) % 5)\n"
+        f"    lifted = obj {'+' if dunder == '__add__' else '*'} b\n"
+        "    sz = obj.size if isinstance(obj.size, int) else -1\n"
+        "    return (obj.bump(b), lifted, sz, C.tag, obj.n, c)\n"
+    )
+
+
+@pytest.mark.parametrize("seed", range(120))
+def test_fuzz_class_program(seed):
+    g = _Gen(seed + 200_000)
+    src = _class_program(g)
+    ns: dict = {}
+    exec(src, ns)  # noqa: S102 - generated from the seeded grammar above
+    fn = ns["f"]
+    for a, b in ((3, 2), (0, 5), (-4, 7)):
+        native = _run(fn, a, b)
+        inter = _run_interp(fn, a, b)
+        assert native == inter, f"seed={seed} args=({a},{b})\n{src}\nnative={native!r}\ninterp={inter!r}"
+
+
 @pytest.mark.parametrize("seed", range(300))
 def test_fuzz_program(seed):
     src = _Gen(seed).program(n_stmts=4)

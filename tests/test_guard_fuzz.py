@@ -19,6 +19,11 @@ import thunder_tpu as tt
 
 import _guard_helper_mod as _hm
 
+import os as _os
+
+# CI default 60 seeds; THUNDER_TPU_FUZZ_SCALE=N multiplies for deep soaks
+_SCALE = max(1, int(_os.environ.get("THUNDER_TPU_FUZZ_SCALE", "1")))
+
 # module-level state the generated programs read (reset per test)
 STATE: dict = {}
 
@@ -110,7 +115,7 @@ def _make_fn(r: random.Random):
     return ns["f"], src, bool(writes)
 
 
-@pytest.mark.parametrize("seed", range(60))
+@pytest.mark.parametrize("seed", range(60 * _SCALE))
 def test_guard_fuzz(seed):
     r = random.Random(seed)
     STATE.clear()

@@ -112,6 +112,12 @@ class _Gen:
 from conftest import diff_interpreted as _run_interp  # noqa: E402
 from conftest import diff_native as _run  # noqa: E402
 
+# CI default seed counts; THUNDER_TPU_FUZZ_SCALE=N multiplies them for
+# deeper offline soaks without code edits
+import os as _os
+
+_SCALE = max(1, int(_os.environ.get("THUNDER_TPU_FUZZ_SCALE", "1")))
+
 
 def _gen_program(g: _Gen) -> str:
     """A program whose core is a random GENERATOR: yields inside loops,
@@ -152,7 +158,7 @@ def _gen_program(g: _Gen) -> str:
     )
 
 
-@pytest.mark.parametrize("seed", range(150))
+@pytest.mark.parametrize("seed", range(150 * _SCALE))
 def test_fuzz_generator_program(seed):
     g = _Gen(seed + 50_000)
     src = _gen_program(g)
@@ -206,7 +212,7 @@ def _class_program(g: _Gen) -> str:
     )
 
 
-@pytest.mark.parametrize("seed", range(120))
+@pytest.mark.parametrize("seed", range(120 * _SCALE))
 def test_fuzz_class_program(seed):
     g = _Gen(seed + 200_000)
     src = _class_program(g)
@@ -219,7 +225,7 @@ def test_fuzz_class_program(seed):
         assert native == inter, f"seed={seed} args=({a},{b})\n{src}\nnative={native!r}\ninterp={inter!r}"
 
 
-@pytest.mark.parametrize("seed", range(300))
+@pytest.mark.parametrize("seed", range(300 * _SCALE))
 def test_fuzz_program(seed):
     src = _Gen(seed).program(n_stmts=4)
     ns: dict = {}

@@ -28,3 +28,10 @@ def diff_interpreted(fn, *args):
         return ("ok", interpret(fn, *args)[0])
     except BaseException as e:
         return ("raise", type(e).__name__, str(e))
+
+
+# fuzz-depth knob shared by the fuzz suites: CI seed counts multiply by
+# THUNDER_TPU_FUZZ_SCALE for deep offline soaks
+import os as _os
+
+FUZZ_SCALE = max(1, int(_os.environ.get("THUNDER_TPU_FUZZ_SCALE", "1")))

@@ -1,0 +1,96 @@
+"""einops interop over traced tensors (reference ``tests/test_einops.py``):
+rearrange / reduce / repeat / einsum on TensorProxy via the registered
+einops backend (``thunder_tpu/einops_support.py``), compared against einops
+on the concrete arrays."""
+import numpy as np
+import pytest
+
+einops = pytest.importorskip("einops")
+
+import thunder_tpu as tt  # noqa: E402
+import thunder_tpu.torch as ltorch  # noqa: E402
+
+rng = np.random.default_rng(7)
+
+
+_REARRANGE_CASES = [
+    ((2, 3, 4, 5), "b c h w -> b (c h w)", {}),
+    ((2, 3, 4), "h w c -> w h c", {}),
+    ((2, 3, 4, 5), "b h w c -> (b h) w c", {}),
+    ((2, 3, 4, 5), "b h w c -> h (b w) c", {}),
+    ((2, 3, 4, 5), "b h w c -> (b h w c)", {}),
+    ((2, 12, 4), "b (h c) w -> b h c w", {"c": 3}),
+    ((12, 2, 3), "(b1 b2) h w -> b1 b2 h w", {"b1": 4}),
+    ((2, 3, 4), "a b c -> c b a", {}),
+]
+
+
+@pytest.mark.parametrize("shape,expr,kw", _REARRANGE_CASES,
+                         ids=[c[1] for c in _REARRANGE_CASES])
+def test_rearrange(shape, expr, kw):
+    x = rng.standard_normal(shape).astype(np.float32)
+    got = np.asarray(tt.jit(lambda a: einops.rearrange(a, expr, **kw))(x))
+    np.testing.assert_allclose(got, einops.rearrange(x, expr, **kw), rtol=1e-6)
+
+
+_REDUCE_CASES = [
+    ("b c h w -> b c", "mean", {}),
+    ("b c h w -> b c", "max", {}),
+    ("b c h w -> b c", "min", {}),
+    ("b c h w -> b", "sum", {}),
+    ("b c h w -> b c h w", "prod", {}),
+    ("b c (h h2) w -> b c h w", "mean", {"h2": 2}),
+]
+
+
+@pytest.mark.parametrize("expr,op,kw", _REDUCE_CASES,
+                         ids=[f"{c[1]}:{c[0]}" for c in _REDUCE_CASES])
+def test_reduce(expr, op, kw):
+    x = rng.standard_normal((2, 3, 4, 5)).astype(np.float32)
+    got = np.asarray(tt.jit(lambda a: einops.reduce(a, expr, op, **kw))(x))
+    np.testing.assert_allclose(got, einops.reduce(x, expr, op, **kw),
+                               rtol=1e-5, atol=1e-6)
+
+
+_REPEAT_CASES = [
+    ("h w -> h w k", {"k": 3}),
+    ("h w -> (h k) w", {"k": 2}),
+    ("h w -> h (w k)", {"k": 4}),
+    ("h w -> k h w", {"k": 2}),
+]
+
+
+@pytest.mark.parametrize("expr,kw", _REPEAT_CASES, ids=[c[0] for c in _REPEAT_CASES])
+def test_repeat(expr, kw):
+    x = rng.standard_normal((3, 4)).astype(np.float32)
+    got = np.asarray(tt.jit(lambda a: einops.repeat(a, expr, **kw))(x))
+    np.testing.assert_allclose(got, einops.repeat(x, expr, **kw), rtol=1e-6)
+
+
+def test_einsum_via_einops():
+    a = rng.standard_normal((3, 4)).astype(np.float32)
+    b = rng.standard_normal((4, 5)).astype(np.float32)
+    got = np.asarray(tt.jit(lambda a, b: einops.einsum(a, b, "i j, j k -> i k"))(a, b))
+    np.testing.assert_allclose(got, a @ b, rtol=1e-4, atol=1e-5)
+
+
+def test_grad_through_einops():
+    x = rng.standard_normal((2, 3, 4)).astype(np.float32)
+
+    def loss(a):
+        y = einops.rearrange(a, "b h w -> b (h w)")
+        m = einops.reduce(a, "b h w -> b", "sum")
+        return ltorch.sum(y * y) + ltorch.sum(m)
+
+    g = np.asarray(tt.grad(loss)(x))
+    np.testing.assert_allclose(g, 2 * x + 1, rtol=1e-5)
+
+
+def test_bytecode_frontend_einops():
+    x = rng.standard_normal((2, 3, 4, 5)).astype(np.float32)
+
+    def f(a):
+        return einops.reduce(a, "b c h w -> b c", "mean")
+
+    got = np.asarray(tt.jit(f, interpretation="bytecode")(x))
+    np.testing.assert_allclose(got, x.mean(axis=(2, 3)), rtol=1e-5, atol=1e-6)

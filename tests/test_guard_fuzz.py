@@ -19,10 +19,7 @@ import thunder_tpu as tt
 
 import _guard_helper_mod as _hm
 
-import os as _os
-
-# CI default 60 seeds; THUNDER_TPU_FUZZ_SCALE=N multiplies for deep soaks
-_SCALE = max(1, int(_os.environ.get("THUNDER_TPU_FUZZ_SCALE", "1")))
+from conftest import FUZZ_SCALE as _SCALE  # noqa: E402
 
 # module-level state the generated programs read (reset per test)
 STATE: dict = {}

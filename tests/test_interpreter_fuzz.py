@@ -112,11 +112,7 @@ class _Gen:
 from conftest import diff_interpreted as _run_interp  # noqa: E402
 from conftest import diff_native as _run  # noqa: E402
 
-# CI default seed counts; THUNDER_TPU_FUZZ_SCALE=N multiplies them for
-# deeper offline soaks without code edits
-import os as _os
-
-_SCALE = max(1, int(_os.environ.get("THUNDER_TPU_FUZZ_SCALE", "1")))
+from conftest import FUZZ_SCALE as _SCALE  # noqa: E402
 
 
 def _gen_program(g: _Gen) -> str:

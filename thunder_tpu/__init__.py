@@ -17,6 +17,14 @@ from typing import Any, Callable, Sequence
 
 from thunder_tpu import clang  # noqa: F401
 from thunder_tpu import numpy  # noqa: F401  (registers the numpy langctx)
+
+# einops interop: registers the TensorProxy backend (PARITY: test_einops).
+# Gated on the PACKAGE being present — a broken interop module must raise,
+# not silently leave proxies unknown to einops
+import importlib.util as _ilu
+
+if _ilu.find_spec("einops") is not None:
+    from thunder_tpu import einops_support  # noqa: F401
 from thunder_tpu import torch as ltorch  # noqa: F401  (registers the torch langctx)
 
 # top-level dtype aliases (reference thunder/__init__.py exports these):

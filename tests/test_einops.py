@@ -94,3 +94,16 @@ def test_bytecode_frontend_einops():
 
     got = np.asarray(tt.jit(f, interpretation="bytecode")(x))
     np.testing.assert_allclose(got, x.mean(axis=(2, 3)), rtol=1e-5, atol=1e-6)
+
+
+def test_pack_unpack():
+    a = rng.standard_normal((3, 4)).astype(np.float32)
+    b = rng.standard_normal((3, 5)).astype(np.float32)
+
+    def f(a, b):
+        packed, ps = einops.pack([a, b], "i *")
+        x, y = einops.unpack(packed, ps, "i *")
+        return ltorch.sum(packed) + ltorch.sum(x - a) + ltorch.sum(y - b)
+
+    got = float(np.asarray(tt.jit(f)(a, b)))
+    np.testing.assert_allclose(got, np.concatenate([a, b], 1).sum(), rtol=1e-5)

@@ -471,3 +471,102 @@ def test_noncontiguous_tensor_runs_keep_rewrite_hint():
 
     with pytest.raises(NotImplementedError, match="take/gather"):
         tt.jit(f)(x, i1, i2)
+
+
+class TestInplaceMethods:
+    """torch's in-place method family (t.add_ / mul_ / clamp_ / ...),
+    functionalized via proxy rebinding (reference: thunder's in-place
+    functionalization) — the variable updates, the trace stays SSA."""
+
+    def test_inplace_family_numerics(self):
+        import numpy as np
+
+        import thunder_tpu as tt
+        import thunder_tpu.torch as lt
+
+        def f(x):
+            y = lt.mul(x, 2.0)
+            y.add_(1.0)
+            y.mul_(3.0)
+            y.clamp_(-5.0, 50.0)
+            z = lt.zeros_like(x)
+            z.copy_(y)
+            z.div_(2.0)
+            w = lt.mul(x, 0.0)
+            w.fill_(7.0)
+            m = lt.mul(x, 1.0)
+            m.masked_fill_(lt.lt(m, 0.0), 9.0)
+            n = lt.mul(x, 1.0)
+            n.zero_()
+            return lt.relu(y) + z + w + m + n
+
+        x = np.array([-1.0, 2.0], np.float32)
+        got = np.asarray(tt.jit(f)(x))
+        y = np.clip((x * 2 + 1) * 3, -5, 50)
+        m = np.where(x < 0, 9.0, x)
+        want = np.maximum(y, 0) + y / 2 + 7.0 + m + 0.0
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_grad_through_inplace(self):
+        import numpy as np
+
+        import thunder_tpu as tt
+        import thunder_tpu.torch as lt
+
+        def loss(x):
+            y = lt.mul(x, 2.0)
+            y.add_(1.0)
+            return lt.sum(y * 3.0)
+
+        g = np.asarray(tt.grad(loss)(np.array([-1.0, 2.0], np.float32)))
+        np.testing.assert_allclose(g, [6.0, 6.0], rtol=1e-6)
+
+    def test_inplace_shape_change_rejected(self):
+        import numpy as np
+        import pytest
+
+        import thunder_tpu as tt
+
+        def f(a, b):
+            return a.add_(b)
+
+        with pytest.raises(RuntimeError, match="in-place result shape"):
+            tt.jit(f)(np.ones((2,), np.float32), np.ones((3, 2), np.float32))
+
+    def test_overwrite_semantics_with_inf_residents(self):
+        """zero_/fill_/copy_ are unconditional overwrites: inf/NaN already
+        in the receiver must not leak through (a mul-by-zero formulation
+        would produce NaN)."""
+        import numpy as np
+
+        import thunder_tpu as tt
+        import thunder_tpu.torch as lt
+
+        def f(x):
+            y = lt.true_divide(x, lt.mul(x, 0.0))  # inf residents
+            y.zero_()
+            z = lt.true_divide(x, lt.mul(x, 0.0))
+            z.fill_(7.0)
+            w = lt.true_divide(x, lt.mul(x, 0.0))
+            w.copy_(lt.mul(x, 2.0))
+            return y + z + w
+
+        x = np.array([1.0, 2.0], np.float32)
+        np.testing.assert_allclose(np.asarray(tt.jit(f)(x)), 7.0 + 2 * x, rtol=1e-6)
+
+    def test_inplace_dtype_contract(self):
+        """torch's in-place dtype rule: a promoting result can't be stored
+        into the receiver."""
+        import numpy as np
+        import pytest
+
+        import thunder_tpu as tt
+        import thunder_tpu.torch as lt
+
+        def f(x):
+            c = lt.to(x, lt.int32)
+            c.add_(1.5)
+            return c
+
+        with pytest.raises(RuntimeError, match="can't be stored in-place"):
+            tt.jit(f)(np.ones(2, np.float32))

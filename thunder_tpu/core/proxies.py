@@ -827,20 +827,14 @@ class TensorProxy(Proxy, TensorProxyInterface):
             raise NotImplementedError("No getitem in the active language context")
         return method(self, key)
 
-    def __setitem__(self, key, value):
-        """In-place indexed assignment under functional tracing (torch's
-        ``a[k] = v`` contract): record the functional update, then REBIND
-        this Python object to the result.  Bound symbols hold proxy OBJECTS
-        and resolve names late, so everything already recorded against this
-        object is first re-pointed at a same-named snapshot of the old
-        value — after that, every later use of this object reads the updated
-        value while the history keeps the old one."""
+    def _rebind_to(self, new: "TensorProxy") -> "TensorProxy":
+        """Functionalized in-place semantics: everything already recorded
+        against this object is re-pointed at a same-named snapshot of the
+        old value, then this Python object REBINDS to ``new`` — every later
+        use reads the updated value while the history keeps the old one
+        (the reference's in-place functionalization)."""
         from thunder_tpu.core.trace import get_tracectx
 
-        method = resolve_method("setitem", self, key, value)
-        if method is None:
-            raise NotImplementedError("No setitem in the active language context")
-        new = method(self, key, value)
         trace = get_tracectx()
         if trace is not None:
             import copy as _copy
@@ -852,6 +846,98 @@ class TensorProxy(Proxy, TensorProxyInterface):
             scope = trace.peek_scope()
             scope[:] = [b.from_bsym_swap_proxies(swap) for b in scope]
         self._name = new._name
+        return self
+
+    def _inplace(self, method_name: str, *args, label: str | None = None, **kwargs) -> "TensorProxy":
+        """torch's ``t.op_(...)`` contract: compute the out-of-place result
+        and rebind.  In-place ops must not change the receiver's shape OR
+        dtype (torch raises on promoting in-place results)."""
+        label = label or f"{method_name}_"
+        method = resolve_method(method_name, self, *args, **kwargs)
+        if method is None:
+            raise NotImplementedError(
+                f"No method {method_name!r} in the active language context")
+        new = method(self, *args, **kwargs)
+        if tuple(new.shape) != tuple(self.shape):
+            raise RuntimeError(
+                f"{label}: in-place result shape {tuple(new.shape)} "
+                f"differs from the receiver's {tuple(self.shape)}")
+        if new.dtype != self.dtype:
+            raise RuntimeError(
+                f"{label}: result type {new.dtype} can't be stored in-place "
+                f"into a {self.dtype} tensor (torch in-place dtype contract)")
+        return self._rebind_to(new)
+
+    # the common in-place method family (torch parity): functionalized via
+    # _inplace — the variable updates, the trace stays SSA
+    def add_(self, other, *, alpha=None):
+        return self._inplace("add", other, alpha=alpha)
+
+    def sub_(self, other):
+        return self._inplace("sub", other)
+
+    def mul_(self, other):
+        return self._inplace("mul", other)
+
+    def div_(self, other):
+        return self._inplace("true_divide", other, label="div_")
+
+    def pow_(self, other):
+        return self._inplace("pow", other)
+
+    def clamp_(self, min=None, max=None):
+        return self._inplace("clamp", min, max)
+
+    def clamp_min_(self, min):
+        return self._inplace("clamp_min", min)
+
+    def clamp_max_(self, max):
+        return self._inplace("clamp_max", max)
+
+    def masked_fill_(self, mask, value):
+        return self._inplace("masked_fill", mask, value)
+
+    def relu_(self):
+        return self._inplace("relu")
+
+    def neg_(self):
+        return self._inplace("neg")
+
+    def exp_(self):
+        return self._inplace("exp")
+
+    def zero_(self):
+        # unconditional overwrite — a mul-by-zero formulation would turn
+        # inf/NaN residents into NaN (IEEE mul(inf, 0))
+        from thunder_tpu import clang
+
+        return self._rebind_to(clang.zeros_like(self))
+
+    def fill_(self, value):
+        from thunder_tpu import clang
+
+        return self._rebind_to(clang.full_like(self, value))
+
+    def copy_(self, src):
+        # value copy with broadcast into the receiver's shape; the receiver
+        # contributes only its shape/dtype, never its values
+        from thunder_tpu import clang
+
+        new = resolve_method("add", clang.zeros_like(self), src)(clang.zeros_like(self), src)
+        if tuple(new.shape) != tuple(self.shape):
+            raise RuntimeError(
+                f"copy_: source broadcasts to {tuple(new.shape)}, receiver is {tuple(self.shape)}")
+        new = resolve_method("to", new, self.dtype)(new, self.dtype)
+        return self._rebind_to(new)
+
+    def __setitem__(self, key, value):
+        """In-place indexed assignment under functional tracing (torch's
+        ``a[k] = v`` contract): record the functional update, then REBIND
+        this Python object to the result (see ``_rebind_to``)."""
+        method = resolve_method("setitem", self, key, value)
+        if method is None:
+            raise NotImplementedError("No setitem in the active language context")
+        self._rebind_to(method(self, key, value))
 
     def __len__(self):
         check(self.ndim > 0, lambda: "len() of a 0-d tensor")

@@ -873,8 +873,8 @@ class TensorProxy(Proxy, TensorProxyInterface):
     def add_(self, other, *, alpha=None):
         return self._inplace("add", other, alpha=alpha)
 
-    def sub_(self, other):
-        return self._inplace("sub", other)
+    def sub_(self, other, *, alpha=None):
+        return self._inplace("sub", other, alpha=alpha)
 
     def mul_(self, other):
         return self._inplace("mul", other)

@@ -2250,3 +2250,27 @@ class TestCrossModuleGuards:
             assert any("write to external state" in str(i.message) for i in w)
         finally:
             del MOD.TT_WRITE_TEST_STATE
+
+    def test_globals_builtin_guards(self):
+        """globals()['x'] — the functional spelling of a global read — must
+        guard like LOAD_GLOBAL: mutation retraces, misses via .get guard
+        absence."""
+        MOD = sys.modules[__name__]
+        MOD.TT_GDICT_SCALE = 2.0
+        try:
+            def f(x):
+                return x * globals()["TT_GDICT_SCALE"] + globals().get("TT_GDICT_OFF", 0.0)
+
+            x = rng.standard_normal((4,)).astype(np.float32)
+            jfn = tt.jit(f, interpretation="bytecode")
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 2.0, rtol=1e-6)
+            MOD.TT_GDICT_SCALE = 5.0
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 5.0, rtol=1e-6)
+            assert tt.cache_misses(jfn) == 2
+            MOD.TT_GDICT_OFF = 1.5
+            np.testing.assert_allclose(np.asarray(jfn(x)), x * 5.0 + 1.5, rtol=1e-6)
+            assert tt.cache_misses(jfn) == 3
+        finally:
+            del MOD.TT_GDICT_SCALE
+            if hasattr(MOD, "TT_GDICT_OFF"):
+                del MOD.TT_GDICT_OFF

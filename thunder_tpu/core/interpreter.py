@@ -65,6 +65,7 @@ class PseudoInst(Enum):
     KEYS = auto()  # dict key tuple observed (iteration / keys()/items())
     TYPE_NAME = auto()  # object class observed via isinstance()
     MODULE = auto()  # a module object (in-function import), root = sys.modules
+    GLOBALS_DICT = auto()  # a frame's globals dict via globals()
     CONSTANT = auto()
     OPAQUE = auto()
 
@@ -141,6 +142,10 @@ class ProvenanceRecord:
             # resolves to the module OBJECT (sys.modules[name]) so attr
             # steps use real getattr — PEP 562 module __getattr__ included
             return (("gmodule", self.key),)
+        if self.inst is PseudoInst.GLOBALS_DICT:
+            # root frame (key None): the prologue's own globals root;
+            # helper frames: the module-qualified dict root
+            return (("gdict", None),) if self.key is None else (("gmod", self.key),)
         return None
 
 
@@ -707,6 +712,12 @@ def _record_method_mutation(ctx: InterpreterCompileCtx, fn) -> None:
     base_rec = ctx.prov_of(recv)
     if base_rec is None:
         return
+    if _is_module_globals(ctx, recv):
+        raise InterpreterError(
+            f"mutating module globals via globals().{fn.__name__}(...) during "
+            f"tracing is not supported (the store would not replay on cache "
+            f"hits); return the value or pass state explicitly"
+        )
     _add_write(ctx, (base_rec, "method", fn.__name__), f"{base_rec}.{fn.__name__}(...)")
 
 
@@ -1314,6 +1325,25 @@ def _load_name(frame, ins, i):
         frame.push(frame.builtins_[name])
     else:
         raise NameError(f"name {name!r} is not defined")
+
+
+def _tracked_frame_globals(frame) -> dict:
+    """globals() inside interpreted code: returns the real frame globals,
+    TRACKED so item reads off it guard.  Root-frame globals root at the
+    prologue's own globals dict; helper frames use the module-qualified
+    root; un-relocatable namespaces return untracked (reads bake, as
+    before)."""
+    g = frame.globals_
+    ctx = frame.ctx
+    if ctx.prov_of(g) is None:
+        if g is ctx.root_globals:
+            ctx.track(g, ProvenanceRecord(PseudoInst.GLOBALS_DICT))
+        else:
+            modname = g.get("__name__")
+            if (isinstance(modname, str)
+                    and getattr(sys.modules.get(modname), "__dict__", None) is g):
+                ctx.track(g, ProvenanceRecord(PseudoInst.GLOBALS_DICT, key=modname))
+    return g
 
 
 def _global_record(frame, name: str) -> "ProvenanceRecord | None":
@@ -2043,6 +2073,12 @@ def _call(frame, ins, i):
         kw_vals = args[len(args) - n_kw :]
         args = args[: len(args) - n_kw]
         kwargs = dict(zip(kw, kw_vals))
+    if fn is globals and not args and not kwargs:
+        # the calling FRAME's globals dict, tracked so reads off it guard
+        # exactly like direct LOAD_GLOBALs (globals()['x'] is just the
+        # functional spelling)
+        frame.push(_tracked_frame_globals(frame))
+        return
     frame.push(_call_value(frame.ctx, frame.depth, fn, tuple(args), kwargs))
 
 
@@ -2053,6 +2089,9 @@ def _call_function_ex(frame, ins, i):
     fn = frame.pop()
     if frame.stack and frame.stack[-1] is _NULL:
         frame.pop()  # NULL slot
+    if fn is globals and not args and not kwargs:
+        frame.push(_tracked_frame_globals(frame))
+        return
     frame.push(_call_value(frame.ctx, frame.depth, fn, tuple(args), dict(kwargs)))
 
 
@@ -2235,14 +2274,33 @@ def _import_from(frame, ins, i):
     frame.push(v)
 
 
+def _is_module_globals(ctx, obj) -> bool:
+    if not isinstance(obj, dict):
+        return False
+    if obj is ctx.root_globals:
+        return True
+    modname = obj.get("__name__")
+    return (isinstance(modname, str)
+            and getattr(sys.modules.get(modname), "__dict__", None) is obj)
+
+
 def _record_external_write(frame, obj, kind: str, key) -> None:
     """A write into TRACKED external state happens once, at trace time (like
     any Python side effect under constant-values caching) — record it so the
     general jit drops the read guards it supersedes, and surface it through
-    the sharp-edges policy."""
+    the sharp-edges policy.  Writes THROUGH a module-globals dict (reached
+    via globals()/module __dict__) are refused outright, matching
+    STORE_GLOBAL's contract — the functional spelling must not be a
+    loophole."""
     base_rec = frame.ctx.prov_of(obj)
     if base_rec is None:
         return
+    if _is_module_globals(frame.ctx, obj):
+        raise InterpreterError(
+            f"writing the global {key!r} during tracing is not supported "
+            f"(the store would not replay on cache hits); return the value or "
+            f"pass state explicitly"
+        )
     entry = (base_rec, kind, key if kind == "attr" or _guardable_key(key) else None)
     _add_write(frame.ctx, entry,
                f"{base_rec}[{key!r}]" if kind == "item" else f"{base_rec}.{key}")

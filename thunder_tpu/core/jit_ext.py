@@ -198,6 +198,8 @@ def _resolve_steps(fn, steps):
             obj = sys.modules[key].__dict__
         elif kind == "gmodule":
             obj = sys.modules[key]
+        elif kind == "gdict":
+            obj = fn.__globals__
         else:
             return False, None
         for kind, key in steps[1:]:
@@ -295,6 +297,12 @@ def build_state_prologue(prologue_trace, fn: Callable, cap: StateCapture, dtype_
         if out_proxy is None and path in unpacked:
             return unpacked[path]
         kind, key = path[-1]
+        if kind == "gdict":
+            # globals() root: the path's collection IS the globals dict
+            out = root_coll("globals")
+            if out_proxy is None:
+                unpacked[path] = out
+            return out
         if kind in ("globals", "closure", "gmod", "gmodule"):
             coll = root_coll(kind)
             if kind == "closure":

@@ -75,6 +75,23 @@ class TestHarnessTargets:
         for name, r in results.items():
             assert r["thunder_ms"] > 0 and r["jax_ms"] > 0, (name, r)
 
+    def test_dispatch_overhead_bench_cpu(self):
+        """The dispatch-overhead microbench (µs/call vs cached
+        specializations) must run and report — no perf gate, but the
+        counters must show the timed loop dispatching through the keyed
+        tier (key hits, no scan blowup)."""
+        from thunder_tpu.benchmarks.dispatch import dispatch_overhead_bench
+
+        # CI-affordable sizes: the suite is wall-clock-budgeted, so the full
+        # 1/8/64 curve is the `bench.py dispatch` artifact's job, not CI's
+        r = dispatch_overhead_bench(spec_counts=(1, 8), iters=20)
+        assert set(r) == {"1", "8"}
+        for n, row in r.items():
+            assert row["us_per_call"] > 0, (n, row)
+            assert row["cached_specializations"] == int(n), (n, row)
+            assert row["key_hits"] >= 20, (n, row)  # the timed loop itself
+            assert row["scan_hits"] == 0 and row["guard_evictions"] == 0, (n, row)
+
     def test_dist_throughput_smoke(self):
         results = bench.dist_throughput_smoke()
         assert results and all(v > 0 for v in results.values())

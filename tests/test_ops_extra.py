@@ -554,6 +554,26 @@ class TestInplaceMethods:
         x = np.array([1.0, 2.0], np.float32)
         np.testing.assert_allclose(np.asarray(tt.jit(f)(x)), 7.0 + 2 * x, rtol=1e-6)
 
+    def test_copy_emits_single_zeros(self):
+        """copy_ binds its zeros_like receiver once: resolve_method and the
+        call share the same operand, so no dead zeros op rides into the
+        trace for DCE to clean up."""
+        import numpy as np
+
+        import thunder_tpu as tt
+
+        def f(a, b):
+            return a.copy_(b)
+
+        jfn = tt.jit(f)
+        jfn(np.zeros((3,), np.float32), np.ones((3,), np.float32))
+        pre_dce = tt.last_traces(jfn)[0]
+        fulls = [
+            bs for bs in pre_dce.bound_symbols
+            if "full" in str(getattr(bs.sym, "name", "")) or "zeros" in str(getattr(bs.sym, "name", ""))
+        ]
+        assert len(fulls) == 1, [str(getattr(b.sym, "name", "")) for b in fulls]
+
     def test_inplace_dtype_contract(self):
         """torch's in-place dtype rule: a promoting result can't be stored
         into the receiver."""

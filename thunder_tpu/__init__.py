@@ -44,6 +44,7 @@ from thunder_tpu.core.dtypes import (  # noqa: F401
     uint8,
 )
 from thunder_tpu.common import CacheEntry, CompileData, CompileStats
+from thunder_tpu.core import cache_key as _cache_key
 from thunder_tpu.core import dtypes, prims
 from thunder_tpu.core.baseutils import check
 from thunder_tpu.core.compile_data import compile_data_and_stats
@@ -81,6 +82,7 @@ __all__ = [
     "cache_option",
     "cache_hits",
     "cache_misses",
+    "dispatch_stats",
     "last_compile_options",
     "dtypes",
 ]
@@ -95,6 +97,7 @@ def jit(
     sharp_edges: str | SHARP_EDGES_OPTIONS | None = None,
     transforms: Sequence | None = None,
     disable_grad: bool = False,
+    max_cached_specializations: int | None = 512,
     **compile_options,
 ) -> Callable:
     """Compiles ``fn``: traces it into a thunder_tpu program, applies
@@ -151,6 +154,7 @@ def jit(
             sharp_edges=sharp_edges,
             transforms=transforms,
             disable_grad=disable_grad,
+            max_cached_specializations=max_cached_specializations,
             **compile_options,
         )
 
@@ -176,6 +180,7 @@ def jit(
         transforms=transforms,
         disable_grad=disable_grad,
         compile_options=compile_options,
+        max_cached_specializations=max_cached_specializations,
     )
     cs = CompileStats()
 
@@ -203,27 +208,81 @@ def jit(
                 "Python function, e.g. tt.grad(lambda x: original_fn(x))"
             )
         cs.calls += 1
-        cs.last_trace_host_start = time.perf_counter_ns()
+        dispatch_start = time.perf_counter_ns()
+        cs.last_trace_host_start = dispatch_start
 
+        # Two-tier dispatch.  Tier 1: one structural key computation + one
+        # hash-map lookup selects the candidate bucket (vs the O(entries)
+        # try-every-prologue scan this replaces).  Tier 2: the candidate's
+        # prologue runs ONCE for exact guard validation — external-state
+        # guards (globals/closures from the bytecode frontend) can't be
+        # keyed.  A prologue failure after a key match shadows the entry
+        # (demoted behind fresher same-key entries) instead of falling
+        # through to a full rescan.
         cache_entry = None
+        key = None
         inps = None
         if cd.cache_option is not CACHE_OPTIONS.NO_CACHING:
-            for entry in cs.interpreter_cache:
-                try:
-                    inps = entry.prologue_fn(*args, **kwargs)
-                except Exception:
-                    continue
-                cache_entry = entry
+            key = _cache_key.compute_cache_key(
+                args, kwargs,
+                symbolic=cd.cache_option is CACHE_OPTIONS.SYMBOLIC_VALUES,
+            )
+            cs.key_computations += 1
+            if key is not None:
+                bucket = cs.dispatch_cache.get(key)
+                if bucket:
+                    for idx, entry in enumerate(tuple(bucket)):
+                        cs.prologue_runs += 1
+                        try:
+                            inps = entry.prologue_fn(*args, **kwargs)
+                        except Exception:
+                            # guard failure after a key match: external state
+                            # changed since this entry was traced — shadow it
+                            # (the recompile lands in front; reverting the
+                            # state later re-finds it via the bucket scan)
+                            cs.guard_evictions += 1
+                            bucket.remove(entry)
+                            bucket.append(entry)
+                            continue
+                        cache_entry = entry
+                        if idx == 0:
+                            cs.key_hits += 1
+                        else:
+                            cs.scan_hits += 1
+                            bucket.remove(entry)
+                            bucket.insert(0, entry)
+                        break
+            else:
+                # unkeyable inputs (unhashable pytree aux, exotic leaves):
+                # the legacy linear prologue scan, correct but O(entries)
+                for entry in cs.interpreter_cache:
+                    cs.prologue_runs += 1
+                    try:
+                        inps = entry.prologue_fn(*args, **kwargs)
+                    except Exception:
+                        continue
+                    cache_entry = entry
+                    cs.scan_hits += 1
+                    break
+            if cache_entry is not None:
                 cs.cache_hits += 1
-                break
+                cache_entry.last_used = cs.calls
 
         if cache_entry is None:
             cs.cache_misses += 1
             with compile_data_and_stats(cd, cs):
                 cache_entry = _compile(cd, cs, args, kwargs)
             if cd.cache_option is not CACHE_OPTIONS.NO_CACHING:
+                cache_entry.cache_key = key
+                cache_entry.last_used = cs.calls
                 cs.interpreter_cache.append(cache_entry)
+                if key is not None:
+                    cs.dispatch_cache.setdefault(key, []).insert(0, cache_entry)
+                _evict_lru(cd, cs)
+            cs.prologue_runs += 1
             inps = cache_entry.prologue_fn(*args, **kwargs)
+        cs.last_dispatch_ns = time.perf_counter_ns() - dispatch_start
+        cs.dispatch_ns += cs.last_dispatch_ns
 
         if cache_entry.uses_rng:
             from thunder_tpu.core import rng
@@ -282,6 +341,24 @@ def jit(
     fn_.__wrapped__ = fn
     fn_.__name__ = getattr(fn, "__name__", "fn") + "_compiled"
     return fn_
+
+
+def _evict_lru(cd: CompileData, cs: CompileStats) -> None:
+    """Enforces the specialization bound: least-recently-validated entries are
+    dropped from both cache views.  Runs at insert time only (compile cost
+    already dominates), so the hot dispatch path never pays for it."""
+    bound = cd.max_cached_specializations
+    if not bound:
+        return
+    while len(cs.interpreter_cache) > bound:
+        victim = min(cs.interpreter_cache, key=lambda e: e.last_used)
+        cs.interpreter_cache.remove(victim)
+        bucket = cs.dispatch_cache.get(victim.cache_key)
+        if bucket is not None and victim in bucket:
+            bucket.remove(victim)
+            if not bucket:
+                del cs.dispatch_cache[victim.cache_key]
+        cs.lru_evictions += 1
 
 
 def _compile(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict) -> CacheEntry:
@@ -409,6 +486,13 @@ def _compile(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict) -> Ca
     entry.return_spec = grad_postprocess
     entry.vjp_mode = vjp_mode
     entry.ct_positions = ct_positions
+    # trace-time key emission (functional.py builds it next to the prologue):
+    # the key function + metadata ride on the entry for introspection; the
+    # dispatcher files the entry under the key it computed for this call
+    key_meta = trace_results.cache_key_meta or {}
+    entry.cache_key_fn = key_meta.get("cache_key_fn")
+    entry.key_meta = key_meta
+    entry.has_state_guards = key_meta.get("state") is not None
     return entry
 
 
@@ -543,6 +627,25 @@ def cache_hits(cfn) -> int:
 
 def cache_misses(cfn) -> int:
     return _get_cs(cfn).cache_misses
+
+
+def dispatch_stats(cfn) -> dict:
+    """Two-tier dispatch counters: ``key_hits`` (O(1) hash-map hit, first
+    bucket entry validated), ``scan_hits`` (shadowed-bucket or legacy linear
+    scan), ``guard_evictions`` (prologue failed after a key match — external
+    state changed), ``lru_evictions``, plus per-call dispatch timing."""
+    cs = _get_cs(cfn)
+    return {
+        "key_hits": cs.key_hits,
+        "scan_hits": cs.scan_hits,
+        "guard_evictions": cs.guard_evictions,
+        "lru_evictions": cs.lru_evictions,
+        "key_computations": cs.key_computations,
+        "prologue_runs": cs.prologue_runs,
+        "cached_specializations": len(cs.interpreter_cache),
+        "last_dispatch_ns": cs.last_dispatch_ns,
+        "dispatch_ns": cs.dispatch_ns,
+    }
 
 
 def last_compile_options(cfn) -> dict:

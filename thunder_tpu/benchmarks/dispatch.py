@@ -1,0 +1,45 @@
+"""Dispatch-overhead microbenchmark: µs/call vs number of cached
+specializations.
+
+The quantity bench.py's headline cannot see: the HOST cost of re-entering an
+already-compiled function.  With the linear prologue scan this grew
+O(entries) (every cached specialization's prologue ran — and raised — until
+one matched); the two-tier keyed cache makes it one key computation + one
+dict lookup + one prologue run, so the curve over 1 → 8 → 64 specializations
+should be roughly flat.  Host-side measurement only (``host_us_per_call``) —
+the tiny computation exists to make the call real, not to be timed.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from thunder_tpu.benchmarks.timing import host_us_per_call
+
+__all__ = ["dispatch_overhead_bench"]
+
+_COUNTERS = ("key_hits", "scan_hits", "guard_evictions", "prologue_runs", "key_computations")
+
+
+def dispatch_overhead_bench(spec_counts: tuple = (1, 8, 64), iters: int = 200) -> dict:
+    """For each N in ``spec_counts``: build a fresh jitted function, populate
+    N specializations (distinct baked static scalars under CONSTANT_VALUES),
+    then measure µs/call of a repeat call against the LAST-compiled
+    specialization — the linear scan's worst case, the keyed cache's common
+    case.  Returns ``{str(N): {"us_per_call": ..., <dispatch counters>}}``."""
+    import thunder_tpu as tt
+
+    x = np.ones((8,), dtype=np.float32)
+    results: dict = {}
+    for n in spec_counts:
+        jfn = tt.jit(lambda a, k: a + float(k))
+        for k in range(n):
+            jfn(x, k)  # each distinct k bakes a new specialization
+        target = n - 1
+        us = host_us_per_call(jfn, x, target, iters=iters)
+        stats = tt.dispatch_stats(jfn)
+        results[str(n)] = {
+            "us_per_call": round(us, 3),
+            "cached_specializations": stats["cached_specializations"],
+            **{c: stats[c] for c in _COUNTERS},
+        }
+    return results

@@ -15,7 +15,7 @@ import time
 import jax
 import jax.numpy as jnp
 
-__all__ = ["sync", "fetch_floor", "time_fn", "best_ms", "reset_floor"]
+__all__ = ["sync", "fetch_floor", "time_fn", "best_ms", "reset_floor", "host_us_per_call"]
 
 _FETCH_FLOOR: float | None = None
 
@@ -78,6 +78,18 @@ def time_fn(fn, *args, iters: int = 20) -> float:
             return float("nan")
         per = max(dt / iters, 1e-9)
     return per
+
+
+def host_us_per_call(fn, *args, iters: int = 200) -> float:
+    """Mean host-side wall time per call in µs.  For dispatch-overhead
+    measurements, where the cost under test is the HOST work before the
+    program launches (key computation, prologue guards, framework plumbing)
+    — no device fence, so use ``time_fn`` for anything device-dominated."""
+    fn(*args)  # warm: compile/caches populated outside the timed loop
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        fn(*args)
+    return (time.perf_counter() - t0) / iters * 1e6
 
 
 def best_ms(fn, *args, reps: int = 3) -> float:

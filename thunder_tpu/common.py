@@ -23,6 +23,21 @@ class CompileStats:
         self.cache_hits: int = 0
         self.cache_misses: int = 0
 
+        # two-tier dispatch counters: key_hits = resolved by the O(1) hash
+        # lookup (first entry of the key's bucket validated); scan_hits =
+        # resolved by scanning shadowed bucket entries or the legacy linear
+        # fallback; guard_evictions = prologue failures AFTER a key match
+        # (external state changed → the entry is shadowed behind fresher
+        # ones); lru_evictions = specializations dropped by the LRU bound
+        self.key_hits: int = 0
+        self.scan_hits: int = 0
+        self.guard_evictions: int = 0
+        self.lru_evictions: int = 0
+        self.key_computations: int = 0
+        self.prologue_runs: int = 0
+        self.last_dispatch_ns: int = -1
+        self.dispatch_ns: int = 0
+
         self.last_trace_host_start: int = -1
         self.last_trace_host_stop: int = -1
         self.last_trace_tracing_start: int = -1
@@ -39,7 +54,12 @@ class CompileStats:
         self.last_compile_reasons: dict[str, str] = {}
         self.used_compile_options: dict[str, Any] = {}
 
+        # live entries in insertion order (introspection + the legacy linear
+        # fallback for unkeyable inputs); the hash-map view below is the hot
+        # dispatch path: structural key → bucket of entries, most recently
+        # validated first (shadowed entries with the same key sit behind)
         self.interpreter_cache: list[CacheEntry] = []
+        self.dispatch_cache: dict[Any, list[CacheEntry]] = {}
 
     @property
     def persistent_cache(self) -> dict:
@@ -64,6 +84,7 @@ class CompileData:
         transforms: Sequence | None = None,
         disable_grad: bool = False,
         compile_options: dict | None = None,
+        max_cached_specializations: int | None = 512,
     ):
         self.fn = fn
         self.executors_list = tuple(executors_list)
@@ -72,16 +93,27 @@ class CompileData:
         self.transforms = list(transforms or [])
         self.disable_grad = disable_grad
         self.compile_options = dict(compile_options or {})
+        # LRU bound on cached specializations (None/0 = unbounded): a served
+        # model with many shape/value variants stays O(1) in dispatch AND
+        # bounded in retained traces/compiled programs
+        self.max_cached_specializations = max_cached_specializations
 
         self.is_module = False
         self.process_group = None
 
 
-@dataclass
+@dataclass(eq=False)  # identity semantics: entries live in lists/buckets
 class CacheEntry:
     """A (prologue, computation[, backward]) triple; the prologue doubles as the
     cache guard — if it raises, the entry does not apply (reference
-    __init__.py:418-491)."""
+    __init__.py:418-491).
+
+    Tier-1 dispatch metadata: ``cache_key`` is the structural key the entry is
+    filed under in ``CompileStats.dispatch_cache`` (None = unkeyable inputs,
+    legacy linear scan only); ``cache_key_fn`` recomputes that key from raw
+    ``(args, kwargs)`` (emitted at trace time alongside the prologue);
+    ``key_meta`` records why tier 2 is still required (external-state guards
+    can't be keyed); ``last_used`` drives the LRU bound."""
 
     prologue_fn: Callable
     computation_fn: Callable
@@ -93,3 +125,8 @@ class CacheEntry:
     uses_rng: bool
     return_spec: Any = None
     epilogue_fn: Callable | None = None
+    cache_key: Any = None
+    cache_key_fn: Callable | None = None
+    key_meta: Any = None
+    has_state_guards: bool = False
+    last_used: int = 0

@@ -1731,11 +1731,25 @@ def _binary_slice(frame, ins, i):
 
 @register_opcode_handler("STORE_SLICE")
 def _store_slice(frame, ins, i):
+    from thunder_tpu.core.proxies import Proxy
+
     end = frame.pop()
     start = frame.pop()
     obj = frame.pop()
     v = frame.pop()
+    if frame.ctx.prov_of(obj) is not None and (
+        isinstance(v, Proxy)
+        or (isinstance(v, (list, tuple)) and any(isinstance(e, Proxy) for e in v))
+    ):
+        raise InterpreterError(
+            f"storing a traced tensor into external state ({frame.ctx.prov_of(obj)}[{start!r}:{end!r}]) "
+            f"is not supported; pass the state as an explicit argument (epilogue handles those)"
+        )
     obj[slice(start, end)] = v
+    # key=None: a slice write can touch any range of the container, so every
+    # guard under it must re-evaluate (same contract as STORE_SUBSCR with an
+    # unguardable key); after the assignment — a failed write is no write
+    _record_external_write(frame, obj, "item", None)
 
 
 @register_opcode_handler("BUILD_SLICE")
@@ -1751,11 +1765,40 @@ def _build_slice(frame, ins, i):
         frame.push(slice(start, stop))
 
 
+# NB_INPLACE arg → the dunder that mutated (for the write record/refusal)
+_INPLACE_OP_NAMES = {
+    13: "__iadd__", 14: "__iand__", 15: "__ifloordiv__", 16: "__ilshift__",
+    17: "__imatmul__", 18: "__imul__", 19: "__imod__", 20: "__ior__",
+    21: "__ipow__", 22: "__irshift__", 23: "__isub__", 24: "__itruediv__",
+    25: "__ixor__",
+}
+
+
 @register_opcode_handler("BINARY_OP")
 def _binary_op(frame, ins, i):
     b = frame.pop()
     a = frame.pop()
-    frame.push(_nb_op(ins.arg, a, b))
+    # in-place op on a TRACKED container through a local alias
+    # (`lst = CFG['lst']; lst += [x]`) mutates external state without a
+    # STORE_* opcode or a visible method call: when the in-place result IS
+    # the same (mutated) object, record the write like _record_method_mutation
+    # would for the equivalent `lst.extend(x)` — incl. the module-globals
+    # refusal (`g = globals(); g |= ...` must not dodge STORE_GLOBAL's ban;
+    # checked BEFORE the op runs so the real module dict is never touched)
+    op_name = _INPLACE_OP_NAMES.get(ins.arg)
+    if op_name is not None and frame.ctx.prov_of(a) is not None and _is_module_globals(frame.ctx, a):
+        raise InterpreterError(
+            f"mutating module globals via {op_name} during tracing is "
+            f"not supported (the store would not replay on cache "
+            f"hits); return the value or pass state explicitly"
+        )
+    r = _nb_op(ins.arg, a, b)
+    if op_name is not None and r is a:
+        base_rec = frame.ctx.prov_of(a)
+        if base_rec is not None:
+            _add_write(frame.ctx, (base_rec, "method", op_name),
+                       f"{base_rec}.{op_name}(...)")
+    frame.push(r)
 
 
 @register_opcode_handler("UNARY_NEGATIVE")

@@ -26,7 +26,7 @@ from thunder_tpu.core import prims
 from thunder_tpu.core.interpreter import ProvenanceRecord, interpret
 from thunder_tpu.core.proxies import CollectionProxy, Proxy, TensorProxy, tensorproxy
 
-__all__ = ["interpret_with_state", "StateCapture", "build_state_prologue"]
+__all__ = ["interpret_with_state", "StateCapture", "build_state_prologue", "state_key_meta"]
 
 
 def _is_tensor_like(x) -> bool:
@@ -79,6 +79,24 @@ class StateCapture:
     @property
     def tensor_proxies(self) -> list[TensorProxy]:
         return [p for _, p in self.tensors.values()]
+
+
+def state_key_meta(cap: StateCapture | None) -> dict | None:
+    """Summary of captured external state for the dispatch cache's key
+    metadata.  Guards and captured tensors are rooted OUTSIDE the call
+    arguments (globals, closures, live module dicts), so the structural key
+    cannot cover them — entries carrying any are exactly why a key hit still
+    runs the prologue once (tier-2 validation).  Returned alongside the key
+    emission so introspection can see what keeps an entry guard-dependent."""
+    if cap is None or (not cap.guards and not cap.tensors):
+        return None
+    return {
+        "n_guards": len(cap.guards),
+        "n_state_tensors": len(cap.tensors),
+        "guard_roots": tuple(sorted(
+            {p[0][0] for p in cap.guards} | {p[0][0] for p in cap.tensors}
+        )),
+    }
 
 
 class _LiveModuleGlobals:

@@ -923,7 +923,8 @@ class TensorProxy(Proxy, TensorProxyInterface):
         # contributes only its shape/dtype, never its values
         from thunder_tpu import clang
 
-        new = resolve_method("add", clang.zeros_like(self), src)(clang.zeros_like(self), src)
+        z = clang.zeros_like(self)
+        new = resolve_method("add", z, src)(z, src)
         if tuple(new.shape) != tuple(self.shape):
             raise RuntimeError(
                 f"copy_: source broadcasts to {tuple(new.shape)}, receiver is {tuple(self.shape)}")

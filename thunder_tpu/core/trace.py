@@ -269,12 +269,18 @@ class TraceCtx:
 
 @dataclass
 class TraceResults:
-    """Result of frontend acquisition (reference trace.py:582)."""
+    """Result of frontend acquisition (reference trace.py:582).
+
+    ``cache_key_meta`` is emitted next to the prologue: the structural
+    dispatch key for the traced inputs, the key function that recomputes it,
+    and a summary of external state the key canNOT cover (bytecode-frontend
+    guards — those are why the prologue still runs once on a key hit)."""
 
     prologue_trace: TraceCtx
     computation_trace: TraceCtx
     epilogue_trace: TraceCtx | None
     interpreter_log: list
+    cache_key_meta: dict | None = None
 
 
 #

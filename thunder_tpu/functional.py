@@ -330,7 +330,25 @@ def trace_from_fn(
         epilogue_trace.args = (e_args, e_kwargs) + tuple(mutated_values)
         epilogue_trace.set_provenance("Epilogue (input-container mutations)")
 
-    return TraceResults(prologue_trace, computation_trace, epilogue_trace, [])
+    #
+    # Key emission (next to the prologue): the structural dispatch key for
+    # these inputs plus the key function that recomputes it — tier 1 of the
+    # two-tier cache.  External state observed by the bytecode frontend can
+    # never be keyed (it lives outside the arguments); its summary rides
+    # along so the dispatcher knows tier-2 prologue validation is load-bearing
+    #
+    from thunder_tpu.core.cache_key import compute_cache_key, make_cache_key_fn
+    from thunder_tpu.core.jit_ext import state_key_meta
+
+    cache_key_meta = {
+        "cache_key": compute_cache_key(args, kwargs, symbolic=symbolic_numbers),
+        "cache_key_fn": make_cache_key_fn(symbolic_numbers),
+        "state": state_key_meta(state_cap),
+    }
+
+    return TraceResults(
+        prologue_trace, computation_trace, epilogue_trace, [], cache_key_meta
+    )
 
 
 def _detect_mutations(orig_proxies, spec, proxy_args, proxy_kwargs):

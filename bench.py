@@ -713,6 +713,31 @@ def main():
             "per_specializations": r,
         }))
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "profile":
+        # profiling-transform overhead: instrumented vs uninstrumented
+        # dispatch on the llama block target (observability subsystem).
+        # Host work only, no TPU probe; artifact uses the BENCH_MICRO schema.
+        from thunder_tpu._platform import force_cpu
+
+        force_cpu()
+        from thunder_tpu.benchmarks.profile_overhead import profile_overhead_bench
+
+        out = profile_overhead_bench(on_tpu=False)
+        artifact = {"backend": jax.default_backend(), **out}
+        with open("BENCH_PROFILE.json", "w") as f:
+            json.dump(artifact, f, indent=1)
+        for k, v in out["results"].items():
+            log(f"profile {k}: {v}")
+        print(json.dumps({
+            "metric": "profiling_transform_overhead_x",
+            "value": out["results"]["overhead_x"],
+            "unit": "x",
+            # plain-vs-plain is definitionally 1.0: profiling off takes the
+            # unmodified code path (byte-identical program)
+            "vs_baseline": 1.0,
+            "results": out["results"],
+        }))
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "cost":
         # analytic companion to the measured headline (no TPU needed): XLA's
         # own cost model on the compiled loss+grad at headline geometry, and

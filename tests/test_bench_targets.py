@@ -92,6 +92,25 @@ class TestHarnessTargets:
             assert row["key_hits"] >= 20, (n, row)  # the timed loop itself
             assert row["scan_hits"] == 0 and row["guard_evictions"] == 0, (n, row)
 
+    def test_profile_overhead_bench_cpu(self):
+        """The profiling-transform overhead bench (`bench.py profile`) must
+        measure all three variants on the llama block target and report the
+        profiler's own accounting — no perf gate (host timing jitters), but
+        every number must be real."""
+        from thunder_tpu.benchmarks.profile_overhead import profile_overhead_bench
+
+        out = profile_overhead_bench(on_tpu=False, iters=10)
+        assert out["shapes"]["cfg"] == "tiny-llama-debug"
+        r = out["results"]
+        for k in ("block_fwd_plain_us", "block_fwd_profiled_us",
+                  "block_fwd_profiled_barrier_us"):
+            assert r[k] > 0, (k, r)
+        assert r["overhead_x"] > 0 and r["barrier_overhead_x"] > 0
+        assert r["instrumented_symbols"] >= 1
+        # warmup + timed loop all flowed through the instrumented program
+        assert r["instrumented_calls"] > r["instrumented_symbols"], r
+        assert r["profiled_total_ms"] > 0
+
     def test_dist_throughput_smoke(self):
         results = bench.dist_throughput_smoke()
         assert results and all(v > 0 for v in results.values())

@@ -1,0 +1,358 @@
+"""Observability subsystem: per-symbol runtime profiling, compile-pipeline
+event tracing, and the unified metrics registry + hooks (ISSUE 2).
+
+Covers: per-symbol stats on a small jitted model (counts match the
+instrumented trace, times monotone), Chrome-trace export validity (matched
+B/E events), metrics snapshot/reset, hook callbacks on cache miss vs key
+hit, the zero-overhead assertion (profiling disabled ⇒ no timing wrappers
+in the generated program), the dynamic env gates (satellite 1), and the
+unguardable-dict-keys sharp edge (satellite 2)."""
+from __future__ import annotations
+
+import json
+import types
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import thunder_tpu as tt
+import thunder_tpu.torch as ltorch
+from thunder_tpu import observability as obs
+
+rng = np.random.default_rng(7)
+
+
+def _xw():
+    return (
+        rng.standard_normal((8, 16)).astype(np.float32),
+        rng.standard_normal((4, 16)).astype(np.float32),
+    )
+
+
+def _mlp(a, b):
+    return ltorch.relu(a @ b.T).sum()
+
+
+class TestRuntimeProfiling:
+    def test_per_symbol_stats_on_llama_block(self):
+        from thunder_tpu.models import llama
+
+        cfg = llama.Config.from_name("tiny-llama-debug")
+        B, T = 2, 16
+        params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+        idx = jax.random.randint(jax.random.PRNGKey(1), (B, T), 0, cfg.vocab_size)
+        cos, sin = llama.build_rope_cache(cfg, T)
+
+        jfn = tt.jit(lambda p, i, c, s: llama.gpt_forward(p, i, c, s, cfg), profile=True)
+        jfn(params, idx, cos, sin)
+        jfn(params, idx, cos, sin)
+
+        report = tt.profile_stats(jfn)
+        assert len(report) >= 1
+        # counts match the instrumented trace's wrapped symbols exactly
+        instr = tt.last_traces(jfn)[-1]
+        wrapped = [b for b in instr.bound_symbols if b.sym.name.startswith("_prof")]
+        assert len(wrapped) == len(report)
+        for label, st in report.items():
+            assert st["calls"] == 2, (label, st)
+            # times monotone/consistent: 0 < min <= mean <= max <= total
+            assert 0 < st["min_ns"] <= st["mean_ns"] <= st["max_ns"] <= st["total_ns"]
+        # the sorted table prints every symbol
+        table = str(report)
+        for label in report:
+            assert label[:40] in table
+
+    def test_flops_bytes_from_xla_cost_model(self):
+        x, w = _xw()
+        jfn = tt.jit(_mlp, profile=True)
+        jfn(x, w)
+        report = tt.profile_stats(jfn)
+        # the fused region carries XLA cost_analysis estimates (matmul ⇒
+        # nonzero flops); keys are optional per-record but must appear here
+        assert any(st.get("flops", 0) and st.get("flops") > 0 for st in report.values()), dict(report)
+        assert any(st.get("bytes", 0) and st.get("bytes") > 0 for st in report.values())
+
+    def test_backward_trace_instrumented_under_grad(self):
+        x = rng.standard_normal((8, 16)).astype(np.float32)
+        g = tt.grad(lambda a: ltorch.relu(a).sum(), profile=True)
+        g(x)
+        report = tt.profile_stats(g)
+        assert any(k.startswith("backward:") for k in report), list(report)
+        assert any(not k.startswith("backward:") for k in report)
+
+    def test_zero_overhead_when_disabled(self):
+        x, w = _xw()
+        plain = tt.jit(_mlp)
+        plain(x, w)
+        src_plain = tt.last_traces(plain)[-1].python()
+        assert "_prof" not in src_plain
+
+        prof = tt.jit(_mlp, profile=True)
+        prof(x, w)
+        traces = tt.last_traces(prof)
+        src_prof = traces[-1].python()
+        assert "_prof" in src_prof
+        # byte-identical contract: the profiled jit's PRE-instrumentation
+        # execution trace prints the same program a plain jit generates —
+        # instrumentation is purely additive, as a final pass
+        assert traces[-2].python() == src_plain
+
+        with pytest.raises(RuntimeError, match="no profiling data"):
+            tt.profile_stats(plain)
+
+    def test_profiled_results_match_unprofiled(self):
+        x, w = _xw()
+        expected = tt.jit(_mlp)(x, w)
+        got = tt.jit(_mlp, profile=True)(x, w)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected), rtol=1e-6)
+
+    def test_env_var_enables_profiling(self, monkeypatch):
+        monkeypatch.setenv("THUNDER_TPU_PROFILE", "1")
+        x, w = _xw()
+        jfn = tt.jit(_mlp)
+        jfn(x, w)
+        assert len(tt.profile_stats(jfn)) >= 1
+
+
+class TestCompileEvents:
+    def test_chrome_trace_export_is_valid_and_matched(self, tmp_path):
+        obs.clear_events()
+        x, w = _xw()
+        tt.jit(_mlp)(x, w)
+
+        path = str(tmp_path / "compile_trace.json")
+        assert tt.export_chrome_trace(path) == path
+        data = json.loads(open(path).read())
+        evs = data["traceEvents"]
+        assert evs, "no events recorded"
+        names = {e["name"] for e in evs}
+        # at least the interpret/transform/lower/compile pipeline phases
+        assert {"compile", "interpret", "lower", "codegen"} <= names, names
+        assert any(n.startswith("transform:") for n in names), names
+        for e in evs:
+            assert e["ph"] in ("B", "E")
+            assert isinstance(e["ts"], float) and "pid" in e and "tid" in e
+        for name in names:
+            b = sum(1 for e in evs if e["name"] == name and e["ph"] == "B")
+            en = sum(1 for e in evs if e["name"] == name and e["ph"] == "E")
+            assert b == en, (name, b, en)
+
+    def test_xla_compile_event_recorded(self):
+        obs.clear_events()
+        x, w = _xw()
+        tt.jit(_mlp)(x, w)
+        names = [e["name"] for e in obs.events()]
+        assert "xla_compile" in names
+
+    def test_ring_buffer_bounded(self):
+        obs.clear_events()
+        cap = obs.event_buffer_capacity()
+        for i in range(cap + 50):
+            obs.record_event("i", f"e{i}")
+        assert len(obs.events()) == cap
+        obs.clear_events()
+
+
+class TestMetricsRegistry:
+    def test_counter_gauge_histogram_snapshot_reset(self):
+        reg = obs.MetricsRegistry()
+        c = reg.counter("c")
+        c.inc()
+        c.inc(2)
+        reg.gauge("g").set(1.5)
+        h = reg.histogram("h")
+        h.observe(2.0)
+        h.observe(4.0)
+
+        snap = reg.snapshot()
+        assert snap["c"] == 3
+        assert snap["g"] == 1.5
+        assert snap["h"] == {"count": 2, "sum": 6.0, "mean": 3.0, "min": 2.0, "max": 4.0}
+
+        # get-or-create returns the same object; a type collision raises
+        assert reg.counter("c") is c
+        with pytest.raises(TypeError):
+            reg.gauge("c")
+
+        reg.reset()
+        snap = reg.snapshot()
+        assert snap["c"] == 0 and snap["g"] is None and snap["h"]["count"] == 0
+        c.inc()  # held references survive reset
+        assert reg.snapshot()["c"] == 1
+
+    def test_dispatch_and_compile_mirror_into_global_registry(self):
+        reg = obs.registry()
+        base = {
+            k: reg.counter(k).value
+            for k in ("dispatch.calls", "dispatch.cache_hits", "dispatch.cache_misses", "compile.count")
+        }
+        x, w = _xw()
+        jfn = tt.jit(_mlp)
+        jfn(x, w)  # miss (compiles)
+        jfn(x, w)  # key hit
+        assert reg.counter("dispatch.calls").value >= base["dispatch.calls"] + 2
+        assert reg.counter("dispatch.cache_misses").value >= base["dispatch.cache_misses"] + 1
+        assert reg.counter("dispatch.cache_hits").value >= base["dispatch.cache_hits"] + 1
+        assert reg.counter("compile.count").value >= base["compile.count"] + 1
+        assert reg.histogram("dispatch.ns").snapshot()["count"] > 0
+
+
+class TestHooks:
+    def test_hooks_fire_on_miss_vs_hit(self):
+        seen = []
+        hooks = {
+            "on_cache_miss": lambda p: seen.append(("miss", p["fn"])),
+            "on_cache_hit": lambda p: seen.append(("hit", p["fn"])),
+            "on_dispatch": lambda p: seen.append(("dispatch", p["ns"], p["cache_hit"])),
+            "on_compile_start": lambda p: seen.append(("compile_start", p["fn"])),
+            "on_compile_end": lambda p: seen.append(("compile_end", p["ns"])),
+        }
+        for ev, fn in hooks.items():
+            obs.register_hook(ev, fn)
+        try:
+            x, w = _xw()
+            jfn = tt.jit(_mlp)
+            jfn(x, w)  # miss → compile
+            jfn(x, w)  # key hit
+        finally:
+            for ev, fn in hooks.items():
+                obs.unregister_hook(ev, fn)
+
+        kinds = [s[0] for s in seen]
+        assert ("miss", "_mlp") in seen
+        assert ("hit", "_mlp") in seen
+        assert kinds.index("compile_start") < kinds.index("compile_end")
+        dispatches = [s for s in seen if s[0] == "dispatch"]
+        assert len(dispatches) == 2
+        assert dispatches[0][2] is False and dispatches[1][2] is True
+        assert all(d[1] > 0 for d in dispatches)
+        # unregistered hooks stay silent
+        n = len(seen)
+        jfn(x, w)
+        assert len(seen) == n
+
+    def test_unknown_event_raises_and_hook_errors_are_swallowed(self):
+        with pytest.raises(ValueError):
+            obs.register_hook("on_nonsense", lambda p: None)
+
+        def broken(p):
+            raise RuntimeError("boom")
+
+        obs.register_hook("on_cache_miss", broken)
+        try:
+            x, w = _xw()
+            with warnings.catch_warnings(record=True) as ws:
+                warnings.simplefilter("always")
+                out = tt.jit(_mlp)(x, w)  # must not raise
+            assert np.isfinite(float(np.asarray(out)))
+            assert any("boom" in str(w.message) for w in ws)
+        finally:
+            obs.unregister_hook("on_cache_miss", broken)
+
+
+class TestDynamicEnvGate:
+    """Satellite 1: the annotate gate must read the env var dynamically —
+    the old core/profile.py froze it at import time."""
+
+    def test_annotate_env_read_after_import(self, monkeypatch):
+        from thunder_tpu.core import profile as prof
+
+        monkeypatch.delenv("THUNDER_TPU_ANNOTATE_TRACES", raising=False)
+        assert not prof.profiling_enabled()
+        assert not obs.profiling_enabled()
+        monkeypatch.setenv("THUNDER_TPU_ANNOTATE_TRACES", "1")
+        # set AFTER import: now visible, both through the shim and the package
+        assert prof.profiling_enabled()
+        assert obs.profiling_enabled()
+        with prof.add_markers("region"):
+            pass
+        with obs.add_markers("region-2"):
+            pass
+
+    def test_legacy_enabled_attr_still_overrides(self, monkeypatch):
+        from thunder_tpu.core import profile as prof
+
+        monkeypatch.delenv("THUNDER_TPU_ANNOTATE_TRACES", raising=False)
+        monkeypatch.setattr(prof, "_ENABLED", True)
+        assert prof.profiling_enabled()
+
+
+class TestUnguardableKeySharpEdge:
+    """Satellite 2 (ADVICE r5 low, interpreter.py _read_keys): iterating a
+    tracked dict with unguardable keys under-guards (LEN only while keys and
+    values bake) — it must surface through the sharp-edges policy."""
+
+    class _Obj:
+        pass
+
+    def _ctx_and_dict(self):
+        from thunder_tpu.core.interpreter import (
+            InterpreterCompileCtx,
+            ProvenanceRecord,
+            PseudoInst,
+        )
+
+        d = {self._Obj(): 1.0, "lr": 0.5}
+        ctx = InterpreterCompileCtx(fn=lambda: None)
+        ctx.track(d, ProvenanceRecord(PseudoInst.LOAD_GLOBAL, key="CFG"))
+        return ctx, d
+
+    def test_allow_policy_keeps_len_guard_silently(self):
+        from thunder_tpu.core.interpreter import PseudoInst, _read_keys
+
+        ctx, d = self._ctx_and_dict()
+        with warnings.catch_warnings(record=True) as ws:
+            warnings.simplefilter("always")
+            keys = _read_keys(ctx, d)  # no compile data → allow
+        assert keys is not None and len(keys) == 2
+        assert any(r.inst is PseudoInst.LEN for r, _ in ctx.reads)
+        assert not any("unguardable" in str(w.message) for w in ws)
+
+    def test_error_policy_raises(self):
+        from thunder_tpu.core.compile_data import compile_data_and_stats
+        from thunder_tpu.core.interpreter import _read_keys
+        from thunder_tpu.core.options import SHARP_EDGES_OPTIONS
+        from thunder_tpu.core.sharp_edges import SharpEdgeError
+
+        ctx, d = self._ctx_and_dict()
+        cd = types.SimpleNamespace(sharp_edges=SHARP_EDGES_OPTIONS.ERROR)
+        with compile_data_and_stats(cd, None):
+            with pytest.raises(SharpEdgeError, match="unguardable keys"):
+                _read_keys(ctx, d)
+
+    def test_warn_policy_warns_and_names_key_type(self):
+        from thunder_tpu.core.compile_data import compile_data_and_stats
+        from thunder_tpu.core.interpreter import _read_keys
+        from thunder_tpu.core.options import SHARP_EDGES_OPTIONS
+
+        ctx, d = self._ctx_and_dict()
+        cd = types.SimpleNamespace(sharp_edges=SHARP_EDGES_OPTIONS.WARN)
+        with warnings.catch_warnings(record=True) as ws:
+            warnings.simplefilter("always")
+            with compile_data_and_stats(cd, None):
+                keys = _read_keys(ctx, d)
+        assert keys is not None and len(keys) == 2
+        msgs = [str(w.message) for w in ws]
+        assert any("unguardable keys" in m and "_Obj" in m for m in msgs), msgs
+
+    def test_guardable_keys_unaffected(self):
+        from thunder_tpu.core.compile_data import compile_data_and_stats
+        from thunder_tpu.core.interpreter import (
+            InterpreterCompileCtx,
+            ProvenanceRecord,
+            PseudoInst,
+            _read_keys,
+        )
+        from thunder_tpu.core.options import SHARP_EDGES_OPTIONS
+
+        d = {"a": 1, ("b", 0): 2}
+        ctx = InterpreterCompileCtx(fn=lambda: None)
+        ctx.track(d, ProvenanceRecord(PseudoInst.LOAD_GLOBAL, key="CFG"))
+        cd = types.SimpleNamespace(sharp_edges=SHARP_EDGES_OPTIONS.ERROR)
+        with compile_data_and_stats(cd, None):
+            keys = _read_keys(ctx, d)  # fully guardable: no sharp edge
+        assert keys == ["a", ("b", 0)]
+        assert any(r.inst is PseudoInst.KEYS for r, _ in ctx.reads)

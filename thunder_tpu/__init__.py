@@ -60,6 +60,8 @@ from thunder_tpu.core.trace import TraceCtx, TraceResults, set_execution_callbac
 from thunder_tpu.core.transform_common import absorb_ce_widening_converts, cse, dce
 from thunder_tpu.extend import resolve_executors
 from thunder_tpu.functional import trace_from_fn
+from thunder_tpu import observability  # noqa: F401  (metrics/events/profiler)
+from thunder_tpu.observability.events import span as _phase_span
 
 __version__ = "0.1.0"
 
@@ -84,6 +86,9 @@ __all__ = [
     "cache_misses",
     "dispatch_stats",
     "last_compile_options",
+    "profile_stats",
+    "export_chrome_trace",
+    "observability",
     "dtypes",
 ]
 
@@ -190,6 +195,8 @@ def jit(
     from thunder_tpu.core.pytree import tree_flatten
     from thunder_tpu.core.trace import get_tracectx
 
+    _fn_label = getattr(fn, "__name__", "fn")
+
     def fn_(*args, **kwargs):
         if get_tracectx() is not None and any(
             isinstance(a, Proxy)
@@ -268,10 +275,14 @@ def jit(
                 cs.cache_hits += 1
                 cache_entry.last_used = cs.calls
 
+        was_hit = cache_entry is not None
         if cache_entry is None:
             cs.cache_misses += 1
-            with compile_data_and_stats(cd, cs):
+            observability.compile_begin(_fn_label)
+            compile_start = time.perf_counter_ns()
+            with _phase_span("compile", fn=_fn_label), compile_data_and_stats(cd, cs):
                 cache_entry = _compile(cd, cs, args, kwargs)
+            observability.compile_end(_fn_label, time.perf_counter_ns() - compile_start)
             if cd.cache_option is not CACHE_OPTIONS.NO_CACHING:
                 cache_entry.cache_key = key
                 cache_entry.last_used = cs.calls
@@ -283,6 +294,9 @@ def jit(
             inps = cache_entry.prologue_fn(*args, **kwargs)
         cs.last_dispatch_ns = time.perf_counter_ns() - dispatch_start
         cs.dispatch_ns += cs.last_dispatch_ns
+        # registry mirror + user hooks (one call; payloads only built when a
+        # hook is registered — see observability.dispatch_event)
+        observability.dispatch_event(_fn_label, ns=cs.last_dispatch_ns, hit=was_hit)
 
         if cache_entry.uses_rng:
             from thunder_tpu.core import rng
@@ -363,12 +377,38 @@ def _evict_lru(cd: CompileData, cs: CompileStats) -> None:
 
 def _compile(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict) -> CacheEntry:
     """Trace → transforms → executor dispatch → codegen (one cache entry)."""
+    from thunder_tpu.core.compile_data import get_compile_option
     from thunder_tpu.executors.passes import del_last_used, transform_for_execution
 
     grad_argnums = cd.compile_options.get("_grad_argnums")
     vjp_mode = bool(cd.compile_options.get("_vjp_mode"))
     if vjp_mode and grad_argnums is None:
         grad_argnums = tuple(range(len(args)))
+
+    # runtime profiling transform (observability): applied LAST, over the
+    # execution trace(s), and only when requested — otherwise the generated
+    # program is byte-identical to the uninstrumented one
+    profile_opt = get_compile_option(
+        "profile",
+        "Enable the runtime profiling transform: every executed symbol/fusion "
+        "region is wrapped in timing, queryable via thunder_tpu.profile_stats.",
+        default=None,
+    )
+    profile_on = bool(profile_opt) if profile_opt is not None else observability.profiling_env_enabled()
+    profile_report = None
+    profile_barriers = True
+    if profile_on:
+        from thunder_tpu.observability.profiler import ProfileReport
+
+        profile_barriers = bool(get_compile_option(
+            "profile_barriers",
+            "Fence each instrumented symbol with jax.block_until_ready for "
+            "device-accurate per-symbol times (default True).",
+            default=True,
+        ))
+        if cs.profile_report is None:
+            cs.profile_report = ProfileReport()
+        profile_report = cs.profile_report
 
     cs.last_trace_tracing_start = time.perf_counter_ns()
     from thunder_tpu.core.sharp_edges import sharp_edges_guard
@@ -393,18 +433,23 @@ def _compile(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict) -> Ca
     cs.last_prologue_traces = [prologue_trace]
     cs.last_interpreter_log = getattr(computation_trace, "_interpreter_log", [])
 
-    computation_trace = dce(computation_trace)
+    with _phase_span("transform:dce"):
+        computation_trace = dce(computation_trace)
     cs.last_traces.append(computation_trace)
-    computation_trace = cse(computation_trace)
+    with _phase_span("transform:cse"):
+        computation_trace = cse(computation_trace)
     cs.last_traces.append(computation_trace)
-    absorbed = absorb_ce_widening_converts(computation_trace)
+    with _phase_span("transform:absorb_ce_widening_converts"):
+        absorbed = absorb_ce_widening_converts(computation_trace)
     if absorbed is not computation_trace:  # no-op returns the input unchanged
         computation_trace = absorbed
         cs.last_traces.append(computation_trace)
 
     # user/distributed transforms (trace -> trace)
     for transform in cd.transforms:
-        computation_trace = transform(computation_trace)
+        tname = getattr(transform, "__name__", type(transform).__name__)
+        with _phase_span(f"transform:{tname}"):
+            computation_trace = transform(computation_trace)
         cs.last_traces.append(computation_trace)
 
     bw_fn = None
@@ -440,13 +485,15 @@ def _compile(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict) -> Ca
                         f"(got {[(tuple(o.shape), str(o.dtype)) for o in outs]})",
                     )
 
-        fw_trace, bw_trace = forward_and_backward_from_trace(computation_trace)
+        with _phase_span("transform:forward_backward_split"):
+            fw_trace, bw_trace = forward_and_backward_from_trace(computation_trace)
         cs.last_traces.append(fw_trace)
         cs.last_backward_traces = [bw_trace]
         if cd.compile_options.get("remat", True):
             from thunder_tpu.core.rematerialization import rematerialize_forward_and_backward
 
-            fw_trace, bw_trace = rematerialize_forward_and_backward(fw_trace, bw_trace)
+            with _phase_span("transform:rematerialization"):
+                fw_trace, bw_trace = rematerialize_forward_and_backward(fw_trace, bw_trace)
             cs.last_traces.append(fw_trace)
             cs.last_backward_traces.append(bw_trace)
         computation_trace = fw_trace
@@ -455,6 +502,13 @@ def _compile(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict) -> Ca
         cs.last_backward_traces.append(bw_extrace)
         bw_extrace = del_last_used(bw_extrace)
         cs.last_backward_traces.append(bw_extrace)
+        if profile_report is not None:
+            from thunder_tpu.observability.profiler import instrument_for_profiling
+
+            bw_extrace = instrument_for_profiling(
+                bw_extrace, profile_report, which="backward", barriers=profile_barriers
+            )
+            cs.last_backward_traces.append(bw_extrace)
         bw_fn = bw_extrace.python_callable()
         grad_postprocess = _make_grad_postprocess(trace_results.computation_trace, grad_argnums)
 
@@ -462,6 +516,14 @@ def _compile(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict) -> Ca
     cs.last_traces.append(extrace)
     extrace = del_last_used(extrace)
     cs.last_traces.append(extrace)
+    if profile_report is not None:
+        from thunder_tpu.observability.profiler import instrument_for_profiling
+
+        with _phase_span("transform:profiling_instrumentation"):
+            extrace = instrument_for_profiling(
+                extrace, profile_report, barriers=profile_barriers
+            )
+        cs.last_traces.append(extrace)
 
     comp_fn = extrace.python_callable()
     pro_fn = prologue_trace.python_callable()
@@ -633,7 +695,12 @@ def dispatch_stats(cfn) -> dict:
     """Two-tier dispatch counters: ``key_hits`` (O(1) hash-map hit, first
     bucket entry validated), ``scan_hits`` (shadowed-bucket or legacy linear
     scan), ``guard_evictions`` (prologue failed after a key match — external
-    state changed), ``lru_evictions``, plus per-call dispatch timing."""
+    state changed), ``lru_evictions``, plus per-call dispatch timing.
+
+    These are the per-function view; the dispatch path also publishes
+    process-wide aggregates into the unified metrics registry
+    (``observability.snapshot()``: ``dispatch.calls`` /
+    ``dispatch.cache_hits`` / ``dispatch.cache_misses`` / ``dispatch.ns``)."""
     cs = _get_cs(cfn)
     return {
         "key_hits": cs.key_hits,
@@ -646,6 +713,31 @@ def dispatch_stats(cfn) -> dict:
         "last_dispatch_ns": cs.last_dispatch_ns,
         "dispatch_ns": cs.dispatch_ns,
     }
+
+
+def profile_stats(cfn):
+    """Per-symbol runtime profile of a function compiled with
+    ``profile=True`` (or under ``THUNDER_TPU_PROFILE=1``): a mapping
+    ``label -> {calls, total_ns, mean_ns, min_ns, max_ns, flops?, bytes?}``
+    covering every instrumented BoundSymbol / fusion region (forward and,
+    when present, backward).  ``print()`` the report for the table sorted by
+    total time.  FLOP/byte estimates come from XLA's ``cost_analysis()`` at
+    the traced shapes, computed lazily on first query."""
+    cs = _get_cs(cfn)
+    check(
+        cs.profile_report is not None,
+        lambda: "no profiling data: compile with tt.jit(fn, profile=True) "
+        "(or set THUNDER_TPU_PROFILE=1 before the first call) and invoke "
+        "the compiled function at least once",
+    )
+    return cs.profile_report
+
+
+def export_chrome_trace(path: str) -> str:
+    """Writes the buffered compile-pipeline events (interpret / transforms /
+    lower / codegen / compile, see ``thunder_tpu.observability.events``) as
+    Chrome-trace JSON loadable in chrome://tracing or ui.perfetto.dev."""
+    return observability.export_chrome_trace(path)
 
 
 def last_compile_options(cfn) -> dict:

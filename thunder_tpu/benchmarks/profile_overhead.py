@@ -1,0 +1,90 @@
+"""Profiling-transform overhead microbench: instrumented vs uninstrumented
+dispatch on the llama block target.
+
+The runtime profiling transform (observability/profiler.py) is opt-in; when
+it IS on, its cost is the per-symbol timing wrapper (clock reads + record
+update) and, optionally, the ``jax.block_until_ready`` fence.  This bench
+measures all three variants on the same compiled llama forward so
+``bench.py profile`` can police that (a) disabled profiling costs nothing
+(same code path as ever) and (b) enabled profiling stays proportionate.
+Host-side µs/call (``host_us_per_call``) is the right meter for the wrapper
+cost; the barrier variant is reported separately because the fence
+deliberately serializes device work.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from thunder_tpu.benchmarks.timing import host_us_per_call
+
+__all__ = ["profile_overhead_bench"]
+
+
+def profile_overhead_bench(on_tpu: bool = False, iters: int = 50) -> dict:
+    """Returns ``{"shapes": {...}, "results": {...}}`` (the BENCH_MICRO.json
+    artifact schema).  Results: µs/call for the plain, instrumented
+    (no-barrier), and instrumented+barrier jits of the llama block forward,
+    the wrapper overhead ratio, and the profiler's own accounting."""
+    import thunder_tpu as tt
+    from thunder_tpu.models import llama
+
+    if on_tpu:
+        cfg = llama.Config.from_name(
+            "Llama-2-7b-hf", n_layer=1, n_embd=2048, n_head=16, intermediate_size=5504
+        )
+        B, T, dt = 4, 2048, jnp.bfloat16
+    else:
+        cfg = llama.Config.from_name("tiny-llama-debug")
+        B, T, dt = 2, 64, jnp.float32
+    T = min(T, cfg.block_size)
+    key = jax.random.PRNGKey(0)
+    params = llama.init_params(cfg, key, dtype=dt)
+    idx = jax.random.randint(jax.random.PRNGKey(1), (B, T), 0, cfg.vocab_size)
+    cos, sin = llama.build_rope_cache(cfg, T, dtype=jnp.float32)
+
+    def block_fwd(p, i, c, s):
+        return llama.gpt_forward(p, i, c, s, cfg)
+
+    plain = tt.jit(block_fwd)
+    instrumented = tt.jit(block_fwd, profile=True, profile_barriers=False)
+    instrumented_barrier = tt.jit(block_fwd, profile=True)
+
+    results = {
+        "block_fwd_plain_us": round(
+            host_us_per_call(plain, params, idx, cos, sin, iters=iters), 3
+        ),
+        "block_fwd_profiled_us": round(
+            host_us_per_call(instrumented, params, idx, cos, sin, iters=iters), 3
+        ),
+        "block_fwd_profiled_barrier_us": round(
+            host_us_per_call(instrumented_barrier, params, idx, cos, sin, iters=iters), 3
+        ),
+    }
+    plain_us = results["block_fwd_plain_us"]
+    results["overhead_x"] = (
+        round(results["block_fwd_profiled_us"] / plain_us, 3) if plain_us > 0 else None
+    )
+    results["barrier_overhead_x"] = (
+        round(results["block_fwd_profiled_barrier_us"] / plain_us, 3)
+        if plain_us > 0
+        else None
+    )
+
+    report = tt.profile_stats(instrumented)
+    stats = dict(report)
+    results["instrumented_symbols"] = len(stats)
+    results["instrumented_calls"] = sum(r["calls"] for r in stats.values())
+    results["profiled_total_ms"] = round(
+        sum(r["total_ns"] for r in stats.values()) / 1e6, 3
+    )
+    return {
+        "shapes": {
+            "cfg": cfg.name,
+            "n_layer": cfg.n_layer,
+            "B": B,
+            "T": T,
+            "dtype": jnp.dtype(dt).name,
+        },
+        "results": results,
+    }

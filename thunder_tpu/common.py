@@ -54,6 +54,11 @@ class CompileStats:
         self.last_compile_reasons: dict[str, str] = {}
         self.used_compile_options: dict[str, Any] = {}
 
+        # per-symbol runtime profile (observability.profiler.ProfileReport);
+        # None unless the function was compiled with profile=True — records
+        # accumulate across specializations of the same compiled function
+        self.profile_report = None
+
         # live entries in insertion order (introspection + the legacy linear
         # fallback for unkeyable inputs); the hash-map view below is the hot
         # dispatch path: structural key → bucket of entries, most recently

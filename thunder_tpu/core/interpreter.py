@@ -431,8 +431,11 @@ def _read_elements(ctx: "InterpreterCompileCtx", obj, *, primitive_only: bool = 
 def _read_keys(ctx: "InterpreterCompileCtx", d: dict) -> list | None:
     """Records a KEYS read for a TRACKED dict — the key tuple (set AND
     order) becomes a prologue check_keys guard, since iteration unrolls in
-    key order.  Falls back to a LEN guard when keys are not guardable.
-    Returns the key list, or None when d is untracked."""
+    key order.  When keys are not guardable only a LEN guard is possible,
+    and the observed keys/values still bake into the trace — an UNDER-guard
+    (same-length key replacement replays stale results), so it is surfaced
+    through the sharp-edges policy (warn/error; ADVICE r5 low).  Returns the
+    key list, or None when d is untracked."""
     base_rec = ctx.prov_of(d)
     if base_rec is None:
         return None
@@ -440,6 +443,15 @@ def _read_keys(ctx: "InterpreterCompileCtx", d: dict) -> list | None:
     if all(_guardable_key(k) for k in keys):
         ctx.record_read(ProvenanceRecord(PseudoInst.KEYS, inputs=(base_rec,)), tuple(keys))
     else:
+        from thunder_tpu.core.compile_data import get_compile_data
+        from thunder_tpu.core.sharp_edges import report_unguardable_keys
+
+        cd = get_compile_data()
+        if cd is not None:
+            offending = sorted({type(k).__name__ for k in keys if not _guardable_key(k)})
+            report_unguardable_keys(
+                cd.sharp_edges, f"key types: {', '.join(offending)}"
+            )
         ctx.record_read(ProvenanceRecord(PseudoInst.LEN, inputs=(base_rec,)), len(d))
     return keys
 

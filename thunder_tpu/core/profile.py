@@ -1,22 +1,24 @@
-"""Trace annotation markers for profiling.
+"""DEPRECATED shim — profiling moved to ``thunder_tpu.observability``.
 
-Capability analog of the reference's ``thunder/core/profile.py`` (NVTX +
-torch.profiler ranges gated by ``THUNDER_ANNOTATE_TRACES``).  On TPU the
-profiler is jax's: markers become ``jax.profiler.TraceAnnotation`` ranges,
-visible in XLA/TensorBoard profiles, gated by ``THUNDER_TPU_ANNOTATE_TRACES``.
+The original module computed its enable flag once at import time, so
+``THUNDER_TPU_ANNOTATE_TRACES`` set afterwards (tests, notebooks) was
+silently ignored.  The env var is now read dynamically on every call
+(``observability/config.py``); ``_ENABLED`` survives only as a legacy
+programmatic override that existing code/tests monkeypatch.
 """
 from __future__ import annotations
 
 import contextlib
-import os
+
+from thunder_tpu.observability.config import annotations_enabled as _annotations_enabled
 
 __all__ = ["profiling_enabled", "add_markers"]
 
-_ENABLED = os.getenv("THUNDER_TPU_ANNOTATE_TRACES") in ("1", "y", "Y")
+_ENABLED = False  # legacy override; the live gate is the dynamic env read
 
 
 def profiling_enabled() -> bool:
-    return _ENABLED
+    return _ENABLED or _annotations_enabled()
 
 
 @contextlib.contextmanager

@@ -17,7 +17,7 @@ from typing import Any
 
 from thunder_tpu.core.options import SHARP_EDGES_OPTIONS
 
-__all__ = ["sharp_edges_guard", "SharpEdgeError"]
+__all__ = ["sharp_edges_guard", "SharpEdgeError", "report_external_write", "report_unguardable_keys"]
 
 
 class SharpEdgeError(RuntimeError):
@@ -53,6 +53,23 @@ def _report(policy: SHARP_EDGES_OPTIONS, what: str):
         f"calls).  Pass sharp_edges='allow' to silence, or move the call "
         f"outside the jitted function."
     ))
+
+
+def report_unguardable_keys(policy: SHARP_EDGES_OPTIONS, where: str) -> None:
+    """Iterating a tracked dict whose keys are not guardable (non-primitive
+    key objects) unrolls the loop over the OBSERVED keys/values, but the
+    prologue can only re-check the dict's LENGTH — replacing a key at the
+    same length would silently replay the stale program.  Surface that
+    under-guarding per policy instead of staying silent (ADVICE r5:
+    interpreter.py _read_keys)."""
+    _dispatch(policy, (
+        f"sharp edge: iteration over a tracked dict with unguardable keys "
+        f"({where}) during tracing — the observed keys and values are baked "
+        f"into the compiled program and only the dict's LENGTH is guarded, "
+        f"so replacing a key (at unchanged length) will NOT retrace.  Use "
+        f"primitive (or all-primitive tuple) keys for exact guarding, pass "
+        f"the dict as an argument, or pass sharp_edges='allow' to silence."
+    ), stacklevel=4)
 
 
 def report_external_write(policy: SHARP_EDGES_OPTIONS, where: str) -> None:

@@ -237,6 +237,12 @@ class TraceCtx:
         and if the file already holds a user-edited program, that version is
         compiled and executed instead (debug lever: edit the generated code,
         rerun)."""
+        from thunder_tpu.observability.events import span as _phase_span
+
+        with _phase_span("codegen", trace=self.siginfo().name):
+            return self._python_callable_impl(**kwargs)
+
+    def _python_callable_impl(self, **kwargs) -> Callable:
         python_str = self.python(**kwargs)
         si = self.siginfo()
         path = _execution_file.get()

@@ -16,6 +16,7 @@ from thunder_tpu.core.trace import TraceCtx, TraceProvenance, from_trace, tracec
 from thunder_tpu.core.transform_common import dce
 from thunder_tpu.extend import Executor, FusionExecutor, OperatorExecutor
 from thunder_tpu.core.pytree import tree_flatten
+from thunder_tpu.observability.events import span as _phase_span
 
 __all__ = ["transform_for_execution", "del_last_used"]
 
@@ -146,6 +147,7 @@ def _apply_execution_transform(trace: TraceCtx, bsym: BoundSymbol, transform) ->
     return [b.from_bsym_swap_proxies(swap_map) for b in scope]
 
 
+@_phase_span("lower")
 def transform_for_execution(trace: TraceCtx, executors: Sequence[Executor]) -> TraceCtx:
     """The claiming pass (reference passes.py:131)."""
     start = time.perf_counter_ns()
@@ -206,6 +208,7 @@ def transform_for_execution(trace: TraceCtx, executors: Sequence[Executor]) -> T
     return extrace
 
 
+@_phase_span("lower:del_last_used")
 def del_last_used(trace: TraceCtx, *, clear_collections: bool = False) -> TraceCtx:
     """Inserts ``del`` statements after each proxy's last use so the generated
     program drops references to dead jax buffers promptly (reference

@@ -25,6 +25,7 @@ from thunder_tpu.core.trace import TraceCtx, TraceProvenance, from_trace
 from thunder_tpu.core.utils import consumers, producers
 from thunder_tpu.extend import FusionExecutor, add_default_executor, register_executor
 from thunder_tpu.executors.utils import Region, eval_bsyms
+from thunder_tpu.observability.events import span as _phase_span
 
 __all__ = ["XLAFusionExecutor", "ex", "xla_ex"]
 
@@ -49,6 +50,7 @@ class FusionCallable:
         self.input_names = [p.name for p in inputs]
         self.output_names = [p.name for p in outputs]
         self._jitted = jax.jit(self._raw)
+        self._compiled_once = False
 
     def _raw(self, *vals):
         env = dict(zip(self.input_names, vals))
@@ -56,6 +58,13 @@ class FusionCallable:
         return tuple(env[n] for n in self.output_names)
 
     def __call__(self, *vals):
+        if not self._compiled_once:
+            # the first call triggers XLA tracing+compilation (jax.jit is
+            # lazy); record it as a pipeline event.  Shape-change recompiles
+            # are not re-spanned — one flag check per call is the budget here
+            self._compiled_once = True
+            with _phase_span("xla_compile", fusion=self.name, ops=len(self.bsyms)):
+                return self._jitted(*vals)
         return self._jitted(*vals)
 
     def lower_hlo(self, *abstract_vals) -> str:
@@ -121,6 +130,7 @@ class XLAFusionExecutor(FusionExecutor):
         )
         return bsym
 
+    @_phase_span("lower:xla_fusion")
     def fusion_pass(self, trace: TraceCtx) -> TraceCtx:
         from thunder_tpu.core.trace import _execution_file
 

@@ -181,8 +181,14 @@ def trace_from_fn(
     # names are snapshotted here and restored onto same-named copies below
     input_names = [p.name if isinstance(p, TensorProxy) else None for p in proxies]
 
+    from thunder_tpu.observability.events import span as _phase_span
+
     state_cap = None
-    with tracectx(computation_trace):
+    with _phase_span(
+        "interpret",
+        fn=getattr(fn, "__name__", "fn"),
+        frontend="bytecode" if interpretation == "bytecode" else "functional",
+    ), tracectx(computation_trace):
         with langctx(language if language is not None else Languages.TORCH):
             if interpretation == "bytecode":
                 from thunder_tpu.core.jit_ext import interpret_with_state

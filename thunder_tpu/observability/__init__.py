@@ -1,0 +1,140 @@
+"""Observability: runtime profiling, compile-pipeline tracing, and metrics.
+
+Three pillars:
+
+1. **Runtime profiling transform** (``profiler.py``) — a post-lowering pass
+   wrapping each executed BoundSymbol / XLA fusion region in monotonic-clock
+   timing (optional ``jax.block_until_ready`` fences, ``TraceAnnotation``
+   ranges folded in from the old ``core/profile.py``).  Enable with
+   ``tt.jit(fn, profile=True)`` or ``THUNDER_TPU_PROFILE=1``; query with
+   ``thunder_tpu.profile_stats(cfn)``.
+
+2. **Compile-pipeline event tracing** (``events.py``) — structured
+   begin/end events for interpretation, transforms, lowering, codegen, and
+   XLA compilation in a bounded ring buffer; export with
+   ``thunder_tpu.export_chrome_trace(path)`` (Perfetto-loadable).
+
+3. **Unified metrics registry** (``metrics.py``) — counters / gauges /
+   histograms that the dispatcher, the compiler, and the profiler publish
+   into, plus user hook callbacks (``on_compile_start/end``,
+   ``on_cache_hit/miss``, ``on_dispatch``).
+
+``core/profile.py`` is now a shim over this package; its old import-frozen
+env gate is fixed here (``config.py`` reads the environment dynamically).
+"""
+from __future__ import annotations
+
+import contextlib
+
+from thunder_tpu.observability.config import (  # noqa: F401
+    annotations_enabled,
+    event_buffer_capacity,
+    profiling_env_enabled,
+)
+from thunder_tpu.observability.events import (  # noqa: F401
+    clear_events,
+    events,
+    export_chrome_trace,
+    record_event,
+    span,
+)
+from thunder_tpu.observability.metrics import (  # noqa: F401
+    HOOK_EVENTS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    clear_hooks,
+    emit,
+    has_hooks,
+    register_hook,
+    registry,
+    unregister_hook,
+)
+
+__all__ = [
+    "annotations_enabled",
+    "profiling_env_enabled",
+    "profiling_enabled",
+    "add_markers",
+    "snapshot",
+    # events
+    "span",
+    "record_event",
+    "events",
+    "clear_events",
+    "export_chrome_trace",
+    # metrics + hooks
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "registry",
+    "HOOK_EVENTS",
+    "register_hook",
+    "unregister_hook",
+    "clear_hooks",
+    "emit",
+    # dispatch/compile glue (called by thunder_tpu.jit)
+    "dispatch_event",
+    "compile_begin",
+    "compile_end",
+]
+
+
+def profiling_enabled() -> bool:
+    """Legacy gate name (old ``core/profile.py``): True when trace
+    annotations are enabled.  Reads ``THUNDER_TPU_ANNOTATE_TRACES``
+    dynamically — setting it after import now works."""
+    return annotations_enabled()
+
+
+@contextlib.contextmanager
+def add_markers(msg: str):
+    """Annotates the enclosed device work with ``msg`` in jax profiles
+    (``jax.profiler.TraceAnnotation``), gated dynamically."""
+    if not annotations_enabled():
+        yield
+        return
+    assert "\n" not in msg, msg
+    import jax
+
+    with jax.profiler.TraceAnnotation(msg):
+        yield
+
+
+def snapshot() -> dict:
+    """One plain dict of every registered metric (see ``metrics.py``)."""
+    return registry().snapshot()
+
+
+#
+# Glue the dispatch/compile paths call.  Kept to ONE function call per event
+# so the hot path stays cheap: counters are attribute increments, and hook
+# payload dicts are only built when a hook is actually registered.
+#
+
+
+def dispatch_event(fn_name: str, *, ns: int, hit: bool) -> None:
+    """Called once per dispatch of a compiled function (post-timing)."""
+    reg = registry()
+    reg.counter("dispatch.calls").inc()
+    reg.counter("dispatch.cache_hits" if hit else "dispatch.cache_misses").inc()
+    reg.histogram("dispatch.ns").observe(ns)
+    if has_hooks("on_dispatch"):
+        emit("on_dispatch", {"fn": fn_name, "ns": ns, "cache_hit": hit})
+    event = "on_cache_hit" if hit else "on_cache_miss"
+    if has_hooks(event):
+        emit(event, {"fn": fn_name})
+
+
+def compile_begin(fn_name: str) -> None:
+    registry().counter("compile.count").inc()
+    if has_hooks("on_compile_start"):
+        emit("on_compile_start", {"fn": fn_name})
+
+
+def compile_end(fn_name: str, ns: int) -> None:
+    registry().histogram("compile.ns").observe(ns)
+    if has_hooks("on_compile_end"):
+        emit("on_compile_end", {"fn": fn_name, "ns": ns})

@@ -1,0 +1,46 @@
+"""Observability configuration: dynamic environment gates.
+
+The old ``core/profile.py`` computed its enable flag ONCE at module import,
+so ``THUNDER_TPU_ANNOTATE_TRACES`` set in a test or notebook after import
+was silently ignored.  Every gate here reads the environment at call time;
+the per-call cost is one ``os.environ`` lookup, paid only on paths that are
+already instrumentation (never on the uninstrumented hot path).
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = [
+    "annotations_enabled",
+    "profiling_env_enabled",
+    "event_buffer_capacity",
+]
+
+_TRUTHY = ("1", "y", "Y", "true", "on")
+
+
+def _env_flag(name: str) -> bool:
+    return os.getenv(name, "") in _TRUTHY
+
+
+def annotations_enabled() -> bool:
+    """``jax.profiler.TraceAnnotation`` ranges around instrumented symbols
+    (visible in XLA/TensorBoard profiles).  Gated by
+    ``THUNDER_TPU_ANNOTATE_TRACES``, read dynamically."""
+    return _env_flag("THUNDER_TPU_ANNOTATE_TRACES")
+
+
+def profiling_env_enabled() -> bool:
+    """``THUNDER_TPU_PROFILE=1`` turns on the runtime profiling transform
+    for every ``jit`` that does not pass an explicit ``profile=`` option.
+    Read at compile time (dynamically), so it can be flipped mid-process."""
+    return _env_flag("THUNDER_TPU_PROFILE")
+
+
+def event_buffer_capacity() -> int:
+    """Ring-buffer bound for compile-pipeline events
+    (``THUNDER_TPU_EVENT_BUFFER``, default 4096)."""
+    try:
+        return max(16, int(os.getenv("THUNDER_TPU_EVENT_BUFFER", "4096")))
+    except ValueError:
+        return 4096

@@ -1,0 +1,81 @@
+"""Compile-pipeline event tracing: a ring buffer of begin/end events,
+exportable as Chrome-trace / Perfetto JSON.
+
+Every stage of the compile pipeline (interpretation, each transform,
+lowering/claiming, codegen, XLA compile) records a ``B``/``E`` event pair
+via :func:`span`.  Events live in a bounded ring buffer (the oldest events
+drop first — an orphaned ``B`` from eviction is tolerated by Perfetto), so
+long-running processes never grow unbounded.  Nothing on the *dispatch*
+hot path records events; recording happens only on compile-time paths,
+where one ``perf_counter_ns`` + deque append is noise against tracing and
+XLA compilation.
+
+``span`` is built on ``contextlib.contextmanager`` and therefore also works
+as a decorator (each call re-creates the context).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+
+from thunder_tpu.observability.config import event_buffer_capacity
+
+__all__ = [
+    "record_event",
+    "span",
+    "events",
+    "clear_events",
+    "export_chrome_trace",
+]
+
+_events: deque = deque(maxlen=event_buffer_capacity())
+
+
+def record_event(ph: str, name: str, args: dict | None = None) -> None:
+    """Appends one Chrome-trace event (``ph``: "B"/"E"/"i"/"X"...) stamped
+    with the monotonic clock in microseconds."""
+    ev = {
+        "ph": ph,
+        "name": name,
+        "cat": "thunder_tpu",
+        "ts": time.perf_counter_ns() / 1e3,
+        "pid": os.getpid(),
+        "tid": threading.get_ident(),
+    }
+    if args:
+        ev["args"] = args
+    _events.append(ev)
+
+
+@contextmanager
+def span(name: str, **meta):
+    """Records a ``B``/``E`` pair around the enclosed work (exception-safe).
+    Usable as a context manager or as a decorator."""
+    record_event("B", name, meta or None)
+    try:
+        yield
+    finally:
+        record_event("E", name)
+
+
+def events() -> list[dict]:
+    """Snapshot of the ring buffer, oldest first."""
+    return list(_events)
+
+
+def clear_events() -> None:
+    _events.clear()
+
+
+def export_chrome_trace(path: str) -> str:
+    """Writes the buffered compile-pipeline events as a Chrome-trace JSON
+    object (loadable in ``chrome://tracing`` and https://ui.perfetto.dev).
+    Returns ``path``."""
+    payload = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    return path

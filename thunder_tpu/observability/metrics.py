@@ -1,0 +1,223 @@
+"""Unified metrics registry: counters, gauges, histograms, and user hooks.
+
+One process-wide registry that every instrumented subsystem publishes into —
+the dispatch path mirrors its per-function counters here
+(``dispatch.calls``/``dispatch.cache_hits``/``dispatch.cache_misses``/
+``dispatch.ns``), compilation records ``compile.count``/``compile.ns``, and
+the runtime profiler observes ``profile.instrumented_calls``/
+``profile.symbol_ns``.  ``snapshot()`` returns one plain dict suitable for
+logging/export; ``reset()`` zeroes everything (the metric objects stay
+registered, so held references keep working).
+
+User hook callbacks (``on_compile_start``/``on_compile_end``/
+``on_cache_hit``/``on_cache_miss``/``on_dispatch``) receive one payload dict
+each.  Hook exceptions are swallowed with a warning — observability must
+never take down the dispatch path.
+"""
+from __future__ import annotations
+
+import threading
+import warnings
+from typing import Any, Callable
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "registry",
+    "HOOK_EVENTS",
+    "register_hook",
+    "unregister_hook",
+    "clear_hooks",
+    "has_hooks",
+    "emit",
+]
+
+
+class Counter:
+    """Monotonic counter (resettable)."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.value += n
+
+    def snapshot(self):
+        return self.value
+
+    def reset(self) -> None:
+        self.value = 0
+
+
+class Gauge:
+    """Last-written value (None until first ``set``)."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = None
+
+    def set(self, v) -> None:
+        self.value = v
+
+    def snapshot(self):
+        return self.value
+
+    def reset(self) -> None:
+        self.value = None
+
+
+class Histogram:
+    """Streaming summary (count/sum/min/max); no bucket boundaries to
+    misconfigure — the consumers here want totals and extremes, not
+    quantile sketches."""
+
+    __slots__ = ("name", "count", "sum", "min", "max")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.reset()
+
+    def observe(self, v) -> None:
+        self.count += 1
+        self.sum += v
+        if self.min is None or v < self.min:
+            self.min = v
+        if self.max is None or v > self.max:
+            self.max = v
+
+    def snapshot(self) -> dict:
+        return {
+            "count": self.count,
+            "sum": self.sum,
+            "mean": (self.sum / self.count) if self.count else None,
+            "min": self.min,
+            "max": self.max,
+        }
+
+    def reset(self) -> None:
+        self.count = 0
+        self.sum = 0
+        self.min = None
+        self.max = None
+
+
+class MetricsRegistry:
+    """Get-or-create metric store.  Lookups are lock-free on the hit path
+    (dict reads are atomic under the GIL); creation takes a lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, Any] = {}
+
+    def _get(self, name: str, cls):
+        m = self._metrics.get(name)
+        if m is None:
+            with self._lock:
+                m = self._metrics.setdefault(name, cls(name))
+        if not isinstance(m, cls):
+            raise TypeError(
+                f"metric {name!r} is already registered as {type(m).__name__}, "
+                f"not {cls.__name__}"
+            )
+        return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(name, Histogram)
+
+    def names(self) -> list[str]:
+        return sorted(self._metrics)
+
+    def snapshot(self) -> dict:
+        return {name: m.snapshot() for name, m in sorted(self._metrics.items())}
+
+    def reset(self) -> None:
+        for m in self._metrics.values():
+            m.reset()
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    """The process-wide registry every subsystem publishes into."""
+    return _REGISTRY
+
+
+#
+# Hooks
+#
+
+HOOK_EVENTS = (
+    "on_compile_start",
+    "on_compile_end",
+    "on_cache_hit",
+    "on_cache_miss",
+    "on_dispatch",
+)
+
+_hooks: dict[str, list[Callable]] = {e: [] for e in HOOK_EVENTS}
+
+
+def _check_event(event: str) -> None:
+    if event not in _hooks:
+        raise ValueError(f"unknown hook event {event!r}; known: {HOOK_EVENTS}")
+
+
+def register_hook(event: str, fn: Callable) -> Callable:
+    """Registers ``fn(payload: dict)`` for ``event``; returns ``fn`` so it
+    can be used as a decorator."""
+    _check_event(event)
+    _hooks[event].append(fn)
+    return fn
+
+
+def unregister_hook(event: str, fn: Callable) -> None:
+    _check_event(event)
+    try:
+        _hooks[event].remove(fn)
+    except ValueError:
+        pass
+
+
+def clear_hooks(event: str | None = None) -> None:
+    if event is None:
+        for hs in _hooks.values():
+            hs.clear()
+        return
+    _check_event(event)
+    _hooks[event].clear()
+
+
+def has_hooks(event: str) -> bool:
+    """Cheap pre-check so hot-path callers can skip building the payload
+    dict when nobody is listening."""
+    return bool(_hooks.get(event))
+
+
+def emit(event: str, payload: dict) -> None:
+    hs = _hooks.get(event)
+    if not hs:
+        _check_event(event)
+        return
+    for h in tuple(hs):
+        try:
+            h(payload)
+        except Exception as e:  # a broken hook must not break dispatch
+            warnings.warn(
+                f"observability hook {getattr(h, '__name__', h)!r} for "
+                f"{event} raised {e!r}; ignoring",
+                stacklevel=2,
+            )

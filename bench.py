@@ -738,6 +738,31 @@ def main():
             "results": out["results"],
         }))
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "anomaly":
+        # anomaly-detection overhead: plain vs detect_anomalies=True dispatch
+        # on the llama block target (numerics observability).  Host work
+        # only, no TPU probe; artifact uses the BENCH_MICRO schema.
+        from thunder_tpu._platform import force_cpu
+
+        force_cpu()
+        from thunder_tpu.benchmarks.anomaly_overhead import anomaly_overhead_bench
+
+        out = anomaly_overhead_bench(on_tpu=False)
+        artifact = {"backend": jax.default_backend(), **out}
+        with open("BENCH_ANOMALY.json", "w") as f:
+            json.dump(artifact, f, indent=1)
+        for k, v in out["results"].items():
+            log(f"anomaly {k}: {v}")
+        print(json.dumps({
+            "metric": "anomaly_detection_overhead_x",
+            "value": out["results"]["overhead_x"],
+            "unit": "x",
+            # plain-vs-plain is definitionally 1.0: anomaly mode off takes
+            # the unmodified code path (byte-identical program)
+            "vs_baseline": 1.0,
+            "results": out["results"],
+        }))
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "cost":
         # analytic companion to the measured headline (no TPU needed): XLA's
         # own cost model on the compiled loss+grad at headline geometry, and

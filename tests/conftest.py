@@ -35,3 +35,19 @@ def diff_interpreted(fn, *args):
 import os as _os
 
 FUZZ_SCALE = max(1, int(_os.environ.get("THUNDER_TPU_FUZZ_SCALE", "1")))
+
+
+# one reset for all accumulated observability state (metrics registry, compile-
+# event ring buffer, profile reports) after every test — process-wide counters
+# otherwise bleed across tests and make registry assertions order-dependent
+import sys as _sys
+
+import pytest as _pytest
+
+
+@_pytest.fixture(autouse=True)
+def _reset_observability_state():
+    yield
+    tt = _sys.modules.get("thunder_tpu")
+    if tt is not None:
+        tt.reset_observability()

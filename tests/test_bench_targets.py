@@ -279,3 +279,19 @@ class TestHarnessTargets:
         null artifact)."""
         src = Path(bench.__file__).read_text()
         assert '"THUNDER_TPU_BENCH_MAX_WAIT_S", "600"' in src
+
+    def test_anomaly_overhead_bench_cpu(self):
+        """The anomaly-detection overhead bench (`bench.py anomaly`) must
+        measure plain vs anomaly-mode dispatch on the llama block target —
+        no perf gate (host timing jitters), but every number must be real
+        and a healthy input must detect nothing."""
+        from thunder_tpu.benchmarks.anomaly_overhead import anomaly_overhead_bench
+
+        out = anomaly_overhead_bench(on_tpu=False, iters=10)
+        assert out["shapes"]["cfg"] == "tiny-llama-debug"
+        r = out["results"]
+        for k in ("block_fwd_plain_us", "block_fwd_anomaly_us"):
+            assert r[k] > 0, (k, r)
+        assert r["overhead_x"] > 0
+        assert r["checked_symbols"] >= 1
+        assert r["anomalies_detected"] == 0, r

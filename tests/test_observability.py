@@ -1,12 +1,18 @@
 """Observability subsystem: per-symbol runtime profiling, compile-pipeline
-event tracing, and the unified metrics registry + hooks (ISSUE 2).
+event tracing, and the unified metrics registry + hooks (ISSUE 2), plus the
+numerics-and-memory layer (ISSUE 3): debug hooks, anomaly detection with
+source provenance, per-symbol memory accounting, and step telemetry.
 
 Covers: per-symbol stats on a small jitted model (counts match the
 instrumented trace, times monotone), Chrome-trace export validity (matched
-B/E events), metrics snapshot/reset, hook callbacks on cache miss vs key
-hit, the zero-overhead assertion (profiling disabled ⇒ no timing wrappers
-in the generated program), the dynamic env gates (satellite 1), and the
-unguardable-dict-keys sharp edge (satellite 2)."""
+B/E events, metadata rows, file-like sinks, ring wraparound), metrics
+snapshot/reset, hook callbacks on cache miss vs key hit (errors counted in
+``hooks.errors``), the zero-overhead assertions (profiling/debugging
+disabled ⇒ byte-identical generated program), the dynamic env gates,
+the unguardable-dict-keys sharp edge, pre/post debug hooks with provenance,
+AnomalyError on forward and backward NaN/Inf (incl. a NaN injected via a
+custom grad rule), provenance surviving fusion, live/peak-bytes columns,
+StepLogger JSONL + registry mirror, and ``tt.reset_observability``."""
 from __future__ import annotations
 
 import json
@@ -132,9 +138,13 @@ class TestCompileEvents:
         # at least the interpret/transform/lower/compile pipeline phases
         assert {"compile", "interpret", "lower", "codegen"} <= names, names
         assert any(n.startswith("transform:") for n in names), names
+        # Perfetto metadata rows (satellite: process/thread labels)
+        assert "process_name" in names and "thread_name" in names, names
         for e in evs:
-            assert e["ph"] in ("B", "E")
-            assert isinstance(e["ts"], float) and "pid" in e and "tid" in e
+            assert e["ph"] in ("B", "E", "M")
+            if e["ph"] != "M":
+                assert isinstance(e["ts"], float)
+            assert "pid" in e and "tid" in e
         for name in names:
             b = sum(1 for e in evs if e["name"] == name and e["ph"] == "B")
             en = sum(1 for e in evs if e["name"] == name and e["ph"] == "E")
@@ -356,3 +366,336 @@ class TestUnguardableKeySharpEdge:
             keys = _read_keys(ctx, d)  # fully guardable: no sharp edge
         assert keys == ["a", ("b", 0)]
         assert any(r.inst is PseudoInst.KEYS for r, _ in ctx.reads)
+
+
+#
+# ISSUE 3: numerics observability — debug hooks, anomaly detection with
+# provenance, memory accounting, telemetry, and the one-call reset
+#
+
+
+def _nan_mid(a):
+    z = a - a
+    return (z / z).sum()  # 0/0 -> NaN mid-trace
+
+
+def _inf_mid(a):
+    z = a - a
+    return (1.0 / z).sum()  # 1/0 -> Inf mid-trace
+
+
+class TestDebugHooks:
+    def test_pre_post_fire_with_symbol_info_and_provenance(self):
+        calls = []
+
+        def pre(info, args, kwargs):
+            calls.append(("pre", info.name, info.trace))
+
+        def post(info, out):
+            calls.append(("post", info.name, info.trace))
+            assert any(f.endswith("test_observability.py") for f, _ in info.provenance), info
+
+        x, w = _xw()
+        jfn = tt.jit(_mlp, debug_hooks=(pre, post))
+        out = jfn(x, w)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(tt.jit(_mlp)(x, w)), rtol=1e-6
+        )
+        kinds = {c[0] for c in calls}
+        assert kinds == {"pre", "post"}, calls
+        assert all(c[2] == "computation" for c in calls)
+
+    def test_single_callable_and_dict_forms(self):
+        seen = []
+        jfn = tt.jit(_mlp, debug_hooks=lambda info, out: seen.append(info.name))
+        jfn(*_xw())
+        assert seen  # single callable == post hook
+
+        seen2 = []
+        jfn2 = tt.jit(_mlp, debug_hooks={"pre": lambda i, a, k: seen2.append(i.name)})
+        jfn2(*_xw())
+        assert seen2
+
+    def test_hook_exceptions_propagate(self):
+        # debug hooks exist to STOP the program — unlike metrics hooks,
+        # their exceptions are not swallowed
+        def post(info, out):
+            raise ValueError("stop here")
+
+        jfn = tt.jit(_mlp, debug_hooks={"post": post})
+        with pytest.raises(ValueError, match="stop here"):
+            jfn(*_xw())
+
+    def test_backward_trace_hooks_under_grad(self):
+        traces = set()
+        g = tt.grad(
+            lambda a: ltorch.relu(a).sum(),
+            debug_hooks={"post": lambda i, o: traces.add(i.trace)},
+        )
+        g(rng.standard_normal((4, 4)).astype(np.float32))
+        assert traces == {"computation", "backward"}, traces
+
+    def test_byte_identical_program_when_disabled(self):
+        x, w = _xw()
+        plain = tt.jit(_mlp)
+        plain(x, w)
+        src = tt.last_traces(plain)[-1].python()
+        assert "_dbg" not in src
+
+        off = tt.jit(_mlp, detect_anomalies=False)
+        off(x, w)
+        assert tt.last_traces(off)[-1].python() == src
+
+        on = tt.jit(_mlp, detect_anomalies=True)
+        on(x, w)
+        traces = tt.last_traces(on)
+        assert "_dbg" in traces[-1].python()
+        # instrumentation is purely additive, as a final pass
+        assert traces[-2].python() == src
+
+
+class TestAnomalyDetection:
+    def test_forward_nan_names_symbol_and_user_line(self):
+        x = rng.standard_normal((8,)).astype(np.float32)
+        jfn = tt.jit(_nan_mid, detect_anomalies=True)
+        with pytest.raises(tt.AnomalyError) as ei:
+            jfn(x)
+        e = ei.value
+        assert e.kind == "nan" and e.trace == "computation"
+        assert e.nan_count >= 1
+        assert e.symbol  # names the executed symbol (fusion region or op)
+        assert any(f.endswith("test_observability.py") for f, _ in e.provenance), e.provenance
+        assert "test_observability.py" in str(e) and "repro" in str(e)
+
+    def test_forward_inf_detected(self):
+        x = rng.standard_normal((8,)).astype(np.float32)
+        jfn = tt.jit(_inf_mid, detect_anomalies=True)
+        with pytest.raises(tt.AnomalyError) as ei:
+            jfn(x)
+        assert ei.value.kind == "inf" and ei.value.inf_count >= 1
+
+    def test_no_false_positive_and_results_match(self):
+        x, w = _xw()
+        expected = tt.jit(_mlp)(x, w)
+        got = tt.jit(_mlp, detect_anomalies=True)(x, w)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected), rtol=1e-6)
+
+    def test_env_var_enables_anomaly_mode(self, monkeypatch):
+        monkeypatch.setenv("THUNDER_TPU_DETECT_ANOMALIES", "1")
+        x = rng.standard_normal((8,)).astype(np.float32)
+        with pytest.raises(tt.AnomalyError):
+            tt.jit(_nan_mid)(x)
+
+    def test_backward_nan_via_custom_grad(self, monkeypatch):
+        # satellite: a custom grad rule injects NaN into the backward trace;
+        # the forward stays finite, so the raise must come from the backward
+        # instrumentation and still name the user's source line
+        from thunder_tpu import clang
+        from thunder_tpu.core import transforms as T
+        from thunder_tpu.core.prims import PrimIDs
+
+        def nan_rule(bsym, g):
+            a = bsym.args[0]
+            return [(a, clang.full_like(a, float("nan")))]
+
+        monkeypatch.setitem(T.backward_rules, PrimIDs.SIN, nan_rule)
+        g = tt.grad(lambda a: ltorch.sin(a).sum(), detect_anomalies=True)
+        with pytest.raises(tt.AnomalyError) as ei:
+            g(rng.standard_normal((4,)).astype(np.float32))
+        e = ei.value
+        assert e.kind == "nan" and e.trace == "backward"
+        assert any(f.endswith("test_observability.py") for f, _ in e.provenance), e.provenance
+
+    def test_anomaly_counter_incremented(self):
+        base = obs.registry().counter("anomaly.detected").value
+        x = rng.standard_normal((8,)).astype(np.float32)
+        with pytest.raises(tt.AnomalyError):
+            tt.jit(_nan_mid, detect_anomalies=True)(x)
+        assert obs.registry().counter("anomaly.detected").value == base + 1
+
+
+class TestProvenance:
+    def test_recorded_at_trace_time(self):
+        import inspect
+
+        x, w = _xw()
+        jfn = tt.jit(_mlp)
+        jfn(x, w)
+        acquisition = tt.last_traces(jfn)[0]
+        lines, start = inspect.getsourcelines(_mlp)
+        body = range(start, start + len(lines))
+        hits = [
+            b
+            for b in acquisition.bound_symbols
+            if b.source_filename is not None
+            and b.source_filename.endswith("test_observability.py")
+            and b.source_positions in body
+        ]
+        assert hits, [
+            (b.sym.name, b.source_filename, b.source_positions)
+            for b in acquisition.bound_symbols
+        ]
+
+    def test_provenance_survives_fusion(self):
+        from thunder_tpu.core.symbol import gather_provenance
+
+        x, w = _xw()
+        jfn = tt.jit(_mlp)
+        jfn(x, w)
+        extrace = tt.last_traces(jfn)[-1]
+        fusions = [b for b in extrace.bound_symbols if b.sym.is_fusion]
+        assert fusions, extrace.python()
+        fused = fusions[0]
+        # the fused region carries the provenance LIST of the ops it absorbed
+        assert isinstance(fused.source_positions, list) and fused.source_positions
+        prov = gather_provenance(fused)
+        assert any(f.endswith("test_observability.py") for f, _ in prov), prov
+
+    def test_backward_symbols_inherit_forward_provenance(self):
+        g = tt.grad(lambda a: ltorch.relu(a).sum())
+        g(rng.standard_normal((4, 4)).astype(np.float32))
+        from thunder_tpu.core.symbol import gather_provenance
+
+        bw = tt.last_backward_traces(g)[-1]
+        prov = [p for b in bw.bound_symbols for p in gather_provenance(b)]
+        assert any(f.endswith("test_observability.py") for f, _ in prov), prov
+
+
+class TestMemoryAccounting:
+    def test_timeline_matches_estimate_and_alignment(self):
+        from thunder_tpu.examine import memory_estimate, memory_timeline
+
+        x, w = _xw()
+        jfn = tt.jit(_mlp)
+        jfn(x, w)
+        trc = tt.last_traces(jfn)[-1]
+        t = memory_timeline(trc)
+        m = memory_estimate(trc)
+        assert len(t["rows"]) == len(trc.bound_symbols)
+        assert t["peak_bytes_estimate"] == m["peak_bytes_estimate"]
+        assert m["peak_bytes_estimate"] >= m["input_bytes"] > 0
+        peaks = [r["peak_bytes"] for r in t["rows"]]
+        assert peaks == sorted(peaks)  # running peak is monotone
+        assert peaks[-1] == t["peak_bytes_estimate"]
+        assert all(0 <= r["live_bytes"] <= r["peak_bytes"] for r in t["rows"])
+        # del placement must actually free: some row's live drops below peak
+        assert any(r["live_bytes"] < r["peak_bytes"] for r in t["rows"])
+
+    def test_profile_stats_has_memory_columns_and_gauges(self):
+        x, w = _xw()
+        jfn = tt.jit(_mlp, profile=True)
+        jfn(x, w)
+        report = tt.profile_stats(jfn)
+        stats = dict(report)
+        assert any("live_bytes" in st and "peak_bytes" in st for st in stats.values()), stats
+        for st in stats.values():
+            if "live_bytes" in st:
+                assert 0 <= st["live_bytes"] <= st["peak_bytes"]
+        assert "live_mb" in str(report) and "peak_mb" in str(report)
+        gauge = obs.registry().gauge("memory.computation.peak_bytes_estimate")
+        assert gauge.value is not None and gauge.value > 0
+
+
+class TestStepLogger:
+    def test_jsonl_and_registry_mirror(self):
+        import io
+
+        from thunder_tpu.observability.telemetry import StepLogger
+
+        reg = obs.registry()
+        base_steps = reg.counter("train.steps").value
+        buf = io.StringIO()
+        with StepLogger(buf, meta={"config": "tiny", "mode": "none"}) as sl:
+            sl.log_step(0, loss=1.5, step_time_s=0.5, tokens=100, peak_bytes=1000)
+            sl.log_step(1, loss=1.25, grad_norm=0.7, step_time_s=0.25, tokens=100)
+        lines = [json.loads(l) for l in buf.getvalue().splitlines()]
+        assert len(lines) == 3
+        assert lines[0]["event"] == "run_start" and lines[0]["config"] == "tiny"
+        assert lines[1]["event"] == "step" and lines[1]["peak_bytes"] == 1000
+        assert lines[1]["tokens_per_sec"] == pytest.approx(200.0)
+        assert lines[2]["grad_norm"] == 0.7 and "peak_bytes" not in lines[2]
+        assert reg.counter("train.steps").value == base_steps + 2
+        assert reg.gauge("train.loss").value == 1.25
+        assert reg.gauge("train.grad_norm").value == 0.7
+        assert reg.histogram("train.step_s").snapshot()["count"] >= 2
+
+    def test_path_sink_appends_and_closes(self, tmp_path):
+        from thunder_tpu.observability.telemetry import StepLogger
+
+        path = tmp_path / "steps.jsonl"
+        sl = StepLogger(str(path))
+        sl.log_step(0, loss=2.0)
+        sl.close()
+        sl2 = StepLogger(str(path))
+        sl2.log_step(1, loss=1.0)
+        sl2.close()
+        lines = [json.loads(l) for l in path.read_text().splitlines()]
+        assert [l["step"] for l in lines] == [0, 1]
+
+
+class TestResetObservability:
+    def test_one_call_clears_metrics_events_and_reports(self):
+        x, w = _xw()
+        obs.registry().counter("reset.probe").inc()
+        obs.record_event("i", "reset-marker")
+        jfn = tt.jit(_mlp, profile=True)
+        jfn(x, w)
+        report = tt.profile_stats(jfn)
+        assert len(report) >= 1
+        assert obs.events()
+
+        tt.reset_observability()
+        assert obs.registry().counter("reset.probe").value == 0
+        assert obs.events() == []
+        assert len(report) == 0  # live reports cleared in place
+
+
+class TestEventExportSatellites:
+    def test_export_accepts_file_like_and_emits_metadata(self):
+        import io
+
+        obs.clear_events()
+        with obs.span("satellite-phase"):
+            pass
+        buf = io.StringIO()
+        assert obs.export_chrome_trace(buf) is buf
+        data = json.loads(buf.getvalue())
+        names = [e["name"] for e in data["traceEvents"]]
+        assert "process_name" in names and "thread_name" in names
+        assert "satellite-phase" in names
+
+    def test_ring_wraparound_drops_oldest_and_export_stays_valid(self):
+        import io
+
+        obs.clear_events()
+        cap = obs.event_buffer_capacity()
+        for i in range(cap + 50):
+            obs.record_event("i", f"e{i}")
+        evs = obs.events()
+        assert len(evs) == cap
+        names = {e["name"] for e in evs}
+        assert "e0" not in names and f"e{cap + 49}" in names  # oldest dropped
+        buf = io.StringIO()
+        obs.export_chrome_trace(buf)
+        data = json.loads(buf.getvalue())  # still valid JSON
+        assert len(data["traceEvents"]) >= cap
+        obs.clear_events()
+
+
+class TestHookErrorCounter:
+    def test_swallowed_hook_exceptions_are_counted(self):
+        reg = obs.registry()
+        base = reg.counter("hooks.errors").value
+
+        def broken(p):
+            raise RuntimeError("boom")
+
+        obs.register_hook("on_cache_hit", broken)
+        try:
+            with warnings.catch_warnings(record=True) as ws:
+                warnings.simplefilter("always")
+                obs.emit("on_cache_hit", {"fn": "f"})
+            assert any("boom" in str(w.message) for w in ws)
+        finally:
+            obs.unregister_hook("on_cache_hit", broken)
+        assert reg.counter("hooks.errors").value == base + 1

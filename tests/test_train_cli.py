@@ -69,3 +69,27 @@ def test_cli_fused_ce_training(tmp_path):
     report = json.loads(out.stdout.strip().splitlines()[-1])
     assert report["fused_ce"] is True
     assert report["final_loss"] < 6.0
+
+
+def test_cli_telemetry_jsonl(tmp_path):
+    """--telemetry writes one run_start record plus one structured record per
+    step (loss, step time, tokens/sec, peak-bytes estimate, grad norm),
+    mirroring the StepLogger contract (ISSUE 3 training-step telemetry)."""
+    path = tmp_path / "telemetry.jsonl"
+    out = subprocess.run(
+        [sys.executable, "train_cli.py", "--mode", "fsdp", "--devices", "4",
+         "--virtual-cpu", "--steps", "3", "--batch", "4", "--seq", "32",
+         "--telemetry", str(path), "--telemetry-grad-norm"],
+        capture_output=True, text=True, timeout=900, cwd="/root/repo",
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    assert lines[0]["event"] == "run_start"
+    assert lines[0]["mode"] == "fsdp" and lines[0]["seq"] == 32
+    steps = [l for l in lines if l["event"] == "step"]
+    assert [s["step"] for s in steps] == [0, 1, 2]
+    for s in steps:
+        assert s["loss"] < 10 and s["step_time_s"] > 0
+        assert s["tokens"] == 4 * 32 and s["tokens_per_sec"] > 0
+        assert s["peak_bytes"] > 0
+        assert s["grad_norm"] > 0

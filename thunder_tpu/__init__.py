@@ -61,6 +61,8 @@ from thunder_tpu.core.transform_common import absorb_ce_widening_converts, cse, 
 from thunder_tpu.extend import resolve_executors
 from thunder_tpu.functional import trace_from_fn
 from thunder_tpu import observability  # noqa: F401  (metrics/events/profiler)
+from thunder_tpu.observability import reset_observability
+from thunder_tpu.observability.debug import AnomalyError
 from thunder_tpu.observability.events import span as _phase_span
 
 __version__ = "0.1.0"
@@ -89,6 +91,8 @@ __all__ = [
     "profile_stats",
     "export_chrome_trace",
     "observability",
+    "reset_observability",
+    "AnomalyError",
     "dtypes",
 ]
 
@@ -410,6 +414,34 @@ def _compile(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict) -> Ca
             cs.profile_report = ProfileReport()
         profile_report = cs.profile_report
 
+    # numerics-debugging transform (observability/debug.py): pre/post hooks
+    # on every executed symbol plus the NaN/Inf anomaly scan.  Like the
+    # profiler, applied LAST and only when requested — off means the
+    # generated program is byte-identical to the uninstrumented one
+    detect_opt = get_compile_option(
+        "detect_anomalies",
+        "Scan every instrumented symbol's outputs for NaN/Inf and raise a "
+        "structured AnomalyError naming the symbol and the user source line "
+        "(forward and backward traces).",
+        default=None,
+    )
+    anomaly_on = (
+        bool(detect_opt) if detect_opt is not None else observability.anomaly_env_enabled()
+    )
+    debug_hooks_opt = get_compile_option(
+        "debug_hooks",
+        "Pre/post callbacks on every executed BoundSymbol/fusion region: "
+        "(pre, post) tuple, {'pre':..., 'post':...} dict, or one callable "
+        "(post).  Each receives a SymbolInfo with name and source provenance.",
+        default=None,
+    )
+    debug_cfg = None
+    if anomaly_on or debug_hooks_opt is not None:
+        from thunder_tpu.observability.debug import resolve_debug_hooks
+
+        dbg_pre, dbg_post = resolve_debug_hooks(debug_hooks_opt)
+        debug_cfg = {"pre": dbg_pre, "post": dbg_post, "detect_anomalies": anomaly_on}
+
     cs.last_trace_tracing_start = time.perf_counter_ns()
     from thunder_tpu.core.sharp_edges import sharp_edges_guard
 
@@ -502,6 +534,13 @@ def _compile(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict) -> Ca
         cs.last_backward_traces.append(bw_extrace)
         bw_extrace = del_last_used(bw_extrace)
         cs.last_backward_traces.append(bw_extrace)
+        if debug_cfg is not None:
+            from thunder_tpu.observability.debug import instrument_for_debugging
+
+            bw_extrace = instrument_for_debugging(
+                bw_extrace, which="backward", **debug_cfg
+            )
+            cs.last_backward_traces.append(bw_extrace)
         if profile_report is not None:
             from thunder_tpu.observability.profiler import instrument_for_profiling
 
@@ -516,6 +555,12 @@ def _compile(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict) -> Ca
     cs.last_traces.append(extrace)
     extrace = del_last_used(extrace)
     cs.last_traces.append(extrace)
+    if debug_cfg is not None:
+        from thunder_tpu.observability.debug import instrument_for_debugging
+
+        with _phase_span("transform:debug_instrumentation"):
+            extrace = instrument_for_debugging(extrace, **debug_cfg)
+        cs.last_traces.append(extrace)
     if profile_report is not None:
         from thunder_tpu.observability.profiler import instrument_for_profiling
 

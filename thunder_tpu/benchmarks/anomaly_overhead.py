@@ -1,0 +1,82 @@
+"""Anomaly-detection overhead microbench: instrumented vs uninstrumented
+dispatch on the llama block target.
+
+Anomaly mode (observability/debug.py) is opt-in; when it IS on, its cost is
+one ``jnp.isfinite().all()`` reduction + host sync per instrumented symbol.
+This bench measures the plain jit vs the anomaly-mode jit of the same llama
+forward so ``bench.py anomaly`` can police that (a) disabled anomaly
+detection costs nothing (byte-identical program, same code path) and (b)
+enabled detection stays proportionate to the debugging value.  The artifact
+(``BENCH_ANOMALY.json``) uses the BENCH_MICRO schema.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from thunder_tpu.benchmarks.timing import host_us_per_call
+
+__all__ = ["anomaly_overhead_bench"]
+
+
+def anomaly_overhead_bench(on_tpu: bool = False, iters: int = 50) -> dict:
+    """Returns ``{"shapes": {...}, "results": {...}}`` (the BENCH_MICRO.json
+    artifact schema).  Results: µs/call for the plain and anomaly-mode jits
+    of the llama block forward, the overhead ratio, the number of
+    instrumented (checked) symbols, and the registry's anomaly counter
+    (must stay 0 on healthy inputs)."""
+    import thunder_tpu as tt
+    from thunder_tpu.models import llama
+    from thunder_tpu.observability.metrics import registry
+
+    if on_tpu:
+        cfg = llama.Config.from_name(
+            "Llama-2-7b-hf", n_layer=1, n_embd=2048, n_head=16, intermediate_size=5504
+        )
+        B, T, dt = 4, 2048, jnp.bfloat16
+    else:
+        cfg = llama.Config.from_name("tiny-llama-debug")
+        B, T, dt = 2, 64, jnp.float32
+    T = min(T, cfg.block_size)
+    key = jax.random.PRNGKey(0)
+    params = llama.init_params(cfg, key, dtype=dt)
+    idx = jax.random.randint(jax.random.PRNGKey(1), (B, T), 0, cfg.vocab_size)
+    cos, sin = llama.build_rope_cache(cfg, T, dtype=jnp.float32)
+
+    def block_fwd(p, i, c, s):
+        return llama.gpt_forward(p, i, c, s, cfg)
+
+    plain = tt.jit(block_fwd)
+    anomaly = tt.jit(block_fwd, detect_anomalies=True)
+
+    detected_before = registry().counter("anomaly.detected").value
+    results = {
+        "block_fwd_plain_us": round(
+            host_us_per_call(plain, params, idx, cos, sin, iters=iters), 3
+        ),
+        "block_fwd_anomaly_us": round(
+            host_us_per_call(anomaly, params, idx, cos, sin, iters=iters), 3
+        ),
+    }
+    plain_us = results["block_fwd_plain_us"]
+    results["overhead_x"] = (
+        round(results["block_fwd_anomaly_us"] / plain_us, 3) if plain_us > 0 else None
+    )
+    results["checked_symbols"] = sum(
+        1
+        for b in tt.last_traces(anomaly)[-1].bound_symbols
+        if b.sym.name.startswith("_dbg")
+    )
+    results["anomalies_detected"] = (
+        registry().counter("anomaly.detected").value - detected_before
+    )
+    return {
+        "shapes": {
+            "cfg": cfg.name,
+            "n_layer": cfg.n_layer,
+            "B": B,
+            "T": T,
+            "dtype": jnp.dtype(dt).name,
+        },
+        "results": results,
+    }

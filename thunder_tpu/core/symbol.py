@@ -8,6 +8,11 @@ hierarchy that executors can claim at any level.
 """
 from __future__ import annotations
 
+import os
+import sys
+import sysconfig
+from contextlib import contextmanager
+from contextvars import ContextVar
 from dataclasses import dataclass, field, replace
 from typing import Any, Callable, Hashable, Sequence
 
@@ -17,7 +22,84 @@ from thunder_tpu.core.codeutils import prettyprint, to_printable
 from thunder_tpu.core.proxies import Proxy, TensorProxy, Variable, variableify
 from thunder_tpu.core.pytree import tree_flatten, tree_unflatten
 
-__all__ = ["Symbol", "BoundSymbol", "BoundSymbolRHS", "has_tags", "gather_tags"]
+__all__ = [
+    "Symbol",
+    "BoundSymbol",
+    "BoundSymbolRHS",
+    "has_tags",
+    "gather_tags",
+    "gather_provenance",
+    "provenance_inherited",
+]
+
+
+#
+# Source provenance: which user line produced a bound symbol.
+#
+# Recorded at trace time (Symbol.__call__ walks up past the framework frames
+# to the first user frame) and carried through every rewriting pass via
+# from_bsym, so anomaly detection and debug hooks can name the user's
+# file:line even after claiming and fusion.  Framework machinery = anything
+# under the thunder_tpu package (except models/, which IS user-level model
+# code), the stdlib, and site-packages; everything else is "user code".
+#
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PKG_USER_DIRS = (os.path.join(_PKG_ROOT, "models"),)
+_STDLIB = sysconfig.get_paths().get("stdlib", "")
+_SEP = os.sep
+
+# per-filename machinery verdicts; traces revisit the same few files thousands
+# of times, so this keeps the per-bsym cost at one dict hit per frame
+_machinery_files: dict[str, bool] = {}
+
+
+def _is_machinery_file(fname: str) -> bool:
+    hit = _machinery_files.get(fname)
+    if hit is None:
+        hit = (
+            not fname
+            or fname.startswith("<")
+            or (f"{_SEP}site-packages{_SEP}" in fname)
+            or (_STDLIB and fname.startswith(_STDLIB + _SEP))
+            or (
+                fname.startswith(_PKG_ROOT + _SEP)
+                and not fname.startswith(_PKG_USER_DIRS)
+            )
+        )
+        _machinery_files[fname] = hit
+    return hit
+
+
+def _capture_provenance() -> tuple[str | None, int | None]:
+    """(filename, lineno) of the nearest user frame, or (None, None)."""
+    f = sys._getframe(2)
+    depth = 0
+    while f is not None and depth < 64:
+        if not _is_machinery_file(f.f_code.co_filename):
+            return f.f_code.co_filename, f.f_lineno
+        f = f.f_back
+        depth += 1
+    return None, None
+
+
+# rewriting passes that re-trace on behalf of an existing bsym (executor
+# execution_transforms, backward-rule expansion) set this so the freshly
+# recorded bsyms inherit the original's provenance instead of walking a
+# stack made entirely of framework frames
+_provenance_override: ContextVar[tuple | None] = ContextVar(
+    "provenance_override", default=None
+)
+
+
+@contextmanager
+def provenance_inherited(bsym: "BoundSymbol"):
+    """Bound symbols recorded inside inherit ``bsym``'s source provenance."""
+    token = _provenance_override.set((bsym.source_filename, bsym.source_positions))
+    try:
+        yield
+    finally:
+        _provenance_override.reset(token)
 
 
 def default_python_printer(bsym: "BoundSymbol", out_printables, arg_printables, kwarg_printables) -> str:
@@ -198,6 +280,11 @@ class Symbol(SymbolInterface):
                     return result
 
         bsym = self.bind(*args, output=result, subsymbols=subsymbols, **kwargs)
+        override = _provenance_override.get()
+        if override is not None:
+            bsym.source_filename, bsym.source_positions = override
+        else:
+            bsym.source_filename, bsym.source_positions = _capture_provenance()
         trace.record(bsym)
         return result
 
@@ -282,6 +369,8 @@ class BoundSymbol(BoundSymbolInterface):
             subsymbols=kwargs.get("subsymbols", self.subsymbols),
             _call_ctx=kwargs.get("_call_ctx", self._call_ctx),
             header=kwargs.get("header", self.header),
+            source_filename=kwargs.get("source_filename", self.source_filename),
+            source_positions=kwargs.get("source_positions", self.source_positions),
         )
         return new
 
@@ -404,6 +493,38 @@ class BoundSymbolRHS:
             return self._key == other._key
         except Exception:
             return self.bsym is other.bsym
+
+
+def gather_provenance(bsym: BoundSymbol) -> tuple[tuple[str, Any], ...]:
+    """Ordered, de-duplicated ``(filename, position)`` pairs for ``bsym`` and
+    its subsymbols — for a fusion region this is the provenance list of every
+    op folded into it.  A bsym whose ``source_filename`` is None but whose
+    ``source_positions`` is a sequence carries a pre-gathered list (fusion
+    symbols store one so provenance survives passes that drop subsymbols)."""
+    out: list[tuple[str, Any]] = []
+    seen: set = set()
+
+    def add(entry) -> None:
+        try:
+            new = entry not in seen
+        except TypeError:  # unhashable position payloads: keep, unde-duplicated
+            out.append(entry)
+            return
+        if new:
+            seen.add(entry)
+            out.append(entry)
+
+    def walk(b: BoundSymbol) -> None:
+        if b.source_filename is not None:
+            add((b.source_filename, b.source_positions))
+        elif isinstance(b.source_positions, (list, tuple)):
+            for entry in b.source_positions:
+                add(tuple(entry) if isinstance(entry, list) else entry)
+        for sub in b.subsymbols:
+            walk(sub)
+
+    walk(bsym)
+    return tuple(out)
 
 
 def gather_tags(bsym: BoundSymbol) -> set:

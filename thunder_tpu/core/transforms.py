@@ -30,7 +30,7 @@ from thunder_tpu.core.codeutils import SigInfo
 from thunder_tpu.core.prims import OpTags, PrimIDs
 from thunder_tpu.core.proxies import Proxy, TensorProxy, Variable, variableify
 from thunder_tpu.core.pytree import tree_flatten, tree_unflatten
-from thunder_tpu.core.symbol import BoundSymbol
+from thunder_tpu.core.symbol import BoundSymbol, provenance_inherited
 from thunder_tpu.core.trace import TraceCtx, TraceTag, from_trace, tracectx
 from thunder_tpu.core.transform_common import dce
 
@@ -970,15 +970,19 @@ def forward_and_backward_from_trace(trace: TraceCtx) -> tuple[TraceCtx, TraceCtx
             if all(ct is None for ct in cts):
                 continue
             rule = backward_rules.get(bsym.sym.id, _generic_vjp_rule)
-            if not getattr(rule, "_accepts_none_cotangents", False):
-                cts = [
-                    ct if ct is not None else clang.full_like(o, 0.0)
-                    for ct, o in zip(cts, outs)
-                ]
-            pairs = rule(bsym, *cts)
-            for inp, g in pairs:
-                if isinstance(inp, TensorProxy) and inp.name in needs_grad and dtypes.is_inexact_dtype(inp.dtype):
-                    accumulate(inp, g)
+            # the backward ops a rule records inherit the FORWARD bsym's
+            # source provenance: a NaN surfacing in the backward trace then
+            # names the user line whose gradient produced it
+            with provenance_inherited(bsym):
+                if not getattr(rule, "_accepts_none_cotangents", False):
+                    cts = [
+                        ct if ct is not None else clang.full_like(o, 0.0)
+                        for ct, o in zip(cts, outs)
+                    ]
+                pairs = rule(bsym, *cts)
+                for inp, g in pairs:
+                    if isinstance(inp, TensorProxy) and inp.name in needs_grad and dtypes.is_inexact_dtype(inp.dtype):
+                        accumulate(inp, g)
 
         input_grads = []
         for p in grad_inputs:

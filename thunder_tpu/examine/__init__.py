@@ -11,7 +11,14 @@ from __future__ import annotations
 import collections
 from typing import Any, Callable
 
-__all__ = ["examine", "get_fusions", "get_fusion_symbols", "memory_estimate", "cost_analysis"]
+__all__ = [
+    "examine",
+    "get_fusions",
+    "get_fusion_symbols",
+    "memory_estimate",
+    "memory_timeline",
+    "cost_analysis",
+]
 
 
 def _collect_torch_functions(fn, args, kwargs):
@@ -131,43 +138,27 @@ def get_fusions(trace) -> list[tuple[str, Callable]]:
 def memory_estimate(trace) -> dict[str, int]:
     """Bytes of inputs / outputs / peak-intermediate estimate for a trace
     (reference examine/memory_caculation.py).  The intermediate estimate
-    walks the trace with del-aware liveness: it is the ceiling XLA's own
-    buffer reuse then improves on."""
-    from thunder_tpu.core.prims import PrimIDs
-    from thunder_tpu.core.proxies import TensorProxy
+    walks the trace with del-aware liveness (the shared pass in
+    ``observability/memory.py``): it is the ceiling XLA's own buffer reuse
+    then improves on.  ``memory_timeline(trace)`` returns the per-symbol
+    live/peak rows behind this summary."""
+    from thunder_tpu.observability.memory import memory_timeline
 
-    def nbytes(p) -> int:
-        if not isinstance(p, TensorProxy):
-            return 0
-        n = 1
-        for s in p.shape:
-            n *= int(s)
-        return n * p.dtype.bytes
+    t = memory_timeline(trace)
+    return {
+        "input_bytes": t["input_bytes"],
+        "output_bytes": t["output_bytes"],
+        "peak_bytes_estimate": t["peak_bytes_estimate"],
+    }
 
-    inputs = sum(nbytes(p) for p in trace.args if isinstance(p, TensorProxy))
-    outputs = 0
-    live: dict[str, int] = {}
-    peak = 0
-    for p in trace.args:
-        if isinstance(p, TensorProxy):
-            live[p.name] = nbytes(p)
-    cur = sum(live.values())
-    peak = cur
-    for bsym in trace.bound_symbols:
-        if bsym.sym.id == PrimIDs.RETURN:
-            outputs = sum(nbytes(p) for p in bsym.flat_proxy_args)
-            continue
-        if bsym.sym.id == PrimIDs.DEL:
-            for p in bsym.flat_proxy_args:
-                cur -= live.pop(p.name, 0)
-            continue
-        for o in bsym.flat_proxy_outs:
-            if o.name not in live:
-                b = nbytes(o)
-                live[o.name] = b
-                cur += b
-        peak = max(peak, cur)
-    return {"input_bytes": inputs, "output_bytes": outputs, "peak_bytes_estimate": peak}
+
+def memory_timeline(trace) -> dict:
+    """Per-symbol live/peak-bytes rows for ``trace`` (del-aware liveness,
+    keyed to ``del_last_used`` placement) — see
+    ``thunder_tpu.observability.memory.memory_timeline``."""
+    from thunder_tpu.observability.memory import memory_timeline as _mt
+
+    return _mt(trace)
 
 
 # hardware peaks (bf16 FLOP/s, HBM bytes/s) keyed by jax backend — the ONE

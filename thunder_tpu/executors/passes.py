@@ -11,7 +11,7 @@ from typing import Any, Sequence
 
 from thunder_tpu.core.prims import OpTags, PrimIDs
 from thunder_tpu.core.proxies import Proxy, variableify
-from thunder_tpu.core.symbol import BoundSymbol
+from thunder_tpu.core.symbol import BoundSymbol, provenance_inherited
 from thunder_tpu.core.trace import TraceCtx, TraceProvenance, from_trace, tracectx
 from thunder_tpu.core.transform_common import dce
 from thunder_tpu.extend import Executor, FusionExecutor, OperatorExecutor
@@ -133,9 +133,11 @@ def _claim_bsym(trace: TraceCtx, bsym: BoundSymbol, executors: Sequence[Executor
 
 def _apply_execution_transform(trace: TraceCtx, bsym: BoundSymbol, transform) -> list[BoundSymbol]:
     """Re-traces ``bsym`` through an executor's execution_transform, swapping
-    the transform's outputs back to the original output proxies."""
+    the transform's outputs back to the original output proxies.  The
+    replacement bsyms inherit the original's source provenance (the stack
+    here is all framework frames)."""
     with tracectx(trace):
-        with trace.push_scope() as scope:
+        with trace.push_scope() as scope, provenance_inherited(bsym):
             result = transform(*bsym.args, **bsym.kwargs)
 
     flat_old, _ = tree_flatten(bsym.output)

@@ -128,6 +128,13 @@ class XLAFusionExecutor(FusionExecutor):
             subsymbols=tuple(region_bsyms),
             _call_ctx={name: fusion},
         )
+        # a fused region keeps the provenance LIST of every op it absorbed
+        # (filename stays None: the list rides in source_positions, which
+        # gather_provenance and the anomaly reporter understand) so the user
+        # file:line survives even if a later pass drops the subsymbols
+        from thunder_tpu.core.symbol import gather_provenance
+
+        bsym.source_positions = list(gather_provenance(bsym))
         return bsym
 
     @_phase_span("lower:xla_fusion")

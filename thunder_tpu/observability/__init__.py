@@ -19,6 +19,20 @@ Three pillars:
    into, plus user hook callbacks (``on_compile_start/end``,
    ``on_cache_hit/miss``, ``on_dispatch``).
 
+Numerics-and-memory layer on top (ISSUE 3):
+
+4. **Debug hooks + anomaly detection** (``debug.py``) — pre/post callbacks
+   on every executed symbol (``tt.jit(fn, debug_hooks=...)``) and a NaN/Inf
+   scan raising :class:`AnomalyError` with source provenance
+   (``detect_anomalies=True`` / ``THUNDER_TPU_DETECT_ANOMALIES=1``).
+
+5. **Memory accounting** (``memory.py``) — del-aware live/peak-bytes
+   timeline behind ``examine.memory_estimate``, the ``live_bytes``/
+   ``peak_bytes`` profile columns, and the ``memory.*`` gauges.
+
+6. **Training-step telemetry** (``telemetry.py``) — ``StepLogger`` JSONL +
+   registry mirror, driven by ``train_cli.py --telemetry``.
+
 ``core/profile.py`` is now a shim over this package; its old import-frozen
 env gate is fixed here (``config.py`` reads the environment dynamically).
 """
@@ -28,6 +42,7 @@ import contextlib
 
 from thunder_tpu.observability.config import (  # noqa: F401
     annotations_enabled,
+    anomaly_env_enabled,
     event_buffer_capacity,
     profiling_env_enabled,
 )
@@ -55,9 +70,11 @@ from thunder_tpu.observability.metrics import (  # noqa: F401
 __all__ = [
     "annotations_enabled",
     "profiling_env_enabled",
+    "anomaly_env_enabled",
     "profiling_enabled",
     "add_markers",
     "snapshot",
+    "reset_observability",
     # events
     "span",
     "record_event",
@@ -106,6 +123,19 @@ def add_markers(msg: str):
 def snapshot() -> dict:
     """One plain dict of every registered metric (see ``metrics.py``)."""
     return registry().snapshot()
+
+
+def reset_observability() -> None:
+    """One call clearing all accumulated observability state: the metrics
+    registry (values zeroed, metric objects stay registered), the compile-
+    event ring buffer, and every live ProfileReport's accumulated per-symbol
+    records.  Registered user hooks are NOT touched.  Used by the test
+    suite's autouse fixture to stop cross-test bleed."""
+    registry().reset()
+    clear_events()
+    from thunder_tpu.observability.profiler import reset_profile_reports
+
+    reset_profile_reports()
 
 
 #

@@ -13,6 +13,7 @@ import os
 __all__ = [
     "annotations_enabled",
     "profiling_env_enabled",
+    "anomaly_env_enabled",
     "event_buffer_capacity",
 ]
 
@@ -35,6 +36,13 @@ def profiling_env_enabled() -> bool:
     for every ``jit`` that does not pass an explicit ``profile=`` option.
     Read at compile time (dynamically), so it can be flipped mid-process."""
     return _env_flag("THUNDER_TPU_PROFILE")
+
+
+def anomaly_env_enabled() -> bool:
+    """``THUNDER_TPU_DETECT_ANOMALIES=1`` turns on NaN/Inf anomaly detection
+    for every ``jit`` that does not pass an explicit ``detect_anomalies=``
+    option.  Read at compile time (dynamically)."""
+    return _env_flag("THUNDER_TPU_DETECT_ANOMALIES")
 
 
 def event_buffer_capacity() -> int:

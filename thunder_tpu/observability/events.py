@@ -71,11 +71,34 @@ def clear_events() -> None:
     _events.clear()
 
 
-def export_chrome_trace(path: str) -> str:
+def _metadata_events(evs: list[dict]) -> list[dict]:
+    """``process_name``/``thread_name`` metadata (``ph: "M"``) records so
+    Perfetto labels the rows instead of showing bare pid/tid numbers."""
+    metas = []
+    for pid in sorted({e["pid"] for e in evs}):
+        metas.append({
+            "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+            "args": {"name": "thunder_tpu compile pipeline"},
+        })
+    for pid, tid in sorted({(e["pid"], e["tid"]) for e in evs}):
+        metas.append({
+            "ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+            "args": {"name": f"thread {tid}"},
+        })
+    return metas
+
+
+def export_chrome_trace(path):
     """Writes the buffered compile-pipeline events as a Chrome-trace JSON
-    object (loadable in ``chrome://tracing`` and https://ui.perfetto.dev).
+    object (loadable in ``chrome://tracing`` and https://ui.perfetto.dev),
+    prefixed with process/thread-name metadata events.  ``path`` may be a
+    filesystem path or an open file-like object (written to, left open).
     Returns ``path``."""
-    payload = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
+    evs = list(_events)
+    payload = {"traceEvents": _metadata_events(evs) + evs, "displayTimeUnit": "ms"}
+    if hasattr(path, "write"):
+        json.dump(payload, path)
+        return path
     with open(path, "w") as f:
         json.dump(payload, f)
     return path

@@ -1,0 +1,77 @@
+"""Per-trace live/peak-bytes accounting keyed to ``del_last_used`` placement.
+
+The single liveness walk behind ``examine.memory_estimate`` and the
+live/peak columns in ``profile_stats``: inputs start live, each bound
+symbol's new tensor outputs allocate, each ``del`` frees, and the running
+sum/peak are recorded per symbol.  This is a static estimate over proxy
+shapes — the ceiling XLA's own buffer reuse then improves on — which is
+exactly what capacity planning wants: if the estimate fits HBM, the program
+fits.
+"""
+from __future__ import annotations
+
+from thunder_tpu.core.prims import PrimIDs
+from thunder_tpu.core.proxies import TensorProxy
+from thunder_tpu.core.trace import TraceCtx
+
+__all__ = ["tensor_nbytes", "memory_timeline"]
+
+
+def tensor_nbytes(p) -> int:
+    """Bytes of one tensor proxy (0 for non-tensors)."""
+    if not isinstance(p, TensorProxy):
+        return 0
+    n = 1
+    for s in p.shape:
+        n *= int(s)
+    return n * p.dtype.bytes
+
+
+def memory_timeline(trace: TraceCtx) -> dict:
+    """Walks ``trace`` with del-aware liveness and returns::
+
+        {
+          "rows": [...],              # aligned with trace.bound_symbols
+          "input_bytes": int,
+          "output_bytes": int,
+          "peak_bytes_estimate": int,
+        }
+
+    where each row is ``{"live_bytes", "peak_bytes"}`` — the live-set size
+    right after that symbol executes (before any following ``del``) and the
+    running peak up to and including it.
+    """
+    inputs = sum(tensor_nbytes(p) for p in (trace.args or ()) if isinstance(p, TensorProxy))
+    outputs = 0
+    live: dict[str, int] = {}
+    for p in trace.args or ():
+        if isinstance(p, TensorProxy):
+            live[p.name] = tensor_nbytes(p)
+    cur = sum(live.values())
+    peak = cur
+
+    rows: list[dict] = []
+    for bsym in trace.bound_symbols:
+        if bsym.sym.id == PrimIDs.RETURN:
+            outputs = sum(tensor_nbytes(p) for p in bsym.flat_proxy_args)
+            rows.append({"live_bytes": cur, "peak_bytes": peak})
+            continue
+        if bsym.sym.id == PrimIDs.DEL:
+            for p in bsym.flat_proxy_args:
+                cur -= live.pop(p.name, 0)
+            rows.append({"live_bytes": cur, "peak_bytes": peak})
+            continue
+        for o in bsym.flat_proxy_outs:
+            if o.name not in live:
+                b = tensor_nbytes(o)
+                live[o.name] = b
+                cur += b
+        peak = max(peak, cur)
+        rows.append({"live_bytes": cur, "peak_bytes": peak})
+
+    return {
+        "rows": rows,
+        "input_bytes": inputs,
+        "output_bytes": outputs,
+        "peak_bytes_estimate": peak,
+    }

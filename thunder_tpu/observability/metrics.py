@@ -12,7 +12,8 @@ registered, so held references keep working).
 User hook callbacks (``on_compile_start``/``on_compile_end``/
 ``on_cache_hit``/``on_cache_miss``/``on_dispatch``) receive one payload dict
 each.  Hook exceptions are swallowed with a warning — observability must
-never take down the dispatch path.
+never take down the dispatch path — and counted in the ``hooks.errors``
+registry counter so silent hook failures stay measurable.
 """
 from __future__ import annotations
 
@@ -215,7 +216,9 @@ def emit(event: str, payload: dict) -> None:
     for h in tuple(hs):
         try:
             h(payload)
-        except Exception as e:  # a broken hook must not break dispatch
+        except Exception as e:  # a broken hook must not break dispatch —
+            # but a silently swallowed failure must still be measurable
+            registry().counter("hooks.errors").inc()
             warnings.warn(
                 f"observability hook {getattr(h, '__name__', h)!r} for "
                 f"{event} raised {e!r}; ignoring",

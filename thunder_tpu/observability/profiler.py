@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import re
 import time
+import weakref
 from collections.abc import Mapping
 from dataclasses import dataclass
 from typing import Any, Callable
@@ -34,7 +35,12 @@ from thunder_tpu.core.trace import TraceCtx, TraceProvenance, from_trace
 from thunder_tpu.observability.config import annotations_enabled
 from thunder_tpu.observability.metrics import registry
 
-__all__ = ["SymbolProfile", "ProfileReport", "instrument_for_profiling"]
+__all__ = [
+    "SymbolProfile",
+    "ProfileReport",
+    "instrument_for_profiling",
+    "reset_profile_reports",
+]
 
 # never instrumented: control prims whose printed form is not a call, and
 # check/unpack prims (prologue machinery)
@@ -53,6 +59,10 @@ class SymbolProfile:
     total_ns: int = 0
     min_ns: int | None = None
     max_ns: int | None = None
+    # static memory-accounting estimates at this symbol's trace position
+    # (del-aware liveness over proxy shapes; observability/memory.py)
+    live_bytes: int | None = None
+    peak_bytes: int | None = None
     _cost_thunk: Callable | None = None
     _cost: tuple | None = None  # (flops|None, bytes|None), lazily computed
 
@@ -91,6 +101,10 @@ class SymbolProfile:
             d["flops"] = flops
         if bytes_accessed is not None:
             d["bytes"] = bytes_accessed
+        if self.live_bytes is not None:
+            d["live_bytes"] = self.live_bytes
+        if self.peak_bytes is not None:
+            d["peak_bytes"] = self.peak_bytes
         return d
 
 
@@ -103,6 +117,7 @@ class ProfileReport(Mapping):
     def __init__(self):
         self.records: list[SymbolProfile] = []
         self._labels: set[str] = set()
+        _REPORTS[id(self)] = self
 
     def add_record(self, symbol: str, index: int, trace: str) -> SymbolProfile:
         base = f"{symbol}" if trace == "computation" else f"{trace}:{symbol}"
@@ -137,13 +152,21 @@ class ProfileReport(Mapping):
         )
         if limit is not None:
             rows = rows[:limit]
-        header = f"{'symbol':<40} {'calls':>7} {'total_ms':>10} {'mean_us':>10} {'flops':>12} {'bytes':>12}"
+        header = (
+            f"{'symbol':<40} {'calls':>7} {'total_ms':>10} {'mean_us':>10} "
+            f"{'flops':>12} {'bytes':>12} {'live_mb':>9} {'peak_mb':>9}"
+        )
         lines = [header, "-" * len(header)]
+
+        def mb(v):
+            return f"{v / 1e6:.2f}" if isinstance(v, (int, float)) else "-"
+
         for name, st in rows:
             lines.append(
                 f"{name[:40]:<40} {st['calls']:>7} "
                 f"{st['total_ns'] / 1e6:>10.3f} {st['mean_ns'] / 1e3:>10.1f} "
-                f"{st.get('flops', '-')!s:>12} {st.get('bytes', '-')!s:>12}"
+                f"{st.get('flops', '-')!s:>12} {st.get('bytes', '-')!s:>12} "
+                f"{mb(st.get('live_bytes')):>9} {mb(st.get('peak_bytes')):>9}"
             )
         return "\n".join(lines)
 
@@ -152,6 +175,22 @@ class ProfileReport(Mapping):
 
     def __repr__(self) -> str:
         return f"<ProfileReport {len(self.records)} symbols>"
+
+
+# every live report, so tt.reset_observability() can clear accumulated
+# per-symbol stats without holding compiled functions alive.  Keyed by id:
+# ProfileReport is a Mapping (value equality, unhashable), so a WeakSet
+# would conflate distinct empty reports
+_REPORTS: "weakref.WeakValueDictionary[int, ProfileReport]" = weakref.WeakValueDictionary()
+
+
+def reset_profile_reports() -> None:
+    """Clears the accumulated records of every live ProfileReport (the
+    reports stay attached to their compiled functions and refill on the next
+    instrumented compilation/call)."""
+    for report in list(_REPORTS.values()):
+        report.records.clear()
+        report._labels.clear()
 
 
 def _sanitize(name: str) -> str:
@@ -297,6 +336,18 @@ def instrument_for_profiling(
     wall times attribute device work to the symbol that launched it (without
     it, async dispatch attributes everything to whatever synchronizes last).
     """
+    # static live/peak-bytes accounting at each symbol's trace position
+    # (del-aware liveness over proxy shapes) — the memory columns of
+    # profile_stats, mirrored into the registry as gauges
+    from thunder_tpu.observability.memory import memory_timeline
+
+    timeline = memory_timeline(trace)
+    registry().gauge(f"memory.{which}.peak_bytes_estimate").set(
+        timeline["peak_bytes_estimate"]
+    )
+    registry().gauge(f"memory.{which}.input_bytes").set(timeline["input_bytes"])
+    registry().gauge(f"memory.{which}.output_bytes").set(timeline["output_bytes"])
+
     ntrace = from_trace(trace)
     new_bsyms: list[BoundSymbol] = []
     n_wrapped = 0
@@ -306,6 +357,9 @@ def instrument_for_profiling(
             new_bsyms.append(bsym)
             continue
         rec = report.add_record(bsym.sym.name, i, which)
+        row = timeline["rows"][i]
+        rec.live_bytes = row["live_bytes"]
+        rec.peak_bytes = row["peak_bytes"]
         if with_cost:
             rec._cost_thunk = _cost_thunk_for(bsym, orig)
         wrapper = _make_timed(rec.name, orig, rec, barriers)

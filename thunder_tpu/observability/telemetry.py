@@ -1,0 +1,121 @@
+"""Training-step telemetry: structured per-step JSONL + registry mirror.
+
+Production pretraining stacks (TorchTitan, the arXiv:2410.06511 playbook)
+treat step-level telemetry — loss, grad-norm, step time, tokens/sec, memory
+watermark — as a first-class subsystem: a bad batch or an OOM-bound run must
+be diagnosable from the log, not by rerunning under a debugger.
+:class:`StepLogger` is that subsystem for thunder_tpu: ``train_cli.py``
+drives it once per optimizer step, it appends one JSON object per line to a
+file (or any file-like sink) and mirrors the same numbers into the unified
+metrics registry (``train.loss`` / ``train.grad_norm`` /
+``train.tokens_per_sec`` / ``train.peak_bytes`` gauges, a ``train.step_s``
+histogram, and a ``train.steps`` counter), so dashboards scraping
+``observability.snapshot()`` and offline JSONL analysis see the same data.
+
+The first line of a run is an ``{"event": "run_start", ...}`` record with
+the run's static metadata; every step is ``{"event": "step", ...}``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, IO
+
+from thunder_tpu.observability.metrics import registry
+
+__all__ = ["StepLogger", "trace_peak_bytes"]
+
+
+class StepLogger:
+    """Appends one structured JSON line per training step.
+
+    ``sink`` is a path (opened in append mode, closed by :meth:`close`) or
+    an open file-like object (left open).  ``meta`` is written once as the
+    run-start record.  ``mirror=False`` skips the metrics-registry mirror.
+    """
+
+    def __init__(
+        self,
+        sink: str | os.PathLike | IO[str],
+        *,
+        meta: dict | None = None,
+        mirror: bool = True,
+    ):
+        self._owns_sink = isinstance(sink, (str, os.PathLike))
+        self._f: IO[str] = open(sink, "a") if self._owns_sink else sink
+        self._mirror = mirror
+        self.steps_logged = 0
+        if meta is not None:
+            self._write({"event": "run_start", "time": time.time(), **meta})
+
+    def _write(self, rec: dict) -> None:
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()
+
+    def log_step(
+        self,
+        step: int,
+        *,
+        loss: float | None = None,
+        grad_norm: float | None = None,
+        step_time_s: float | None = None,
+        tokens: int | None = None,
+        peak_bytes: int | None = None,
+        **extra: Any,
+    ) -> dict:
+        """Records one step; returns the record written.
+
+        ``tokens`` is the number of tokens the step consumed —
+        ``tokens_per_sec`` is derived from it and ``step_time_s``.  Unset
+        fields are omitted from the JSON line (and not mirrored)."""
+        rec: dict[str, Any] = {"event": "step", "step": int(step), "time": time.time()}
+        if loss is not None:
+            rec["loss"] = float(loss)
+        if grad_norm is not None:
+            rec["grad_norm"] = float(grad_norm)
+        if step_time_s is not None:
+            rec["step_time_s"] = float(step_time_s)
+        if tokens is not None:
+            rec["tokens"] = int(tokens)
+            if step_time_s:
+                rec["tokens_per_sec"] = int(tokens) / float(step_time_s)
+        if peak_bytes is not None:
+            rec["peak_bytes"] = int(peak_bytes)
+        rec.update(extra)
+        self._write(rec)
+        self.steps_logged += 1
+
+        if self._mirror:
+            reg = registry()
+            reg.counter("train.steps").inc()
+            for key in ("loss", "grad_norm", "tokens_per_sec", "peak_bytes"):
+                if key in rec:
+                    reg.gauge(f"train.{key}").set(rec[key])
+            if "step_time_s" in rec:
+                reg.histogram("train.step_s").observe(rec["step_time_s"])
+        return rec
+
+    def close(self) -> None:
+        if self._owns_sink and not self._f.closed:
+            self._f.close()
+
+    def __enter__(self) -> "StepLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def trace_peak_bytes(trace) -> int:
+    """Peak-bytes estimate for an execution trace, keyed to
+    ``del_last_used`` placement (the pass is applied here when the trace has
+    no ``del`` statements yet — e.g. TrainStep's fw/bw traces)."""
+    from thunder_tpu.core.prims import PrimIDs
+    from thunder_tpu.observability.memory import memory_timeline
+
+    if not any(b.sym.id == PrimIDs.DEL for b in trace.bound_symbols):
+        from thunder_tpu.executors.passes import del_last_used
+
+        trace = del_last_used(trace)
+    return memory_timeline(trace)["peak_bytes_estimate"]

@@ -66,6 +66,12 @@ def main(argv=None):
                     help="activation rematerialization; 'auto' pays recompute only "
                          "when residuals would not fit device memory (overrides --no-remat)")
     ap.add_argument("--checkpoint-dir", default=None, help="save a checkpoint at the end (orbax)")
+    ap.add_argument("--telemetry", default=None,
+                    help="per-step JSONL telemetry path (StepLogger: loss, step time, "
+                         "tokens/sec, peak-bytes estimate; mirrored into the metrics registry)")
+    ap.add_argument("--telemetry-grad-norm", action="store_true",
+                    help="also log the global grad norm each step (runs one extra "
+                         "grads-only step per logged step; TrainStep modes, accum=1)")
     args = ap.parse_args(argv)
 
     if args.virtual_cpu:
@@ -148,6 +154,7 @@ def main(argv=None):
 
         step = lambda p, o, i, t, c, s: sharded_step(p, o, i, t)
         accumulate = None
+        train_step_obj = None
         params = train_params
     else:
         if args.mode == "none":
@@ -178,6 +185,7 @@ def main(argv=None):
         opt_state = train_step.init_optimizer_state(params)
         step = train_step
         accumulate = train_step.accumulate
+        train_step_obj = train_step
 
     t0 = time.perf_counter()
     if args.accum > 1:
@@ -190,15 +198,57 @@ def main(argv=None):
     jax.block_until_ready(loss)
     log(f"compile+first step: {time.perf_counter()-t0:.1f}s loss={float(loss):.4f}")
 
+    # per-step telemetry (observability.telemetry.StepLogger): one JSONL
+    # record per optimizer step, mirrored into the metrics registry.  The
+    # peak-bytes estimate is static (del-aware liveness over the lowered
+    # fw/bw traces), computed once — TrainStep modes only (sp/pp/ep drive
+    # shard_map losses directly, no thunder trace to account)
+    telemetry = None
+    peak_bytes = None
+    if args.telemetry:
+        from thunder_tpu.observability.telemetry import StepLogger, trace_peak_bytes
+
+        telemetry = StepLogger(args.telemetry, meta={
+            "config": cfg.name, "mode": args.mode, "devices": args.devices,
+            "batch": args.batch, "seq": T, "dtype": args.dtype,
+            "accum": args.accum, "quant": args.quant,
+        })
+        if getattr(train_step_obj, "fw_trace", None) is not None:
+            peak_bytes = max(
+                trace_peak_bytes(train_step_obj.fw_trace),
+                trace_peak_bytes(train_step_obj.bw_trace),
+            )
+        log(f"telemetry -> {args.telemetry}"
+            + (f" (peak_bytes_estimate={peak_bytes})" if peak_bytes else ""))
+
     t0 = time.perf_counter()
     last = loss
     for k in range(args.steps):
+        t_step = time.perf_counter()
         if args.accum > 1:
             params, opt_state, last = accumulate(params, opt_state, micro)
         else:
             params, opt_state, last = step(params, opt_state, idx, tgt, cos, sin)
+        if telemetry is not None:
+            jax.block_until_ready(last)
+            gn = None
+            if args.telemetry_grad_norm and train_step_obj is not None and args.accum == 1:
+                import optax as _optax
+
+                _, g = train_step_obj.grads(params, opt_state, idx, tgt, cos, sin)
+                gn = float(_optax.global_norm(g))
+            telemetry.log_step(
+                k,
+                loss=float(last),
+                grad_norm=gn,
+                step_time_s=time.perf_counter() - t_step,
+                tokens=args.batch * T,
+                peak_bytes=peak_bytes,
+            )
     jax.block_until_ready(last)
     dt = time.perf_counter() - t0
+    if telemetry is not None:
+        telemetry.close()
     tps = args.batch * T * args.steps / dt
 
     if args.checkpoint_dir:

@@ -763,6 +763,35 @@ def main():
             "results": out["results"],
         }))
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "donation":
+        # buffer-donation microbench: transformer-block train step with the
+        # del-aware donation pass on/off — steps/sec, peak-bytes estimate
+        # delta (examine.memory_timeline, donation-aware), and the
+        # donate=False-vs-plain dispatch ratio CI gates on.  Host work only,
+        # no TPU probe; artifact uses the BENCH_MICRO schema.
+        from thunder_tpu._platform import force_cpu
+
+        force_cpu()
+        from thunder_tpu.benchmarks.donation import donation_bench
+
+        out = donation_bench(on_tpu=False)
+        artifact = {"backend": jax.default_backend(), **out}
+        with open("BENCH_DONATION.json", "w") as f:
+            json.dump(artifact, f, indent=1)
+        for k, v in out["results"].items():
+            log(f"donation {k}: {v}")
+        print(json.dumps({
+            "metric": "donation_peak_bytes_reduction_pct",
+            "value": out["results"]["peak_reduction_pct"],
+            "unit": "%",
+            # the donated peak vs the undonated peak of the same program
+            "vs_baseline": round(
+                out["results"]["update_peak_bytes_on"]
+                / out["results"]["update_peak_bytes_off"], 3)
+            if out["results"]["update_peak_bytes_off"] else None,
+            "results": out["results"],
+        }))
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "cost":
         # analytic companion to the measured headline (no TPU needed): XLA's
         # own cost model on the compiled loss+grad at headline geometry, and

@@ -280,6 +280,47 @@ class TestHarnessTargets:
         src = Path(bench.__file__).read_text()
         assert '"THUNDER_TPU_BENCH_MAX_WAIT_S", "600"' in src
 
+    def test_donation_bench_cpu(self):
+        """The buffer-donation microbench (`bench.py donation`) must show a
+        real peak-bytes reduction on the llama-block train step (the del-aware
+        estimate is exact about what XLA may reuse) and pass the donate=False
+        overhead gate: the donation pass must never touch the donate=False
+        path."""
+        from thunder_tpu.benchmarks.donation import donation_bench
+        from tools.bench_targets import check_donation_off_overhead
+
+        out = donation_bench(on_tpu=False, iters=8)
+        assert out["shapes"]["cfg"] == "tiny-llama-debug"
+        r = out["results"]
+        # the tentpole's headline: donation lowers the peak (optimizer update
+        # writes into the donated dead params/grads instead of a third copy)
+        assert r["update_peak_bytes_on"] < r["update_peak_bytes_off"], r
+        assert r["peak_bytes_saved"] > 0 and r["peak_reduction_pct"] > 0
+        assert r["buffers_donated"] > 0 and r["bytes_donated"] > 0
+        assert r["aliased_outputs"] > 0
+        for k in ("steps_per_sec_donate_on", "steps_per_sec_donate_off",
+                  "steps_per_sec_plain"):
+            assert r[k] > 0, (k, r)
+        # CI gate: live measurement AND the committed artifact
+        assert check_donation_off_overhead(r) > 0
+
+    def test_bench_target_gates_on_committed_artifacts(self):
+        """tools/bench_targets.py must hold against what is committed: the
+        BENCH_DONATION.json overhead ratio and the BENCH_MICRO.json schema
+        the sweep/tuning tools parse.  A regression recorded into either
+        artifact fails CI here, not in a wasted TPU window."""
+        from tools.bench_targets import (
+            check_donation_off_overhead,
+            check_micro_baseline_schema,
+            load_artifact,
+        )
+
+        donation = load_artifact("BENCH_DONATION.json")
+        assert donation["results"]["peak_bytes_saved"] > 0
+        assert check_donation_off_overhead(donation["results"]) > 0
+        micro = check_micro_baseline_schema()
+        assert micro["backend"] in ("cpu", "tpu")
+
     def test_anomaly_overhead_bench_cpu(self):
         """The anomaly-detection overhead bench (`bench.py anomaly`) must
         measure plain vs anomaly-mode dispatch on the llama block target —

@@ -63,6 +63,7 @@ from thunder_tpu.functional import trace_from_fn
 from thunder_tpu import observability  # noqa: F401  (metrics/events/profiler)
 from thunder_tpu.observability import reset_observability
 from thunder_tpu.observability.debug import AnomalyError
+from thunder_tpu.executors.donation import DonationError
 from thunder_tpu.observability.events import span as _phase_span
 
 __version__ = "0.1.0"
@@ -89,12 +90,36 @@ __all__ = [
     "dispatch_stats",
     "last_compile_options",
     "profile_stats",
+    "donation_stats",
+    "metrics_snapshot",
     "export_chrome_trace",
     "observability",
     "reset_observability",
     "AnomalyError",
+    "DonationError",
     "dtypes",
 ]
+
+
+def _normalize_donate(donate):
+    """``donate=`` → a hashable canonical form: ``None`` (off), ``"auto"``
+    (True: every provably dead fusion input), or a sorted argnums tuple
+    (explicit: those positional args' tensors MUST be donatable, else
+    :class:`DonationError`).  Raises on anything else at jit() time."""
+    if donate is None or donate is False:
+        return None
+    if donate is True:
+        return "auto"
+    if isinstance(donate, int) and not isinstance(donate, bool):
+        return (donate,)
+    if isinstance(donate, (tuple, list)) and all(
+        isinstance(i, int) and not isinstance(i, bool) for i in donate
+    ):
+        check(len(donate) > 0, lambda: "donate=() donates nothing; pass False or argnums")
+        return tuple(sorted(set(donate)))
+    check(False, lambda: (
+        f"donate must be True, False, or a tuple of positional argnums, got {donate!r}"
+    ))
 
 
 def jit(
@@ -181,6 +206,12 @@ def jit(
 
         compile_options["langctx"] = resolve_language(langctx)
 
+    # normalized donation setting (None | "auto" | argnums tuple): validated
+    # here so a typo fails at jit() time, and folded into the dispatch key as
+    # a salt so the same fn under different donation settings never shares a
+    # specialization (the donated and undonated programs differ)
+    _donation_salt = _normalize_donate(compile_options.get("donate", None))
+
     cd = CompileData(
         fn=fn,
         executors_list=resolve_executors(executors),
@@ -237,6 +268,7 @@ def jit(
             key = _cache_key.compute_cache_key(
                 args, kwargs,
                 symbolic=cd.cache_option is CACHE_OPTIONS.SYMBOLIC_VALUES,
+                salt=("donate", _donation_salt) if _donation_salt is not None else None,
             )
             cs.key_computations += 1
             if key is not None:
@@ -442,6 +474,23 @@ def _compile(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict) -> Ca
         dbg_pre, dbg_post = resolve_debug_hooks(debug_hooks_opt)
         debug_cfg = {"pre": dbg_pre, "post": dbg_post, "detect_anomalies": anomaly_on}
 
+    # del-aware buffer donation (executors/donation.py): a post-lowering
+    # pass arming each fusion region with the inputs the trace proves dead.
+    # Off (None) means the pass never runs and the generated program stays
+    # byte-identical to the undonated one
+    donate_opt = get_compile_option(
+        "donate",
+        "Buffer donation for XLA fusion regions: True donates every input "
+        "the lowered trace proves dead (its DEL follows the region; it is "
+        "not a trace output, an aliased view, or consumed later); a tuple "
+        "of positional argnums additionally asserts those args' tensors "
+        "MUST donate (DonationError names the proxy and the blocking use "
+        "otherwise).  Donated caller arrays are CONSUMED — do not reuse "
+        "them after the call.  Default False: byte-identical program.",
+        default=None,
+    )
+    donation = _normalize_donate(donate_opt)
+
     cs.last_trace_tracing_start = time.perf_counter_ns()
     from thunder_tpu.core.sharp_edges import sharp_edges_guard
 
@@ -486,6 +535,7 @@ def _compile(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict) -> Ca
 
     bw_fn = None
     bw_extrace = None
+    bw_donation_report = None
     grad_postprocess = None
     ct_positions = ()
     if grad_argnums is not None:
@@ -534,6 +584,15 @@ def _compile(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict) -> Ca
         cs.last_backward_traces.append(bw_extrace)
         bw_extrace = del_last_used(bw_extrace)
         cs.last_backward_traces.append(bw_extrace)
+        if donation is not None:
+            # backward donation is always automatic: its inputs are saved
+            # residuals and cotangents, which user argnums cannot name
+            from thunder_tpu.executors.passes import annotate_donations
+
+            bw_extrace, bw_donation_report = annotate_donations(
+                bw_extrace, which="backward"
+            )
+            cs.last_backward_traces.append(bw_extrace)
         if debug_cfg is not None:
             from thunder_tpu.observability.debug import instrument_for_debugging
 
@@ -555,6 +614,36 @@ def _compile(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict) -> Ca
     cs.last_traces.append(extrace)
     extrace = del_last_used(extrace)
     cs.last_traces.append(extrace)
+    if donation is not None:
+        from thunder_tpu.executors.donation import donation_summary
+        from thunder_tpu.executors.passes import annotate_donations
+
+        candidate = None
+        strict = False
+        if donation != "auto":
+            # explicit argnums: resolve the user's positional args to their
+            # tensor-leaf proxies (functional.py records the map at trace
+            # time) and assert donation of exactly those
+            arg_map = getattr(trace_results.computation_trace, "_input_argnums", {})
+            candidate = {n for n, a in arg_map.items() if a in donation}
+            check(
+                bool(candidate),
+                lambda: f"donate={donation!r} matched no tensor arguments of "
+                f"{getattr(cd.fn, '__name__', cd.fn)!r}",
+            )
+            strict = True
+        extrace, fw_donation_report = annotate_donations(
+            extrace, candidate_names=candidate, strict=strict
+        )
+        cs.last_traces.append(extrace)
+        cs.donation_reports = {
+            "forward": donation_summary(fw_donation_report),
+            "backward": (
+                donation_summary(bw_donation_report)
+                if bw_donation_report is not None
+                else None
+            ),
+        }
     if debug_cfg is not None:
         from thunder_tpu.observability.debug import instrument_for_debugging
 
@@ -598,6 +687,14 @@ def _compile(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict) -> Ca
     # dispatcher files the entry under the key it computed for this call
     key_meta = trace_results.cache_key_meta or {}
     entry.cache_key_fn = key_meta.get("cache_key_fn")
+    if donation is not None:
+        # the dispatcher salts this entry's key with the donation setting;
+        # the recomputing key fn (and the introspectable meta) must agree
+        entry.cache_key_fn = _cache_key.make_cache_key_fn(
+            cd.cache_option is CACHE_OPTIONS.SYMBOLIC_VALUES,
+            salt=("donate", donation),
+        )
+        key_meta = {**key_meta, "donate": donation}
     entry.key_meta = key_meta
     entry.has_state_guards = key_meta.get("state") is not None
     return entry
@@ -776,6 +873,30 @@ def profile_stats(cfn):
         "the compiled function at least once",
     )
     return cs.profile_report
+
+
+def donation_stats(cfn) -> dict:
+    """The donation analysis of a function compiled with ``tt.jit(fn,
+    donate=True|argnums)``: ``{"forward": summary, "backward": summary|None}``
+    where each summary lists, per fusion region, the donated buffers, the
+    input→output alias pairings, the donated byte count, and every rejection
+    with its reason (``trace_output`` / ``later_use`` / ``aliased_view`` /
+    ``no_del``).  Process-wide aggregates land in the ``donation.*`` metrics
+    (``tt.metrics_snapshot()``)."""
+    cs = _get_cs(cfn)
+    check(
+        cs.donation_reports is not None,
+        lambda: "no donation data: compile with tt.jit(fn, donate=True) (or "
+        "an argnums tuple) and call the compiled function at least once",
+    )
+    return cs.donation_reports
+
+
+def metrics_snapshot() -> dict:
+    """One plain dict of every registered metric — dispatch, compile,
+    profiler, anomaly, memory, and ``donation.*`` counters included
+    (alias of ``thunder_tpu.observability.snapshot()``)."""
+    return observability.snapshot()
 
 
 def export_chrome_trace(path: str) -> str:

@@ -1,0 +1,148 @@
+"""Buffer-donation microbench: transformer-block train step with donation
+on/off.
+
+Two measurements on a llama-block train step (``tt.value_and_grad`` of the
+block loss + a compiled optimizer update):
+
+1. **Peak-bytes delta** — the optimizer update is the canonical donation
+   target (``new_p = p - lr*g``: every input dies, every output is
+   shape/dtype-compatible with a dead input).  With donation off the del-aware
+   estimate must hold params + grads + new params live at the peak (~3N);
+   with donation on the update writes into the donated buffers (~2N).  The
+   estimate comes from ``examine.memory_timeline`` (donation-aware since this
+   PR), which is exact about what XLA is ALLOWED to reuse — the in-container
+   CPU backend has no real donation to measure against.
+
+2. **steps/sec + dispatch cost** — the same step timed with donation on, off
+   (``donate=False``), and unspecified (the plain path).  ``donate=False``
+   must cost the same as plain: the pass never runs and the program is
+   byte-identical, so the dispatch-ns ratio between the two is the
+   CI-policed "donation overhead" number (``tools/bench_targets.py``).
+
+The artifact (``BENCH_DONATION.json``) uses the BENCH_MICRO schema.
+"""
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+from thunder_tpu.benchmarks.timing import host_us_per_call
+
+__all__ = ["donation_bench"]
+
+
+def donation_bench(on_tpu: bool = False, iters: int = 20) -> dict:
+    """Returns ``{"shapes": {...}, "results": {...}}``.  Results: µs/call and
+    steps/sec for the donated / undonated / plain train step, the donation
+    pass's own accounting (buffers/bytes donated, aliases), the peak-bytes
+    estimates of the update program with donation on vs off, and the
+    donate=False-vs-plain dispatch ratio."""
+    import thunder_tpu as tt
+    from thunder_tpu.examine import memory_timeline
+    from thunder_tpu.models import llama
+    from thunder_tpu.observability.metrics import registry
+
+    if on_tpu:
+        cfg = llama.Config.from_name(
+            "Llama-2-7b-hf", n_layer=1, n_embd=2048, n_head=16, intermediate_size=5504
+        )
+        B, T, dt = 4, 1024, jnp.bfloat16
+    else:
+        cfg = llama.Config.from_name("tiny-llama-debug")
+        B, T, dt = 2, 64, jnp.float32
+    T = min(T, cfg.block_size)
+    key = jax.random.PRNGKey(0)
+    params = llama.init_params(cfg, key, dtype=dt)
+    idx = jax.random.randint(jax.random.PRNGKey(1), (B, T), 0, cfg.vocab_size)
+    tgt = jax.random.randint(jax.random.PRNGKey(2), (B, T), 0, cfg.vocab_size)
+    cos, sin = llama.build_rope_cache(cfg, T, dtype=jnp.float32)
+
+    def loss_fn(p, i, t, c, s):
+        return llama.gpt_loss(p, i, t, c, s, cfg)
+
+    # grads come from the framework's fw/bw pipeline; the UPDATE is the
+    # donation target: params and grads die inside it and the new params
+    # alias straight into the donated buffers (the copy_/optimizer pattern)
+    def sgd_update(p, g):
+        return jax.tree_util.tree_map(lambda a, b: a - 0.01 * b, p, g)
+
+    vg = tt.value_and_grad(loss_fn)
+    upd_plain = tt.jit(sgd_update)
+    upd_off = tt.jit(sgd_update, donate=False)
+    upd_on = tt.jit(sgd_update, donate=True)
+
+    donated_before = registry().counter("donation.buffers_donated").value
+    bytes_before = registry().counter("donation.bytes_donated").value
+
+    _, grads = vg(params, idx, tgt, cos, sin)
+    # warm the undonated specializations (they leave their inputs alive)
+    p_plain = upd_plain(params, grads)
+    p_off = upd_off(params, grads)
+
+    # dispatch-only cost of the donate=False path vs the plain path: the
+    # pass never ran for either, so any ratio above noise is a regression
+    # (tools/bench_targets.py gates on this).  Measured BEFORE the donating
+    # variant runs — a donated call CONSUMES params/grads (for real: even
+    # this CPU backend deletes the buffers), and these loops reuse them.
+    plain_us = host_us_per_call(upd_plain, params, grads, iters=max(iters, 20))
+    off_us = host_us_per_call(upd_off, params, grads, iters=max(iters, 20))
+
+    def step_seconds(update, p):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            _, g = vg(p, idx, tgt, cos, sin)
+            p = update(p, g)
+        jax.block_until_ready(jax.tree_util.tree_leaves(p))
+        return (time.perf_counter() - t0) / iters
+
+    s_off = step_seconds(upd_off, p_off)
+    s_plain = step_seconds(upd_plain, p_plain)
+    # the donated step consumes its param/grad buffers each iteration and
+    # feeds the (aliased) outputs forward — exactly the serving/training
+    # loop donation is for.  Runs on copies so params/grads stay usable.
+    p_on = upd_on(
+        jax.tree_util.tree_map(lambda x: x.copy(), params),
+        jax.tree_util.tree_map(lambda x: x.copy(), grads),
+    )
+    s_on = step_seconds(upd_on, p_on)
+
+    peak_off = memory_timeline(tt.last_traces(upd_off)[-1])["peak_bytes_estimate"]
+    t_on = memory_timeline(tt.last_traces(upd_on)[-1])
+    peak_on = t_on["peak_bytes_estimate"]
+
+    results = {
+        "steps_per_sec_donate_on": round(1.0 / s_on, 3),
+        "steps_per_sec_donate_off": round(1.0 / s_off, 3),
+        "steps_per_sec_plain": round(1.0 / s_plain, 3),
+        "update_peak_bytes_off": int(peak_off),
+        "update_peak_bytes_on": int(peak_on),
+        "peak_bytes_saved": int(peak_off - peak_on),
+        "peak_reduction_pct": round(100.0 * (peak_off - peak_on) / peak_off, 2)
+        if peak_off
+        else 0.0,
+        "update_donated_bytes": int(t_on["donated_bytes"]),
+        "buffers_donated": registry().counter("donation.buffers_donated").value
+        - donated_before,
+        "bytes_donated": registry().counter("donation.bytes_donated").value
+        - bytes_before,
+        "update_plain_dispatch_us": round(plain_us, 3),
+        "update_donate_off_dispatch_us": round(off_us, 3),
+        "donate_off_overhead_x": round(off_us / plain_us, 3) if plain_us > 0 else None,
+        "aliased_outputs": len(
+            tt.donation_stats(upd_on)["forward"]["regions"][0]["aliases"]
+        )
+        if tt.donation_stats(upd_on)["forward"]["regions"]
+        else 0,
+    }
+    return {
+        "shapes": {
+            "cfg": cfg.name,
+            "n_layer": cfg.n_layer,
+            "B": B,
+            "T": T,
+            "dtype": jnp.dtype(dt).name,
+        },
+        "results": results,
+    }

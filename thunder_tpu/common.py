@@ -59,6 +59,11 @@ class CompileStats:
         # accumulate across specializations of the same compiled function
         self.profile_report = None
 
+        # donation analysis of the last compilation: {"forward": summary,
+        # "backward": summary|None} plain dicts (executors/donation.py
+        # donation_summary); None unless compiled with donate=True/argnums
+        self.donation_reports = None
+
         # live entries in insertion order (introspection + the legacy linear
         # fallback for unkeyable inputs); the hash-map view below is the hot
         # dispatch path: structural key → bucket of entries, most recently

@@ -98,25 +98,34 @@ def leaf_token(leaf: Any, symbolic: bool = False) -> tuple:
     return ("o", type(leaf).__qualname__, name if isinstance(name, str) else None)
 
 
-def compute_cache_key(args: tuple, kwargs: dict, *, symbolic: bool = False):
+def compute_cache_key(args: tuple, kwargs: dict, *, symbolic: bool = False, salt=None):
     """The structural dispatch key for one call, or ``None`` when the inputs
     cannot be keyed (unhashable pytree aux data, exotic leaves) — the caller
-    falls back to the legacy linear prologue scan, never to a wrong entry."""
+    falls back to the legacy linear prologue scan, never to a wrong entry.
+
+    ``salt`` folds compile-configuration that changes the GENERATED program
+    (not the inputs) into the key — e.g. the normalized ``donate=`` setting —
+    so the same function compiled under different configurations never shares
+    a specialization.  ``None`` (the default) adds nothing, keeping existing
+    keys stable."""
     try:
         flat, spec = tree_flatten((tuple(args), dict(kwargs)))
         key = (spec, tuple(leaf_token(leaf, symbolic) for leaf in flat))
+        if salt is not None:
+            key = key + (salt,)
         hash(key)  # force hashability failures onto the fallback path here
         return key
     except Exception:
         return None
 
 
-def make_cache_key_fn(symbolic: bool) -> Callable:
+def make_cache_key_fn(symbolic: bool, salt=None) -> Callable:
     """The per-entry key function emitted at trace time alongside the
-    prologue: closes over the trace's cache mode so introspection (and any
-    external dispatcher) can recompute an entry's key from raw inputs."""
+    prologue: closes over the trace's cache mode (and any compile-config
+    salt) so introspection (and any external dispatcher) can recompute an
+    entry's key from raw inputs."""
 
     def cache_key_fn(args: tuple, kwargs: dict):
-        return compute_cache_key(args, kwargs, symbolic=symbolic)
+        return compute_cache_key(args, kwargs, symbolic=symbolic, salt=salt)
 
     return cache_key_fn

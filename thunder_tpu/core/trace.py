@@ -190,6 +190,12 @@ class TraceCtx:
             lines: list[str] = []
             if self._provenance is not None:
                 lines.append(repr(self._provenance))
+            # the donation pass leaves a one-line summary (buffers/bytes
+            # donated, per-reason rejections) so a dumped program documents
+            # its own aliasing behavior
+            summary = getattr(self, "_donation_summary", None)
+            if summary:
+                lines.append(f"# donation: {summary}")
             lines.append("import thunder_tpu.core.dtypes as dtypes")
             lines.append("import thunder_tpu.core.devices as devices")
             lines.append("")

@@ -198,6 +198,7 @@ class TrainStep:
         *,
         batch_specs: Sequence[P] | None = None,
         donate: bool = True,
+        donate_batch: bool = False,
         remat: bool = True,
         zero3: bool = False,
         executors=None,
@@ -213,6 +214,15 @@ class TrainStep:
         self.mesh = mesh
         self.batch_specs = batch_specs
         self.donate = donate
+        # opt-in: additionally donate batch args whose tensors the donation
+        # analysis proves die inside the forward (not saved as residuals).
+        # The caller's batch arrays are then CONSUMED per step — only enable
+        # when every step gets fresh batches
+        self.donate_batch = donate_batch
+        #: donation analysis of the last _build ({"forward","backward"}
+        #: summaries + donated-aware peak estimates); None until built or
+        #: when donate=False
+        self.donation_report = None
         if not (isinstance(remat, bool) or remat == "auto"):
             raise ValueError(f"remat must be True, False, or 'auto', got {remat!r}")
         self.remat = remat
@@ -341,6 +351,31 @@ class TrainStep:
         fw_fn = _trace_to_jax_fn(fw_trace)
         bw_fn = _trace_to_jax_fn(bw_trace)
 
+        # donation analysis (the SAME pass tt.jit uses, executors/passes.py):
+        # the fw/bw traces here are evaluated inside ONE outer jax.jit, so
+        # per-region donate_argnums would be ignored by XLA — instead the
+        # analysis (a) feeds the donation.* metrics and the donated-aware
+        # peak-bytes estimates, and (b) proves which batch args die inside
+        # the forward so donate_batch can extend the OUTER donation safely
+        fw_donation = None
+        if self.donate:
+            from thunder_tpu.executors.donation import donation_summary
+            from thunder_tpu.executors.passes import annotate_donations, del_last_used
+            from thunder_tpu.observability.memory import memory_timeline
+
+            fw_deld, fw_donation = annotate_donations(
+                del_last_used(fw_trace), which="trainstep_forward"
+            )
+            bw_deld, bw_donation = annotate_donations(
+                del_last_used(bw_trace), which="trainstep_backward"
+            )
+            self.donation_report = {
+                "forward": donation_summary(fw_donation),
+                "backward": donation_summary(bw_donation),
+                "fw_peak_bytes_estimate": memory_timeline(fw_deld)["peak_bytes_estimate"],
+                "bw_peak_bytes_estimate": memory_timeline(bw_deld)["peak_bytes_estimate"],
+            }
+
         # map runtime leaves → computation inputs (flatten order, tensors only).
         # MUST use the same tensor predicate as the frontend so the env order
         # here matches the trace's input order exactly
@@ -404,6 +439,27 @@ class TrainStep:
         copts = combine_threshold_options(self.comm_combine_threshold_mb)
         self.compiler_options = copts
         jit_kw = {"compiler_options": copts} if copts else {}
+
+        # outer-jit donation: params/opt state always (their updated versions
+        # alias straight back into the dead inputs); batch args only when the
+        # analysis proved their tensors die inside the forward (never saved
+        # as residuals) AND the caller opted in via donate_batch
+        step_donate: tuple = (0, 1) if self.donate else ()
+        grads_donate: tuple = ()
+        if self.donate and self.donate_batch and fw_donation is not None:
+            from thunder_tpu.functional import _is_tensor_like as _itl
+
+            fw_args = fw_trace.args or ()
+            off = sum(1 for x in jax.tree_util.tree_leaves(params) if _itl(x))
+            protected = set(fw_donation.protected_names)
+            for i, b in enumerate(batch):
+                n_i = sum(1 for x in jax.tree_util.tree_leaves(b) if _itl(x))
+                names = {p.name for p in fw_args[off : off + n_i]}
+                off += n_i
+                if names and not (names & protected):
+                    step_donate += (2 + i,)
+                    grads_donate += (1 + i,)
+        self.last_donate_argnums = step_donate
         entry = {
             # out_shardings pin the updated params/opt state to their INPUT
             # placements: without them XLA may pick a different layout for
@@ -414,7 +470,7 @@ class TrainStep:
                 step,
                 in_shardings=(param_sh, opt_sh) + batch_sh,
                 out_shardings=(param_sh, opt_sh, None),
-                donate_argnums=(0, 1) if self.donate else (),
+                donate_argnums=step_donate,
                 **jit_kw,
             ),
             # gradient-accumulation pieces (reference no_sync/_sync_grads,
@@ -427,6 +483,7 @@ class TrainStep:
                 value_and_grad_fn,
                 in_shardings=(param_sh,) + batch_sh,
                 out_shardings=(None, param_sh),
+                donate_argnums=grads_donate,
                 **jit_kw,
             ),
             "apply": jax.jit(
@@ -471,9 +528,21 @@ class TrainStep:
             return batch
         return tuple(self.bucketer(batch))
 
+    def _donation_ctx(self):
+        """The shared "donated buffers were not usable" filter when this step
+        donates (CPU smoke runs and declined donations would otherwise warn
+        once per execute); a no-op context otherwise."""
+        if self.donate:
+            from thunder_tpu.executors.donation import suppress_unusable_donation_warnings
+
+            return suppress_unusable_donation_warnings()
+        import contextlib
+
+        return contextlib.nullcontext()
+
     def __call__(self, params, opt_state, *batch):
         batch = self._prepare(batch)
-        with self._mesh_context():
+        with self._mesh_context(), self._donation_ctx():
             return self._get_jitted(params, opt_state, batch)(params, opt_state, *batch)
 
     def grads(self, params, opt_state, *batch):
@@ -481,7 +550,7 @@ class TrainStep:
         accumulation building block (reference ``no_sync``,
         ``thunder/distributed/__init__.py:200-242``)."""
         batch = self._prepare(batch)
-        with self._mesh_context():
+        with self._mesh_context(), self._donation_ctx():
             return self._get_entry(params, opt_state, batch)["grads"](params, *batch)
 
     def apply_gradients(self, params, opt_state, grads, *, batch_template):
@@ -490,7 +559,7 @@ class TrainStep:
         ``batch_template`` is any batch of the shape used with :meth:`grads`
         (it keys the compiled-entry cache; values are not read)."""
         batch_template = self._prepare(batch_template)
-        with self._mesh_context():
+        with self._mesh_context(), self._donation_ctx():
             entry = self._get_entry(params, opt_state, batch_template)
             return entry["apply"](params, opt_state, grads)
 
@@ -553,6 +622,7 @@ def make_train_step(
     *,
     batch_specs: Sequence[P] | None = None,
     donate: bool = True,
+    donate_batch: bool = False,
     remat: bool = True,
     zero3: bool = False,
     executors=None,
@@ -561,7 +631,8 @@ def make_train_step(
     bucketer: Callable | None = None,
 ) -> TrainStep:
     return TrainStep(
-        loss_fn, optimizer, mesh, batch_specs=batch_specs, donate=donate, remat=remat,
+        loss_fn, optimizer, mesh, batch_specs=batch_specs, donate=donate,
+        donate_batch=donate_batch, remat=remat,
         zero3=zero3, executors=executors, quant=quant,
         comm_combine_threshold_mb=comm_combine_threshold_mb, bucketer=bucketer,
     )

@@ -140,8 +140,11 @@ def memory_estimate(trace) -> dict[str, int]:
     (reference examine/memory_caculation.py).  The intermediate estimate
     walks the trace with del-aware liveness (the shared pass in
     ``observability/memory.py``): it is the ceiling XLA's own buffer reuse
-    then improves on.  ``memory_timeline(trace)`` returns the per-symbol
-    live/peak rows behind this summary."""
+    then improves on.  Donation-aware: on a trace compiled with
+    ``tt.jit(fn, donate=...)`` the peak reflects donated buffers being
+    reclaimed at their consuming region, and ``donated_bytes`` reports the
+    total reclaimed that way.  ``memory_timeline(trace)`` returns the
+    per-symbol live/peak rows behind this summary."""
     from thunder_tpu.observability.memory import memory_timeline
 
     t = memory_timeline(trace)
@@ -149,6 +152,7 @@ def memory_estimate(trace) -> dict[str, int]:
         "input_bytes": t["input_bytes"],
         "output_bytes": t["output_bytes"],
         "peak_bytes_estimate": t["peak_bytes_estimate"],
+        "donated_bytes": t["donated_bytes"],
     }
 
 

@@ -1,0 +1,383 @@
+"""Del-aware buffer donation analysis for lowered traces.
+
+On TPU the batch size and step time of the programs this framework emits are
+bound by peak HBM and copy bandwidth, not FLOPs.  Every XLA fusion region is
+a separate ``jax.jit`` program, and without ``donate_argnums`` XLA must keep
+each region input alive across the call even when the lowered trace provably
+kills it immediately afterwards (``del_last_used`` already computes exactly
+that).  This module closes the gap: :func:`analyze_trace_donations` proves,
+from the lowered trace alone, which region inputs are safe to donate, and
+:func:`apply_donation` re-arms each region's :class:`FusionCallable` with the
+proven ``donate_argnums`` (plus shape/dtype-compatible input→output alias
+hints — the ``copy_``/optimizer-update pattern, where the new value can land
+in the dead old value's buffer).
+
+Safety contract — an input of fusion region R is donatable iff:
+
+- its last (non-``del``) consumer is R: a ``DEL`` of it follows R in the
+  lowered trace and no later bound symbol reads it (this also covers "input
+  to a later region");
+- it is not a trace output (``RETURN`` operand — the caller receives it);
+- it is not an endpoint of an eagerly-executed view-class op
+  (``SHAPE_OP``-tagged bsyms outside fusion regions may alias buffers at the
+  XLA runtime's discretion, so donating one endpoint could invalidate the
+  other).
+
+Every rejection is counted per reason in the ``donation.*`` metrics
+(``thunder_tpu.observability``) so "why wasn't this donated?" is always
+answerable from a snapshot.
+
+The "Some donated buffers were not usable" warning handling (CPU has no
+donation; XLA may also decline a donation it cannot use) is centralized in
+:func:`suppress_unusable_donation_warnings`, shared with the decode loops in
+``models/generate.py`` / ``models/speculative.py`` and ``TrainStep``.
+"""
+from __future__ import annotations
+
+import contextlib
+import warnings
+from dataclasses import dataclass, field
+
+from thunder_tpu.core.prims import OpTags, PrimIDs
+from thunder_tpu.core.proxies import TensorProxy
+from thunder_tpu.core.symbol import BoundSymbol, gather_provenance
+from thunder_tpu.core.trace import TraceCtx, TraceProvenance, from_trace
+
+__all__ = [
+    "DonationError",
+    "DonationReport",
+    "RegionDonation",
+    "analyze_trace_donations",
+    "apply_donation",
+    "donation_summary",
+    "suppress_unusable_donation_warnings",
+    "REJECT_TRACE_OUTPUT",
+    "REJECT_LATER_USE",
+    "REJECT_ALIASED_VIEW",
+    "REJECT_NO_DEL",
+]
+
+# jax emits this (module jax._src.interpreters.mlir / pxla depending on
+# version) once per compile/execute when a donated buffer cannot be used —
+# e.g. the CPU backend, or an input XLA found no aliasing opportunity for.
+# Donation is still correct there (it degrades to a no-op), so the framework
+# silences exactly this message wherever it donates on purpose.
+_UNUSABLE_DONATION_MSG = "Some donated buffers were not usable"
+
+
+@contextlib.contextmanager
+def suppress_unusable_donation_warnings():
+    """Scoped filter for jax's "donated buffers were not usable" note.
+
+    The ONE place this warning is handled: ``FusionCallable`` wraps donated
+    region calls in it, ``TrainStep`` wraps its donated step, and the decode
+    loops in ``models/generate.py`` / ``models/speculative.py`` use it around
+    their cache-donating programs."""
+    with warnings.catch_warnings():
+        warnings.filterwarnings("ignore", message=_UNUSABLE_DONATION_MSG)
+        yield
+
+
+class DonationError(RuntimeError):
+    """An explicitly requested donation is provably unsafe.
+
+    Raised by :func:`apply_donation` in strict mode (``tt.jit(fn,
+    donate=(argnums,))``): the user asserted an input's buffer may be
+    consumed, but the lowered trace shows it escaping — the message names
+    the proxy, the rejection reason, and the source provenance of the
+    blocking use so the fix is one hop away."""
+
+
+REJECT_TRACE_OUTPUT = "trace_output"
+REJECT_LATER_USE = "later_use"
+REJECT_ALIASED_VIEW = "aliased_view"
+REJECT_NO_DEL = "no_del"
+# strict-mode only: the candidate never reached any fusion region (the trace
+# has none, or only eager symbols consume it) — there is nowhere to donate it
+REJECT_UNFUSED = "unfused"
+
+
+@dataclass
+class RegionDonation:
+    """Donation decision for one fusion region."""
+
+    name: str                                   # fusion symbol name (XLA0, ...)
+    index: int                                  # position in trace.bound_symbols
+    bsym: BoundSymbol
+    donated: list = field(default_factory=list)       # [(arg_pos, TensorProxy)]
+    aliases: dict = field(default_factory=dict)       # input name -> output name
+    rejected: dict = field(default_factory=dict)      # input name -> (reason, blocking_bsym|None)
+    donated_bytes: int = 0
+
+
+@dataclass
+class DonationReport:
+    """The full analysis result for one lowered trace."""
+
+    regions: list = field(default_factory=list)        # [RegionDonation]
+    protected_names: frozenset = frozenset()           # RETURN operands
+    view_names: frozenset = frozenset()                # endpoints of eager view-class ops
+
+    @property
+    def donated_buffers(self) -> int:
+        return sum(len(r.donated) for r in self.regions)
+
+    @property
+    def donated_bytes(self) -> int:
+        return sum(r.donated_bytes for r in self.regions)
+
+    def rejections(self) -> dict:
+        out: dict[str, int] = {}
+        for r in self.regions:
+            for reason, _ in r.rejected.values():
+                out[reason] = out.get(reason, 0) + 1
+        return out
+
+
+def _proxy_nbytes(p) -> int:
+    from thunder_tpu.observability.memory import tensor_nbytes
+
+    return tensor_nbytes(p)
+
+
+def analyze_trace_donations(
+    trace: TraceCtx, *, candidate_names: set | None = None
+) -> DonationReport:
+    """Proves which fusion-region inputs are safe to donate, from the lowered
+    trace alone (requires ``del_last_used`` to have run so buffer death is
+    explicit as ``DEL`` bound symbols).
+
+    ``candidate_names`` restricts the candidate set (the ``donate=argnums``
+    form); ``None`` considers every tensor input of every region.  Inputs
+    outside the candidate set are skipped silently — they are neither donated
+    nor counted as rejections."""
+    from thunder_tpu.executors.utils import trace_return_names
+
+    bsyms = trace.bound_symbols
+    protected: set[str] = trace_return_names(trace)
+
+    # last non-del, non-return read and first del AFTER each position
+    last_use: dict[str, int] = {}
+    del_index: dict[str, int] = {}
+    view_names: set[str] = set()
+    for i, bsym in enumerate(bsyms):
+        if bsym.sym.id == PrimIDs.DEL:
+            for p in bsym.flat_proxy_args:
+                del_index[p.name] = i
+            continue
+        if bsym.sym.id == PrimIDs.RETURN:
+            continue
+        for p in bsym.flat_proxy_args:
+            last_use[p.name] = i
+        # an eagerly-executed (unfused) view-class op may alias its operand's
+        # buffer at runtime; both endpoints are unsafe to donate anywhere
+        if not bsym.sym.is_fusion and bsym.sym.tags and OpTags.SHAPE_OP in bsym.sym.tags:
+            for p in list(bsym.flat_proxy_args) + list(bsym.flat_proxy_outs):
+                if isinstance(p, TensorProxy):
+                    view_names.add(p.name)
+
+    report = DonationReport(
+        protected_names=frozenset(protected), view_names=frozenset(view_names)
+    )
+
+    for i, bsym in enumerate(bsyms):
+        if not bsym.sym.is_fusion:
+            continue
+        region = RegionDonation(name=bsym.sym.name, index=i, bsym=bsym)
+        for pos, p in enumerate(bsym.args):
+            if not isinstance(p, TensorProxy):
+                continue
+            name = p.name
+            if candidate_names is not None and name not in candidate_names:
+                continue
+            if name in protected:
+                region.rejected[name] = (REJECT_TRACE_OUTPUT, None)
+            elif last_use.get(name, -1) > i:
+                region.rejected[name] = (REJECT_LATER_USE, bsyms[last_use[name]])
+            elif name in view_names:
+                region.rejected[name] = (REJECT_ALIASED_VIEW, None)
+            elif del_index.get(name, -1) <= i:
+                # no DEL after the region: liveness was not (or could not be)
+                # established — without the proof, keep the buffer
+                region.rejected[name] = (REJECT_NO_DEL, None)
+            else:
+                region.donated.append((pos, p))
+                region.donated_bytes += _proxy_nbytes(p)
+        _match_aliases(region)
+        report.regions.append(region)
+    return report
+
+
+def _match_aliases(region: RegionDonation) -> None:
+    """Greedy input→output alias hints: each donated dead input is paired
+    with the first unclaimed region output of identical shape/dtype — the
+    ``copy_``/optimizer-update pattern, where XLA can write the new value
+    straight into the donated buffer.  Purely informational (XLA performs
+    the actual aliasing through ``donate_argnums``): the hints feed the
+    donation metrics and the memory timeline's reuse accounting."""
+    outs = [o for o in region.bsym.flat_proxy_outs if isinstance(o, TensorProxy)]
+    claimed: set[str] = set()
+    for _, p in region.donated:
+        for o in outs:
+            if o.name in claimed:
+                continue
+            if tuple(o.shape) == tuple(p.shape) and o.dtype == p.dtype:
+                region.aliases[p.name] = o.name
+                claimed.add(o.name)
+                break
+
+
+def _format_provenance(bsym: BoundSymbol | None) -> str:
+    if bsym is None:
+        return ""
+    entries = gather_provenance(bsym)
+    if not entries:
+        return ""
+    fname, pos = entries[0]
+    lineno = getattr(pos, "lineno", pos)
+    return f" (blocking use: {bsym.sym.name} from {fname}:{lineno})"
+
+
+def apply_donation(
+    trace: TraceCtx,
+    *,
+    candidate_names: set | None = None,
+    strict: bool = False,
+    which: str = "forward",
+) -> tuple[TraceCtx, DonationReport]:
+    """Runs the analysis and arms the trace's fusion callables.
+
+    Returns a new trace (provenance-stamped, fusion bsyms annotated with a
+    ``_donation`` record and a codegen header comment) plus the report.
+    Publishes the ``donation.*`` metrics.  In strict mode (explicit
+    ``donate=argnums``), a rejected candidate raises :class:`DonationError`
+    instead of being skipped."""
+    from thunder_tpu.observability.metrics import registry
+
+    report = analyze_trace_donations(trace, candidate_names=candidate_names)
+
+    if strict:
+        # a candidate rejected at one region may legally donate at a LATER
+        # region (its true last consumer); only a nowhere-donated candidate
+        # violates the user's explicit assertion.  Report the most specific
+        # rejection (anything beats later_use, which only says "not here").
+        donated_names = {p.name for r in report.regions for _, p in r.donated}
+        worst: dict[str, tuple] = {}
+        for region in report.regions:
+            for name, (reason, blocker) in region.rejected.items():
+                if name in donated_names:
+                    continue
+                if name not in worst or worst[name][0] == REJECT_LATER_USE:
+                    worst[name] = (reason, blocker, region)
+        # a candidate no fusion region consumes is rejected nowhere above —
+        # classify it here (trace output / aliased view / simply unfused) and
+        # point at its last reader so the error still lands on a source line
+        for name in sorted(candidate_names or ()):
+            if name in donated_names or name in worst:
+                continue
+            blocker = None
+            for b in trace.bound_symbols:
+                if b.sym.id == PrimIDs.DEL:
+                    continue
+                if any(p.name == name for p in b.flat_proxy_args):
+                    blocker = b
+            if name in report.protected_names:
+                worst[name] = (REJECT_TRACE_OUTPUT, blocker, None)
+            elif name in report.view_names:
+                worst[name] = (REJECT_ALIASED_VIEW, blocker, None)
+            else:
+                worst[name] = (REJECT_UNFUSED, blocker, None)
+        for name, (reason, blocker, region) in worst.items():
+            at = f" at fusion region {region.name}" if region is not None else ""
+            raise DonationError(
+                f"donation of {name!r} was requested explicitly but is unsafe: "
+                f"{reason}{at}"
+                f"{_format_provenance(blocker) or (_format_provenance(region.bsym) if region is not None else '')} — "
+                f"drop it from donate= or stop reusing the buffer"
+            )
+
+    reg = registry()
+    annotated: dict[int, BoundSymbol] = {}
+    total_aliases = 0
+    for region in report.regions:
+        for reason, _ in region.rejected.values():
+            reg.counter(f"donation.rejected.{reason}").inc()
+        if not region.donated:
+            continue
+        reg.counter("donation.regions").inc()
+        reg.counter("donation.buffers_donated").inc(len(region.donated))
+        reg.counter("donation.bytes_donated").inc(region.donated_bytes)
+        total_aliases += len(region.aliases)
+
+        names = [p.name for _, p in region.donated]
+        info = {
+            "donated": names,
+            "aliases": dict(region.aliases),
+            "bytes": region.donated_bytes,
+        }
+        alias_note = "".join(
+            f"; {a} reused for {b}" for a, b in region.aliases.items()
+        )
+        header = f"donated: {', '.join(names)} ({region.donated_bytes} bytes{alias_note})"
+        bsym = region.bsym
+        new_bsym = bsym.from_bsym(
+            header=f"{bsym.header}\n{header}" if bsym.header else header
+        )
+        new_bsym._donation = info
+        annotated[region.index] = new_bsym
+        region.bsym = new_bsym
+
+        # arm the compiled region: positions follow the callable's own input
+        # order (identical to the bsym arg order by construction, but matched
+        # by name so hand-built traces and re-lowered regions stay safe)
+        fusion = (bsym._call_ctx or {}).get(bsym.sym.name)
+        if fusion is not None and hasattr(fusion, "set_donation"):
+            argnums = tuple(
+                fusion.input_names.index(n) for n in names if n in fusion.input_names
+            )
+            fusion.set_donation(argnums, region.aliases)
+    if total_aliases:
+        reg.counter("donation.aliased_outputs").inc(total_aliases)
+
+    ntrace = from_trace(trace)
+    ntrace.bound_symbols = [
+        annotated.get(i, b) for i, b in enumerate(trace.bound_symbols)
+    ]
+    rej = report.rejections()
+    rej_note = (
+        " rejected " + ", ".join(f"{k}={v}" for k, v in sorted(rej.items()))
+        if rej
+        else ""
+    )
+    ntrace._donation_summary = (
+        f"{report.donated_buffers} buffer(s) / {report.donated_bytes} bytes donated"
+        f" across {sum(1 for r in report.regions if r.donated)} region(s);{rej_note}"
+        if report.regions
+        else "no fusion regions"
+    )
+    ntrace.set_provenance(
+        TraceProvenance(
+            f"Donation analysis ({which}): {report.donated_buffers} buffers / "
+            f"{report.donated_bytes} bytes donated"
+        )
+    )
+    return ntrace, report
+
+
+def donation_summary(report: DonationReport) -> dict:
+    """Plain-dict view of a report (what ``tt.donation_stats`` returns)."""
+    return {
+        "buffers_donated": report.donated_buffers,
+        "bytes_donated": report.donated_bytes,
+        "regions": [
+            {
+                "name": r.name,
+                "donated": [p.name for _, p in r.donated],
+                "aliases": dict(r.aliases),
+                "bytes": r.donated_bytes,
+                "rejected": {n: reason for n, (reason, _) in r.rejected.items()},
+            }
+            for r in report.regions
+        ],
+        "rejections": report.rejections(),
+    }

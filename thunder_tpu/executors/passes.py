@@ -18,7 +18,7 @@ from thunder_tpu.extend import Executor, FusionExecutor, OperatorExecutor
 from thunder_tpu.core.pytree import tree_flatten
 from thunder_tpu.observability.events import span as _phase_span
 
-__all__ = ["transform_for_execution", "del_last_used"]
+__all__ = ["transform_for_execution", "del_last_used", "annotate_donations"]
 
 _PASSTHROUGH_IDS = {
     PrimIDs.RETURN,
@@ -219,11 +219,9 @@ def del_last_used(trace: TraceCtx, *, clear_collections: bool = False) -> TraceC
     from thunder_tpu.core.prims import python_del
 
     # proxies that must outlive the program
-    protected: set[str] = set()
-    for bsym in trace.bound_symbols:
-        if bsym.sym.id == PrimIDs.RETURN:
-            for p in bsym.flat_proxy_args:
-                protected.add(p.name)
+    from thunder_tpu.executors.utils import trace_return_names
+
+    protected: set[str] = trace_return_names(trace)
 
     new_reversed: list[BoundSymbol] = []
     seen: set[str] = set()
@@ -248,3 +246,23 @@ def del_last_used(trace: TraceCtx, *, clear_collections: bool = False) -> TraceC
     elapsed = (time.perf_counter_ns() - start) // 1000000
     ntrace.set_provenance(TraceProvenance(f"Delete Last Used (took {elapsed} milliseconds)"))
     return ntrace
+
+
+@_phase_span("lower:donation")
+def annotate_donations(
+    trace: TraceCtx,
+    *,
+    candidate_names: set | None = None,
+    strict: bool = False,
+    which: str = "forward",
+):
+    """Del-aware buffer donation pass: runs AFTER ``del_last_used`` (it needs
+    the explicit ``DEL`` placement as its liveness proof) and arms each XLA
+    fusion region with the inputs that are provably safe to donate.  Returns
+    ``(annotated_trace, DonationReport)`` — see
+    ``thunder_tpu.executors.donation`` for the safety contract."""
+    from thunder_tpu.executors.donation import apply_donation
+
+    return apply_donation(
+        trace, candidate_names=candidate_names, strict=strict, which=which
+    )

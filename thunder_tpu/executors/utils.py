@@ -15,7 +15,21 @@ from thunder_tpu.core.pytree import tree_flatten, tree_unflatten
 from thunder_tpu.core.symbol import BoundSymbol
 from thunder_tpu.core.utils import OrderedSet, consumers, producers
 
-__all__ = ["Region", "eval_bsyms", "resolve_impl", "resolve_args"]
+__all__ = ["Region", "eval_bsyms", "resolve_impl", "resolve_args", "trace_return_names"]
+
+
+def trace_return_names(trace) -> set[str]:
+    """Names of every proxy the trace returns — the buffers that must outlive
+    the program.  Shared by ``del_last_used`` (they are never deleted) and
+    the donation pass (they are never donated)."""
+    from thunder_tpu.core.prims import PrimIDs
+
+    out: set[str] = set()
+    for bsym in trace.bound_symbols:
+        if bsym.sym.id == PrimIDs.RETURN:
+            for p in bsym.flat_proxy_args:
+                out.add(p.name)
+    return out
 
 
 class Region:

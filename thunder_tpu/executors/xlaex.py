@@ -49,7 +49,25 @@ class FusionCallable:
         self.bsyms = list(bsyms)
         self.input_names = [p.name for p in inputs]
         self.output_names = [p.name for p in outputs]
+        #: positions donated to XLA (set post-lowering by the donation pass —
+        #: executors/donation.py — never at construction, so the donate=False
+        #: path compiles the exact program it always did)
+        self.donate_argnums: tuple[int, ...] = ()
+        #: input name -> output name alias hints (introspection/metrics; the
+        #: actual buffer aliasing is XLA's, via donate_argnums)
+        self.out_aliases: dict[str, str] = {}
         self._jitted = jax.jit(self._raw)
+        self._compiled_once = False
+
+    def set_donation(self, argnums: Sequence[int], aliases: dict | None = None) -> None:
+        """Re-arms the region with ``donate_argnums`` (donation pass only).
+        The jit is rebuilt — it is lazy, so nothing recompiles until the next
+        call — and the compile event re-fires for the donated program."""
+        self.donate_argnums = tuple(sorted(argnums))
+        self.out_aliases = dict(aliases or {})
+        self._jitted = jax.jit(
+            self._raw, donate_argnums=self.donate_argnums or None
+        )
         self._compiled_once = False
 
     def _raw(self, *vals):
@@ -58,6 +76,41 @@ class FusionCallable:
         return tuple(env[n] for n in self.output_names)
 
     def __call__(self, *vals):
+        if self.donate_argnums:
+            # a donated input from an EARLIER call may arrive here deleted
+            # (donation consumes the caller's array); catch it before XLA
+            # does so the error names the proxy and the source lines that
+            # built the region, not just an anonymous deleted buffer
+            for i in self.donate_argnums:
+                v = vals[i] if i < len(vals) else None
+                if getattr(v, "is_deleted", None) is not None and v.is_deleted():
+                    from thunder_tpu.core.symbol import gather_provenance
+                    from thunder_tpu.executors.donation import DonationError
+
+                    prov = ""
+                    for b in self.bsyms:
+                        entries = gather_provenance(b)
+                        if entries:
+                            fname, pos = entries[0]
+                            lineno = getattr(pos, "lineno", pos)
+                            prov = f" (region built from {fname}:{lineno})"
+                            break
+                    raise DonationError(
+                        f"input {self.input_names[i]!r} (position {i}) of fusion "
+                        f"region {self.name} was donated by an earlier call and its "
+                        f"buffer is gone{prov} — donated inputs are CONSUMED: pass a "
+                        f"fresh array (feed the outputs forward) or compile with "
+                        f"donate=False"
+                    )
+            # backends without donation (CPU) and declined donations warn per
+            # execute; the shared helper silences exactly that message
+            from thunder_tpu.executors.donation import suppress_unusable_donation_warnings
+
+            with suppress_unusable_donation_warnings():
+                return self._call_impl(*vals)
+        return self._call_impl(*vals)
+
+    def _call_impl(self, *vals):
         if not self._compiled_once:
             # the first call triggers XLA tracing+compilation (jax.jit is
             # lazy); record it as a pipeline event.  Shape-change recompiles

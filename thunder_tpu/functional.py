@@ -248,6 +248,19 @@ def trace_from_fn(
     computation_trace.set_siginfo(si)
     computation_trace.args = tuple(comp_inputs)
 
+    # tensor-leaf proxy name -> POSITIONAL argnum (kwargs leaves absent):
+    # the donation pass's explicit ``donate=argnums`` form resolves user
+    # argument positions to computation inputs through this map
+    arg_leaf_argnums: dict[str, int] = {}
+    offset = 0
+    for i, a in enumerate(args):
+        leaves_i, _ = tree_flatten(a)
+        for p in proxies[offset : offset + len(leaves_i)]:
+            if isinstance(p, TensorProxy):
+                arg_leaf_argnums[p.name] = i
+        offset += len(leaves_i)
+    computation_trace._input_argnums = arg_leaf_argnums
+
     #
     # Prologue trace: unpack every leaf, check it, return computation inputs
     #

@@ -343,13 +343,12 @@ def generate(
     )
     cache = init_cache(cfg, B, T_max, dtype=dtype, mesh=mesh)
     first, cache, key = prefill(params, prompt, cache, key)
-    import warnings
+    from thunder_tpu.executors.donation import suppress_unusable_donation_warnings
 
-    with warnings.catch_warnings():
-        # decode returns only tokens, so the donated cache can't alias an
-        # output; the donation still frees it for scratch — silence jax's
-        # "donated buffers were not usable" note
-        warnings.filterwarnings("ignore", message="Some donated buffers were not usable")
+    # decode returns only tokens, so the donated cache can't alias an
+    # output; the donation still frees it for scratch — the shared helper
+    # silences jax's "donated buffers were not usable" note
+    with suppress_unusable_donation_warnings():
         new_toks = decode_all(params, first, cache, key)
     return jnp.concatenate([prompt, new_toks], axis=1)
 

@@ -199,13 +199,12 @@ def speculative_generate(
     tcache = init_cache(cfg, B, T_max, dtype=dtype)
     dcache = init_cache(draft_cfg, B, T_max, dtype=dtype)
     tcache, dcache, first_logits = prefill(params, draft_params, tcache, dcache, prompt)
-    import warnings
+    from thunder_tpu.executors.donation import suppress_unusable_donation_warnings
 
-    with warnings.catch_warnings():
-        # decode_all returns only tokens/counters, so the donated caches
-        # cannot alias an output; donation still frees them for scratch
-        # (same pattern and rationale as generate.py's decode loop)
-        warnings.filterwarnings("ignore", message="Some donated buffers were not usable")
+    # decode_all returns only tokens/counters, so the donated caches
+    # cannot alias an output; donation still frees them for scratch
+    # (same pattern and rationale as generate.py's decode loop)
+    with suppress_unusable_donation_warnings():
         out, n, rounds = decode_all(params, draft_params, tcache, dcache, first_logits, key)
     #: mean over rows of (tokens emitted / that row's ACTIVE rounds), the
     #: prefill-seeded first token excluded and emission clamped to max_new —

@@ -40,9 +40,17 @@ def memory_timeline(trace: TraceCtx) -> dict:
     where each row is ``{"live_bytes", "peak_bytes"}`` — the live-set size
     right after that symbol executes (before any following ``del``) and the
     running peak up to and including it.
+
+    Donation-aware: a fusion bound symbol annotated by the donation pass
+    (``executors/donation.py`` sets ``bsym._donation``) releases its donated
+    input buffers AS it executes — XLA reuses them for the region's outputs
+    (the input→output alias pattern) or scratch — so the peak at that symbol
+    is ``live - donated + outputs`` instead of ``live + outputs``.  The total
+    reclaimed this way is returned as ``donated_bytes``.
     """
     inputs = sum(tensor_nbytes(p) for p in (trace.args or ()) if isinstance(p, TensorProxy))
     outputs = 0
+    donated_total = 0
     live: dict[str, int] = {}
     for p in trace.args or ():
         if isinstance(p, TensorProxy):
@@ -61,6 +69,14 @@ def memory_timeline(trace: TraceCtx) -> dict:
                 cur -= live.pop(p.name, 0)
             rows.append({"live_bytes": cur, "peak_bytes": peak})
             continue
+        donation = getattr(bsym, "_donation", None)
+        if donation:
+            # donated buffers are dead the moment the region runs (proven by
+            # the analysis); the following DEL then pops nothing
+            for name in donation["donated"]:
+                freed = live.pop(name, 0)
+                cur -= freed
+                donated_total += freed
         for o in bsym.flat_proxy_outs:
             if o.name not in live:
                 b = tensor_nbytes(o)
@@ -74,4 +90,5 @@ def memory_timeline(trace: TraceCtx) -> dict:
         "input_bytes": inputs,
         "output_bytes": outputs,
         "peak_bytes_estimate": peak,
+        "donated_bytes": donated_total,
     }

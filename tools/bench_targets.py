@@ -1,0 +1,75 @@
+"""Bench-target regression checks shared by CI (tests/test_bench_targets.py)
+and the TPU queue.
+
+The committed BENCH_*.json artifacts are the performance memory of this repo;
+this module turns a handful of them into *gates* rather than mere records.
+Checks are deliberately coarse (CI hosts jitter by 2-3x): they catch
+category errors — a disabled-by-default feature leaking cost onto the hot
+path, a schema break that would make a TPU window's artifact useless — not
+single-digit-percent drift.
+
+Current gates:
+
+- ``check_donation_off_overhead``: the ``donate=False`` path must cost the
+  same dispatch ns as the donation-unaware path (the pass must not run at
+  all when off; the program is byte-identical).  Fails when the measured
+  ratio exceeds ``max_ratio``.
+- ``check_micro_baseline_schema``: the committed ``BENCH_MICRO.json`` must
+  keep the shape the sweep/tuning tools parse (a malformed refresh would
+  waste the next TPU window).
+"""
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+__all__ = [
+    "repo_root",
+    "load_artifact",
+    "check_donation_off_overhead",
+    "check_micro_baseline_schema",
+]
+
+# generous: CI hosts jitter, and the gate exists to catch the donate=False
+# path accidentally running the analysis / recompiling — a category error
+# that shows up as far more than 2x — not percent-level drift
+DONATION_OFF_MAX_RATIO = 2.0
+
+
+def repo_root() -> Path:
+    return Path(__file__).resolve().parent.parent
+
+
+def load_artifact(name: str) -> dict:
+    """Loads a committed BENCH_*.json artifact from the repo root."""
+    return json.loads((repo_root() / name).read_text())
+
+
+def check_donation_off_overhead(results: dict, max_ratio: float = DONATION_OFF_MAX_RATIO) -> float:
+    """``results`` is the ``results`` dict of a donation-bench run (live or
+    the committed ``BENCH_DONATION.json``).  Returns the measured
+    donate=False-vs-plain dispatch ratio; raises ``AssertionError`` when it
+    regresses past ``max_ratio``."""
+    plain = results["update_plain_dispatch_us"]
+    off = results["update_donate_off_dispatch_us"]
+    assert plain > 0 and off > 0, results
+    ratio = off / plain
+    assert ratio <= max_ratio, (
+        f"donate=False dispatch regressed: {off:.1f}us vs plain {plain:.1f}us "
+        f"({ratio:.2f}x > {max_ratio}x) — the donation pass must not touch "
+        f"the donate=False path (byte-identical program, same code path)"
+    )
+    return ratio
+
+
+def check_micro_baseline_schema(artifact: dict | None = None) -> dict:
+    """Validates the BENCH_MICRO.json shape the sweep/tuning tools rely on:
+    a backend, shape metadata, and per-op rows each carrying ``thunder_ms``.
+    Returns the artifact for chaining."""
+    if artifact is None:
+        artifact = load_artifact("BENCH_MICRO.json")
+    assert "backend" in artifact and "results" in artifact, sorted(artifact)
+    assert artifact["results"], "BENCH_MICRO.json has no result rows"
+    for name, row in artifact["results"].items():
+        assert "thunder_ms" in row and row["thunder_ms"] > 0, (name, row)
+    return artifact

@@ -792,6 +792,31 @@ def main():
             "results": out["results"],
         }))
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "serving":
+        # continuous-batching serving bench: N concurrent requests through
+        # the paged-pool engine vs N sequential generate() calls — tokens/s,
+        # mean batch occupancy, and the bucket-bounded compile count.  Host
+        # work only, no TPU probe; artifact uses the BENCH_MICRO schema.
+        from thunder_tpu._platform import force_cpu
+
+        force_cpu()
+        from thunder_tpu.benchmarks.serving import serving_bench
+
+        out = serving_bench(on_tpu=False)
+        artifact = {"backend": jax.default_backend(), **out}
+        with open("BENCH_SERVING.json", "w") as f:
+            json.dump(artifact, f, indent=1)
+        for k, v in out["results"].items():
+            log(f"serving {k}: {v}")
+        print(json.dumps({
+            "metric": "serving_vs_sequential_throughput_x",
+            "value": out["results"]["throughput_ratio"],
+            "unit": "x",
+            # the sequential path IS the baseline of this ratio
+            "vs_baseline": out["results"]["throughput_ratio"],
+            "results": out["results"],
+        }))
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "cost":
         # analytic companion to the measured headline (no TPU needed): XLA's
         # own cost model on the compiled loss+grad at headline geometry, and

@@ -51,3 +51,8 @@ def _reset_observability_state():
     tt = _sys.modules.get("thunder_tpu")
     if tt is not None:
         tt.reset_observability()
+
+
+def pytest_configure(config):
+    # tier-1 runs with -m 'not slow'; soak/long-horizon tests opt out with it
+    config.addinivalue_line("markers", "slow: long-running test, excluded from tier-1")

@@ -336,3 +336,57 @@ class TestHarnessTargets:
         assert r["overhead_x"] > 0
         assert r["checked_symbols"] >= 1
         assert r["anomalies_detected"] == 0, r
+
+
+class TestServingTargets:
+    def test_serving_gate_on_committed_artifact(self):
+        """BENCH_SERVING.json must keep showing the subsystem's reason to
+        exist: continuous batching >= sequential generate() in tokens/sec,
+        mean batch occupancy > 1, and the compiled-program count inside the
+        bucket bound.  A regression recorded into the artifact fails here."""
+        from tools.bench_targets import check_serving_targets
+
+        art = check_serving_targets()
+        assert art["backend"] in ("cpu", "tpu")
+        assert art["results"]["throughput_ratio"] >= 1.0
+
+    def test_serving_gate_rejects_regressions(self):
+        from tools.bench_targets import check_serving_targets, load_artifact
+
+        good = load_artifact("BENCH_SERVING.json")
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["mean_batch_occupancy"] = 1.0
+        with pytest.raises(AssertionError, match="occupancy"):
+            check_serving_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["throughput_ratio"] = 0.8
+        with pytest.raises(AssertionError, match="lost to sequential"):
+            check_serving_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["decode_compiles"] = bad["results"]["bucket_bound"] + 1
+        with pytest.raises(AssertionError, match="bucket bound"):
+            check_serving_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        del bad["results"]["serving_tokens_per_sec"]
+        with pytest.raises(AssertionError):
+            check_serving_targets(bad)
+
+    @pytest.mark.slow
+    def test_serving_bench_live_smoke(self):
+        """The bench harness itself at smoke shapes: occupancy must exceed
+        one request and every schema key must be present (the throughput
+        ratio is not gated live — smoke shapes on a jittery CI host are
+        dispatch-bound; the committed full-shape artifact carries that
+        gate)."""
+        from thunder_tpu.benchmarks.serving import serving_bench
+        from tools.bench_targets import check_serving_targets
+
+        out = serving_bench(on_tpu=False, smoke=True)
+        art = {"backend": jax.default_backend(), **out}
+        check_serving_targets(art, min_ratio=0.0)
+        assert out["results"]["smoke"] is True
+        assert out["results"]["mean_batch_occupancy"] > 1.0

@@ -180,7 +180,10 @@ class TestMetricsRegistry:
         snap = reg.snapshot()
         assert snap["c"] == 3
         assert snap["g"] == 1.5
-        assert snap["h"] == {"count": 2, "sum": 6.0, "mean": 3.0, "min": 2.0, "max": 4.0}
+        assert snap["h"] == {
+            "count": 2, "sum": 6.0, "mean": 3.0, "min": 2.0, "max": 4.0,
+            "p50": 2.0, "p95": 4.0, "p99": 4.0,
+        }
 
         # get-or-create returns the same object; a type collision raises
         assert reg.counter("c") is c
@@ -192,6 +195,28 @@ class TestMetricsRegistry:
         assert snap["c"] == 0 and snap["g"] is None and snap["h"]["count"] == 0
         c.inc()  # held references survive reset
         assert reg.snapshot()["c"] == 1
+
+    def test_histogram_percentiles(self):
+        """p50/p95/p99 are nearest-rank over the bounded recent window, so
+        latency histograms (serving TTFT/TPOT, train.step_s) report as the
+        percentiles dashboards scrape."""
+        h = obs.Histogram("lat")
+        assert h.percentile(50) is None and h.snapshot()["p99"] is None
+        for v in range(1, 101):                      # 1..100
+            h.observe(float(v))
+        snap = h.snapshot()
+        assert snap["p50"] == 50.0
+        assert snap["p95"] == 95.0
+        assert snap["p99"] == 99.0
+        assert snap["min"] == 1.0 and snap["max"] == 100.0
+        # window-bounded: a burst of large values shifts the percentiles
+        # even though min/mean stay exact over the full stream
+        for _ in range(obs.Histogram.WINDOW):
+            h.observe(1000.0)
+        snap = h.snapshot()
+        assert snap["p50"] == 1000.0 and snap["min"] == 1.0
+        h.reset()
+        assert h.snapshot()["p50"] is None and h.count == 0
 
     def test_dispatch_and_compile_mirror_into_global_registry(self):
         reg = obs.registry()
@@ -631,6 +656,26 @@ class TestStepLogger:
         sl2.close()
         lines = [json.loads(l) for l in path.read_text().splitlines()]
         assert [l["step"] for l in lines] == [0, 1]
+
+    def test_request_records(self):
+        """Per-request serving records share the step-JSONL sink: one
+        ``{"event": "request", ...}`` line per completed request, None
+        fields omitted (the serving engine drives this)."""
+        import io
+
+        from thunder_tpu.observability.telemetry import StepLogger
+
+        buf = io.StringIO()
+        with StepLogger(buf, meta={"kind": "serving"}) as sl:
+            rec = sl.log_request(
+                rid=3, prompt_tokens=7, new_tokens=5, finish_reason="length",
+                ttft_s=0.01, tpot_s=0.002, tokens_per_sec=450.0, queue_s=None,
+            )
+        assert rec["event"] == "request" and "queue_s" not in rec
+        lines = [json.loads(l) for l in buf.getvalue().splitlines()]
+        assert lines[1]["rid"] == 3
+        assert lines[1]["finish_reason"] == "length"
+        assert lines[1]["ttft_s"] == 0.01 and lines[1]["tokens_per_sec"] == 450.0
 
 
 class TestResetObservability:

@@ -92,6 +92,7 @@ __all__ = [
     "profile_stats",
     "donation_stats",
     "metrics_snapshot",
+    "serve",
     "export_chrome_trace",
     "observability",
     "reset_observability",
@@ -911,3 +912,19 @@ def last_compile_options(cfn) -> dict:
     via get_compile_option; reference __init__.py:850)."""
     cs = _get_cs(cfn)
     return dict(cs.last_compile_reasons)
+
+
+def serve(model_fn, params, cfg, **kwargs):
+    """Continuous-batching inference engine over a paged KV-cache pool:
+    ``tt.serve(None, params, cfg, num_blocks=..., max_batch=...)`` →
+    :class:`thunder_tpu.serving.ServingEngine` with ``submit(prompt, *,
+    max_new_tokens, deadline, stream_cb) -> RequestHandle``, a synchronous
+    ``step()`` drive loop, and ``run()``/``drain()``/``shutdown()``.
+    ``model_fn=None`` serves the in-tree ``models.generate`` forward; pass a
+    callable with the same signature to serve a custom model.  Strictly
+    additive: nothing else in the pipeline changes by building an engine
+    (the import is deferred to keep the off-path cost at zero).  See
+    GUIDE.md "Serving" and ``thunder_tpu.serving``."""
+    from thunder_tpu.serving import serve as _serve
+
+    return _serve(model_fn, params, cfg, **kwargs)

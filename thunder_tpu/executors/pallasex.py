@@ -860,8 +860,9 @@ def _ce_kernel(logits_ref, tgt_ref, loss_ref, lse_ref, m_s, s_s, p_s, *, BN, BV)
 def _tuning() -> dict:
     """Measured kernel tuning, committed by tools/kernel_tune.py from a real
     TPU run (VERDICT r3 #2: a kernel that loses to XLA must win or yield).
-    Keys: ``ce.bn`` / ``ce.bv_cap`` (block geometry), ``ce.claim`` (False =
-    the checker defers to the XLA lowering)."""
+    Keys: ``ce.bn`` / ``ce.bv_cap`` (block geometry), ``ce.claim`` (default
+    **False** — the checker defers to the XLA lowering until a measurement
+    says otherwise)."""
     import json
 
     path = os.environ.get(
@@ -1010,8 +1011,12 @@ _ce_op = ex.register_operator(
 
 
 def _ce_checker(logits, target):
-    if not _tuning().get("ce", {}).get("claim", True):
-        return False  # measured loss to XLA on TPU: yield (tools/kernel_tune.py)
+    # Default is YIELD: the kernel was last *measured* losing to XLA on the
+    # default geometry, and win-or-yield says an unmeasured claim is a
+    # regression risk.  A fresh TPU measurement (tools/kernel_tune.py)
+    # writes ``ce.claim: true`` into pallas_tuning.json to re-arm it.
+    if not _tuning().get("ce", {}).get("claim", False):
+        return False
     try:
         from thunder_tpu.core import dtypes as _dt
 

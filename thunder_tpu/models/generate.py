@@ -34,7 +34,17 @@ import jax.numpy as jnp
 
 from thunder_tpu.models.llama import Config, build_rope_cache
 
-__all__ = ["init_cache", "forward_with_cache", "generate"]
+__all__ = [
+    "init_cache",
+    "forward_with_cache",
+    "generate",
+    "cache_len",
+    "cache_shape",
+    "kv_block_shape",
+    "ring_slot",
+    "ring_gather_positions",
+    "sample_token",
+]
 
 
 def _linear(x, w, b=None, *, quantized=False):
@@ -120,7 +130,7 @@ def _project_qkv(ap, x, cos_t, sin_t, cfg: Config, *, lin=None):
     return q, k, v
 
 
-def _cache_len(cfg: Config, T_max: int) -> int:
+def cache_len(cfg: Config, T_max: int) -> int:
     """Sequence capacity of the KV cache: ``sliding_window`` bounds it — a
     banded model never attends further back, so the cache is a **ring** of
     ``window`` slots (slot = position % window) and decode memory is
@@ -131,6 +141,40 @@ def _cache_len(cfg: Config, T_max: int) -> int:
     return T_max
 
 
+_cache_len = cache_len  # back-compat alias
+
+
+def cache_shape(cfg: Config, B: int, T_max: int) -> tuple[int, int, int, int, int]:
+    """Dense KV-cache geometry ``(L, B, n_query_groups, Tc, hs)`` — the one
+    layout every cache consumer (``init_cache``, the serving KV pool's
+    gathered views) agrees on."""
+    return (cfg.n_layer, B, cfg.n_query_groups, cache_len(cfg, T_max), cfg.head_size)
+
+
+def kv_block_shape(cfg: Config, block_size: int) -> tuple[int, int, int, int]:
+    """Per-block geometry ``(L, n_query_groups, block_size, hs)`` of the
+    paged serving pool's arena — one block holds ``block_size`` consecutive
+    token slots of every layer's K (or V), so a gather over a request's
+    block table reassembles exactly the :func:`cache_shape` layout."""
+    return (cfg.n_layer, cfg.n_query_groups, block_size, cfg.head_size)
+
+
+def ring_slot(pos, window: int):
+    """Ring-cache slot of global position ``pos``: ``pos % window``."""
+    return jax.lax.rem(pos, window)
+
+
+def ring_gather_positions(T: int, window: int):
+    """Prefill→ring scatter map: for each ring slot ``j``, the latest prompt
+    position ``p < T`` with ``p ≡ j (mod window)`` (clamped to 0 for slots no
+    prompt position reaches; those stay garbage and are masked positionally
+    at decode)."""
+    import numpy as _np
+
+    src_pos = _np.array([j + ((T - 1 - j) // window) * window for j in range(window)])
+    return _np.maximum(src_pos, 0)
+
+
 def init_cache(cfg: Config, B: int, T_max: int, dtype=jnp.bfloat16, *, mesh=None, axis="tp") -> dict:
     """Preallocated KV cache: ``{"k"/"v": (L, B, n_query_groups, Tc, hs)}``
     where ``Tc = T_max``, bounded by ``cfg.sliding_window`` (ring cache).
@@ -138,7 +182,7 @@ def init_cache(cfg: Config, B: int, T_max: int, dtype=jnp.bfloat16, *, mesh=None
     With ``mesh``, the KV-group dim shards over ``axis`` (tensor-parallel
     serving: each device holds its heads' cache; attention stays device-local
     and only the output projection reduces)."""
-    shape = (cfg.n_layer, B, cfg.n_query_groups, _cache_len(cfg, T_max), cfg.head_size)
+    shape = cache_shape(cfg, B, T_max)
     sh = None
     if mesh is not None and axis in mesh.axis_names and mesh.shape[axis] > 1:
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -212,8 +256,6 @@ def _attn_with_cache(ap, x, cos_t, sin_t, ck, cv, pos, cfg: Config, *, quantized
         # ring prefill: the chunk attends within itself (banded); the cache
         # keeps each ring slot's latest prompt position.  pos==0 because a
         # later chunk would need K/V already evicted from the ring.
-        import numpy as _np
-
         assert isinstance(pos, int) and pos == 0, "ring-cache prefill must start at position 0"
         kk, vv = k, v
         row = jnp.arange(T)[None, None, :, None]
@@ -221,13 +263,12 @@ def _attn_with_cache(ap, x, cos_t, sin_t, ck, cv, pos, cfg: Config, *, quantized
         keep = jnp.logical_and(col <= row, col > row - W)
         # slot j <- the latest prompt position p ≡ j (mod W); slots with no
         # such position stay garbage (masked positionally at decode)
-        src_pos = _np.array([j + ((T - 1 - j) // W) * W for j in range(W)])
-        gather = _np.maximum(src_pos, 0)
+        gather = ring_gather_positions(T, W)
         ck = jnp.take(k, gather, axis=2).astype(ck.dtype)
         cv = jnp.take(v, gather, axis=2).astype(cv.dtype)
     else:
         # ring decode: one token at global position pos -> slot pos % W
-        slot = jax.lax.rem(pos, W)
+        slot = ring_slot(pos, W)
         ck = jax.lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), slot, axis=2)
         cv = jax.lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), slot, axis=2)
         kk, vv = ck, cv
@@ -293,10 +334,15 @@ def forward_with_cache(params, idx, pos, cache, cos_all, sin_all, cfg: Config, *
     return logits, cache
 
 
-def _sample(logits, temperature, key):
+def sample_token(logits, temperature, key):
+    """Greedy (``temperature == 0``) or temperature sampling over the last
+    axis; ``temperature`` is static (baked into the compiled program)."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     return jax.random.categorical(key, logits / temperature, axis=-1).astype(jnp.int32)
+
+
+_sample = sample_token  # back-compat alias
 
 
 def generate(

@@ -75,11 +75,17 @@ class Gauge:
 
 
 class Histogram:
-    """Streaming summary (count/sum/min/max); no bucket boundaries to
-    misconfigure — the consumers here want totals and extremes, not
-    quantile sketches."""
+    """Streaming summary (count/sum/min/max) plus windowed percentiles: a
+    bounded ring of the most recent ``WINDOW`` observations backs the
+    ``p50``/``p95``/``p99`` snapshot keys (nearest-rank over the window), so
+    latency metrics — serving TTFT/TPOT, step times — report as the
+    percentiles dashboards want without an unbounded sample store or bucket
+    boundaries to misconfigure.  min/max/mean/sum remain exact over the full
+    stream; the percentiles describe the recent window."""
 
-    __slots__ = ("name", "count", "sum", "min", "max")
+    WINDOW = 512
+
+    __slots__ = ("name", "count", "sum", "min", "max", "_window")
 
     def __init__(self, name: str):
         self.name = name
@@ -92,6 +98,16 @@ class Histogram:
             self.min = v
         if self.max is None or v > self.max:
             self.max = v
+        self._window[self.count % self.WINDOW] = v
+
+    def percentile(self, q: float):
+        """Nearest-rank percentile (``q`` in [0, 100]) over the retained
+        window; None before the first observation."""
+        if not self.count:
+            return None
+        vals = sorted(v for v in self._window if v is not None)
+        rank = max(int(-(-q / 100.0 * len(vals) // 1)), 1)  # ceil, >= 1
+        return vals[min(rank, len(vals)) - 1]
 
     def snapshot(self) -> dict:
         return {
@@ -100,6 +116,9 @@ class Histogram:
             "mean": (self.sum / self.count) if self.count else None,
             "min": self.min,
             "max": self.max,
+            "p50": self.percentile(50),
+            "p95": self.percentile(95),
+            "p99": self.percentile(99),
         }
 
     def reset(self) -> None:
@@ -107,6 +126,7 @@ class Histogram:
         self.sum = 0
         self.min = None
         self.max = None
+        self._window = [None] * self.WINDOW
 
 
 class MetricsRegistry:

@@ -96,6 +96,32 @@ class StepLogger:
                 reg.histogram("train.step_s").observe(rec["step_time_s"])
         return rec
 
+    def log_request(
+        self,
+        *,
+        rid: int,
+        prompt_tokens: int,
+        new_tokens: int,
+        finish_reason: str,
+        **extra: Any,
+    ) -> dict:
+        """Records one *served request* (the serving engine drives this once
+        per completed/expired/evicted request): ``{"event": "request", ...}``
+        with the request-level latency numbers (``ttft_s``, ``tpot_s``,
+        ``tokens_per_sec``, ``queue_s``) passed through ``extra``.  ``None``
+        values are omitted, mirroring :meth:`log_step`."""
+        rec: dict[str, Any] = {
+            "event": "request",
+            "rid": int(rid),
+            "time": time.time(),
+            "prompt_tokens": int(prompt_tokens),
+            "new_tokens": int(new_tokens),
+            "finish_reason": str(finish_reason),
+        }
+        rec.update({k: v for k, v in extra.items() if v is not None})
+        self._write(rec)
+        return rec
+
     def close(self) -> None:
         if self._owns_sink and not self._f.closed:
             self._f.close()

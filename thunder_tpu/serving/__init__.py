@@ -1,0 +1,41 @@
+"""Serving subsystem: continuous batching over a paged KV-cache pool.
+
+Layer #10 of the stack — the request level.  ``models/generate.py`` turns a
+compiled decode step into *one* fixed-batch generation; this package turns
+it into a server: a FIFO request queue with admission control, a
+block-granular KV pool shared by every in-flight request (with reference-
+counted prefix sharing), bucketed batch shapes so the compiled-program set
+stays bounded, and per-request deadlines, streaming, and telemetry.
+
+Entry point: ``tt.serve(model_fn, params, cfg, ...)`` (or construct
+:class:`ServingEngine` directly).  Everything is strictly additive — no
+other compiled program changes by importing or using this package.
+"""
+from thunder_tpu.serving.engine import (  # noqa: F401
+    RequestHandle,
+    RequestResult,
+    ServingEngine,
+    serve,
+)
+from thunder_tpu.serving.kv_pool import PagedKVPool, PoolExhaustedError  # noqa: F401
+from thunder_tpu.serving.scheduler import (  # noqa: F401
+    AdmissionError,
+    Request,
+    Scheduler,
+    pick_bucket,
+    pow2_buckets,
+)
+
+__all__ = [
+    "serve",
+    "ServingEngine",
+    "RequestHandle",
+    "RequestResult",
+    "PagedKVPool",
+    "PoolExhaustedError",
+    "Scheduler",
+    "Request",
+    "AdmissionError",
+    "pick_bucket",
+    "pow2_buckets",
+]

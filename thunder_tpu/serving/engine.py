@@ -1,0 +1,650 @@
+"""Serving engine front-end: ``tt.serve(...)`` → :class:`ServingEngine`.
+
+Continuous (in-flight) batching over the compiled decode step: independent
+requests share one bucketed decode program, join the batch the step after
+their prefill, and leave it the step they finish — batch occupancy is a
+scheduling property, not a caller-visible one.  The engine composes the
+pieces the repo already has:
+
+- ``models.generate.forward_with_cache`` is the model step — the pool's
+  gathered block views reassemble exactly the dense cache layout it
+  consumes, and per-row vector positions (the speculative-decode machinery)
+  drive mixed-progress batches;
+- the **paged pool** (:mod:`serving.kv_pool`) owns cache memory; every
+  program donates the arenas so updates stay in place (PR 4);
+- the **scheduler** (:mod:`serving.scheduler`) owns admission, FIFO order,
+  deadlines, and the bucket sets that bound recompiles (absorbed by the
+  PR-1 dispatch cache when the model fn is a ``tt.jit`` product);
+- **observability** (PRs 2–3): queue/occupancy/pool gauges, TTFT/TPOT and
+  tokens/sec histograms in the metrics registry, per-request JSONL records
+  through :class:`observability.telemetry.StepLogger`.
+
+Reproducibility contract: each request carries its own PRNG key chain and
+splits it exactly like a solo ``generate()`` call (one split at prefill, one
+per decode step; per-row sampling under ``vmap`` is bit-equivalent to the
+unbatched call), so a request's tokens do not depend on what else shares
+the batch — and greedy tokens match ``generate()`` exactly.
+
+The drive loop is synchronous and explicit: ``step()`` runs one scheduler
+iteration (expire → admit+prefill → one decode step); ``run()``/``drain()``
+loop it.  No threads — integrate into any host loop.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from thunder_tpu.models.generate import (
+    build_rope_cache,
+    forward_with_cache,
+    sample_token,
+)
+from thunder_tpu.observability.metrics import registry
+from thunder_tpu.serving.kv_pool import (
+    SINK_BLOCK,
+    PagedKVPool,
+    gather_dense,
+    scatter_blocks,
+    scatter_token,
+)
+from thunder_tpu.serving.scheduler import (
+    FINISH_DEADLINE,
+    FINISH_EOS,
+    FINISH_EVICTED,
+    FINISH_LENGTH,
+    AdmissionError,
+    Request,
+    Scheduler,
+    pick_bucket,
+)
+
+__all__ = ["serve", "ServingEngine", "RequestHandle", "RequestResult", "AdmissionError"]
+
+
+@dataclass(frozen=True)
+class RequestResult:
+    """Structured outcome of one served request."""
+
+    rid: int
+    prompt: np.ndarray
+    new_tokens: tuple[int, ...]
+    finish_reason: str                      # length | eos | deadline | evicted
+    ttft_s: float | None                    # submit → first token
+    tpot_s: float | None                    # mean per-token after the first
+    tokens_per_sec: float | None
+    queue_s: float | None                   # submit → admission
+    shared_prefix_blocks: int
+
+    @property
+    def tokens(self) -> np.ndarray:
+        """Full sequence (prompt + generated), the solo ``generate()`` row."""
+        return np.concatenate([self.prompt, np.asarray(self.new_tokens, dtype=np.int32)])
+
+
+class RequestHandle:
+    """Caller's view of a submitted request."""
+
+    def __init__(self, engine: "ServingEngine", req: Request):
+        self._engine = engine
+        self._req = req
+
+    @property
+    def rid(self) -> int:
+        return self._req.rid
+
+    @property
+    def state(self) -> str:
+        return self._req.state
+
+    def done(self) -> bool:
+        return self._req.state == "finished"
+
+    def tokens_so_far(self) -> tuple[int, ...]:
+        return tuple(self._req.generated)
+
+    def result(self, *, drive: bool = True) -> RequestResult:
+        """The structured result; with ``drive`` (default) steps the engine
+        until this request finishes."""
+        while drive and not self.done():
+            if not self._engine.step() and not self.done():
+                raise RuntimeError(
+                    f"engine stalled with request {self.rid} still {self._req.state}"
+                )
+        if not self.done():
+            raise RuntimeError(f"request {self.rid} is still {self._req.state}")
+        return self._engine._result(self._req)
+
+
+# jitted bucket programs, shared across engines with identical static
+# configuration (the _generate_cache idiom): an engine restart — or a test
+# suite full of small engines — reuses steady-state compiled programs
+_program_cache: dict = {}
+
+
+class ServingEngine:
+    """Continuous-batching inference engine over a paged KV pool."""
+
+    def __init__(
+        self,
+        params,
+        cfg,
+        *,
+        model_fn: Callable | None = None,
+        block_size: int = 16,
+        num_blocks: int = 64,
+        max_batch: int = 8,
+        max_queue: int = 64,
+        temperature: float = 0.0,
+        eos_id: int | None = None,
+        quantized: bool = False,
+        cache_dtype=None,
+        prefix_sharing: bool = True,
+        clock: Callable[[], float] | None = None,
+        telemetry=None,
+        batch_buckets: Sequence[int] | None = None,
+        block_buckets: Sequence[int] | None = None,
+        prefill_buckets: Sequence[int] | None = None,
+    ):
+        self.params = params
+        self.cfg = cfg
+        self._forward = model_fn if model_fn is not None else forward_with_cache
+        self.temperature = float(temperature)
+        self.eos_id = eos_id
+        self.quantized = bool(quantized)
+        self.prefix_sharing = bool(prefix_sharing)
+        dtype = cache_dtype if cache_dtype is not None else params["wte"].dtype
+        self.pool = PagedKVPool(cfg, num_blocks=num_blocks, block_size=block_size, dtype=dtype)
+        self.scheduler = Scheduler(
+            self.pool,
+            max_batch=max_batch,
+            max_queue=max_queue,
+            clock=clock,
+            batch_buckets=batch_buckets,
+            block_buckets=block_buckets,
+            prefill_buckets=prefill_buckets,
+            sliding_window=cfg.sliding_window,
+        )
+        if getattr(cfg, "learned_pos_embedding", False):
+            # wpe has block_size rows and dynamic_slice clamps silently past
+            # them: cap the bucket sets so no program's dense capacity can
+            # reach beyond the learned table
+            sch = self.scheduler
+            blk = tuple(
+                b for b in sch.block_buckets
+                if self.pool.capacity_tokens(b) <= cfg.block_size
+            )
+            assert blk, (
+                f"block_size(cfg)={cfg.block_size} admits no pool bucket at "
+                f"pool block_size={block_size} with learned position embeddings"
+            )
+            sch.block_buckets = blk
+            sch.prefill_buckets = tuple(
+                t for t in sch.prefill_buckets if t <= cfg.block_size
+            ) or (cfg.block_size,)
+            # a block-aligned resume point near block_size would push the
+            # padded prefill window past the wpe table (dynamic_slice clamps
+            # the start — real tokens would read shifted embeddings), so
+            # suffix prefill is off the table for learned-pos models
+            self.prefix_sharing = False
+        # telemetry: a StepLogger, a path for one, or None
+        self._owns_telemetry = isinstance(telemetry, (str, bytes)) or hasattr(telemetry, "__fspath__")
+        if self._owns_telemetry:
+            from thunder_tpu.observability.telemetry import StepLogger
+
+            telemetry = StepLogger(telemetry, meta={
+                "kind": "serving", "block_size": block_size, "num_blocks": num_blocks,
+                "max_batch": max_batch, "model": getattr(cfg, "name", "?"),
+            })
+        self.telemetry = telemetry
+        self._handles: dict[int, RequestHandle] = {}
+        self._prefix_index: dict[tuple, tuple[int, tuple[int, ...]]] = {}
+        self._programs: dict[tuple, Callable] = {}
+        self._closed = False
+        # drive-loop accounting (mirrored into the registry as it changes)
+        self.decode_steps = 0
+        self.prefill_runs = 0
+        self.tokens_generated = 0
+        self._occupancy_sum = 0
+        self.compile_counts = {"prefill": 0, "decode": 0}
+
+    #
+    # public API
+    #
+
+    def submit(
+        self,
+        prompt,
+        *,
+        max_new_tokens: int,
+        deadline: float | None = None,
+        key=None,
+        stream_cb: Callable[[int], Any] | None = None,
+    ) -> RequestHandle:
+        """Enqueues one request; returns immediately with a handle.
+
+        ``deadline`` is seconds from now; past it the request finishes with
+        reason ``"deadline"`` wherever it is.  ``key`` seeds the request's
+        private sampling chain (default ``PRNGKey(0)``, like ``generate``).
+        ``stream_cb`` receives each generated token id, in order, as soon as
+        the host sees it.  Raises :class:`AdmissionError` when the wait
+        queue is full or the request can never fit the pool."""
+        if self._closed:
+            raise RuntimeError("engine is shut down")
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        reg = registry()
+        try:
+            req = self.scheduler.submit(
+                prompt, max_new_tokens, key=key, deadline_s=deadline, stream_cb=stream_cb,
+            )
+        except AdmissionError:
+            reg.counter("serving.requests.rejected").inc()
+            raise
+        reg.counter("serving.requests.submitted").inc()
+        reg.gauge("serving.queue_depth").set(len(self.scheduler.queue))
+        handle = RequestHandle(self, req)
+        self._handles[req.rid] = handle
+        return handle
+
+    def step(self) -> bool:
+        """One scheduler iteration: expire deadlines, admit + prefill while
+        capacity allows, then one decode step for the running batch.
+        Returns whether any work happened."""
+        if self._closed:
+            raise RuntimeError("engine is shut down")
+        worked = False
+        for req in self.scheduler.deadline_expired():
+            self._finish(req, FINISH_DEADLINE)
+            worked = True
+        while self._try_admit():
+            worked = True
+        if self.scheduler.running:
+            self._decode_once()
+            worked = True
+        self._update_gauges()
+        return worked
+
+    def run(self, requests: Sequence, *, max_new_tokens: int | None = None) -> list[RequestResult]:
+        """Convenience driver: submits every request (stepping through
+        transient queue-full rejections) and drives to completion.  Each
+        request is a prompt array or a dict of :meth:`submit` kwargs."""
+        handles = []
+        for r in requests:
+            kw = dict(r) if isinstance(r, dict) else {"prompt": r}
+            if "max_new_tokens" not in kw:
+                if max_new_tokens is None:
+                    raise ValueError("max_new_tokens missing (argument or per-request)")
+                kw["max_new_tokens"] = max_new_tokens
+            prompt = kw.pop("prompt")
+            while True:
+                try:
+                    handles.append(self.submit(prompt, **kw))
+                    break
+                except AdmissionError:
+                    if not self.step():
+                        raise
+        self.drain()
+        return [h.result(drive=False) for h in handles]
+
+    def drain(self) -> None:
+        """Steps until every submitted request has finished."""
+        while self.scheduler.queue or self.scheduler.running:
+            if not self.step():
+                raise RuntimeError("engine stalled during drain")
+
+    def evict(self, handle: RequestHandle) -> None:
+        """Administratively removes a queued/running request (finish reason
+        ``"evicted"``); its blocks return to the pool immediately."""
+        if not handle.done():
+            self._finish(handle._req, FINISH_EVICTED)
+
+    def shutdown(self, *, drain: bool = True) -> None:
+        """Graceful stop: optionally drains, evicts whatever remains, closes
+        owned telemetry, and rejects further submits."""
+        if self._closed:
+            return
+        if drain:
+            self.drain()
+        for req in (*self.scheduler.running, *self.scheduler.queue):
+            self._finish(req, FINISH_EVICTED)
+        self._closed = True
+        if self._owns_telemetry and self.telemetry is not None:
+            self.telemetry.close()
+
+    def __enter__(self) -> "ServingEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown(drain=exc == (None, None, None))
+
+    def stats(self) -> dict:
+        """Host-side engine statistics (registry-independent)."""
+        occ = (self._occupancy_sum / self.decode_steps) if self.decode_steps else 0.0
+        return {
+            "queue_depth": len(self.scheduler.queue),
+            "running": len(self.scheduler.running),
+            "pool_free_blocks": self.pool.num_free,
+            "pool_utilization": self.pool.utilization(),
+            "decode_steps": self.decode_steps,
+            "prefill_runs": self.prefill_runs,
+            "tokens_generated": self.tokens_generated,
+            "mean_batch_occupancy": occ,
+            "compile_counts": dict(self.compile_counts),
+            "bucket_bound": (
+                len(self.scheduler.batch_buckets) * len(self.scheduler.block_buckets)
+                + len(self.scheduler.prefill_buckets) * len(self.scheduler.block_buckets)
+            ),
+        }
+
+    #
+    # admission + prefill
+    #
+
+    def _nbb(self, min_blocks: int) -> int:
+        """Table-width bucket for ``min_blocks`` — avoiding a gathered
+        capacity exactly equal to ``sliding_window``, which
+        ``forward_with_cache`` would interpret as the ring layout (the pool
+        always uses the plain slot-=-position layout; the window lives in
+        the keep-mask)."""
+        buckets = self.scheduler.block_buckets
+        # prefill-bucket padding can push the dense width past the largest
+        # block bucket; fall back to the exact width (still bounded: the
+        # overflow is a function of the finite prefill bucket set)
+        b = pick_bucket(min_blocks, buckets) if min_blocks <= buckets[-1] else min_blocks
+        W = self.cfg.sliding_window
+        if W is not None and self.pool.capacity_tokens(b) == W:
+            b += 1
+        return b
+
+    def _try_admit(self) -> bool:
+        sch = self.scheduler
+        if not sch.queue:
+            return False
+        head = sch.queue[0]
+        shared = self._find_shared_prefix(head)
+        req = sch.next_admittable(shared_blocks=len(shared))
+        if req is None:
+            return False
+        n_needed = sch.blocks_needed(req)
+        table = self.pool.share(shared) + self.pool.alloc(n_needed - len(shared))
+        sch.admit(req, table, len(shared))
+        self._prefill(req)
+        return True
+
+    def _find_shared_prefix(self, req: Request) -> list[int]:
+        """Longest block-aligned prompt prefix already resident in a live
+        request's blocks (the last prompt token always re-prefills, so the
+        share is capped one token short of the full prompt)."""
+        if not self.prefix_sharing:
+            return []
+        bs = self.pool.block_size
+        max_share = ((req.prompt_len - 1) // bs) * bs
+        for k in range(max_share, 0, -bs):
+            hit = self._prefix_index.get(tuple(req.prompt[:k].tolist()))
+            if hit is not None:
+                return list(hit[1])
+        return []
+
+    def _register_prefix(self, req: Request) -> None:
+        if not self.prefix_sharing:
+            return
+        bs = self.pool.block_size
+        toks = req.prompt.tolist()
+        for k in range(bs, ((req.prompt_len - 1) // bs) * bs + 1, bs):
+            self._prefix_index.setdefault(tuple(toks[:k]), (req.rid, tuple(req.block_table[: k // bs])))
+
+    def _unregister_prefix(self, req: Request) -> None:
+        if self._prefix_index:
+            stale = [k for k, (rid, _) in self._prefix_index.items() if rid == req.rid]
+            for k in stale:
+                del self._prefix_index[k]
+
+    def _prefill(self, req: Request) -> None:
+        sch, pool = self.scheduler, self.pool
+        bs = pool.block_size
+        pos = req.n_shared_blocks * bs                     # block-aligned resume point
+        remainder = req.prompt[pos:]
+        Tb = sch.prefill_bucket(len(remainder))
+        nbb = self._nbb(max(len(req.block_table), -(-(pos + Tb) // bs)))
+        toks = np.zeros(Tb, dtype=np.int32)
+        toks[: len(remainder)] = remainder
+        table = np.full(nbb, SINK_BLOCK, dtype=np.int32)
+        table[: len(req.block_table)] = req.block_table
+        # scatter back only the freshly written block range; everything else
+        # (shared prefix, future decode blocks, bucket padding) sinks
+        dest = np.full(nbb, SINK_BLOCK, dtype=np.int32)
+        lo, hi = pos // bs, min(len(req.block_table), -(-(pos + Tb) // bs))
+        dest[lo:hi] = req.block_table[lo:hi]
+        prog = self._program("prefill", Tb, nbb)
+        tok, k_arena, v_arena, key = prog(
+            self.params, jnp.asarray(toks)[None], jnp.int32(pos), jnp.int32(len(remainder)),
+            pool.k_arena, pool.v_arena, jnp.asarray(table), jnp.asarray(dest),
+            jnp.asarray(req.key),
+        )
+        pool.update_arenas(k_arena, v_arena)
+        req.key = np.asarray(key)
+        req.pos = req.prompt_len                           # prompt KV resident
+        req.first_token_t = sch.clock()
+        self.prefill_runs += 1
+        self.tokens_generated += 1                         # prefill samples token 0
+        self._register_prefix(req)
+        reg = registry()
+        reg.counter("serving.steps.prefill").inc()
+        reg.counter("serving.tokens").inc()
+        if req.n_shared_blocks:
+            reg.counter("serving.prefix.shared_blocks").inc(req.n_shared_blocks)
+        self._emit_token(req, int(np.asarray(tok)[0]))
+
+    #
+    # decode
+    #
+
+    def _decode_once(self) -> None:
+        sch, pool = self.scheduler, self.pool
+        running = list(sch.running)                        # FIFO admission order
+        Bb, _nbb_raw = sch.decode_bucket()
+        nbb = self._nbb(_nbb_raw)
+        bs = pool.block_size
+        toks = np.zeros(Bb, dtype=np.int32)
+        pos = np.zeros(Bb, dtype=np.int32)
+        tables = np.full((Bb, nbb), SINK_BLOCK, dtype=np.int32)
+        dest_block = np.full(Bb, SINK_BLOCK, dtype=np.int32)
+        dest_slot = np.zeros(Bb, dtype=np.int32)
+        keys = np.zeros((Bb, *np.shape(running[0].key)), dtype=np.asarray(running[0].key).dtype)
+        for i, r in enumerate(running):
+            wpos = r.prompt_len + len(r.generated) - 1     # slot this step writes
+            toks[i] = r.generated[-1]
+            pos[i] = wpos
+            tables[i, : len(r.block_table)] = r.block_table
+            dest_block[i] = r.block_table[wpos // bs]
+            dest_slot[i] = wpos % bs
+            keys[i] = r.key
+        prog = self._program("decode", Bb, nbb)
+        nxt, new_keys, k_arena, v_arena = prog(
+            self.params, jnp.asarray(toks), jnp.asarray(pos), jnp.asarray(tables),
+            pool.k_arena, pool.v_arena, jnp.asarray(dest_block), jnp.asarray(dest_slot),
+            jnp.asarray(keys),
+        )
+        pool.update_arenas(k_arena, v_arena)
+        nxt = np.asarray(nxt)
+        new_keys = np.asarray(new_keys)
+        self.decode_steps += 1
+        self._occupancy_sum += len(running)
+        self.tokens_generated += len(running)
+        reg = registry()
+        reg.counter("serving.steps.decode").inc()
+        reg.counter("serving.tokens").inc(len(running))
+        reg.histogram("serving.batch_occupancy").observe(len(running))
+        for i, r in enumerate(running):
+            r.key = new_keys[i]
+            r.pos = int(pos[i]) + 1
+            sch.expire_window_blocks(r)
+            self._emit_token(r, int(nxt[i]))
+
+    #
+    # finishing / results
+    #
+
+    def _emit_token(self, req: Request, tok: int) -> None:
+        req.generated.append(tok)
+        if req.stream_cb is not None:
+            req.stream_cb(tok)
+        if self.eos_id is not None and tok == self.eos_id:
+            self._finish(req, FINISH_EOS)
+        elif len(req.generated) >= req.max_new_tokens:
+            self._finish(req, FINISH_LENGTH)
+
+    def _finish(self, req: Request, reason: str) -> None:
+        self._unregister_prefix(req)                       # before blocks free
+        self.scheduler.finish(req, reason)
+        reg = registry()
+        reg.counter("serving.requests.completed").inc()
+        reg.counter(f"serving.finish.{reason}").inc()
+        res = self._result(req)
+        if res.ttft_s is not None:
+            reg.histogram("serving.ttft_s").observe(res.ttft_s)
+        if res.tpot_s is not None:
+            reg.histogram("serving.tpot_s").observe(res.tpot_s)
+        if res.tokens_per_sec is not None:
+            reg.histogram("serving.tokens_per_sec").observe(res.tokens_per_sec)
+        if self.telemetry is not None:
+            self.telemetry.log_request(
+                rid=req.rid,
+                prompt_tokens=req.prompt_len,
+                new_tokens=len(req.generated),
+                finish_reason=reason,
+                ttft_s=res.ttft_s,
+                tpot_s=res.tpot_s,
+                tokens_per_sec=res.tokens_per_sec,
+                queue_s=res.queue_s,
+                shared_prefix_blocks=req.n_shared_blocks,
+            )
+
+    def _result(self, req: Request) -> RequestResult:
+        n = len(req.generated)
+        ttft = (req.first_token_t - req.submit_t) if req.first_token_t is not None else None
+        tpot = None
+        tps = None
+        if req.first_token_t is not None and req.finish_t is not None and n > 1:
+            span = max(req.finish_t - req.first_token_t, 0.0)
+            tpot = span / (n - 1)
+        if req.finish_t is not None and n and (req.finish_t - req.submit_t) > 0:
+            tps = n / (req.finish_t - req.submit_t)
+        return RequestResult(
+            rid=req.rid,
+            prompt=req.prompt,
+            new_tokens=tuple(req.generated),
+            finish_reason=req.finish_reason or "?",
+            ttft_s=ttft,
+            tpot_s=tpot,
+            tokens_per_sec=tps,
+            queue_s=(req.admit_t - req.submit_t) if req.admit_t is not None else None,
+            shared_prefix_blocks=req.n_shared_blocks,
+        )
+
+    def _update_gauges(self) -> None:
+        reg = registry()
+        reg.gauge("serving.queue_depth").set(len(self.scheduler.queue))
+        reg.gauge("serving.running").set(len(self.scheduler.running))
+        reg.gauge("serving.pool.utilization").set(self.pool.utilization())
+        reg.gauge("serving.pool.free_blocks").set(self.pool.num_free)
+
+    #
+    # compiled bucket programs
+    #
+
+    def _static_key(self) -> tuple | None:
+        """Global program-cache key for everything baked into a bucket
+        program besides its bucket dims — or None (per-engine programs only)
+        when a custom ``model_fn`` makes the closure unkeyable."""
+        if self._forward is not forward_with_cache:
+            return None
+        import dataclasses
+
+        return (
+            tuple(sorted(dataclasses.asdict(self.cfg).items())),
+            self.pool.block_size, str(self.pool.dtype),
+            self.temperature, self.quantized,
+        )
+
+    def _program(self, kind: str, a: int, b: int) -> Callable:
+        key = (kind, a, b)
+        prog = self._programs.get(key)
+        if prog is not None:
+            return prog
+        static = self._static_key()
+        gkey = (static, kind, a, b) if static is not None else None
+        prog = _program_cache.get(gkey) if gkey is not None else None
+        if prog is None:
+            prog = self._build_prefill(a, b) if kind == "prefill" else self._build_decode(a, b)
+            # a genuinely new program for this geometry: count the compile
+            self.compile_counts[kind] += 1
+            registry().counter(f"serving.compiles.{kind}").inc()
+            if gkey is not None:
+                if len(_program_cache) >= 32:  # LRU-ish bound, same as _generate_cache
+                    _program_cache.pop(next(iter(_program_cache)))
+                _program_cache[gkey] = prog
+        self._programs[key] = prog
+        return prog
+
+    def _build_prefill(self, Tb: int, nbb: int) -> Callable:
+        cfg, fwd, temp, quant = self.cfg, self._forward, self.temperature, self.quantized
+        cap = self.pool.capacity_tokens(nbb)
+        cos_all, sin_all = build_rope_cache(cfg, cap)
+
+        @partial(jax.jit, donate_argnums=(4, 5))
+        def prefill(params, toks, pos, n_real, k_arena, v_arena, table, dest, key):
+            kd, vd = gather_dense(k_arena, v_arena, table[None, :])
+            logits, cache = fwd(
+                params, toks, pos, {"k": kd, "v": vd}, cos_all, sin_all, cfg, quantized=quant
+            )
+            last = jax.lax.dynamic_index_in_dim(logits, n_real - 1, axis=1, keepdims=False)
+            key, sub = jax.random.split(key)
+            tok = sample_token(last, temp, sub)            # (1,) — solo-prefill parity
+            k_arena = scatter_blocks(k_arena, cache["k"], dest)
+            v_arena = scatter_blocks(v_arena, cache["v"], dest)
+            return tok, k_arena, v_arena, key
+
+        return prefill
+
+    def _build_decode(self, Bb: int, nbb: int) -> Callable:
+        cfg, fwd, temp, quant = self.cfg, self._forward, self.temperature, self.quantized
+        cap = self.pool.capacity_tokens(nbb)
+        cos_all, sin_all = build_rope_cache(cfg, cap)
+
+        @partial(jax.jit, donate_argnums=(4, 5))
+        def decode(params, toks, pos, tables, k_arena, v_arena, dest_block, dest_slot, keys):
+            kd, vd = gather_dense(k_arena, v_arena, tables)
+            logits, cache = fwd(
+                params, toks[:, None], pos, {"k": kd, "v": vd}, cos_all, sin_all, cfg,
+                quantized=quant,
+            )
+            sp = jax.vmap(jax.random.split)(keys)          # per-request key chains
+            new_keys, subs = sp[:, 0], sp[:, 1]
+            # (1, V) per row under vmap == the unbatched B=1 generate() draw
+            nxt = jax.vmap(lambda l, k: sample_token(l[None], temp, k)[0])(
+                logits[:, 0], subs
+            )
+            kc = cache["k"].transpose(1, 0, 2, 3, 4)       # (B, L, ng, cap, hs)
+            vc = cache["v"].transpose(1, 0, 2, 3, 4)
+            pick = jax.vmap(
+                lambda c, p: jax.lax.dynamic_index_in_dim(c, p, axis=2, keepdims=False)
+            )
+            k_arena = scatter_token(k_arena, pick(kc, pos), dest_block, dest_slot)
+            v_arena = scatter_token(v_arena, pick(vc, pos), dest_block, dest_slot)
+            return nxt, new_keys, k_arena, v_arena
+
+        return decode
+
+
+def serve(model_fn, params, cfg, **kwargs) -> ServingEngine:
+    """Builds a :class:`ServingEngine` over ``model_fn`` (``None`` → the
+    in-tree ``models.generate.forward_with_cache``).  See
+    :class:`ServingEngine` for the knobs; nothing about constructing an
+    engine touches any other compiled program (strictly additive)."""
+    return ServingEngine(params, cfg, model_fn=model_fn, **kwargs)

@@ -1,0 +1,192 @@
+"""Paged KV-cache pool: a preallocated block arena + free-list allocator.
+
+vLLM's PagedAttention insight, recast for the XLA static-shape world: instead
+of one contiguous per-request cache, all requests share one arena of
+fixed-size **blocks** — ``(num_blocks, L, n_query_groups, block_size, hs)``
+for K and V each (per-block geometry from
+:func:`models.generate.kv_block_shape`, so a gather over a request's block
+table reassembles exactly the dense :func:`models.generate.cache_shape`
+layout that ``forward_with_cache`` already consumes).  Fragmentation is
+bounded to one partial block per request, admission control becomes a free-
+block count, and finished/expired requests return their blocks in O(blocks).
+
+Design points:
+
+- **Physical block 0 is a reserved garbage sink.**  Every compiled serving
+  program is static-shape: padding rows in a bucketed batch and
+  not-yet-reached table slots still need *some* valid physical index to
+  read from / write to.  They all point at block 0, whose contents are never
+  attended (the positional keep-mask excludes them), so no dynamic shapes
+  and no masked scatters are ever needed.
+- **Reference counting** enables prefix sharing: two requests with the same
+  block-aligned prompt prefix map their leading table entries to the same
+  physical blocks (``share``), and a block returns to the free list only
+  when its last owner releases it.
+- The pool owns only the *allocator* state (host-side, O(num_blocks) ints)
+  and the two arena arrays.  All array movement (gather/scatter) is pure
+  jnp code in :mod:`thunder_tpu.serving.engine`'s jitted bucket programs,
+  which donate the arenas so updates stay in place.
+- Sliding-window models keep the plain positional layout (slot = position);
+  the window shows up as the keep-mask band plus **early block release**:
+  once every position in a block has slid out of the window, the scheduler
+  frees it and the table entry falls back to the sink block.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from thunder_tpu.models.generate import kv_block_shape
+
+__all__ = ["PoolExhaustedError", "PagedKVPool"]
+
+SINK_BLOCK = 0  # reserved physical block for padding/expired table entries
+
+
+class PoolExhaustedError(RuntimeError):
+    """Raised by :meth:`PagedKVPool.alloc` when fewer free blocks remain
+    than requested.  Admission control catches this to queue the request."""
+
+
+class PagedKVPool:
+    """Block arena + free-list allocator + per-block reference counts."""
+
+    def __init__(self, cfg, num_blocks: int, block_size: int, dtype=jnp.bfloat16):
+        if num_blocks < 2:
+            raise ValueError(f"num_blocks must be >= 2 (block 0 is the sink), got {num_blocks}")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.cfg = cfg
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.dtype = dtype
+        shape = (self.num_blocks, *kv_block_shape(cfg, self.block_size))
+        # two independent buffers (no copy traffic between K and V updates)
+        self.k_arena = jnp.zeros(shape, dtype=dtype)
+        self.v_arena = jnp.zeros(shape, dtype=dtype)
+        # block 0 is permanently leased to the sink
+        self._refcount = np.zeros(self.num_blocks, dtype=np.int32)
+        self._refcount[SINK_BLOCK] = 1
+        self._free: list[int] = list(range(self.num_blocks - 1, SINK_BLOCK, -1))  # pop() -> lowest id
+
+    #
+    # allocator
+    #
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_usable(self) -> int:
+        """Allocatable blocks (arena minus the sink)."""
+        return self.num_blocks - 1
+
+    def utilization(self) -> float:
+        """Fraction of usable blocks currently leased."""
+        return 1.0 - self.num_free / self.num_usable
+
+    def blocks_for_tokens(self, n_tokens: int) -> int:
+        """Blocks needed to hold ``n_tokens`` cache slots."""
+        return -(-max(int(n_tokens), 0) // self.block_size)
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= self.num_free
+
+    def alloc(self, n: int) -> list[int]:
+        """Leases ``n`` blocks (refcount 1 each); raises
+        :class:`PoolExhaustedError` without side effects when short."""
+        if n > self.num_free:
+            raise PoolExhaustedError(
+                f"need {n} blocks, {self.num_free} free of {self.num_usable}"
+            )
+        out = [self._free.pop() for _ in range(n)]
+        for b in out:
+            self._refcount[b] = 1
+        return out
+
+    def share(self, blocks: Sequence[int]) -> list[int]:
+        """Increments the refcount of already-leased ``blocks`` (prefix
+        sharing: the new owner's table points at the same physical blocks).
+        Returns the same ids for convenience."""
+        for b in blocks:
+            if b == SINK_BLOCK:
+                continue
+            if self._refcount[b] <= 0:
+                raise ValueError(f"block {b} is not leased; cannot share")
+            self._refcount[b] += 1
+        return list(blocks)
+
+    def free(self, blocks: Sequence[int]) -> int:
+        """Releases one reference on each block; blocks whose count reaches
+        zero return to the free list.  Returns how many became free."""
+        released = 0
+        for b in blocks:
+            if b == SINK_BLOCK:
+                continue
+            if self._refcount[b] <= 0:
+                raise ValueError(f"double free of block {b}")
+            self._refcount[b] -= 1
+            if self._refcount[b] == 0:
+                self._free.append(b)
+                released += 1
+        return released
+
+    def refcount(self, block: int) -> int:
+        return int(self._refcount[block])
+
+    #
+    # arena geometry helpers (pure; the jitted programs in engine.py close
+    # over these shapes)
+    #
+
+    def capacity_tokens(self, n_blocks: int) -> int:
+        return n_blocks * self.block_size
+
+    def dense_shape(self, B: int, n_blocks: int) -> tuple[int, ...]:
+        L, ng, bs, hs = kv_block_shape(self.cfg, self.block_size)
+        return (L, B, ng, n_blocks * bs, hs)
+
+    def update_arenas(self, k_arena: jax.Array, v_arena: jax.Array) -> None:
+        """Installs the arenas a donated program returned (in-place update)."""
+        self.k_arena = k_arena
+        self.v_arena = v_arena
+
+
+def gather_dense(k_arena, v_arena, tables):
+    """Reassembles dense caches from block tables.
+
+    ``tables``: (B, nb) int32 physical-block ids (sink-padded).  Returns
+    ``k, v`` of shape (L, B, ng, nb*bs, hs) — the :func:`cache_shape` layout
+    ``forward_with_cache`` consumes.  Pure jnp; call inside jit."""
+    def one(arena):
+        g = jnp.take(arena, tables, axis=0)        # (B, nb, L, ng, bs, hs)
+        g = g.transpose(2, 0, 3, 1, 4, 5)          # (L, B, ng, nb, bs, hs)
+        L, B, ng, nb, bs, hs = g.shape
+        return g.reshape(L, B, ng, nb * bs, hs)
+
+    return one(k_arena), one(v_arena)
+
+
+def scatter_token(arena, new_kv, dest_block, dest_slot):
+    """Writes one token's K (or V) per batch row back into the arena.
+
+    ``new_kv``: (B, L, ng, hs); ``dest_block``/``dest_slot``: (B,) int32
+    (sink-routed for padding rows).  Pure jnp; call inside jit on a donated
+    arena."""
+    return arena.at[dest_block, :, :, dest_slot, :].set(new_kv)
+
+
+def scatter_blocks(arena, dense, dest_table):
+    """Writes a request's dense cache back into the arena block-by-block.
+
+    ``dense``: (L, 1, ng, nb*bs, hs) (B=1 prefill layout); ``dest_table``:
+    (nb,) int32 — entries equal to the sink absorb padding/garbage blocks.
+    Duplicate sink entries are benign (last write wins into garbage)."""
+    L, B, ng, cap, hs = dense.shape
+    bs = arena.shape[3]
+    blocks = dense[:, 0].reshape(L, ng, cap // bs, bs, hs).transpose(2, 0, 1, 3, 4)
+    return arena.at[dest_table].set(blocks.astype(arena.dtype))

@@ -1,0 +1,278 @@
+"""Continuous-batching scheduler: FIFO admission, deadlines, buckets.
+
+The scheduler owns the *request-level* state machine — queued → running →
+finished — and every policy decision:
+
+- **Admission control** is reservation-based (the TGI model, not vLLM's
+  preempt-and-recompute): a request is admitted only when a batch slot is
+  free AND the pool can lease every block the request could ever need
+  (``prompt + max_new_tokens``, minus blocks covered by a shared prefix).
+  Requests that don't fit wait in a bounded FIFO queue; a full queue rejects
+  at ``submit`` (:class:`AdmissionError`).  Admitted requests therefore
+  *never* run out of blocks mid-decode.
+- **Strict FIFO**: if the queue head does not fit, later (smaller) requests
+  do not jump it — saturation cannot starve a large request forever.
+- **Deadlines** are absolute timestamps on the engine's clock (injectable
+  for tests); expiry is checked every step for queued and running requests
+  alike and finishes the request with reason ``"deadline"``.
+- **Bucketed shapes**: batch size and per-request block counts round up to
+  small power-of-two bucket sets, so the number of distinct compiled
+  programs — and thus recompiles absorbed by the PR-1 dispatch cache — is
+  bounded by ``len(batch_buckets) × len(block_buckets)`` regardless of
+  traffic mix.
+- **Sliding-window expiry**: for banded models, blocks whose every position
+  has slid out of the attention window are released back to the pool and
+  the table entry falls back to the sink block (the positional keep-mask
+  already excludes those slots, so correctness is unaffected).
+"""
+from __future__ import annotations
+
+import itertools
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Sequence
+
+import numpy as np
+
+from thunder_tpu.serving.kv_pool import SINK_BLOCK, PagedKVPool
+
+__all__ = [
+    "AdmissionError",
+    "FINISH_LENGTH",
+    "FINISH_EOS",
+    "FINISH_DEADLINE",
+    "FINISH_EVICTED",
+    "Request",
+    "Scheduler",
+    "pick_bucket",
+    "pow2_buckets",
+]
+
+FINISH_LENGTH = "length"
+FINISH_EOS = "eos"
+FINISH_DEADLINE = "deadline"
+FINISH_EVICTED = "evicted"
+
+
+class AdmissionError(RuntimeError):
+    """Submit rejected: the wait queue is at capacity (or the request could
+    never fit the pool at all)."""
+
+
+def pow2_buckets(lo: int, hi: int) -> tuple[int, ...]:
+    """Powers of two covering [lo, hi] (endpoints rounded up)."""
+    out = []
+    b = 1
+    while b < lo:
+        b *= 2
+    while True:
+        out.append(b)
+        if b >= hi:
+            break
+        b *= 2
+    return tuple(out)
+
+
+def pick_bucket(n: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket >= n (buckets sorted ascending)."""
+    for b in buckets:
+        if b >= n:
+            return b
+    raise ValueError(f"{n} exceeds the largest bucket {buckets[-1]}")
+
+
+@dataclass
+class Request:
+    """One in-flight generation request (scheduler-owned mutable state)."""
+
+    rid: int
+    prompt: np.ndarray                      # (T_prompt,) int32
+    max_new_tokens: int
+    key: np.ndarray                         # PRNG key, same chain as solo generate()
+    deadline_t: float | None = None         # absolute, engine clock
+    stream_cb: Callable | None = None
+    submit_t: float = 0.0
+    # cache state
+    block_table: list[int] = field(default_factory=list)
+    n_shared_blocks: int = 0                # leading table entries leased via share()
+    pos: int = 0                            # cache slots written (prompt + generated)
+    # lifecycle
+    state: str = "queued"                   # queued | running | finished
+    generated: list[int] = field(default_factory=list)
+    finish_reason: str | None = None
+    admit_t: float | None = None
+    first_token_t: float | None = None
+    finish_t: float | None = None
+
+    @property
+    def prompt_len(self) -> int:
+        return int(self.prompt.shape[0])
+
+    @property
+    def total_capacity(self) -> int:
+        """Cache slots this request may ever write."""
+        return self.prompt_len + self.max_new_tokens
+
+    def remaining_budget(self) -> int:
+        return self.max_new_tokens - len(self.generated)
+
+
+class Scheduler:
+    """Queue + running set + every admission/finish policy decision."""
+
+    def __init__(
+        self,
+        pool: PagedKVPool,
+        *,
+        max_batch: int = 8,
+        max_queue: int = 64,
+        clock: Callable[[], float] | None = None,
+        batch_buckets: Sequence[int] | None = None,
+        block_buckets: Sequence[int] | None = None,
+        prefill_buckets: Sequence[int] | None = None,
+        sliding_window: int | None = None,
+    ):
+        self.pool = pool
+        self.max_batch = int(max_batch)
+        self.max_queue = int(max_queue)
+        self.clock = clock if clock is not None else time.monotonic
+        self.sliding_window = sliding_window
+        max_blocks = pool.num_usable
+        self.batch_buckets = tuple(batch_buckets) if batch_buckets else pow2_buckets(1, self.max_batch)
+        self.block_buckets = tuple(block_buckets) if block_buckets else pow2_buckets(1, max_blocks)
+        self.prefill_buckets = (
+            tuple(prefill_buckets) if prefill_buckets
+            else pow2_buckets(min(8, pool.block_size), pool.capacity_tokens(max_blocks))
+        )
+        self.queue: deque[Request] = deque()
+        self.running: list[Request] = []     # admission order == FIFO batch order
+        self._ids = itertools.count()
+
+    #
+    # submit / admission
+    #
+
+    def blocks_needed(self, req: Request) -> int:
+        """Full reservation: blocks covering prompt + max_new (window models
+        reclaim early via :meth:`expire_window_blocks`, but admission is
+        conservative so a running request can never be starved of blocks)."""
+        return self.pool.blocks_for_tokens(req.total_capacity)
+
+    def submit(self, prompt, max_new_tokens: int, *, key, deadline_s: float | None = None,
+               stream_cb=None) -> Request:
+        prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        now = self.clock()
+        req = Request(
+            rid=next(self._ids),
+            prompt=prompt,
+            max_new_tokens=int(max_new_tokens),
+            key=np.asarray(key),
+            deadline_t=(now + deadline_s) if deadline_s is not None else None,
+            stream_cb=stream_cb,
+            submit_t=now,
+        )
+        hard_cap = min(self.pool.num_usable, self.block_buckets[-1])
+        if self.blocks_needed(req) > hard_cap:
+            raise AdmissionError(
+                f"request needs {self.blocks_needed(req)} blocks; the pool/bucket "
+                f"cap is {hard_cap} — it can never be admitted"
+            )
+        if req.prompt_len > self.prefill_buckets[-1]:
+            raise AdmissionError(
+                f"prompt of {req.prompt_len} tokens exceeds the largest prefill "
+                f"bucket {self.prefill_buckets[-1]} — it can never be admitted"
+            )
+        if len(self.queue) >= self.max_queue:
+            raise AdmissionError(
+                f"wait queue full ({self.max_queue}); request rejected"
+            )
+        self.queue.append(req)
+        return req
+
+    def next_admittable(self, *, shared_blocks: int = 0) -> Request | None:
+        """FIFO head if a batch slot and enough blocks are free, else None
+        (strict FIFO: a blocked head blocks everything behind it).
+        ``shared_blocks`` discounts blocks the engine found shareable."""
+        if not self.queue or len(self.running) >= self.max_batch:
+            return None
+        head = self.queue[0]
+        if self.pool.can_alloc(max(self.blocks_needed(head) - shared_blocks, 0)):
+            return head
+        return None
+
+    def admit(self, req: Request, block_table: list[int], n_shared: int) -> None:
+        """Moves the queue head to running with its leased table."""
+        assert self.queue and self.queue[0] is req, "admission must be FIFO"
+        self.queue.popleft()
+        req.block_table = block_table
+        req.n_shared_blocks = n_shared
+        req.state = "running"
+        req.admit_t = self.clock()
+        self.running.append(req)
+
+    #
+    # finishing
+    #
+
+    def finish(self, req: Request, reason: str) -> None:
+        """Marks finished and returns every leased block to the pool."""
+        if req.state == "finished":
+            return
+        if req.state == "running":
+            self.running.remove(req)
+        elif req.state == "queued":
+            self.queue.remove(req)
+        req.state = "finished"
+        req.finish_reason = reason
+        req.finish_t = self.clock()
+        if req.block_table:
+            self.pool.free([b for b in req.block_table if b != SINK_BLOCK])
+            req.block_table = []
+
+    def deadline_expired(self) -> list[Request]:
+        """Queued/running requests past their deadline.  The engine finishes
+        them (it must scrub its prefix index *before* blocks are freed)."""
+        now = self.clock()
+        return [
+            r for r in (*self.running, *self.queue)
+            if r.deadline_t is not None and now >= r.deadline_t
+        ]
+
+    def expire_window_blocks(self, req: Request) -> int:
+        """Releases blocks that slid fully out of the attention window:
+        block i (positions [i*bs, (i+1)*bs)) is dead once
+        ``(i+1)*bs <= pos+1 - window`` — the next query attends only
+        ``(pos-window, pos]``.  Dead table entries fall back to the sink.
+        Never releases shared-prefix blocks still co-owned (free() only
+        drops this request's reference).  Returns blocks released."""
+        W = self.sliding_window
+        if W is None:
+            return 0
+        bs = self.pool.block_size
+        horizon = req.pos + 1 - W  # strictly-below-this positions are dead
+        n_dead = min(max(horizon // bs, 0), len(req.block_table))
+        released = 0
+        for i in range(n_dead):
+            if req.block_table[i] != SINK_BLOCK:
+                self.pool.free([req.block_table[i]])
+                req.block_table[i] = SINK_BLOCK
+                released += 1
+        return released
+
+    #
+    # bucket selection
+    #
+
+    def decode_bucket(self) -> tuple[int, int]:
+        """(batch bucket, table-width bucket) for the current running set."""
+        B = pick_bucket(len(self.running), self.batch_buckets)
+        widest = max(len(r.block_table) for r in self.running)
+        return B, pick_bucket(widest, self.block_buckets)
+
+    def prefill_bucket(self, n_tokens: int) -> int:
+        return pick_bucket(n_tokens, self.prefill_buckets)

@@ -28,6 +28,7 @@ __all__ = [
     "load_artifact",
     "check_donation_off_overhead",
     "check_micro_baseline_schema",
+    "check_serving_targets",
 ]
 
 # generous: CI hosts jitter, and the gate exists to catch the donate=False
@@ -60,6 +61,41 @@ def check_donation_off_overhead(results: dict, max_ratio: float = DONATION_OFF_M
         f"the donate=False path (byte-identical program, same code path)"
     )
     return ratio
+
+
+def check_serving_targets(artifact: dict | None = None, *, min_ratio: float = 1.0) -> dict:
+    """Validates the BENCH_SERVING.json artifact: schema (the keys the
+    serving dashboard and the TPU queue parse), sanity (mean batch occupancy
+    must exceed one request — otherwise "continuous batching" degenerated to
+    sequential decode with extra steps), and the headline claim (continuous
+    batching at least matches sequential generate() in tokens/sec; the
+    committed artifact shows the win).  Also enforces the bucket bound: the
+    compiled-program count may not exceed what the bucket sets allow.
+    Returns the artifact for chaining."""
+    if artifact is None:
+        artifact = load_artifact("BENCH_SERVING.json")
+    assert "backend" in artifact and "results" in artifact, sorted(artifact)
+    r = artifact["results"]
+    for key in (
+        "serving_tokens_per_sec", "sequential_tokens_per_sec", "throughput_ratio",
+        "mean_batch_occupancy", "prefill_compiles", "decode_compiles", "bucket_bound",
+    ):
+        assert key in r, (key, sorted(r))
+    assert r["serving_tokens_per_sec"] > 0 and r["sequential_tokens_per_sec"] > 0, r
+    assert r["mean_batch_occupancy"] > 1.0, (
+        f"mean batch occupancy {r['mean_batch_occupancy']} <= 1: requests never "
+        f"actually shared a decode step"
+    )
+    assert r["throughput_ratio"] >= min_ratio, (
+        f"continuous batching lost to sequential generate(): "
+        f"{r['throughput_ratio']:.2f}x < {min_ratio}x"
+    )
+    compiles = r["prefill_compiles"] + r["decode_compiles"]
+    assert compiles <= r["bucket_bound"], (
+        f"{compiles} compiled programs exceed the bucket bound {r['bucket_bound']} — "
+        f"bucketing is not containing recompiles"
+    )
+    return artifact
 
 
 def check_micro_baseline_schema(artifact: dict | None = None) -> dict:

@@ -374,6 +374,71 @@ class TestEngine:
         np.testing.assert_array_equal(rb.tokens, solo)
         assert eng.pool.num_free == eng.pool.num_usable  # refcounts drained
 
+    def test_window_expiry_scrubs_prefix_index(self, micro):
+        """Regression: sliding-window expiry frees a running request's
+        leading blocks; a later same-prefix request must not share the
+        stale snapshot (pre-fix: pool.share raised 'not leased', or leased
+        a re-allocated foreign block).  It re-prefills and matches solo."""
+        cfg, params = micro
+        wcfg = llama.Config.from_name("tiny-llama-debug", **{**MICRO, "sliding_window": 6})
+        eng = _engine(wcfg, params, block_size=2, num_blocks=16, max_batch=2)
+        p = (np.arange(4) * 3 + 1).astype(np.int32) % cfg.vocab_size
+        ha = eng.submit(p, max_new_tokens=8)
+        eng.step()                                       # prefill A registers prefixes
+        assert eng._prefix_index
+        free0 = eng.pool.num_free
+        while eng.pool.num_free <= free0:                # decode until a block expires
+            eng.step()
+        assert not ha.done()
+        assert eng._prefix_index == {}                   # expiry scrubbed A's entries
+        hb = eng.submit(p.copy(), max_new_tokens=4)
+        eng.step()                                       # would crash on a stale share
+        assert hb._req.n_shared_blocks == 0
+        eng.drain()
+        np.testing.assert_array_equal(
+            ha.result(drive=False).tokens, _solo(params, p, wcfg, 8)
+        )
+        np.testing.assert_array_equal(
+            hb.result(drive=False).tokens, _solo(params, p, wcfg, 4)
+        )
+        assert eng.pool.num_free == eng.pool.num_usable
+
+    def test_nbb_widths_stay_in_bucket_set(self, micro):
+        """Every table width _nbb can produce — including the prefill
+        overflow past the largest block bucket and the sliding-window
+        capacity dodge — is in the precomputed set that bucket_bound
+        counts."""
+        cfg, params = micro
+        eng = _engine(cfg, params, block_buckets=(1, 2), prefill_buckets=(8,))
+        assert eng._table_widths == (1, 2, 4)            # overflow extends the set
+        for k in range(1, max(eng._table_widths) + 1):
+            assert eng._nbb(k) in eng._table_widths
+        stats = eng.stats()
+        assert stats["bucket_bound"] == (
+            (len(eng.scheduler.batch_buckets) + len(eng.scheduler.prefill_buckets))
+            * len(eng._table_widths)
+        )
+        # window dodge: a width whose gathered capacity equals the window
+        # (which forward_with_cache would read as the ring layout) is shifted
+        wcfg = llama.Config.from_name("tiny-llama-debug", **{**MICRO, "sliding_window": 8})
+        weng = _engine(wcfg, params, block_buckets=(1, 2, 4))
+        assert 2 not in weng._table_widths               # capacity 2*4 == window
+        assert weng._nbb(2) == 3
+        for w in weng._table_widths:
+            assert weng.pool.capacity_tokens(w) != 8
+
+    def test_run_backpressure_not_counted_as_rejection(self, micro):
+        """run() riding out a full wait queue is backpressure, not a
+        rejection — serving.requests.rejected must stay zero."""
+        cfg, params = micro
+        eng = _engine(cfg, params, num_blocks=8, max_batch=1, max_queue=1)
+        p = np.arange(3, dtype=np.int32)
+        results = eng.run([{"prompt": p, "max_new_tokens": 4} for _ in range(3)])
+        assert all(r.finish_reason == "length" for r in results)
+        snap = tt.metrics_snapshot()
+        assert snap.get("serving.requests.rejected", 0) == 0
+        assert snap["serving.requests.submitted"] == 3
+
     @pytest.mark.slow
     def test_sliding_window_frees_blocks_and_matches_ring_generate(self, micro):
         cfg, params = micro

@@ -191,6 +191,7 @@ class ServingEngine:
             # the start — real tokens would read shifted embeddings), so
             # suffix prefill is off the table for learned-pos models
             self.prefix_sharing = False
+        self._table_widths = self._table_width_buckets()
         # telemetry: a StepLogger, a path for one, or None
         self._owns_telemetry = isinstance(telemetry, (str, bytes)) or hasattr(telemetry, "__fspath__")
         if self._owns_telemetry:
@@ -281,13 +282,16 @@ class ServingEngine:
                     raise ValueError("max_new_tokens missing (argument or per-request)")
                 kw["max_new_tokens"] = max_new_tokens
             prompt = kw.pop("prompt")
-            while True:
-                try:
-                    handles.append(self.submit(prompt, **kw))
-                    break
-                except AdmissionError:
-                    if not self.step():
-                        raise
+            # transient queue-full backpressure is not a rejection: make room
+            # by stepping instead of bouncing off submit() (which counts every
+            # AdmissionError it raises in serving.requests.rejected)
+            while len(self.scheduler.queue) >= self.scheduler.max_queue:
+                if not self.step():
+                    raise AdmissionError(
+                        f"wait queue full ({self.scheduler.max_queue}) and the "
+                        "engine cannot make progress"
+                    )
+            handles.append(self.submit(prompt, **kw))
         self.drain()
         return [h.result(drive=False) for h in handles]
 
@@ -336,8 +340,8 @@ class ServingEngine:
             "mean_batch_occupancy": occ,
             "compile_counts": dict(self.compile_counts),
             "bucket_bound": (
-                len(self.scheduler.batch_buckets) * len(self.scheduler.block_buckets)
-                + len(self.scheduler.prefill_buckets) * len(self.scheduler.block_buckets)
+                (len(self.scheduler.batch_buckets) + len(self.scheduler.prefill_buckets))
+                * len(self._table_widths)
             ),
         }
 
@@ -345,21 +349,42 @@ class ServingEngine:
     # admission + prefill
     #
 
-    def _nbb(self, min_blocks: int) -> int:
-        """Table-width bucket for ``min_blocks`` — avoiding a gathered
-        capacity exactly equal to ``sliding_window``, which
-        ``forward_with_cache`` would interpret as the ring layout (the pool
-        always uses the plain slot-=-position layout; the window lives in
-        the keep-mask)."""
-        buckets = self.scheduler.block_buckets
-        # prefill-bucket padding can push the dense width past the largest
-        # block bucket; fall back to the exact width (still bounded: the
-        # overflow is a function of the finite prefill bucket set)
-        b = pick_bucket(min_blocks, buckets) if min_blocks <= buckets[-1] else min_blocks
+    def _table_width_buckets(self) -> tuple[int, ...]:
+        """Every table width a compiled program may use: the scheduler's
+        block buckets, shifted off any width whose gathered capacity equals
+        ``sliding_window`` (which ``forward_with_cache`` would interpret as
+        the ring layout — the pool always uses the plain slot-=-position
+        layout; the window lives in the keep-mask), then extended so a
+        shared-prefix resume point plus prefill-bucket padding past the
+        largest block bucket still rounds up into the set.  ``stats()``'s
+        ``bucket_bound`` counts these widths, so :meth:`_nbb` may never
+        produce one outside them."""
+        sch, bs = self.scheduler, self.pool.block_size
         W = self.cfg.sliding_window
-        if W is not None and self.pool.capacity_tokens(b) == W:
-            b += 1
-        return b
+
+        def dodge(b: int) -> int:
+            return b + 1 if W is not None and self.pool.capacity_tokens(b) == W else b
+
+        widths = {dodge(b) for b in sch.block_buckets}
+        # widest dense window a prefill can touch: the largest block-aligned
+        # resume point plus a padded prefill bucket (prompts are capped by
+        # both the prefill buckets and the admission hard cap on blocks)
+        max_prompt = min(
+            sch.prefill_buckets[-1],
+            self.pool.capacity_tokens(min(self.pool.num_usable, sch.block_buckets[-1])),
+        )
+        max_resume = ((max_prompt - 1) // bs) * bs if self.prefix_sharing else 0
+        need = -(-(max_resume + pick_bucket(max_prompt, sch.prefill_buckets)) // bs)
+        b = max(widths)
+        while b < need:
+            b *= 2
+            widths.add(dodge(b))
+        return tuple(sorted(widths))
+
+    def _nbb(self, min_blocks: int) -> int:
+        """Table-width bucket for ``min_blocks``, from the precomputed
+        width set (see :meth:`_table_width_buckets`)."""
+        return pick_bucket(min_blocks, self._table_widths)
 
     def _try_admit(self) -> bool:
         sch = self.scheduler
@@ -385,10 +410,26 @@ class ServingEngine:
         bs = self.pool.block_size
         max_share = ((req.prompt_len - 1) // bs) * bs
         for k in range(max_share, 0, -bs):
-            hit = self._prefix_index.get(tuple(req.prompt[:k].tolist()))
-            if hit is not None:
+            key = tuple(req.prompt[:k].tolist())
+            hit = self._prefix_index.get(key)
+            if hit is None:
+                continue
+            if self._prefix_alive(hit):
                 return list(hit[1])
+            # stale snapshot (the owner's blocks were freed or sunk, e.g. by
+            # sliding-window expiry): sharing it would lease dead block ids
+            del self._prefix_index[key]
         return []
+
+    def _prefix_alive(self, hit: tuple[int, tuple[int, ...]]) -> bool:
+        """A registered prefix is shareable only while its owner is still
+        running AND every snapshot block id is still the live table entry
+        (window expiry sinks leading entries without finishing the owner)."""
+        rid, blocks = hit
+        owner = next((r for r in self.scheduler.running if r.rid == rid), None)
+        if owner is None or len(owner.block_table) < len(blocks):
+            return False
+        return all(t == b != SINK_BLOCK for t, b in zip(owner.block_table, blocks))
 
     def _register_prefix(self, req: Request) -> None:
         if not self.prefix_sharing:
@@ -396,7 +437,10 @@ class ServingEngine:
         bs = self.pool.block_size
         toks = req.prompt.tolist()
         for k in range(bs, ((req.prompt_len - 1) // bs) * bs + 1, bs):
-            self._prefix_index.setdefault(tuple(toks[:k]), (req.rid, tuple(req.block_table[: k // bs])))
+            key = tuple(toks[:k])
+            cur = self._prefix_index.get(key)
+            if cur is None or not self._prefix_alive(cur):
+                self._prefix_index[key] = (req.rid, tuple(req.block_table[: k // bs]))
 
     def _unregister_prefix(self, req: Request) -> None:
         if self._prefix_index:
@@ -429,7 +473,8 @@ class ServingEngine:
         pool.update_arenas(k_arena, v_arena)
         req.key = np.asarray(key)
         req.pos = req.prompt_len                           # prompt KV resident
-        req.first_token_t = sch.clock()
+        tok0 = int(np.asarray(tok)[0])                     # blocks until the device delivers
+        req.first_token_t = sch.clock()                    # TTFT = token availability, not dispatch
         self.prefill_runs += 1
         self.tokens_generated += 1                         # prefill samples token 0
         self._register_prefix(req)
@@ -438,7 +483,7 @@ class ServingEngine:
         reg.counter("serving.tokens").inc()
         if req.n_shared_blocks:
             reg.counter("serving.prefix.shared_blocks").inc(req.n_shared_blocks)
-        self._emit_token(req, int(np.asarray(tok)[0]))
+        self._emit_token(req, tok0)
 
     #
     # decode
@@ -483,7 +528,10 @@ class ServingEngine:
         for i, r in enumerate(running):
             r.key = new_keys[i]
             r.pos = int(pos[i]) + 1
-            sch.expire_window_blocks(r)
+            if sch.expire_window_blocks(r):
+                # every registered prefix of r starts at its (just-sunk)
+                # leading blocks — scrub before anyone can share them
+                self._unregister_prefix(r)
             self._emit_token(r, int(nxt[i]))
 
     #

@@ -817,6 +817,32 @@ def main():
             "results": out["results"],
         }))
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "tracing":
+        # serving-plane tracing overhead: default engine vs observability
+        # explicitly off (the gated ≈1.0x claim — off must be the identical
+        # code path) vs spans+SLO+flight armed.  Host work only, no TPU
+        # probe; artifact uses the BENCH_MICRO schema.
+        from thunder_tpu._platform import force_cpu
+
+        force_cpu()
+        from thunder_tpu.benchmarks.tracing_overhead import tracing_overhead_bench
+
+        out = tracing_overhead_bench(on_tpu=False)
+        artifact = {"backend": jax.default_backend(), **out}
+        with open("BENCH_TRACING.json", "w") as f:
+            json.dump(artifact, f, indent=1)
+        for k, v in out["results"].items():
+            log(f"tracing {k}: {v}")
+        print(json.dumps({
+            "metric": "serving_tracing_off_overhead_x",
+            "value": out["results"]["off_overhead_x"],
+            "unit": "x",
+            # off-vs-default is definitionally 1.0: tracing off takes the
+            # unmodified drive loop (token-identical, program-identical)
+            "vs_baseline": 1.0,
+            "results": out["results"],
+        }))
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "cost":
         # analytic companion to the measured headline (no TPU needed): XLA's
         # own cost model on the compiled loss+grad at headline geometry, and

@@ -375,6 +375,14 @@ class TestServingTargets:
         with pytest.raises(AssertionError):
             check_serving_targets(bad)
 
+    def test_serving_gate_rejects_cold_compiles_in_measured_run(self):
+        from tools.bench_targets import check_serving_targets, load_artifact
+
+        bad = json.loads(json.dumps(load_artifact("BENCH_SERVING.json")))
+        bad["results"]["cold_compile_prefills_measured"] = 2
+        with pytest.raises(AssertionError, match="cold starts"):
+            check_serving_targets(bad)
+
     @pytest.mark.slow
     def test_serving_bench_live_smoke(self):
         """The bench harness itself at smoke shapes: occupancy must exceed
@@ -390,3 +398,50 @@ class TestServingTargets:
         check_serving_targets(art, min_ratio=0.0)
         assert out["results"]["smoke"] is True
         assert out["results"]["mean_batch_occupancy"] > 1.0
+
+
+class TestTracingTargets:
+    def test_tracing_gate_on_committed_artifact(self):
+        """BENCH_TRACING.json must keep showing that the serving-plane
+        observability costs nothing when off (off_overhead_x within the
+        gate) while the armed run actually recorded spans/SLO/flight data.
+        A regression recorded into the artifact fails here."""
+        from tools.bench_targets import check_tracing_targets
+
+        art = check_tracing_targets()
+        assert art["backend"] in ("cpu", "tpu")
+        assert art["results"]["off_overhead_x"] <= 1.05
+
+    def test_tracing_gate_rejects_regressions(self):
+        from tools.bench_targets import check_tracing_targets, load_artifact
+
+        good = load_artifact("BENCH_TRACING.json")
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["off_overhead_x"] = 1.2
+        with pytest.raises(AssertionError, match="cost nothing when off"):
+            check_tracing_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["async_spans"] = 0
+        with pytest.raises(AssertionError, match="not actually on"):
+            check_tracing_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        del bad["results"]["flight_events"]
+        with pytest.raises(AssertionError):
+            check_tracing_targets(bad)
+
+    @pytest.mark.slow
+    def test_tracing_bench_live_smoke(self):
+        """The bench harness itself at reduced reps: schema + sanity only
+        (the off-overhead ratio is not gated live — short drives on a
+        jittery CI host; the committed artifact carries that gate)."""
+        from thunder_tpu.benchmarks.tracing_overhead import tracing_overhead_bench
+        from tools.bench_targets import check_tracing_targets
+
+        out = tracing_overhead_bench(on_tpu=False, reps=2, n_requests=3, max_new=4)
+        art = {"backend": jax.default_backend(), **out}
+        check_tracing_targets(art, max_off_ratio=100.0)
+        assert out["results"]["async_spans"] > 0
+        assert out["results"]["slo_dimensions"] == 4

@@ -242,6 +242,8 @@ class TestEngine:
             assert r.ttft_s is not None and r.ttft_s >= 0
             assert r.tpot_s is not None and r.tpot_s >= 0
             assert r.queue_s is not None
+            # submit→finish wall time dominates every partial latency
+            assert r.e2e_s is not None and r.e2e_s >= r.ttft_s >= r.queue_s
         assert snap["serving.requests.completed"] >= 4
         assert snap["serving.ttft_s"]["count"] >= 4
         assert "p95" in snap["serving.ttft_s"]
@@ -259,6 +261,8 @@ class TestEngine:
             assert rec["finish_reason"] == "length"
             assert rec["new_tokens"] == 5
             assert "ttft_s" in rec and "tokens_per_sec" in rec
+            assert rec["e2e_s"] >= rec["ttft_s"]
+            assert isinstance(rec["prefill_compiled"], bool)
 
     def test_pool_drains_clean(self, served):
         *_, eng, _snap = served
@@ -480,7 +484,9 @@ class TestEngine:
 
 def test_serving_is_strictly_additive(micro):
     """Off-path guarantee (same pattern as PR 2/4): building and running an
-    engine leaves other compiled programs byte-identical."""
+    engine leaves other compiled programs byte-identical — including an
+    engine with the full serving-observability stack (tracing + SLO +
+    flight recorder) armed."""
     cfg, params = micro
 
     def fn(x):
@@ -495,6 +501,11 @@ def test_serving_is_strictly_additive(micro):
     after = tt.jit(fn)
     after(x)
     assert tt.last_traces(after)[-1].python() == ref
+    instrumented = _engine(cfg, params, trace=True, slo=True, flight_recorder=True)
+    instrumented.run([{"prompt": np.arange(3, dtype=np.int32), "max_new_tokens": 2}])
+    again = tt.jit(fn)
+    again(x)
+    assert tt.last_traces(again)[-1].python() == ref
 
 
 @pytest.mark.slow

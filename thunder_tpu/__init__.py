@@ -94,6 +94,7 @@ __all__ = [
     "metrics_snapshot",
     "serve",
     "export_chrome_trace",
+    "flight_record",
     "observability",
     "reset_observability",
     "AnomalyError",
@@ -901,10 +902,26 @@ def metrics_snapshot() -> dict:
 
 
 def export_chrome_trace(path: str) -> str:
-    """Writes the buffered compile-pipeline events (interpret / transforms /
-    lower / codegen / compile, see ``thunder_tpu.observability.events``) as
-    Chrome-trace JSON loadable in chrome://tracing or ui.perfetto.dev."""
+    """Writes the buffered events — compile pipeline (interpret / transforms
+    / lower / codegen / compile) AND any per-request serving lifecycle spans
+    recorded by a ``tt.serve(..., trace=True)`` engine — as one merged
+    Chrome-trace JSON loadable in chrome://tracing or ui.perfetto.dev, with
+    the serving plane on its own labeled process/request tracks (see
+    ``thunder_tpu.observability.events``/``tracing``)."""
     return observability.export_chrome_trace(path)
+
+
+def flight_record(path) -> str:
+    """Dumps the most recently active serving flight recorder (an engine
+    built with ``flight_recorder=True`` or ``THUNDER_TPU_FLIGHT_RECORDER=1``)
+    to ``path``: the bounded ring of recent engine events plus a
+    scheduler/pool state snapshot (occupancy, free-list/sharing accounting,
+    prefix-share hit rate, per-bucket compile causes).  The same payload is
+    auto-dumped when ``engine.step()`` raises.  See
+    ``thunder_tpu.observability.flight``."""
+    from thunder_tpu.observability.flight import flight_record as _fr
+
+    return _fr(path)
 
 
 def last_compile_options(cfn) -> dict:
@@ -921,9 +938,13 @@ def serve(model_fn, params, cfg, **kwargs):
     max_new_tokens, deadline, stream_cb) -> RequestHandle``, a synchronous
     ``step()`` drive loop, and ``run()``/``drain()``/``shutdown()``.
     ``model_fn=None`` serves the in-tree ``models.generate`` forward; pass a
-    callable with the same signature to serve a custom model.  Strictly
-    additive: nothing else in the pipeline changes by building an engine
-    (the import is deferred to keep the off-path cost at zero).  See
+    callable with the same signature to serve a custom model.
+    Serving-plane observability (each off by default): ``trace=True`` for
+    per-request lifecycle spans in ``tt.export_chrome_trace``, ``slo={...}``
+    for burn-rate monitoring via ``engine.slo_report()``, and
+    ``flight_recorder=True`` for crash dumps (``tt.flight_record``).
+    Strictly additive: nothing else in the pipeline changes by building an
+    engine (the import is deferred to keep the off-path cost at zero).  See
     GUIDE.md "Serving" and ``thunder_tpu.serving``."""
     from thunder_tpu.serving import serve as _serve
 

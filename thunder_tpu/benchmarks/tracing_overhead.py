@@ -1,0 +1,109 @@
+"""Serving-plane tracing overhead microbench (`bench.py tracing`).
+
+Two claims, one artifact (BENCH_TRACING.json):
+
+1. **Off-path overhead ≈ 1.0x** — the gated claim.  An engine constructed
+   with tracing/SLO/flight-recorder explicitly off must drive requests at
+   the same speed as a default engine (the observability hooks are one
+   ``is None`` check per touch point; a regression here is a category
+   error — some instrumentation leaked onto the untraced path — not
+   timing jitter, which best-of-reps interleaved measurement suppresses).
+2. **On-path overhead is measured, not guessed** — with spans + SLO +
+   flight ring all armed, the same drive costs `on_overhead_x`; reported
+   for the docs, not gated (host-side appends are workload-relative).
+
+The drive under test is the full engine loop (submit → prefill → decode →
+finish) on the micro llama at serving-test shapes — small enough that host
+work, the thing tracing could tax, dominates; a real model would hide an
+off-path regression under device compute.
+"""
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _drive(make_engine, reqs) -> float:
+    """One timed engine drive over fresh copies of ``reqs``."""
+    eng = make_engine()
+    t0 = time.perf_counter()
+    eng.run([dict(r) for r in reqs])
+    return time.perf_counter() - t0
+
+
+def tracing_overhead_bench(on_tpu: bool = False, *, reps: int = 12,
+                           n_requests: int = 6, max_new: int = 12) -> dict:
+    """Returns ``{"shapes": ..., "results": ...}`` in the BENCH_MICRO
+    artifact shape."""
+    import thunder_tpu as tt
+    from thunder_tpu.models import llama
+    from thunder_tpu.observability import clear_events
+
+    cfg = llama.Config.from_name(
+        "tiny-llama-debug",
+        n_layer=1, n_head=2, n_embd=16, intermediate_size=32,
+        vocab_size=32, block_size=64,
+    )
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    reqs = [
+        {"prompt": rng.integers(0, cfg.vocab_size, (3 + (i % 3) * 4,)).astype(np.int32),
+         "max_new_tokens": max_new}
+        for i in range(n_requests)
+    ]
+    base_kw = dict(block_size=4, num_blocks=64, max_batch=4, cache_dtype=jnp.float32)
+    slo_cfg = {"ttft_s": 1.0, "tpot_s": 0.5, "queue_s": 1.0}
+
+    def plain():
+        return tt.serve(None, params, cfg, **base_kw)
+
+    def off():
+        # every serving-plane observability knob EXPLICITLY off: must take
+        # the identical code path as the default engine
+        return tt.serve(None, params, cfg, trace=False, slo=None,
+                        flight_recorder=False, **base_kw)
+
+    def on():
+        return tt.serve(None, params, cfg, trace=True, slo=slo_cfg,
+                        flight_recorder=True, **base_kw)
+
+    # warm every bucket program once so all timed drives are compile-free
+    _drive(plain, reqs)
+
+    # interleave the variants so clock drift / cache state hits them alike;
+    # best-of-reps per variant is the jitter-robust summary
+    t_plain, t_off, t_on = [], [], []
+    for _ in range(reps):
+        t_plain.append(_drive(plain, reqs))
+        t_off.append(_drive(off, reqs))
+        t_on.append(_drive(on, reqs))
+    plain_s, off_s, on_s = min(t_plain), min(t_off), min(t_on)
+
+    # span accounting from one final traced drive over a clean ring
+    clear_events()
+    eng = tt.serve(None, params, cfg, trace=True, slo=slo_cfg,
+                   flight_recorder=True, **base_kw)
+    eng.run([dict(r) for r in reqs])
+    from thunder_tpu.observability import events
+
+    serving_events = [e for e in events() if e.get("cat", "").startswith("serving")]
+    slo_rep = eng.slo_report()
+
+    return {
+        "shapes": {"cfg": "tiny-llama-debug", "n_requests": n_requests,
+                   "max_new_tokens": max_new, "reps": reps},
+        "results": {
+            "drive_plain_ms": round(plain_s * 1e3, 3),
+            "drive_tracing_off_ms": round(off_s * 1e3, 3),
+            "drive_tracing_on_ms": round(on_s * 1e3, 3),
+            "off_overhead_x": round(off_s / plain_s, 4),
+            "on_overhead_x": round(on_s / plain_s, 4),
+            "serving_events_recorded": len(serving_events),
+            "async_spans": sum(1 for e in serving_events if e["ph"] == "b"),
+            "slo_dimensions": len(slo_rep.get("dimensions", {})),
+            "flight_events": eng._flight.events_recorded,
+        },
+    }

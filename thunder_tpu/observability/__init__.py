@@ -33,8 +33,25 @@ Numerics-and-memory layer on top (ISSUE 3):
 6. **Training-step telemetry** (``telemetry.py``) — ``StepLogger`` JSONL +
    registry mirror, driven by ``train_cli.py --telemetry``.
 
+Serving-plane layer on top (ISSUE 6):
+
+7. **Request-lifecycle tracing** (``tracing.py``) — async Chrome-trace
+   spans per served request (queued / prefill compile-vs-cached / decode
+   steps / finish) merged with the compile-pipeline ring into one
+   ``tt.export_chrome_trace`` Perfetto timeline; ``tt.serve(...,
+   trace=True)`` / ``THUNDER_TPU_TRACE_SERVING=1``.
+
+8. **SLO monitoring** (``slo.py``) — configurable TTFT/TPOT/queue/deadline
+   targets, windowed good/bad counters, ``serving.slo.*`` burn-rate
+   gauges, ``engine.slo_report()``.
+
+9. **Flight recorder** (``flight.py``) — bounded ring of engine events +
+   scheduler/pool state, auto-dumped to JSON when ``step()`` raises;
+   ``tt.flight_record(path)``.
+
 ``core/profile.py`` is now a shim over this package; its old import-frozen
-env gate is fixed here (``config.py`` reads the environment dynamically).
+env gate is fixed here (``config.py`` reads the environment dynamically —
+including the event-ring capacity, re-applied on every append).
 """
 from __future__ import annotations
 
@@ -44,15 +61,26 @@ from thunder_tpu.observability.config import (  # noqa: F401
     annotations_enabled,
     anomaly_env_enabled,
     event_buffer_capacity,
+    flight_recorder_env_enabled,
     profiling_env_enabled,
+    serving_trace_env_enabled,
 )
 from thunder_tpu.observability.events import (  # noqa: F401
     clear_events,
     events,
     export_chrome_trace,
     record_event,
+    register_process_name,
+    register_thread_name,
     span,
 )
+from thunder_tpu.observability.flight import (  # noqa: F401
+    FlightRecorder,
+    active_recorder,
+    flight_record,
+)
+from thunder_tpu.observability.slo import SLOConfig, SLOMonitor  # noqa: F401
+from thunder_tpu.observability.tracing import RequestTracer  # noqa: F401
 from thunder_tpu.observability.metrics import (  # noqa: F401
     HOOK_EVENTS,
     Counter,
@@ -81,6 +109,17 @@ __all__ = [
     "events",
     "clear_events",
     "export_chrome_trace",
+    "register_process_name",
+    "register_thread_name",
+    # serving plane
+    "RequestTracer",
+    "SLOConfig",
+    "SLOMonitor",
+    "FlightRecorder",
+    "flight_record",
+    "active_recorder",
+    "serving_trace_env_enabled",
+    "flight_recorder_env_enabled",
     # metrics + hooks
     "Counter",
     "Gauge",

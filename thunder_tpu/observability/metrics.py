@@ -161,8 +161,14 @@ class MetricsRegistry:
     def names(self) -> list[str]:
         return sorted(self._metrics)
 
-    def snapshot(self) -> dict:
-        return {name: m.snapshot() for name, m in sorted(self._metrics.items())}
+    def snapshot(self, prefix: str | None = None) -> dict:
+        """Every registered metric as one plain dict; ``prefix`` narrows to
+        one namespace (e.g. ``"serving.slo."`` for the SLO dashboard slice)."""
+        return {
+            name: m.snapshot()
+            for name, m in sorted(self._metrics.items())
+            if prefix is None or name.startswith(prefix)
+        }
 
     def reset(self) -> None:
         for m in self._metrics.values():

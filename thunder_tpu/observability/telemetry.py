@@ -108,8 +108,10 @@ class StepLogger:
         """Records one *served request* (the serving engine drives this once
         per completed/expired/evicted request): ``{"event": "request", ...}``
         with the request-level latency numbers (``ttft_s``, ``tpot_s``,
-        ``tokens_per_sec``, ``queue_s``) passed through ``extra``.  ``None``
-        values are omitted, mirroring :meth:`log_step`."""
+        ``tokens_per_sec``, ``queue_s``, ``e2e_s`` — submit→finish wall
+        time) and the ``prefill_compiled`` cold-compile tag passed through
+        ``extra``.  ``None`` values are omitted, mirroring
+        :meth:`log_step`."""
         rec: dict[str, Any] = {
             "event": "request",
             "rid": int(rid),

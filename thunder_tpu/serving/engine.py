@@ -28,6 +28,22 @@ the batch — and greedy tokens match ``generate()`` exactly.
 The drive loop is synchronous and explicit: ``step()`` runs one scheduler
 iteration (expire → admit+prefill → one decode step); ``run()``/``drain()``
 loop it.  No threads — integrate into any host loop.
+
+Serving-plane observability (all off by default; the off path is an
+``is None`` check per touch point):
+
+- ``trace=True`` / ``THUNDER_TPU_TRACE_SERVING=1`` — per-request lifecycle
+  spans (queued / prefill split into compile-or-dispatch + host / every
+  decode step / finish) plus ``engine.step`` spans into the shared event
+  ring; ``tt.export_chrome_trace`` merges them with the compile-pipeline
+  rows into one Perfetto timeline (:mod:`observability.tracing`);
+- ``slo={"ttft_s": ..., "tpot_s": ...}`` — windowed good/bad counters and
+  burn-rate gauges per finished request, surfaced by
+  :meth:`ServingEngine.slo_report` (:mod:`observability.slo`);
+- ``flight_recorder=True`` / ``THUNDER_TPU_FLIGHT_RECORDER=1`` — bounded
+  ring of engine events + scheduler/pool state, auto-dumped to JSON when
+  ``step()`` raises, exportable any time via ``tt.flight_record(path)``
+  (:mod:`observability.flight`).
 """
 from __future__ import annotations
 
@@ -44,7 +60,14 @@ from thunder_tpu.models.generate import (
     forward_with_cache,
     sample_token,
 )
+from thunder_tpu.observability.config import (
+    flight_recorder_env_enabled,
+    serving_trace_env_enabled,
+)
+from thunder_tpu.observability.flight import FlightRecorder
 from thunder_tpu.observability.metrics import registry
+from thunder_tpu.observability.slo import resolve_slo
+from thunder_tpu.observability.tracing import RequestTracer
 from thunder_tpu.serving.kv_pool import (
     SINK_BLOCK,
     PagedKVPool,
@@ -78,7 +101,9 @@ class RequestResult:
     tpot_s: float | None                    # mean per-token after the first
     tokens_per_sec: float | None
     queue_s: float | None                   # submit → admission
+    e2e_s: float | None                     # submit → finish wall time
     shared_prefix_blocks: int
+    prefill_compiled: bool = False          # the prefill run paid an XLA compile
 
     @property
     def tokens(self) -> np.ndarray:
@@ -149,6 +174,9 @@ class ServingEngine:
         batch_buckets: Sequence[int] | None = None,
         block_buckets: Sequence[int] | None = None,
         prefill_buckets: Sequence[int] | None = None,
+        trace: bool | None = None,
+        slo=None,
+        flight_recorder=None,
     ):
         self.params = params
         self.cfg = cfg
@@ -212,6 +240,25 @@ class ServingEngine:
         self.tokens_generated = 0
         self._occupancy_sum = 0
         self.compile_counts = {"prefill": 0, "decode": 0}
+        self._compile_log: list[dict] = []               # per-bucket compile causes
+        self._prefix_lookups = 0
+        self._prefix_hits = 0
+        # serving-plane observability (all off by default; the off path is
+        # one `is None` check per touch point — measured by bench.py tracing)
+        if trace is None:
+            trace = serving_trace_env_enabled()
+        self._tracer = RequestTracer() if trace else None
+        self._slo = resolve_slo(slo)
+        if flight_recorder is None:
+            flight_recorder = flight_recorder_env_enabled()
+        if isinstance(flight_recorder, FlightRecorder):
+            flight_recorder.state_provider = self._flight_state
+            self._flight = flight_recorder
+        else:
+            self._flight = (
+                FlightRecorder(state_provider=self._flight_state)
+                if flight_recorder else None
+            )
 
     #
     # public API
@@ -248,6 +295,16 @@ class ServingEngine:
             raise
         reg.counter("serving.requests.submitted").inc()
         reg.gauge("serving.queue_depth").set(len(self.scheduler.queue))
+        if self._tracer is not None:
+            self._tracer.register_request(req.rid)
+            self._tracer.begin(req.rid, "queued",
+                               prompt_tokens=req.prompt_len,
+                               max_new_tokens=req.max_new_tokens)
+        if self._flight is not None:
+            self._flight.record("submit", rid=req.rid,
+                                prompt_tokens=req.prompt_len,
+                                max_new_tokens=req.max_new_tokens,
+                                queue_depth=len(self.scheduler.queue))
         handle = RequestHandle(self, req)
         self._handles[req.rid] = handle
         return handle
@@ -255,9 +312,30 @@ class ServingEngine:
     def step(self) -> bool:
         """One scheduler iteration: expire deadlines, admit + prefill while
         capacity allows, then one decode step for the running batch.
-        Returns whether any work happened."""
+        Returns whether any work happened.  When a flight recorder is armed,
+        any exception out of the step auto-dumps the flight record before
+        propagating; when tracing is on, the step lands as an
+        ``engine.step`` span."""
         if self._closed:
             raise RuntimeError("engine is shut down")
+        tr = self._tracer
+        if tr is not None:
+            tr.engine_begin("engine.step",
+                            queued=len(self.scheduler.queue),
+                            running=len(self.scheduler.running))
+        try:
+            worked = self._step_inner()
+        except Exception as e:
+            if self._flight is not None:
+                self._flight.crash_dump(e)
+            if tr is not None:
+                tr.engine_end("engine.step", error=type(e).__name__)
+            raise
+        if tr is not None:
+            tr.engine_end("engine.step", worked=worked)
+        return worked
+
+    def _step_inner(self) -> bool:
         worked = False
         for req in self.scheduler.deadline_expired():
             self._finish(req, FINISH_DEADLINE)
@@ -343,6 +421,29 @@ class ServingEngine:
                 (len(self.scheduler.batch_buckets) + len(self.scheduler.prefill_buckets))
                 * len(self._table_widths)
             ),
+            "prefix_lookups": self._prefix_lookups,
+            "prefix_hits": self._prefix_hits,
+        }
+
+    def slo_report(self) -> dict:
+        """Burn rates against the configured SLO targets (``slo=`` at
+        construction; see :mod:`thunder_tpu.observability.slo`).  Without a
+        configured SLO the report is ``{"enabled": False}`` — the engine
+        carries no monitor and no per-request classification cost."""
+        if self._slo is None:
+            return {"enabled": False}
+        return self._slo.report()
+
+    def _flight_state(self) -> dict:
+        """State snapshot the flight recorder embeds in every dump."""
+        lookups = self._prefix_lookups
+        return {
+            "engine": self.stats(),
+            "scheduler": self.scheduler.state_snapshot(),
+            "pool": self.pool.state_snapshot(),
+            "prefix_share_hit_rate": (self._prefix_hits / lookups) if lookups else None,
+            "compiles": list(self._compile_log),         # per-bucket compile causes
+            "slo": self.slo_report(),
         }
 
     #
@@ -398,6 +499,13 @@ class ServingEngine:
         n_needed = sch.blocks_needed(req)
         table = self.pool.share(shared) + self.pool.alloc(n_needed - len(shared))
         sch.admit(req, table, len(shared))
+        if self._tracer is not None:
+            self._tracer.end(req.rid, "queued",
+                             queue_s=req.admit_t - req.submit_t)
+        if self._flight is not None:
+            self._flight.record("admit", rid=req.rid, blocks=n_needed,
+                                shared_blocks=len(shared),
+                                pool_free=self.pool.num_free)
         self._prefill(req)
         return True
 
@@ -407,6 +515,7 @@ class ServingEngine:
         share is capped one token short of the full prompt)."""
         if not self.prefix_sharing:
             return []
+        self._prefix_lookups += 1
         bs = self.pool.block_size
         max_share = ((req.prompt_len - 1) // bs) * bs
         for k in range(max_share, 0, -bs):
@@ -415,6 +524,7 @@ class ServingEngine:
             if hit is None:
                 continue
             if self._prefix_alive(hit):
+                self._prefix_hits += 1
                 return list(hit[1])
             # stale snapshot (the owner's blocks were freed or sunk, e.g. by
             # sliding-window expiry): sharing it would lease dead block ids
@@ -464,25 +574,47 @@ class ServingEngine:
         dest = np.full(nbb, SINK_BLOCK, dtype=np.int32)
         lo, hi = pos // bs, min(len(req.block_table), -(-(pos + Tb) // bs))
         dest[lo:hi] = req.block_table[lo:hi]
-        prog = self._program("prefill", Tb, nbb)
+        prog, compiled = self._program("prefill", Tb, nbb)
+        req.prefill_compiled = compiled
+        tr = self._tracer
+        if tr is not None:
+            tr.begin(req.rid, "prefill", compile=compiled, bucket=[Tb, nbb],
+                     shared_blocks=req.n_shared_blocks)
+            # the dispatch phase is named by its dominant cost: a fresh
+            # program pays the XLA compile here, a cached one only dispatches
+            tr.begin(req.rid, "prefill.compile" if compiled else "prefill.dispatch")
         tok, k_arena, v_arena, key = prog(
             self.params, jnp.asarray(toks)[None], jnp.int32(pos), jnp.int32(len(remainder)),
             pool.k_arena, pool.v_arena, jnp.asarray(table), jnp.asarray(dest),
             jnp.asarray(req.key),
         )
         pool.update_arenas(k_arena, v_arena)
+        if tr is not None:
+            tr.end(req.rid, "prefill.compile" if compiled else "prefill.dispatch")
+            tr.begin(req.rid, "prefill.host")
         req.key = np.asarray(key)
         req.pos = req.prompt_len                           # prompt KV resident
         tok0 = int(np.asarray(tok)[0])                     # blocks until the device delivers
         req.first_token_t = sch.clock()                    # TTFT = token availability, not dispatch
+        if tr is not None:
+            tr.end(req.rid, "prefill.host")
+            tr.end(req.rid, "prefill", compile=compiled)
         self.prefill_runs += 1
         self.tokens_generated += 1                         # prefill samples token 0
         self._register_prefix(req)
         reg = registry()
         reg.counter("serving.steps.prefill").inc()
         reg.counter("serving.tokens").inc()
+        if compiled:
+            # cold-compile TTFT outliers must be distinguishable from queue
+            # delay: count prefill RUNS that paid a compile (vs
+            # serving.compiles.prefill, which counts program builds)
+            reg.counter("serving.prefill.compiles").inc()
         if req.n_shared_blocks:
             reg.counter("serving.prefix.shared_blocks").inc(req.n_shared_blocks)
+        if self._flight is not None:
+            self._flight.record("prefill", rid=req.rid, compiled=compiled,
+                                bucket=[Tb, nbb], shared_blocks=req.n_shared_blocks)
         self._emit_token(req, tok0)
 
     #
@@ -509,7 +641,12 @@ class ServingEngine:
             dest_block[i] = r.block_table[wpos // bs]
             dest_slot[i] = wpos % bs
             keys[i] = r.key
-        prog = self._program("decode", Bb, nbb)
+        prog, compiled = self._program("decode", Bb, nbb)
+        tr = self._tracer
+        if tr is not None:
+            for r in running:
+                tr.begin(r.rid, "decode", step=self.decode_steps,
+                         compile=compiled, bucket=[Bb, nbb])
         nxt, new_keys, k_arena, v_arena = prog(
             self.params, jnp.asarray(toks), jnp.asarray(pos), jnp.asarray(tables),
             pool.k_arena, pool.v_arena, jnp.asarray(dest_block), jnp.asarray(dest_slot),
@@ -518,6 +655,14 @@ class ServingEngine:
         pool.update_arenas(k_arena, v_arena)
         nxt = np.asarray(nxt)
         new_keys = np.asarray(new_keys)
+        if tr is not None:                                 # tokens host-visible
+            for r in running:
+                tr.end(r.rid, "decode")
+        if self._flight is not None:
+            self._flight.record("decode", step=self.decode_steps,
+                                batch=len(running), bucket=[Bb, nbb],
+                                compiled=compiled,
+                                rids=[r.rid for r in running])
         self.decode_steps += 1
         self._occupancy_sum += len(running)
         self.tokens_generated += len(running)
@@ -528,10 +673,14 @@ class ServingEngine:
         for i, r in enumerate(running):
             r.key = new_keys[i]
             r.pos = int(pos[i]) + 1
-            if sch.expire_window_blocks(r):
+            released = sch.expire_window_blocks(r)
+            if released:
                 # every registered prefix of r starts at its (just-sunk)
                 # leading blocks — scrub before anyone can share them
                 self._unregister_prefix(r)
+                if self._flight is not None:
+                    self._flight.record("window_expire", rid=r.rid,
+                                        released=released)
             self._emit_token(r, int(nxt[i]))
 
     #
@@ -548,12 +697,23 @@ class ServingEngine:
             self._finish(req, FINISH_LENGTH)
 
     def _finish(self, req: Request, reason: str) -> None:
+        never_admitted = req.admit_t is None
         self._unregister_prefix(req)                       # before blocks free
         self.scheduler.finish(req, reason)
         reg = registry()
         reg.counter("serving.requests.completed").inc()
         reg.counter(f"serving.finish.{reason}").inc()
         res = self._result(req)
+        if self._tracer is not None:
+            if never_admitted:                             # died in the queue
+                self._tracer.end(req.rid, "queued", finish_reason=reason)
+            self._tracer.instant(req.rid, "finish", reason=reason,
+                                 new_tokens=len(req.generated))
+        if self._flight is not None:
+            self._flight.record("finish", rid=req.rid, reason=reason,
+                                new_tokens=len(req.generated))
+        if self._slo is not None:
+            self._slo.observe(res)
         if res.ttft_s is not None:
             reg.histogram("serving.ttft_s").observe(res.ttft_s)
         if res.tpot_s is not None:
@@ -570,6 +730,8 @@ class ServingEngine:
                 tpot_s=res.tpot_s,
                 tokens_per_sec=res.tokens_per_sec,
                 queue_s=res.queue_s,
+                e2e_s=res.e2e_s,
+                prefill_compiled=req.prefill_compiled,
                 shared_prefix_blocks=req.n_shared_blocks,
             )
 
@@ -592,7 +754,9 @@ class ServingEngine:
             tpot_s=tpot,
             tokens_per_sec=tps,
             queue_s=(req.admit_t - req.submit_t) if req.admit_t is not None else None,
+            e2e_s=(req.finish_t - req.submit_t) if req.finish_t is not None else None,
             shared_prefix_blocks=req.n_shared_blocks,
+            prefill_compiled=req.prefill_compiled,
         )
 
     def _update_gauges(self) -> None:
@@ -620,25 +784,32 @@ class ServingEngine:
             self.temperature, self.quantized,
         )
 
-    def _program(self, kind: str, a: int, b: int) -> Callable:
+    def _program(self, kind: str, a: int, b: int) -> tuple[Callable, bool]:
+        """The bucket program for ``(kind, a, b)`` plus whether THIS lookup
+        built it fresh — i.e. the imminent call pays the XLA compile (a
+        cached program, per-engine or module-wide, was already traced and
+        compiled by its first caller)."""
         key = (kind, a, b)
         prog = self._programs.get(key)
         if prog is not None:
-            return prog
+            return prog, False
         static = self._static_key()
         gkey = (static, kind, a, b) if static is not None else None
         prog = _program_cache.get(gkey) if gkey is not None else None
-        if prog is None:
+        compiled = prog is None
+        if compiled:
             prog = self._build_prefill(a, b) if kind == "prefill" else self._build_decode(a, b)
             # a genuinely new program for this geometry: count the compile
             self.compile_counts[kind] += 1
+            self._compile_log.append({"kind": kind, "bucket": [a, b],
+                                      "cause": f"new {kind} geometry"})
             registry().counter(f"serving.compiles.{kind}").inc()
             if gkey is not None:
                 if len(_program_cache) >= 32:  # LRU-ish bound, same as _generate_cache
                     _program_cache.pop(next(iter(_program_cache)))
                 _program_cache[gkey] = prog
         self._programs[key] = prog
-        return prog
+        return prog, compiled
 
     def _build_prefill(self, Tb: int, nbb: int) -> Callable:
         cfg, fwd, temp, quant = self.cfg, self._forward, self.temperature, self.quantized

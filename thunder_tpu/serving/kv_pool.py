@@ -138,6 +138,21 @@ class PagedKVPool:
     def refcount(self, block: int) -> int:
         return int(self._refcount[block])
 
+    def state_snapshot(self) -> dict:
+        """Allocator state for the flight recorder: occupancy plus the
+        free-list/sharing breakdown (the paged-pool notion of
+        fragmentation is how lease references spread over blocks)."""
+        counts = self._refcount[SINK_BLOCK + 1:]
+        return {
+            "num_blocks": self.num_blocks,
+            "block_size": self.block_size,
+            "num_free": self.num_free,
+            "utilization": self.utilization(),
+            "leased_blocks": int((counts > 0).sum()),
+            "shared_blocks": int((counts > 1).sum()),
+            "lease_refs": int(counts.sum()),
+        }
+
     #
     # arena geometry helpers (pure; the jitted programs in engine.py close
     # over these shapes)

@@ -104,6 +104,7 @@ class Request:
     admit_t: float | None = None
     first_token_t: float | None = None
     finish_t: float | None = None
+    prefill_compiled: bool = False          # this request's prefill paid an XLA compile
 
     @property
     def prompt_len(self) -> int:
@@ -263,6 +264,34 @@ class Scheduler:
                 req.block_table[i] = SINK_BLOCK
                 released += 1
         return released
+
+    def state_snapshot(self) -> dict:
+        """Request-level state for the flight recorder: one compact row per
+        queued/running request plus the bucket configuration."""
+        def row(r: Request) -> dict:
+            return {
+                "rid": r.rid,
+                "state": r.state,
+                "prompt_tokens": r.prompt_len,
+                "generated": len(r.generated),
+                "max_new_tokens": r.max_new_tokens,
+                "pos": r.pos,
+                "blocks": len(r.block_table),
+                "shared_blocks": r.n_shared_blocks,
+                "prefill_compiled": r.prefill_compiled,
+                "deadline_t": r.deadline_t,
+            }
+
+        return {
+            "queue_depth": len(self.queue),
+            "running": len(self.running),
+            "max_batch": self.max_batch,
+            "max_queue": self.max_queue,
+            "batch_buckets": list(self.batch_buckets),
+            "block_buckets": list(self.block_buckets),
+            "prefill_buckets": list(self.prefill_buckets),
+            "requests": [row(r) for r in (*self.running, *self.queue)],
+        }
 
     #
     # bucket selection

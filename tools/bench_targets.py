@@ -29,6 +29,7 @@ __all__ = [
     "check_donation_off_overhead",
     "check_micro_baseline_schema",
     "check_serving_targets",
+    "check_tracing_targets",
 ]
 
 # generous: CI hosts jitter, and the gate exists to catch the donate=False
@@ -94,6 +95,50 @@ def check_serving_targets(artifact: dict | None = None, *, min_ratio: float = 1.
     assert compiles <= r["bucket_bound"], (
         f"{compiles} compiled programs exceed the bucket bound {r['bucket_bound']} — "
         f"bucketing is not containing recompiles"
+    )
+    # cold-compile attribution (present since the tracing PR): the measured
+    # steady-state engine must see zero compile-tagged prefills — its TTFT
+    # percentiles are compile-free by construction, so a nonzero count means
+    # the program cache stopped carrying warmed programs across engines
+    if "cold_compile_prefills_measured" in r:
+        assert r["cold_compile_prefills_measured"] == 0, (
+            f"{r['cold_compile_prefills_measured']} measured-engine prefills "
+            f"paid an XLA compile — the steady-state TTFT numbers are "
+            f"polluted by cold starts"
+        )
+    return artifact
+
+
+def check_tracing_targets(artifact: dict | None = None, *,
+                          max_off_ratio: float = 1.05) -> dict:
+    """Validates the BENCH_TRACING.json artifact: schema, sanity (the traced
+    drive actually recorded request spans, SLO dimensions, and flight
+    events — a silently-disabled feature would "win" the overhead gate),
+    and the gated claim: an engine with tracing/SLO/flight explicitly OFF
+    drives requests at the same speed as a default engine
+    (``off_overhead_x`` ≤ ``max_off_ratio``; a breach means instrumentation
+    leaked onto the untraced path — a category error, not jitter, which the
+    bench's interleaved best-of-reps already suppresses).  Returns the
+    artifact for chaining."""
+    if artifact is None:
+        artifact = load_artifact("BENCH_TRACING.json")
+    assert "backend" in artifact and "results" in artifact, sorted(artifact)
+    r = artifact["results"]
+    for key in (
+        "drive_plain_ms", "drive_tracing_off_ms", "drive_tracing_on_ms",
+        "off_overhead_x", "on_overhead_x", "serving_events_recorded",
+        "async_spans", "slo_dimensions", "flight_events",
+    ):
+        assert key in r, (key, sorted(r))
+    assert r["drive_plain_ms"] > 0 and r["drive_tracing_off_ms"] > 0, r
+    assert r["async_spans"] > 0 and r["serving_events_recorded"] > 0, (
+        "the traced drive recorded no serving spans — tracing is not actually on"
+    )
+    assert r["slo_dimensions"] > 0 and r["flight_events"] > 0, r
+    assert r["off_overhead_x"] <= max_off_ratio, (
+        f"tracing-off drive regressed: {r['off_overhead_x']:.3f}x > "
+        f"{max_off_ratio}x vs the default engine — serving observability "
+        f"must cost nothing when off (is-None checks only)"
     )
     return artifact
 

@@ -817,6 +817,32 @@ def main():
             "results": out["results"],
         }))
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "serving_mesh":
+        # mesh-parallel serving bench: the SPMD engine (TP-sharded params,
+        # heads-over-tp block arena, pjit bucket programs) vs the
+        # single-device engine at equal total batch, token parity asserted
+        # against solo sharded generate().  Runs on the virtual 8-device
+        # CPU mesh; artifact uses the BENCH_MICRO schema.
+        from thunder_tpu._platform import force_cpu
+
+        force_cpu(8)
+        from thunder_tpu.benchmarks.serving_mesh import serving_mesh_bench
+
+        out = serving_mesh_bench(on_tpu=False)
+        artifact = {"backend": jax.default_backend(), **out}
+        with open("BENCH_SERVING_MESH.json", "w") as f:
+            json.dump(artifact, f, indent=1)
+        for k, v in out["results"].items():
+            log(f"serving_mesh {k}: {v}")
+        print(json.dumps({
+            "metric": "serving_mesh_vs_single_device_throughput_x",
+            "value": out["results"]["throughput_ratio"],
+            "unit": "x",
+            # the single-device engine IS the baseline of this ratio
+            "vs_baseline": out["results"]["throughput_ratio"],
+            "results": out["results"],
+        }))
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "tracing":
         # serving-plane tracing overhead: default engine vs observability
         # explicitly off (the gated ≈1.0x claim — off must be the identical

@@ -400,6 +400,77 @@ class TestServingTargets:
         assert out["results"]["mean_batch_occupancy"] > 1.0
 
 
+class TestServingMeshTargets:
+    def test_serving_mesh_gate_on_committed_artifact(self):
+        """BENCH_SERVING_MESH.json must keep showing ROADMAP item 1's gate:
+        the SPMD engine >= the single-device engine in tokens/sec at equal
+        total batch, served tokens parity-checked against solo sharded
+        generate(), compiles inside the per-(mesh, bucket) bound, and the
+        arena bytes actually sharded.  A regression recorded into the
+        artifact fails here."""
+        from tools.bench_targets import check_serving_mesh_targets
+
+        art = check_serving_mesh_targets()
+        assert art["backend"] in ("cpu", "tpu")
+        assert art["results"]["throughput_ratio"] >= 1.0
+        assert art["results"]["mesh_axes"]["tp"] >= 2
+
+    def test_serving_mesh_gate_rejects_regressions(self):
+        from tools.bench_targets import check_serving_mesh_targets, load_artifact
+
+        good = load_artifact("BENCH_SERVING_MESH.json")
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["throughput_ratio"] = 0.8
+        with pytest.raises(AssertionError, match="lost to the single-device"):
+            check_serving_mesh_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["token_parity"] = False
+        with pytest.raises(AssertionError, match="diverged"):
+            check_serving_mesh_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["decode_compiles"] = bad["results"]["bucket_bound"] + 1
+        with pytest.raises(AssertionError, match="bucket bound"):
+            check_serving_mesh_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["arena_shard_bytes"] = bad["results"]["arena_total_bytes"]
+        with pytest.raises(AssertionError, match="not sharded"):
+            check_serving_mesh_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["collectives_decode"] = {"total": 0}
+        with pytest.raises(AssertionError, match="no collectives"):
+            check_serving_mesh_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["mesh_devices"] = 1
+        with pytest.raises(AssertionError, match="one device"):
+            check_serving_mesh_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        del bad["results"]["mesh_tokens_per_sec"]
+        with pytest.raises(AssertionError):
+            check_serving_mesh_targets(bad)
+
+    @pytest.mark.slow
+    def test_serving_mesh_bench_live_smoke(self):
+        """The bench harness itself at smoke shapes: schema + parity +
+        compile bound must hold live (the throughput ratio is not gated at
+        smoke shapes on a jittery CI host; the committed full-shape
+        artifact carries that gate)."""
+        from thunder_tpu.benchmarks.serving_mesh import serving_mesh_bench
+        from tools.bench_targets import check_serving_mesh_targets
+
+        out = serving_mesh_bench(on_tpu=False, smoke=True)
+        art = {"backend": jax.default_backend(), **out}
+        check_serving_mesh_targets(art, min_ratio=0.0)
+        assert out["results"]["smoke"] is True
+        assert out["results"]["token_parity"] is True
+
+
 class TestTracingTargets:
     def test_tracing_gate_on_committed_artifact(self):
         """BENCH_TRACING.json must keep showing that the serving-plane

@@ -325,6 +325,28 @@ class TestEngine:
             h1.result(drive=False).tokens, h2.result(drive=False).tokens
         )
 
+    def test_drain_stall_carries_state_snapshot(self, micro):
+        """A stalled drain raises EngineStalledError with the flight-state
+        snapshot attached (queued/running rids, pool counts) instead of the
+        old bare 'engine stalled during drain' message."""
+        from thunder_tpu.serving import EngineStalledError
+
+        cfg, params = micro
+        eng = _engine(cfg, params, num_blocks=8, max_batch=2)
+        leak = eng.pool.alloc(5)          # blocks held outside the scheduler
+        h = eng.submit(np.arange(4, dtype=np.int32), max_new_tokens=8)
+        with pytest.raises(EngineStalledError) as ei:
+            eng.drain()
+        assert h.state == "queued"        # head needs 3 blocks, 2 free: stuck
+        err = ei.value
+        assert err.state["pool"]["num_free"] == 2
+        assert [r["rid"] for r in err.state["scheduler"]["requests"]] == [h.rid]
+        assert f"queued rids=[{h.rid}]" in str(err)
+        assert "free=2/8" in str(err)
+        eng.pool.free(leak)
+        eng.drain()                       # unstuck: the head admits and runs
+        assert h.done()
+
     def test_fifo_fairness_under_saturation(self, micro):
         cfg, params = micro
         eng = _engine(cfg, params, num_blocks=8, max_batch=1)

@@ -939,6 +939,12 @@ def serve(model_fn, params, cfg, **kwargs):
     ``step()`` drive loop, and ``run()``/``drain()``/``shutdown()``.
     ``model_fn=None`` serves the in-tree ``models.generate`` forward; pass a
     callable with the same signature to serve a custom model.
+    Mesh serving: ``mesh=`` (plus optional ``shardings=`` from
+    ``distributed``'s rule tables) runs the whole engine SPMD — params
+    placed once, the KV block arena sharded heads-over-``tp``
+    (``distributed.kv_cache_spec``), bucket programs compiled once per
+    (mesh, bucket) — with served tokens bit-identical to solo
+    ``generate(..., mesh=mesh)``; see GUIDE.md "Sharded serving".
     Serving-plane observability (each off by default): ``trace=True`` for
     per-request lifecycle spans in ``tt.export_chrome_trace``, ``slo={...}``
     for burn-rate monitoring via ``engine.slo_report()``, and

@@ -42,6 +42,7 @@ from thunder_tpu.distributed.sharding import (
     batch_spec,
     ddp_shardings,
     fsdp_shardings,
+    kv_cache_spec,
     llama_shardings,
     make_mesh,
 )
@@ -59,6 +60,7 @@ __all__ = [
     "batch_spec",
     "ddp_shardings",
     "fsdp_shardings",
+    "kv_cache_spec",
     "llama_shardings",
     "make_mesh",
     "prims",

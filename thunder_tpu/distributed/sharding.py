@@ -30,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 __all__ = [
     "make_mesh",
     "batch_spec",
+    "kv_cache_spec",
     "fsdp_shardings",
     "ddp_shardings",
     "llama_shardings",
@@ -64,6 +65,27 @@ def batch_spec(mesh: Mesh) -> P:
     if not axes:
         return P()
     return P(axes if len(axes) > 1 else axes[0])
+
+
+def kv_cache_spec(cfg, mesh: Mesh | None, *, axis: str = "tp") -> P:
+    """PartitionSpec for a KV cache/arena with the **heads dim at axis 2**:
+    the dense ``(L, B, n_query_groups, T, hs)`` layout of
+    ``models.generate.cache_shape`` AND the paged serving arena
+    ``(num_blocks, L, n_query_groups, block_size, hs)`` — one rule so
+    serving and ``generate()`` can never disagree on how KV bytes shard.
+
+    Heads split over ``axis`` (tensor-parallel: each device holds its
+    query groups' cache, attention stays device-local, only the output
+    projection reduces).  Falls back to full replication (``P()``) when
+    the mesh is absent, the axis is missing/trivial, or ``axis`` does not
+    divide ``n_query_groups`` — same degrade-don't-error policy as
+    :func:`ShardingRules` via ``_prune_spec``.
+    """
+    if mesh is None or axis not in mesh.axis_names or mesh.shape[axis] <= 1:
+        return P()
+    if cfg.n_query_groups % mesh.shape[axis] != 0:
+        return P()
+    return P(None, None, axis)
 
 
 def _divisible(dim_size: int, mesh: Mesh, axes) -> bool:

@@ -179,18 +179,21 @@ def init_cache(cfg: Config, B: int, T_max: int, dtype=jnp.bfloat16, *, mesh=None
     """Preallocated KV cache: ``{"k"/"v": (L, B, n_query_groups, Tc, hs)}``
     where ``Tc = T_max``, bounded by ``cfg.sliding_window`` (ring cache).
 
-    With ``mesh``, the KV-group dim shards over ``axis`` (tensor-parallel
-    serving: each device holds its heads' cache; attention stays device-local
-    and only the output projection reduces)."""
+    With ``mesh``, the KV-group dim shards over ``axis`` per
+    ``distributed.kv_cache_spec`` — the ONE spec rule shared with the
+    serving pool's block arena (tensor-parallel serving: each device holds
+    its heads' cache; attention stays device-local and only the output
+    projection reduces).  An indivisible group count degrades to
+    replication rather than erroring (same policy as the sharding rules)."""
     shape = cache_shape(cfg, B, T_max)
     sh = None
-    if mesh is not None and axis in mesh.axis_names and mesh.shape[axis] > 1:
-        from jax.sharding import NamedSharding, PartitionSpec as P
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+        from thunder_tpu.distributed.sharding import kv_cache_spec
 
-        assert cfg.n_query_groups % mesh.shape[axis] == 0, (
-            f"{axis}={mesh.shape[axis]} must divide n_query_groups {cfg.n_query_groups}"
-        )
-        sh = NamedSharding(mesh, P(None, None, axis, None, None))
+        spec = kv_cache_spec(cfg, mesh, axis=axis)
+        if len(spec):  # non-empty spec: the heads dim actually shards
+            sh = NamedSharding(mesh, spec)
 
     def zeros():  # two independent buffers, no copy traffic
         z = jnp.zeros(shape, dtype=dtype)

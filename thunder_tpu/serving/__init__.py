@@ -10,14 +10,25 @@ stays bounded, and per-request deadlines, streaming, and telemetry.
 Entry point: ``tt.serve(model_fn, params, cfg, ...)`` (or construct
 :class:`ServingEngine` directly).  Everything is strictly additive — no
 other compiled program changes by importing or using this package.
+
+With ``mesh=`` the engine is SPMD end to end (:mod:`serving.mesh`): params
+placed once, the block arena's KV-heads dim sharded over ``tp`` via the
+``distributed.kv_cache_spec`` rule, and every bucket program pjit-compiled
+once per (mesh, bucket) — served tokens bit-identical to solo sharded
+``generate()`` on the same mesh.
 """
 from thunder_tpu.serving.engine import (  # noqa: F401
+    EngineStalledError,
     RequestHandle,
     RequestResult,
     ServingEngine,
     serve,
 )
-from thunder_tpu.serving.kv_pool import PagedKVPool, PoolExhaustedError  # noqa: F401
+from thunder_tpu.serving.kv_pool import (  # noqa: F401
+    ArenaMismatchError,
+    PagedKVPool,
+    PoolExhaustedError,
+)
 from thunder_tpu.serving.scheduler import (  # noqa: F401
     AdmissionError,
     Request,
@@ -33,6 +44,8 @@ __all__ = [
     "RequestResult",
     "PagedKVPool",
     "PoolExhaustedError",
+    "ArenaMismatchError",
+    "EngineStalledError",
     "Scheduler",
     "Request",
     "AdmissionError",

@@ -86,7 +86,41 @@ from thunder_tpu.serving.scheduler import (
     pick_bucket,
 )
 
-__all__ = ["serve", "ServingEngine", "RequestHandle", "RequestResult", "AdmissionError"]
+__all__ = [
+    "serve",
+    "ServingEngine",
+    "RequestHandle",
+    "RequestResult",
+    "AdmissionError",
+    "EngineStalledError",
+]
+
+
+class EngineStalledError(RuntimeError):
+    """``drain()``/``result()`` could not make progress: requests remain
+    queued/running but ``step()`` did no work (e.g. blocks leaked outside
+    the scheduler, or a queue head that can never fit the live pool).
+    Carries the flight-recorder state snapshot — queued/running request
+    rows, pool free/lease counts, compile log — as ``.state`` and inlines
+    the headline numbers in the message so a stall is diagnosable from the
+    traceback alone."""
+
+    def __init__(self, msg: str, state: dict | None = None):
+        self.state = state or {}
+        sched = self.state.get("scheduler", {})
+        pool = self.state.get("pool", {})
+        rows = sched.get("requests", [])
+        rids = {
+            "queued": [r["rid"] for r in rows if r.get("state") == "queued"],
+            "running": [r["rid"] for r in rows if r.get("state") == "running"],
+        }
+        detail = (
+            f" [queued rids={rids['queued']} running rids={rids['running']} "
+            f"pool free={pool.get('num_free')}/{pool.get('num_blocks')} "
+            f"leased={pool.get('leased_blocks')} shared={pool.get('shared_blocks')}]"
+            if self.state else ""
+        )
+        super().__init__(msg + detail)
 
 
 @dataclass(frozen=True)
@@ -137,8 +171,9 @@ class RequestHandle:
         until this request finishes."""
         while drive and not self.done():
             if not self._engine.step() and not self.done():
-                raise RuntimeError(
-                    f"engine stalled with request {self.rid} still {self._req.state}"
+                raise EngineStalledError(
+                    f"engine stalled with request {self.rid} still {self._req.state}",
+                    self._engine._flight_state(),
                 )
         if not self.done():
             raise RuntimeError(f"request {self.rid} is still {self._req.state}")
@@ -149,6 +184,10 @@ class RequestHandle:
 # configuration (the _generate_cache idiom): an engine restart — or a test
 # suite full of small engines — reuses steady-state compiled programs
 _program_cache: dict = {}
+
+# one decode program's collective census per (mesh, static config, bucket):
+# the census pays an extra AOT compile, so it is module-cached like programs
+_collectives_cache: dict = {}
 
 
 class ServingEngine:
@@ -177,7 +216,28 @@ class ServingEngine:
         trace: bool | None = None,
         slo=None,
         flight_recorder=None,
+        mesh=None,
+        shardings=None,
     ):
+        if shardings is not None and mesh is None:
+            raise ValueError("shardings= requires mesh= (param placement needs a mesh)")
+        self.mesh = mesh
+        if mesh is not None:
+            # SPMD serving: place params once (tp_fsdp-style rules unless
+            # the caller brings their own), shard the KV arenas heads-over-
+            # tp, and compile every bucket program with explicit shardings
+            from thunder_tpu.serving.mesh import mesh_fingerprint, place_params
+
+            params = place_params(params, mesh, shardings)
+            # the param placement is baked into every program's
+            # in_shardings, so it is part of the program identity too
+            self._mesh_key = (
+                mesh_fingerprint(mesh),
+                tuple(str(x.sharding.spec) for x in jax.tree_util.tree_leaves(params)),
+            )
+        else:
+            self._mesh_key = None
+        self._mesh_collectives: dict | None = None         # lazy decode census
         self.params = params
         self.cfg = cfg
         self._forward = model_fn if model_fn is not None else forward_with_cache
@@ -186,7 +246,9 @@ class ServingEngine:
         self.quantized = bool(quantized)
         self.prefix_sharing = bool(prefix_sharing)
         dtype = cache_dtype if cache_dtype is not None else params["wte"].dtype
-        self.pool = PagedKVPool(cfg, num_blocks=num_blocks, block_size=block_size, dtype=dtype)
+        self.pool = PagedKVPool(
+            cfg, num_blocks=num_blocks, block_size=block_size, dtype=dtype, mesh=mesh
+        )
         self.scheduler = Scheduler(
             self.pool,
             max_batch=max_batch,
@@ -259,6 +321,14 @@ class ServingEngine:
                 FlightRecorder(state_provider=self._flight_state)
                 if flight_recorder else None
             )
+        if mesh is not None:
+            # serving.mesh.* gauges: static facts land at construction; the
+            # decode collective count follows once a decode program exists
+            reg = registry()
+            reg.gauge("serving.mesh.devices").set(int(mesh.devices.size))
+            for a in mesh.axis_names:
+                reg.gauge(f"serving.mesh.axis.{a}").set(int(mesh.shape[a]))
+            reg.gauge("serving.mesh.arena_shard_bytes").set(self.pool.per_shard_bytes())
 
     #
     # public API
@@ -374,10 +444,14 @@ class ServingEngine:
         return [h.result(drive=False) for h in handles]
 
     def drain(self) -> None:
-        """Steps until every submitted request has finished."""
+        """Steps until every submitted request has finished.  A stall (work
+        remains but no step can progress) raises :class:`EngineStalledError`
+        carrying the flight-recorder state snapshot."""
         while self.scheduler.queue or self.scheduler.running:
             if not self.step():
-                raise RuntimeError("engine stalled during drain")
+                raise EngineStalledError(
+                    "engine stalled during drain", self._flight_state()
+                )
 
     def evict(self, handle: RequestHandle) -> None:
         """Administratively removes a queued/running request (finish reason
@@ -404,10 +478,28 @@ class ServingEngine:
     def __exit__(self, *exc) -> None:
         self.shutdown(drain=exc == (None, None, None))
 
+    def mesh_stats(self) -> dict | None:
+        """Mesh-serving facts (``None`` on a single-device engine): mesh
+        shape, per-shard arena bytes, and — once the first decode step has
+        run its program census — the collective count of one compiled
+        decode program."""
+        if self.mesh is None:
+            return None
+        return {
+            "devices": int(self.mesh.devices.size),
+            "axes": {a: int(self.mesh.shape[a]) for a in self.mesh.axis_names},
+            "arena_spec": str(self.pool.arena_sharding.spec),
+            "arena_shard_bytes": self.pool.per_shard_bytes(),
+            "arena_total_bytes": int(self.pool.k_arena.nbytes) * 2,
+            "collectives_decode": self._mesh_collectives,  # None until censused
+        }
+
     def stats(self) -> dict:
         """Host-side engine statistics (registry-independent)."""
         occ = (self._occupancy_sum / self.decode_steps) if self.decode_steps else 0.0
+        mesh = self.mesh_stats()
         return {
+            **({"mesh": mesh} if mesh is not None else {}),
             "queue_depth": len(self.scheduler.queue),
             "running": len(self.scheduler.running),
             "pool_free_blocks": self.pool.num_free,
@@ -438,7 +530,7 @@ class ServingEngine:
         """State snapshot the flight recorder embeds in every dump."""
         lookups = self._prefix_lookups
         return {
-            "engine": self.stats(),
+            "engine": self.stats(),                      # includes "mesh" when SPMD
             "scheduler": self.scheduler.state_snapshot(),
             "pool": self.pool.state_snapshot(),
             "prefix_share_hit_rate": (self._prefix_hits / lookups) if lookups else None,
@@ -642,6 +734,13 @@ class ServingEngine:
             dest_slot[i] = wpos % bs
             keys[i] = r.key
         prog, compiled = self._program("decode", Bb, nbb)
+        if self.mesh is not None and self._mesh_collectives is None:
+            # census BEFORE the call: the arenas are donated by it
+            self._mesh_collectives = self._collective_census(
+                ("decode", Bb, nbb), prog,
+                (self.params, toks, pos, tables, pool.k_arena, pool.v_arena,
+                 dest_block, dest_slot, keys),
+            )
         tr = self._tracer
         if tr is not None:
             for r in running:
@@ -773,7 +872,10 @@ class ServingEngine:
     def _static_key(self) -> tuple | None:
         """Global program-cache key for everything baked into a bucket
         program besides its bucket dims — or None (per-engine programs only)
-        when a custom ``model_fn`` makes the closure unkeyable."""
+        when a custom ``model_fn`` makes the closure unkeyable.  Mesh
+        engines extend the key with the mesh fingerprint (axis layout +
+        device ids), so programs compile once per (mesh, bucket) and a
+        different device set never reuses a stale placement."""
         if self._forward is not forward_with_cache:
             return None
         import dataclasses
@@ -782,6 +884,7 @@ class ServingEngine:
             tuple(sorted(dataclasses.asdict(self.cfg).items())),
             self.pool.block_size, str(self.pool.dtype),
             self.temperature, self.quantized,
+            self._mesh_key,
         )
 
     def _program(self, kind: str, a: int, b: int) -> tuple[Callable, bool]:
@@ -811,12 +914,41 @@ class ServingEngine:
         self._programs[key] = prog
         return prog, compiled
 
+    def _jit_kwargs(self, kind: str) -> dict:
+        """Extra ``jax.jit`` kwargs for a bucket program: empty single-
+        device; explicit in/out shardings under a mesh (params as placed,
+        arenas per the pool's NamedSharding, host arrays replicated) so the
+        compiled program is pjit-partitioned with per-shard arena donation."""
+        if self.mesh is None:
+            return {}
+        from thunder_tpu.serving.mesh import program_shardings
+
+        return program_shardings(kind, self.params, self.mesh, self.pool.arena_sharding)
+
+    def _collective_census(self, bucket_key: tuple, prog, example_args) -> dict:
+        """Collective count of one compiled decode program (mesh mode):
+        how many cross-device ops one token step costs.  The census is an
+        extra AOT compile, so it is cached module-wide next to the program
+        cache — one census per (mesh, static config, bucket) per process —
+        and mirrored into the ``serving.mesh.collectives.decode`` gauge."""
+        static = self._static_key()
+        gkey = ("collectives", static, *bucket_key) if static is not None else None
+        got = _collectives_cache.get(gkey) if gkey is not None else None
+        if got is None:
+            from thunder_tpu.serving.mesh import collective_counts
+
+            got = collective_counts(prog, *example_args)
+            if gkey is not None:
+                _collectives_cache[gkey] = got
+        registry().gauge("serving.mesh.collectives.decode").set(got.get("total", 0))
+        return got
+
     def _build_prefill(self, Tb: int, nbb: int) -> Callable:
         cfg, fwd, temp, quant = self.cfg, self._forward, self.temperature, self.quantized
         cap = self.pool.capacity_tokens(nbb)
         cos_all, sin_all = build_rope_cache(cfg, cap)
 
-        @partial(jax.jit, donate_argnums=(4, 5))
+        @partial(jax.jit, donate_argnums=(4, 5), **self._jit_kwargs("prefill"))
         def prefill(params, toks, pos, n_real, k_arena, v_arena, table, dest, key):
             kd, vd = gather_dense(k_arena, v_arena, table[None, :])
             logits, cache = fwd(
@@ -836,7 +968,7 @@ class ServingEngine:
         cap = self.pool.capacity_tokens(nbb)
         cos_all, sin_all = build_rope_cache(cfg, cap)
 
-        @partial(jax.jit, donate_argnums=(4, 5))
+        @partial(jax.jit, donate_argnums=(4, 5), **self._jit_kwargs("decode"))
         def decode(params, toks, pos, tables, k_arena, v_arena, dest_block, dest_slot, keys):
             kd, vd = gather_dense(k_arena, v_arena, tables)
             logits, cache = fwd(
@@ -865,5 +997,13 @@ def serve(model_fn, params, cfg, **kwargs) -> ServingEngine:
     """Builds a :class:`ServingEngine` over ``model_fn`` (``None`` → the
     in-tree ``models.generate.forward_with_cache``).  See
     :class:`ServingEngine` for the knobs; nothing about constructing an
-    engine touches any other compiled program (strictly additive)."""
+    engine touches any other compiled program (strictly additive).
+
+    Mesh serving: ``serve(None, params, cfg, mesh=mesh)`` makes the whole
+    engine SPMD — params are placed once (``shardings=`` overrides the
+    default llama TP×FSDP rules), the paged K/V arenas shard their heads
+    dim over ``tp`` (:func:`thunder_tpu.distributed.kv_cache_spec`), and
+    every bucket program compiles once per (mesh, bucket) with explicit
+    shardings and per-shard arena donation.  Served tokens stay
+    bit-identical to solo ``generate(..., mesh=mesh)`` on the same mesh."""
     return ServingEngine(params, cfg, model_fn=model_fn, **kwargs)

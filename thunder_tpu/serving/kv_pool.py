@@ -41,7 +41,7 @@ import numpy as np
 
 from thunder_tpu.models.generate import kv_block_shape
 
-__all__ = ["PoolExhaustedError", "PagedKVPool"]
+__all__ = ["PoolExhaustedError", "ArenaMismatchError", "PagedKVPool"]
 
 SINK_BLOCK = 0  # reserved physical block for padding/expired table entries
 
@@ -51,10 +51,38 @@ class PoolExhaustedError(RuntimeError):
     than requested.  Admission control catches this to queue the request."""
 
 
-class PagedKVPool:
-    """Block arena + free-list allocator + per-block reference counts."""
+class ArenaMismatchError(ValueError):
+    """A program handed :meth:`PagedKVPool.update_arenas` an arena that
+    does not match the pool's geometry (shape/dtype) or placement
+    (sharding).  Caught at the swap, not steps later as garbage KV.
 
-    def __init__(self, cfg, num_blocks: int, block_size: int, dtype=jnp.bfloat16):
+    Attributes: ``arena`` ("k" | "v"), ``field`` ("shape" | "dtype" |
+    "sharding"), ``expected``, ``got``."""
+
+    def __init__(self, arena: str, field: str, expected, got):
+        self.arena = arena
+        self.field = field
+        self.expected = expected
+        self.got = got
+        super().__init__(
+            f"refusing to install {arena}-arena with mismatched {field}: "
+            f"program returned {got!r}, pool expects {expected!r} — the "
+            f"producing bucket program is writing a different arena "
+            f"geometry/placement than this pool owns"
+        )
+
+
+class PagedKVPool:
+    """Block arena + free-list allocator + per-block reference counts.
+
+    With ``mesh``, the arenas carry a ``NamedSharding`` splitting the
+    KV-heads dim over ``axis`` (the shared ``distributed.kv_cache_spec``
+    rule) — the *bytes* live sharded across the mesh while every allocator
+    decision (free list, refcounts, prefix sharing) stays host-side and
+    identical to the single-device pool."""
+
+    def __init__(self, cfg, num_blocks: int, block_size: int, dtype=jnp.bfloat16,
+                 *, mesh=None, axis: str = "tp"):
         if num_blocks < 2:
             raise ValueError(f"num_blocks must be >= 2 (block 0 is the sink), got {num_blocks}")
         if block_size < 1:
@@ -63,10 +91,25 @@ class PagedKVPool:
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
         self.dtype = dtype
+        self.mesh = mesh
         shape = (self.num_blocks, *kv_block_shape(cfg, self.block_size))
-        # two independent buffers (no copy traffic between K and V updates)
-        self.k_arena = jnp.zeros(shape, dtype=dtype)
-        self.v_arena = jnp.zeros(shape, dtype=dtype)
+        self._arena_shape = shape
+        if mesh is not None:
+            from thunder_tpu.serving.mesh import arena_sharding
+
+            self.arena_sharding = arena_sharding(cfg, mesh, axis=axis)
+            # shard-local allocation: no device ever materializes the full
+            # arena (the whole point — a model/cache too big for one chip)
+            zeros = jax.jit(
+                lambda: jnp.zeros(shape, dtype=dtype), out_shardings=self.arena_sharding
+            )
+            self.k_arena = zeros()
+            self.v_arena = zeros()
+        else:
+            self.arena_sharding = None
+            # two independent buffers (no copy traffic between K and V updates)
+            self.k_arena = jnp.zeros(shape, dtype=dtype)
+            self.v_arena = jnp.zeros(shape, dtype=dtype)
         # block 0 is permanently leased to the sink
         self._refcount = np.zeros(self.num_blocks, dtype=np.int32)
         self._refcount[SINK_BLOCK] = 1
@@ -143,7 +186,7 @@ class PagedKVPool:
         free-list/sharing breakdown (the paged-pool notion of
         fragmentation is how lease references spread over blocks)."""
         counts = self._refcount[SINK_BLOCK + 1:]
-        return {
+        snap = {
             "num_blocks": self.num_blocks,
             "block_size": self.block_size,
             "num_free": self.num_free,
@@ -152,6 +195,10 @@ class PagedKVPool:
             "shared_blocks": int((counts > 1).sum()),
             "lease_refs": int(counts.sum()),
         }
+        if self.arena_sharding is not None:
+            snap["arena_spec"] = str(self.arena_sharding.spec)
+            snap["arena_shard_bytes"] = self.per_shard_bytes()
+        return snap
 
     #
     # arena geometry helpers (pure; the jitted programs in engine.py close
@@ -165,8 +212,36 @@ class PagedKVPool:
         L, ng, bs, hs = kv_block_shape(self.cfg, self.block_size)
         return (L, B, ng, n_blocks * bs, hs)
 
+    def per_shard_bytes(self) -> int:
+        """Bytes of ONE K arena on one device (what a chip's HBM must
+        hold; ×2 for K+V).  Equals ``k_arena.nbytes`` unsharded."""
+        from thunder_tpu.serving.mesh import per_shard_bytes
+
+        return per_shard_bytes(self.k_arena)
+
+    def _check_arena(self, name: str, new: jax.Array) -> None:
+        if tuple(new.shape) != self._arena_shape:
+            raise ArenaMismatchError(name, "shape", self._arena_shape, tuple(new.shape))
+        if new.dtype != jnp.dtype(self.dtype):
+            raise ArenaMismatchError(name, "dtype", jnp.dtype(self.dtype), new.dtype)
+        if self.arena_sharding is not None:
+            got = getattr(new, "sharding", None)
+            ok = got is not None and (
+                got == self.arena_sharding
+                or self.arena_sharding.is_equivalent_to(got, new.ndim)
+            )
+            if not ok:
+                raise ArenaMismatchError(name, "sharding", self.arena_sharding, got)
+
     def update_arenas(self, k_arena: jax.Array, v_arena: jax.Array) -> None:
-        """Installs the arenas a donated program returned (in-place update)."""
+        """Installs the arenas a donated program returned (in-place update).
+
+        Validates geometry, dtype, and (mesh mode) sharding first: a buggy
+        program's mismatched arena would otherwise surface steps later as
+        garbage KV — :class:`ArenaMismatchError` names the offending arena
+        at the swap instead."""
+        self._check_arena("k", k_arena)
+        self._check_arena("v", v_arena)
         self.k_arena = k_arena
         self.v_arena = v_arena
 

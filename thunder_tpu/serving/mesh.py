@@ -1,0 +1,156 @@
+"""Mesh-parallel serving: the SPMD layer under the continuous-batching engine.
+
+The engine's math (gather → ``forward_with_cache`` → scatter, see
+:mod:`thunder_tpu.serving.engine`) is already pure jnp inside ``jax.jit``;
+this module supplies everything needed to run those bucket programs SPMD
+over a :class:`jax.sharding.Mesh`:
+
+- the **arena sharding**: the paged K/V arenas
+  ``(num_blocks, L, n_query_groups, block_size, hs)`` carry a
+  ``NamedSharding`` splitting the KV-heads dim over ``tp`` — the same
+  :func:`thunder_tpu.distributed.kv_cache_spec` rule the dense
+  ``generate()`` cache uses (heads dim at axis 2 in both layouts), so each
+  device holds only its heads' blocks while the host-side allocator
+  (free list, refcounts, prefix index) is untouched;
+- **explicit program shardings**: per-bucket prefill/decode programs get
+  ``in_shardings``/``out_shardings`` (params as placed, arenas per the
+  arena sharding, every host-built table/token array replicated), with
+  ``donate_argnums`` preserved so arena updates stay in place *per shard*;
+- a **mesh fingerprint** extending the module-level program-cache key, so
+  programs compile once per (mesh, bucket) and engines on the same mesh
+  share them while a different device set never aliases a stale program;
+- **observability**: per-shard arena bytes and the collective count of one
+  compiled decode program (from its optimized-HLO text), surfaced through
+  ``engine.stats()["mesh"]``, the flight-recorder snapshot, and
+  ``serving.mesh.*`` registry gauges.
+
+Attention under this sharding is Megatron-style: per-head score/value work
+is device-local (q heads and KV groups co-shard), the output projection
+all-reduces, and the vocab-sharded head resolves sampling with one small
+collective — exactly the placement ``distributed.tp_fsdp`` gives the
+params, which is the default when ``tt.serve(..., mesh=...)`` is called
+without explicit ``shardings``.
+"""
+from __future__ import annotations
+
+import re
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from thunder_tpu.distributed.sharding import apply_shardings, kv_cache_spec, llama_shardings
+
+__all__ = [
+    "mesh_fingerprint",
+    "arena_sharding",
+    "place_params",
+    "program_shardings",
+    "collective_counts",
+    "per_shard_bytes",
+]
+
+
+def mesh_fingerprint(mesh: Mesh | None) -> tuple | None:
+    """Hashable identity of a mesh for program-cache keys: axis names,
+    axis sizes, and the concrete device ids in mesh order.  Two mesh
+    objects over the same devices in the same layout fingerprint equal
+    (their compiled programs are interchangeable); the same shape over a
+    different device set does not."""
+    if mesh is None:
+        return None
+    return (
+        tuple(mesh.axis_names),
+        tuple(int(mesh.shape[a]) for a in mesh.axis_names),
+        tuple(int(d.id) for d in mesh.devices.flat),
+    )
+
+
+def arena_sharding(cfg, mesh: Mesh, *, axis: str = "tp") -> NamedSharding:
+    """NamedSharding of the paged K/V arenas: heads-over-``axis`` via the
+    shared :func:`kv_cache_spec` rule (the arena keeps the heads dim at
+    axis 2 just like the dense cache, so one spec serves both layouts);
+    replicated when the rule degrades."""
+    return NamedSharding(mesh, kv_cache_spec(cfg, mesh, axis=axis))
+
+
+def place_params(params, mesh: Mesh, shardings=None):
+    """Places ``params`` on the mesh once, at engine construction.
+
+    ``shardings`` is a pytree of ``NamedSharding``s (from
+    ``distributed.llama_shardings`` / ``fsdp_shardings`` / custom rules);
+    ``None`` defaults to the llama TP×FSDP rules — the placement
+    ``distributed.tp_fsdp`` uses, which is what the differential parity
+    guarantee is tested against.  Already-placed params are a no-op
+    (``apply_shardings`` never aliases, so donation stays safe)."""
+    if shardings is None:
+        shardings = llama_shardings(params, mesh)
+    return apply_shardings(params, shardings)
+
+
+def program_shardings(kind: str, params, mesh: Mesh, arena_sh: NamedSharding) -> dict:
+    """``in_shardings``/``out_shardings`` for a bucket program.
+
+    Everything the host builds per step (token/pos/table/dest arrays, PRNG
+    keys) is replicated — O(batch) ints, negligible next to the arenas;
+    params keep their placement; arenas carry ``arena_sh`` in AND out so
+    the donated update is shard-local (no resharding between steps).
+
+    Argument orders match ``ServingEngine._build_prefill`` /
+    ``_build_decode`` exactly:
+
+    - prefill: ``(params, toks, pos, n_real, k, v, table, dest, key)``
+      → ``(tok, k, v, key)``
+    - decode:  ``(params, toks, pos, tables, k, v, dest_block, dest_slot,
+      keys)`` → ``(nxt, new_keys, k, v)``
+    """
+    repl = NamedSharding(mesh, P())
+    param_sh = jax.tree_util.tree_map(lambda x: x.sharding, params)
+    if kind == "prefill":
+        return dict(
+            in_shardings=(param_sh, repl, repl, repl, arena_sh, arena_sh, repl, repl, repl),
+            out_shardings=(repl, arena_sh, arena_sh, repl),
+        )
+    assert kind == "decode", kind
+    return dict(
+        in_shardings=(param_sh, repl, repl, repl, arena_sh, arena_sh, repl, repl, repl),
+        out_shardings=(repl, repl, arena_sh, arena_sh),
+    )
+
+
+# HLO collective ops XLA's SPMD partitioner inserts (both sync and -start
+# async forms); counted from one compiled program's optimized HLO
+_COLLECTIVE_OPS = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "all-to-all",
+    "collective-permute",
+)
+
+
+def collective_counts(prog, *example_args) -> dict[str, int]:
+    """Collective-op census of one jitted bucket program, from the
+    optimized HLO of an AOT lowering at ``example_args``
+    (ShapeDtypeStructs suffice — the program's own ``in_shardings`` drive
+    the partitioner).  One extra XLA compile; callers cache the result per
+    (mesh, static-config)."""
+    structs = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), example_args
+    )
+    txt = prog.lower(*structs).compile().as_text()
+    counts = {}
+    for op in _COLLECTIVE_OPS:
+        n = len(re.findall(rf"\b{op}(?:-start)?\(", txt))
+        if n:
+            counts[op] = n
+    counts["total"] = sum(counts.values())
+    return counts
+
+
+def per_shard_bytes(arena) -> int:
+    """Bytes of one device's shard of an arena array (the quantity that
+    must fit a single chip's HBM — the whole point of mesh serving)."""
+    shards = getattr(arena, "addressable_shards", None)
+    if not shards:
+        return int(arena.nbytes)
+    return max(int(s.data.nbytes) for s in shards)

@@ -29,6 +29,7 @@ __all__ = [
     "check_donation_off_overhead",
     "check_micro_baseline_schema",
     "check_serving_targets",
+    "check_serving_mesh_targets",
     "check_tracing_targets",
 ]
 
@@ -106,6 +107,62 @@ def check_serving_targets(artifact: dict | None = None, *, min_ratio: float = 1.
             f"paid an XLA compile — the steady-state TTFT numbers are "
             f"polluted by cold starts"
         )
+    return artifact
+
+
+def check_serving_mesh_targets(artifact: dict | None = None, *, min_ratio: float = 1.0) -> dict:
+    """Validates the BENCH_SERVING_MESH.json artifact: schema, sanity
+    (batching still happened; the mesh actually spans >1 device; parity
+    with solo sharded generate() was asserted — a throughput number from a
+    diverging engine is meaningless), the headline claim (the SPMD engine
+    at least matches the single-device engine in tokens/sec at equal total
+    batch), the per-(mesh, bucket) compile bound, the compile-free measured
+    window, and the capacity fact the mesh exists for: one shard holds
+    strictly fewer arena bytes than the whole arena.  Returns the artifact
+    for chaining."""
+    if artifact is None:
+        artifact = load_artifact("BENCH_SERVING_MESH.json")
+    assert "backend" in artifact and "results" in artifact, sorted(artifact)
+    r = artifact["results"]
+    for key in (
+        "mesh_tokens_per_sec", "single_tokens_per_sec", "throughput_ratio",
+        "mean_batch_occupancy", "prefill_compiles", "decode_compiles",
+        "bucket_bound", "token_parity", "mesh_devices", "arena_shard_bytes",
+        "arena_total_bytes", "collectives_decode", "cold_compile_prefills_measured",
+    ):
+        assert key in r, (key, sorted(r))
+    assert r["mesh_tokens_per_sec"] > 0 and r["single_tokens_per_sec"] > 0, r
+    assert r["mesh_devices"] > 1, "the 'mesh' engine ran on one device"
+    assert r["token_parity"] is True, (
+        "mesh-served tokens diverged from solo sharded generate() — the "
+        "throughput comparison is void"
+    )
+    assert r["mean_batch_occupancy"] > 1.0, (
+        f"mean batch occupancy {r['mean_batch_occupancy']} <= 1: requests never "
+        f"actually shared a decode step"
+    )
+    assert r["throughput_ratio"] >= min_ratio, (
+        f"mesh serving lost to the single-device engine at equal total batch: "
+        f"{r['throughput_ratio']:.2f}x < {min_ratio}x"
+    )
+    compiles = r["prefill_compiles"] + r["decode_compiles"]
+    assert compiles <= r["bucket_bound"], (
+        f"{compiles} compiled programs exceed the bucket bound {r['bucket_bound']} — "
+        f"one compile per (mesh, bucket) is not holding"
+    )
+    assert r["cold_compile_prefills_measured"] == 0, (
+        f"{r['cold_compile_prefills_measured']} measured-engine prefills paid "
+        f"an XLA compile — the mesh program cache stopped carrying warmed "
+        f"programs across engines"
+    )
+    assert r["arena_shard_bytes"] < r["arena_total_bytes"], (
+        "one shard holds the whole arena — the KV bytes are not sharded, "
+        "which defeats the capacity point of mesh serving"
+    )
+    assert r["collectives_decode"].get("total", 0) >= 1, (
+        "the decode program has no collectives — it cannot be SPMD across "
+        "tensor-parallel shards"
+    )
     return artifact
 
 

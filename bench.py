@@ -843,6 +843,32 @@ def main():
             "results": out["results"],
         }))
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "capacity":
+        # multi-tenant capacity bench: admitted concurrency at fixed arena
+        # bytes (int8 KV pool vs the f32 baseline, exact token parity
+        # asserted) plus the adapter-mix tokens/sec overhead and the
+        # zero-recompile-per-adapter contract.  Host work only, no TPU
+        # probe; artifact uses the BENCH_MICRO schema.
+        from thunder_tpu._platform import force_cpu
+
+        force_cpu()
+        from thunder_tpu.benchmarks.capacity import capacity_bench
+
+        out = capacity_bench(on_tpu=False)
+        artifact = {"backend": jax.default_backend(), **out}
+        with open("BENCH_CAPACITY.json", "w") as f:
+            json.dump(artifact, f, indent=1)
+        for k, v in out["results"].items():
+            log(f"capacity {k}: {v}")
+        print(json.dumps({
+            "metric": "int8_admitted_concurrency_x",
+            "value": out["results"]["admitted_ratio"],
+            "unit": "x",
+            # the f32 pool at the same arena bytes IS the baseline
+            "vs_baseline": out["results"]["admitted_ratio"],
+            "results": out["results"],
+        }))
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "tracing":
         # serving-plane tracing overhead: default engine vs observability
         # explicitly off (the gated ≈1.0x claim — off must be the identical

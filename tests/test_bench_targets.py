@@ -400,6 +400,86 @@ class TestServingTargets:
         assert out["results"]["mean_batch_occupancy"] > 1.0
 
 
+class TestCapacityTargets:
+    def test_capacity_gate_on_committed_artifact(self):
+        """BENCH_CAPACITY.json must keep showing ROADMAP item 5's gates:
+        the int8 pool admits >= 3x the concurrent requests of the
+        full-width pool at equal arena bytes with exact greedy token
+        parity, and a >= 3-adapter mixed batch compiles nothing beyond the
+        (bucket, registry-geometry) program set.  A regression recorded
+        into the artifact fails here."""
+        from tools.bench_targets import check_capacity_targets
+
+        art = check_capacity_targets()
+        assert art["backend"] in ("cpu", "tpu")
+        assert art["results"]["admitted_ratio"] >= 3.0
+        assert art["results"]["adapter_mix_new_programs_after_register"] == 0
+
+    def test_capacity_gate_rejects_regressions(self):
+        from tools.bench_targets import check_capacity_targets, load_artifact
+
+        good = load_artifact("BENCH_CAPACITY.json")
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["admitted_ratio"] = 2.5
+        with pytest.raises(AssertionError, match="capacity multiple"):
+            check_capacity_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["token_parity_exact"] = False
+        with pytest.raises(AssertionError, match="diverged"):
+            check_capacity_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["kv_quant_rel_err"] = 0.5
+        with pytest.raises(AssertionError, match="tolerance"):
+            check_capacity_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["kv_quant_rel_err"] = 0.0       # nothing was quantized
+        with pytest.raises(AssertionError, match="tolerance"):
+            check_capacity_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["int8_admitted_peak"] = bad["results"]["baseline_admitted_peak"]
+        with pytest.raises(AssertionError, match="no capacity"):
+            check_capacity_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["adapter_mix_new_programs_after_register"] = 1
+        with pytest.raises(AssertionError, match="leaked into the program cache"):
+            check_capacity_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["adapter_mix_max_distinct"] = 2
+        with pytest.raises(AssertionError, match="multi-tenant"):
+            check_capacity_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["decode_compiles"] = bad["results"]["bucket_bound"] + 1
+        with pytest.raises(AssertionError, match="bucket bound"):
+            check_capacity_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        del bad["results"]["admitted_ratio"]
+        with pytest.raises(AssertionError):
+            check_capacity_targets(bad)
+
+    @pytest.mark.slow
+    def test_capacity_bench_live_smoke(self):
+        """The bench harness itself at smoke shapes: the equal-bytes
+        capacity ratio, exact parity, and the zero-recompile adapter
+        contract must all hold live (the ratio gate stays at 3x — it is a
+        bytes property, not a timing one, so CI jitter cannot move it)."""
+        from thunder_tpu.benchmarks.capacity import capacity_bench
+        from tools.bench_targets import check_capacity_targets
+
+        out = capacity_bench(on_tpu=False, smoke=True)
+        art = {"backend": jax.default_backend(), **out}
+        check_capacity_targets(art)
+        assert out["results"]["smoke"] is True
+
+
 class TestServingMeshTargets:
     def test_serving_mesh_gate_on_committed_artifact(self):
         """BENCH_SERVING_MESH.json must keep showing ROADMAP item 1's gate:

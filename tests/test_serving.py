@@ -400,6 +400,50 @@ class TestEngine:
         np.testing.assert_array_equal(rb.tokens, solo)
         assert eng.pool.num_free == eng.pool.num_usable  # refcounts drained
 
+    def test_evict_scrubs_prefix_index(self, micro):
+        """Audit of the PR-5 stale-prefix-index bug class on the evict
+        path: evicting a running request must scrub its _prefix_index
+        entries exactly like window expiry does (the blocks are freed and
+        may be re-leased — a later same-prefix request sharing the stale
+        snapshot would lease dead or foreign blocks).  The resubmit gets
+        no shared blocks and matches solo."""
+        cfg, params = micro
+        eng = _engine(cfg, params, num_blocks=16, max_batch=2)
+        p = (np.arange(9) * 5 + 2).astype(np.int32) % cfg.vocab_size
+        ha = eng.submit(p, max_new_tokens=12)
+        eng.step()                                       # prefill A registers prefixes
+        assert eng._prefix_index
+        old_blocks = set(ha._req.block_table) - {SINK_BLOCK}
+        eng.evict(ha)
+        assert ha.result(drive=False).finish_reason == "evicted"
+        assert eng._prefix_index == {}                   # evict scrubbed A's entries
+        assert eng.pool.num_free == eng.pool.num_usable
+        hb = eng.submit(p.copy(), max_new_tokens=4)
+        eng.step()                                       # would share stale blocks pre-fix
+        assert hb._req.n_shared_blocks == 0
+        assert set(hb._req.block_table) & old_blocks     # same physical blocks, re-leased
+        eng.drain()
+        np.testing.assert_array_equal(
+            hb.result(drive=False).tokens, _solo(params, p, cfg, 4)
+        )
+
+    def test_free_blocks_low_water_gauge(self, micro):
+        """The capacity floor is visible post-mortem: the gauge and the
+        flight-recorder pool snapshot carry the fewest free blocks ever
+        seen, surviving after the pool drains back to full."""
+        cfg, params = micro
+        eng = _engine(cfg, params, num_blocks=16, max_batch=2)
+        p = np.arange(6, dtype=np.int32)
+        eng.run([{"prompt": p, "max_new_tokens": 6, "key": jax.random.PRNGKey(i)}
+                 for i in range(2)])
+        assert eng.pool.num_free == eng.pool.num_usable  # drained clean...
+        low = eng.pool.free_blocks_low_water
+        assert low < eng.pool.num_usable                 # ...but the floor survives
+        assert eng._flight_state()["pool"]["free_blocks_low_water"] == low
+        assert eng.stats()["pool_free_blocks_low_water"] == low
+        snap = tt.metrics_snapshot()
+        assert snap["serving.pool.free_blocks_low_water"] == low
+
     def test_window_expiry_scrubs_prefix_index(self, micro):
         """Regression: sliding-window expiry frees a running request's
         leading blocks; a later same-prefix request must not share the

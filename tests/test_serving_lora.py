@@ -1,0 +1,307 @@
+"""Per-request LoRA adapter serving (serving/lora.py + engine adapter_id).
+
+The load-bearing guarantees:
+
+- **mixed-tenant bit-exactness**: a request's tokens are identical whether
+  it runs alone or batched with requests using *different* adapters
+  (extends the PR-5 differential harness to multi-tenant batches);
+- **program identity**: a batch mixing >= 3 distinct adapter_ids compiles
+  no new programs beyond the (bucket, registry-geometry) set — adapters
+  are data (registry arenas are program arguments), register/evict never
+  recompiles;
+- registry policy: bounded slots, evict-zeroes, unknown ids rejected at
+  submit.
+
+Bucket sets are pinned small so the whole file compiles a handful of tiny
+programs (tier-1 budget).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import thunder_tpu as tt
+from thunder_tpu.models import llama
+from thunder_tpu.serving import (
+    AdapterRegistry,
+    RegistryFullError,
+    make_lora_factors,
+)
+
+MICRO = dict(
+    n_layer=1, n_head=2, n_embd=16, intermediate_size=32, vocab_size=32, block_size=64,
+)
+BUCKETS = dict(batch_buckets=(4,), block_buckets=(4,), prefill_buckets=(16,))
+RANK = 2
+
+
+@pytest.fixture(scope="module")
+def micro():
+    cfg = llama.Config.from_name("tiny-llama-debug", **MICRO)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def registry(micro):
+    cfg, _ = micro
+    reg = AdapterRegistry(cfg, rank=RANK, max_adapters=4)
+    for i, name in enumerate(("alice", "bob", "carol")):
+        reg.register(name, make_lora_factors(cfg, RANK, jax.random.PRNGKey(10 + i),
+                                             std=0.5))
+    return reg
+
+
+def _engine(cfg, params, **kw):
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 32)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("cache_dtype", jnp.float32)
+    for k, v in BUCKETS.items():
+        kw.setdefault(k, v)
+    return tt.serve(None, params, cfg, **kw)
+
+
+#
+# registry policy (host-side)
+#
+
+
+class TestAdapterRegistry:
+    def test_geometry_and_base_slot(self, micro, registry):
+        cfg, _ = micro
+        assert registry.geometry == (RANK, 5, ("wq", "wk", "wv", "wo"), 1.0, "float32")
+        assert registry.slots_used == 3
+        # slot 0 is the reserved zero (base) slot
+        for t in registry.targets:
+            assert float(jnp.abs(registry.arenas[t]["a"][0]).sum()) == 0.0
+        assert registry.slot("alice") != 0
+
+    def test_register_validates_shapes_and_targets(self, micro):
+        cfg, _ = micro
+        reg = AdapterRegistry(cfg, rank=RANK, max_adapters=2)
+        good = make_lora_factors(cfg, RANK, jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="missing targets"):
+            reg.register("x", {"wq": good["wq"]})
+        bad = dict(good)
+        bad["wq"] = (good["wq"][0][:, :1], good["wq"][1])   # wrong rank dim
+        with pytest.raises(ValueError, match="shapes"):
+            reg.register("x", bad)
+        with pytest.raises(ValueError, match="unknown LoRA targets"):
+            AdapterRegistry(cfg, rank=RANK, targets=("wq", "fc_1"))
+
+    def test_bounded_register_evict_cycle(self, micro):
+        cfg, _ = micro
+        reg = AdapterRegistry(cfg, rank=RANK, max_adapters=2)
+        f = make_lora_factors(cfg, RANK, jax.random.PRNGKey(1), std=0.5)
+        reg.register("a", f)
+        slot_b = reg.register("b", f)
+        with pytest.raises(RegistryFullError):
+            reg.register("c", f)
+        reg.evict("b")
+        # evict zeroes the slot: in-flight requests degrade to base
+        for t in reg.targets:
+            assert float(jnp.abs(reg.arenas[t]["a"][slot_b]).sum()) == 0.0
+        assert reg.register("c", f) == slot_b               # slot recycled
+        with pytest.raises(KeyError, match="unknown adapter_id"):
+            reg.slot("b")
+        # re-register overwrites in place (same slot, no extra capacity)
+        assert reg.register("c", f) == slot_b
+
+    def test_occupancy_gauges(self, micro):
+        cfg, _ = micro
+        reg = AdapterRegistry(cfg, rank=RANK, max_adapters=3)
+        reg.register("t1", make_lora_factors(cfg, RANK, jax.random.PRNGKey(2)))
+        snap = tt.metrics_snapshot()
+        assert snap["serving.lora.slots"] == 3
+        assert snap["serving.lora.adapters"] == 1
+        assert reg.state_snapshot()["adapters"] == ["t1"]
+
+
+#
+# engine integration: the differential + program-identity guarantees
+#
+
+
+@pytest.fixture(scope="module")
+def mixed_served(micro, registry):
+    """One mixed-tenant drive shared by several assertions: four requests,
+    three distinct adapters plus a base request, all in one batch."""
+    cfg, params = micro
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in (4, 6, 9, 11)]
+    ids = ["alice", "bob", "carol", None]
+    eng = _engine(cfg, params, lora=registry)
+    handles = [eng.submit(p, max_new_tokens=5, adapter_id=a)
+               for p, a in zip(prompts, ids)]
+    eng.drain()
+    results = [h.result(drive=False) for h in handles]
+    snap = tt.metrics_snapshot()
+    return cfg, params, prompts, ids, eng, results, snap
+
+
+class TestMixedTenantBatches:
+    def test_solo_equals_mixed_bit_exact(self, mixed_served, registry):
+        """Acceptance: each request's tokens match its solo single-adapter
+        run bit-exactly, regardless of the other tenants in the batch."""
+        cfg, params, prompts, ids, _, results, _ = mixed_served
+        for p, a, r in zip(prompts, ids, results):
+            solo = _engine(cfg, params, lora=registry)
+            s = solo.submit(p, max_new_tokens=5, adapter_id=a).result()
+            np.testing.assert_array_equal(r.tokens, s.tokens)
+
+    def test_adapters_actually_change_tokens(self, mixed_served, registry):
+        """The deltas are live: every adapter's tokens differ from the base
+        model's on the same prompt (guards against a silently-zero delta
+        making the parity tests vacuous)."""
+        cfg, params, prompts, ids, _, results, _ = mixed_served
+        for p, a, r in zip(prompts[:3], ids[:3], results[:3]):
+            base = _engine(cfg, params, lora=registry)
+            b = base.submit(p, max_new_tokens=5).result()
+            assert not np.array_equal(r.tokens, b.tokens), a
+
+    def test_base_request_unaffected_by_registry(self, mixed_served):
+        """A no-adapter request in a LoRA engine rides slot 0's exact-zero
+        delta: its tokens equal a plain (registry-free) engine's."""
+        cfg, params, prompts, ids, _, results, _ = mixed_served
+        assert ids[3] is None
+        plain = _engine(cfg, params)
+        r = plain.submit(prompts[3], max_new_tokens=5).result()
+        np.testing.assert_array_equal(results[3].tokens, r.tokens)
+
+    def test_no_programs_beyond_geometry_set(self, mixed_served, micro, registry):
+        """Acceptance: the mixed >= 3-adapter batch stayed inside the
+        bucket bound, a second engine with the same registry geometry
+        compiles nothing, and registering a NEW adapter then serving it
+        compiles nothing — adapter identity never enters the program
+        cache key."""
+        cfg, params, prompts, ids, eng, _, _ = mixed_served
+        stats = eng.stats()
+        assert len({a for a in ids if a}) == 3
+        assert sum(stats["compile_counts"].values()) <= stats["bucket_bound"]
+        eng2 = _engine(cfg, params, lora=registry)
+        eng2.run([{"prompt": prompts[0], "max_new_tokens": 3, "adapter_id": "bob"}])
+        assert eng2.compile_counts == {"prefill": 0, "decode": 0}
+        registry.register("dave", make_lora_factors(cfg, RANK, jax.random.PRNGKey(99),
+                                                    std=0.5))
+        try:
+            eng3 = _engine(cfg, params, lora=registry)
+            eng3.run([{"prompt": prompts[1], "max_new_tokens": 3,
+                       "adapter_id": "dave"}])
+            assert eng3.compile_counts == {"prefill": 0, "decode": 0}
+        finally:
+            registry.evict("dave")                          # keep the fixture clean
+
+    def test_static_key_carries_geometry_not_ids(self, mixed_served, micro, registry):
+        cfg, params = micro
+        eng = _engine(cfg, params, lora=registry)
+        key = eng._static_key()
+        assert registry.geometry in key
+        assert not any("alice" in str(k) for k in key)
+        other = AdapterRegistry(cfg, rank=RANK + 1, max_adapters=4)
+        assert _engine(cfg, params, lora=other)._static_key() != key
+
+    def test_tenant_metrics(self, mixed_served):
+        """serving.tenant.<id>.* carry per-adapter token counts and
+        latency; base requests emit no tenant series."""
+        *_, results, snap = mixed_served
+        for name in ("alice", "bob", "carol"):
+            assert snap[f"serving.tenant.{name}.tokens"] == 5
+            assert snap[f"serving.tenant.{name}.requests"] == 1
+            assert snap[f"serving.tenant.{name}.e2e_s"]["count"] == 1
+        assert "serving.tenant.None.tokens" not in snap
+
+    def test_request_rows_carry_adapter_id(self, micro, registry):
+        cfg, params = micro
+        eng = _engine(cfg, params, lora=registry)
+        h = eng.submit(np.arange(4, dtype=np.int32), max_new_tokens=8,
+                       adapter_id="alice")
+        eng.step()
+        row = eng.scheduler.state_snapshot()["requests"][0]
+        assert row["adapter_id"] == "alice"
+        eng.evict(h)
+
+    def test_submit_validation(self, micro, registry):
+        cfg, params = micro
+        plain = _engine(cfg, params)
+        with pytest.raises(ValueError, match="requires an engine built with"):
+            plain.submit(np.arange(3, dtype=np.int32), max_new_tokens=2,
+                         adapter_id="alice")
+        eng = _engine(cfg, params, lora=registry)
+        with pytest.raises(KeyError, match="unknown adapter_id"):
+            eng.submit(np.arange(3, dtype=np.int32), max_new_tokens=2,
+                       adapter_id="nobody")
+        wrong = llama.Config.from_name("tiny-llama-debug",
+                                       **{**MICRO, "n_embd": 32, "n_head": 4})
+        with pytest.raises(ValueError, match="registry was built for"):
+            wrong_params = llama.init_params(wrong, jax.random.PRNGKey(0),
+                                             dtype=jnp.float32)
+            _engine(wrong, wrong_params, lora=registry)
+
+
+#
+# the traced-path (models/llama.py) single-adapter hook
+#
+
+
+def test_llama_attention_lora_hook(micro):
+    """The ltorch block-forward hook: params blocks carrying a "lora" entry
+    apply B(A(x)) next to the target matmul — equivalent to merging the
+    low-rank product into the dense weight."""
+    from thunder_tpu.models.llama import build_rope_cache, gpt_forward
+
+    cfg, params = micro
+    key = jax.random.PRNGKey(3)
+    f = make_lora_factors(cfg, RANK, key, std=0.3)
+    idx = (np.arange(6, dtype=np.int32) % cfg.vocab_size)[None]
+    cos, sin = build_rope_cache(cfg, idx.shape[1])
+    fwd = tt.jit(lambda p, i, c, s: gpt_forward(p, i, c, s, cfg))
+
+    base = fwd(params, jnp.asarray(idx), cos, sin)
+
+    import copy
+    hooked = copy.copy(params)
+    hooked["blocks"] = [dict(b) for b in params["blocks"]]
+    hooked["blocks"][0] = dict(hooked["blocks"][0])
+    hooked["blocks"][0]["attn"] = dict(hooked["blocks"][0]["attn"])
+    hooked["blocks"][0]["attn"]["lora"] = {
+        t: (f[t][0][0], f[t][1][0]) for t in ("wq", "wo")
+    }
+    out_hook = fwd(hooked, jnp.asarray(idx), cos, sin)
+    assert not np.allclose(np.asarray(out_hook), np.asarray(base))
+
+    merged = copy.copy(params)
+    merged["blocks"] = [dict(b) for b in params["blocks"]]
+    merged["blocks"][0] = dict(merged["blocks"][0])
+    merged["blocks"][0]["attn"] = dict(merged["blocks"][0]["attn"])
+    for t in ("wq", "wo"):
+        a, b = f[t][0][0], f[t][1][0]                      # (r, fin), (fout, r)
+        w = merged["blocks"][0]["attn"][t]
+        merged["blocks"][0]["attn"][t] = w + b @ a
+    out_merged = fwd(merged, jnp.asarray(idx), cos, sin)
+    np.testing.assert_allclose(
+        np.asarray(out_hook), np.asarray(out_merged), rtol=2e-4, atol=2e-4
+    )
+
+
+@pytest.mark.slow
+def test_mixed_tenant_temperature_soak(micro, registry):
+    """Temperature sampling across tenants: per-request chains stay solo-
+    exact in a mixed-adapter batch."""
+    cfg, params = micro
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in (5, 8, 10)]
+    ids = ["alice", "carol", None]
+    keys = [jax.random.PRNGKey(i * 13 + 1) for i in range(3)]
+    eng = _engine(cfg, params, lora=registry, temperature=0.8)
+    hs = [eng.submit(p, max_new_tokens=5, adapter_id=a, key=k)
+          for p, a, k in zip(prompts, ids, keys)]
+    eng.drain()
+    for p, a, k, h in zip(prompts, ids, keys, hs):
+        solo = _engine(cfg, params, lora=registry, temperature=0.8)
+        s = solo.submit(p, max_new_tokens=5, adapter_id=a, key=k).result()
+        np.testing.assert_array_equal(h.result(drive=False).tokens, s.tokens)

@@ -246,3 +246,25 @@ class TestMeshEngine:
         cfg, params = micro
         with pytest.raises(ValueError, match="requires mesh"):
             tt.serve(None, params, cfg, shardings={"any": None})
+
+    def test_int8_arena_shards_scales_by_the_same_rule(self, micro, tp2):
+        """Quantized mesh serving: the int8 data arenas AND their float32
+        scale arenas carry the one kv_cache_spec placement (heads dim at
+        axis 2 in both ranks), and mesh-served int8 tokens still match
+        solo sharded f32 generate() exactly (greedy margins dominate the
+        quantization noise at micro shapes)."""
+        cfg, params = micro
+        mesh, p_tp = tp2
+        pool = PagedKVPool(cfg, num_blocks=8, block_size=4, dtype=jnp.float32,
+                           kv_dtype="int8", mesh=mesh)
+        want = NamedSharding(mesh, dist.kv_cache_spec(cfg, mesh))
+        assert pool.k_arena.dtype == jnp.int8
+        assert pool.k_arena.sharding.is_equivalent_to(want, pool.k_arena.ndim)
+        assert pool.k_scale.sharding.is_equivalent_to(want, pool.k_scale.ndim)
+        assert pool.per_shard_bytes() == pool.k_arena.nbytes // 2
+        eng = _engine(cfg, params, mesh, kv_dtype="int8")
+        base = (np.arange(10) * 7 + 3).astype(np.int32) % cfg.vocab_size
+        r = eng.submit(base, max_new_tokens=4).result()
+        np.testing.assert_array_equal(r.tokens, _solo_sharded(p_tp, base, cfg, 4, mesh))
+        # the donated update preserved the scale placement
+        assert eng.pool.k_scale.sharding.is_equivalent_to(want, eng.pool.k_scale.ndim)

@@ -73,6 +73,10 @@ def kv_cache_spec(cfg, mesh: Mesh | None, *, axis: str = "tp") -> P:
     ``models.generate.cache_shape`` AND the paged serving arena
     ``(num_blocks, L, n_query_groups, block_size, hs)`` — one rule so
     serving and ``generate()`` can never disagree on how KV bytes shard.
+    The int8 pool's float32 scale arenas
+    ``(num_blocks, L, n_query_groups, block_size)`` keep the heads dim at
+    axis 2 as well, so this spec is a valid prefix for them too: all four
+    serving arrays place with the ONE rule.
 
     Heads split over ``axis`` (tensor-parallel: each device holds its
     query groups' cache, attention stays device-local, only the output

@@ -110,17 +110,37 @@ def _mlp(mp, x, cfg: Config, *, quantized=False):
     )
 
 
-def _project_qkv(ap, x, cos_t, sin_t, cfg: Config, *, lin=None):
+def _lora_delta(x, a, b, scaling):
+    """Per-request (batched) LoRA delta: ``scaling * B(A(x))`` with one
+    adapter per batch row.  ``x``: (B, T, fin); ``a``: (B, r, fin);
+    ``b``: (B, fout, r) → (B, T, fout).  Row ``i``'s delta depends only on
+    row ``i``'s activations and factors, so a request's math is identical
+    whatever else shares the batch (the serving bit-exactness contract)."""
+    d = jnp.einsum("btc,brc->btr", x, a.astype(x.dtype))
+    return jnp.einsum("btr,bor->bto", d, b.astype(x.dtype)) * scaling
+
+
+def _project_qkv(ap, x, cos_t, sin_t, cfg: Config, *, lin=None, lora=None,
+                 lora_scaling=1.0):
     """QKV projections + partial rotary for new tokens: x (B, T, C) →
     q (B, nh, T, hs), k/v (B, ng, T, hs) — K/V stay at the grouped head
-    count.  Shared by KV-cache decode and sequence-parallel training."""
+    count.  Shared by KV-cache decode and sequence-parallel training.
+    ``lora``: optional ``{target: (a, b)}`` per-request factors for this
+    layer (see :func:`_lora_delta`)."""
     if lin is None:
         lin = _linear
     B, T, C = x.shape
     hs, nh, ng = cfg.head_size, cfg.n_head, cfg.n_query_groups
-    q = lin(x, ap["wq"], ap.get("bq")).reshape(B, T, nh, hs).transpose(0, 2, 1, 3)
-    k = lin(x, ap["wk"], ap.get("bk")).reshape(B, T, ng, hs).transpose(0, 2, 1, 3)
-    v = lin(x, ap["wv"], ap.get("bv")).reshape(B, T, ng, hs).transpose(0, 2, 1, 3)
+
+    def proj(name, bias):
+        o = lin(x, ap[name], ap.get(bias))
+        if lora is not None and name in lora:
+            o = o + _lora_delta(x, *lora[name], lora_scaling)
+        return o
+
+    q = proj("wq", "bq").reshape(B, T, nh, hs).transpose(0, 2, 1, 3)
+    k = proj("wk", "bk").reshape(B, T, ng, hs).transpose(0, 2, 1, 3)
+    v = proj("wv", "bv").reshape(B, T, ng, hs).transpose(0, 2, 1, 3)
     n_elem = cfg.rope_n_elem
     if n_elem > 0:
         q_r = _rope(q[..., :n_elem], cos_t, sin_t)
@@ -216,7 +236,8 @@ def _expand_groups(kk, vv, nh):
     return kk, vv
 
 
-def _attn_with_cache(ap, x, cos_t, sin_t, ck, cv, pos, cfg: Config, *, quantized=False):
+def _attn_with_cache(ap, x, cos_t, sin_t, ck, cv, pos, cfg: Config, *, quantized=False,
+                     lora=None, lora_scaling=1.0):
     """x: (B, T, C) new tokens at global positions [pos, pos+T).  Writes their
     K/V into the per-layer cache (ck/cv: (B, ng, Tc, hs)) and attends against
     every slot the model may see.
@@ -230,7 +251,8 @@ def _attn_with_cache(ap, x, cos_t, sin_t, ck, cv, pos, cfg: Config, *, quantized
     B, T, C = x.shape
     hs, nh, ng = cfg.head_size, cfg.n_head, cfg.n_query_groups
     lin = partial(_linear, quantized=quantized)
-    q, k, v = _project_qkv(ap, x, cos_t, sin_t, cfg, lin=lin)
+    q, k, v = _project_qkv(ap, x, cos_t, sin_t, cfg, lin=lin, lora=lora,
+                           lora_scaling=lora_scaling)
     Tc = ck.shape[2]
     W = cfg.sliding_window
     ring = W is not None and Tc == W
@@ -289,12 +311,22 @@ def _attn_with_cache(ap, x, cos_t, sin_t, ck, cv, pos, cfg: Config, *, quantized
     w = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     y = jnp.einsum("bhqk,bhkd->bhqd", w, vv.astype(q.dtype))
     y = y.transpose(0, 2, 1, 3).reshape(B, T, nh * hs)
-    return lin(y, ap["wo"], ap.get("bo")), ck, cv
+    out = lin(y, ap["wo"], ap.get("bo"))
+    if lora is not None and "wo" in lora:
+        out = out + _lora_delta(y, *lora["wo"], lora_scaling)
+    return out, ck, cv
 
 
-def forward_with_cache(params, idx, pos, cache, cos_all, sin_all, cfg: Config, *, quantized=False):
+def forward_with_cache(params, idx, pos, cache, cos_all, sin_all, cfg: Config, *,
+                       quantized=False, lora=None, lora_scaling=1.0):
     """Forward of new tokens ``idx`` (B, T) at global positions [pos, pos+T)
-    against/into ``cache``.  Returns (logits (B, T, V), updated cache)."""
+    against/into ``cache``.  Returns (logits (B, T, V), updated cache).
+
+    ``lora``: optional per-request LoRA factors —
+    ``{target: {"a": (B, L, r, fin), "b": (B, L, fout, r)}}`` with one
+    adapter per batch row (the layout
+    :func:`serving.lora.gather_adapter_slots` produces); the delta
+    ``lora_scaling * B(A(x))`` lands next to each target's matmul."""
     B, T = idx.shape
     x = params["wte"][idx]
     if cfg.scale_embedding:
@@ -317,9 +349,12 @@ def forward_with_cache(params, idx, pos, cache, cos_all, sin_all, cfg: Config, *
     new_k, new_v = [], []
     for l, bp in enumerate(params["blocks"]):
         n1 = _norm(x, bp["norm_1"], cfg, bp.get("norm_1_b"))
+        lora_l = None
+        if lora:
+            lora_l = {t: (ab["a"][:, l], ab["b"][:, l]) for t, ab in lora.items()}
         h, ck, cv = _attn_with_cache(
             bp["attn"], n1, cos_t, sin_t, cache["k"][l], cache["v"][l], pos, cfg,
-            quantized=quantized,
+            quantized=quantized, lora=lora_l, lora_scaling=lora_scaling,
         )
         new_k.append(ck)
         new_v.append(cv)

@@ -393,9 +393,23 @@ def _norm(x, weight, config: Config, bias=None):
 def attention(ap, x, cos, sin, config: Config):
     B, T, C = x.shape
     hs, nh, ng = config.head_size, config.n_head, config.n_query_groups
-    q = ltorch.linear(x, ap["wq"], ap.get("bq"))  # (B, T, nh*hs)
-    k = ltorch.linear(x, ap["wk"], ap.get("bk"))  # (B, T, ng*hs)
-    v = ltorch.linear(x, ap["wv"], ap.get("bv"))
+    # optional single-adapter LoRA hook: ap["lora"] = {target: (a, b)} with
+    # a (r, in_features), b (out_features, r) — the low-rank delta B(A(x))
+    # rides next to the target matmul (fold the alpha/r scaling into b).
+    # Per-request multi-tenant serving lives in thunder_tpu.serving.lora;
+    # this hook is the traced-path analog for fine-tune forwards.
+    lora = ap.get("lora") or {}
+
+    def proj(name, x_in, w, bias):
+        o = ltorch.linear(x_in, w, bias)
+        if name in lora:
+            a, b = lora[name]
+            o = o + ltorch.linear(ltorch.linear(x_in, a), b)
+        return o
+
+    q = proj("wq", x, ap["wq"], ap.get("bq"))  # (B, T, nh*hs)
+    k = proj("wk", x, ap["wk"], ap.get("bk"))  # (B, T, ng*hs)
+    v = proj("wv", x, ap["wv"], ap.get("bv"))
 
     q = q.reshape(B, T, nh, hs).permute(0, 2, 1, 3)  # (B, nh, T, hs)
     k = k.reshape(B, T, ng, hs).permute(0, 2, 1, 3)  # (B, ng, T, hs)
@@ -418,7 +432,7 @@ def attention(ap, x, cos, sin, config: Config):
         q, k, v, is_causal=True, sliding_window=config.sliding_window
     )  # (B, nh, T, hs)
     y = y.permute(0, 2, 1, 3).reshape(B, T, nh * hs)
-    return ltorch.linear(y, ap["wo"], ap.get("bo"))
+    return proj("wo", y, ap["wo"], ap.get("bo"))
 
 
 def moe_mlp(mp, x, config: Config):

@@ -16,6 +16,14 @@ placed once, the block arena's KV-heads dim sharded over ``tp`` via the
 ``distributed.kv_cache_spec`` rule, and every bucket program pjit-compiled
 once per (mesh, bucket) — served tokens bit-identical to solo sharded
 ``generate()`` on the same mesh.
+
+Multi-tenancy (:mod:`serving.quant` + :mod:`serving.lora`):
+``kv_dtype="int8"`` stores the block arenas quantized (per-token absmax
+scales, ~4x the resident requests per arena byte vs f32), and
+``lora=AdapterRegistry(...)`` + ``submit(..., adapter_id=...)`` serves many
+LoRA fine-tunes off one base model — adapters are program *data*, so
+batches mix tenants without recompiling and each request's tokens match
+its solo single-adapter run bit-exactly.
 """
 from thunder_tpu.serving.engine import (  # noqa: F401
     EngineStalledError,
@@ -28,6 +36,15 @@ from thunder_tpu.serving.kv_pool import (  # noqa: F401
     ArenaMismatchError,
     PagedKVPool,
     PoolExhaustedError,
+)
+from thunder_tpu.serving.lora import (  # noqa: F401
+    AdapterRegistry,
+    RegistryFullError,
+    make_lora_factors,
+)
+from thunder_tpu.serving.quant import (  # noqa: F401
+    arena_block_bytes,
+    blocks_for_arena_bytes,
 )
 from thunder_tpu.serving.scheduler import (  # noqa: F401
     AdmissionError,
@@ -49,6 +66,11 @@ __all__ = [
     "Scheduler",
     "Request",
     "AdmissionError",
+    "AdapterRegistry",
+    "RegistryFullError",
+    "make_lora_factors",
+    "arena_block_bytes",
+    "blocks_for_arena_bytes",
     "pick_bucket",
     "pow2_buckets",
 ]

@@ -17,7 +17,12 @@ pieces the repo already has:
   PR-1 dispatch cache when the model fn is a ``tt.jit`` product);
 - **observability** (PRs 2–3): queue/occupancy/pool gauges, TTFT/TPOT and
   tokens/sec histograms in the metrics registry, per-request JSONL records
-  through :class:`observability.telemetry.StepLogger`.
+  through :class:`observability.telemetry.StepLogger`;
+- **multi-tenancy**: ``kv_dtype="int8"`` stores the arenas quantized
+  (:mod:`serving.quant`), and ``lora=AdapterRegistry(...)`` routes each
+  request through a per-request LoRA adapter (:mod:`serving.lora`) — both
+  live inside the same bucket programs, keyed only by storage dtype and
+  registry geometry.
 
 Reproducibility contract: each request carries its own PRNG key chain and
 splits it exactly like a solo ``generate()`` call (one split at prefill, one
@@ -74,6 +79,12 @@ from thunder_tpu.serving.kv_pool import (
     gather_dense,
     scatter_blocks,
     scatter_token,
+)
+from thunder_tpu.serving.lora import gather_adapter_slots
+from thunder_tpu.serving.quant import (
+    gather_dense_q,
+    scatter_blocks_q,
+    scatter_token_q,
 )
 from thunder_tpu.serving.scheduler import (
     FINISH_DEADLINE,
@@ -207,6 +218,8 @@ class ServingEngine:
         eos_id: int | None = None,
         quantized: bool = False,
         cache_dtype=None,
+        kv_dtype=None,
+        lora=None,
         prefix_sharing: bool = True,
         clock: Callable[[], float] | None = None,
         telemetry=None,
@@ -247,8 +260,23 @@ class ServingEngine:
         self.prefix_sharing = bool(prefix_sharing)
         dtype = cache_dtype if cache_dtype is not None else params["wte"].dtype
         self.pool = PagedKVPool(
-            cfg, num_blocks=num_blocks, block_size=block_size, dtype=dtype, mesh=mesh
+            cfg, num_blocks=num_blocks, block_size=block_size, dtype=dtype,
+            kv_dtype=kv_dtype, mesh=mesh,
         )
+        # multi-tenant LoRA: a bounded AdapterRegistry shared across engines;
+        # its stacked factor arenas are program *arguments* (register/evict
+        # are data writes), only its geometry enters the program identity
+        self._registry = lora
+        if lora is not None:
+            for dim in ("n_layer", "n_head", "n_query_groups", "head_size", "n_embd"):
+                if getattr(lora.cfg, dim) != getattr(cfg, dim):
+                    raise ValueError(
+                        f"lora registry was built for {dim}="
+                        f"{getattr(lora.cfg, dim)} but the engine serves "
+                        f"{dim}={getattr(cfg, dim)}"
+                    )
+            if mesh is not None:
+                lora.place(mesh)   # placed once per mesh, like params
         self.scheduler = Scheduler(
             self.pool,
             max_batch=max_batch,
@@ -342,6 +370,7 @@ class ServingEngine:
         deadline: float | None = None,
         key=None,
         stream_cb: Callable[[int], Any] | None = None,
+        adapter_id: str | None = None,
     ) -> RequestHandle:
         """Enqueues one request; returns immediately with a handle.
 
@@ -349,16 +378,29 @@ class ServingEngine:
         reason ``"deadline"`` wherever it is.  ``key`` seeds the request's
         private sampling chain (default ``PRNGKey(0)``, like ``generate``).
         ``stream_cb`` receives each generated token id, in order, as soon as
-        the host sees it.  Raises :class:`AdmissionError` when the wait
-        queue is full or the request can never fit the pool."""
+        the host sees it.  ``adapter_id`` routes the request through a LoRA
+        adapter registered in the engine's ``lora=`` registry (resolved to
+        its slot here, at admission time — an unknown id raises KeyError
+        immediately, never a silent base fallback).  Raises
+        :class:`AdmissionError` when the wait queue is full or the request
+        can never fit the pool."""
         if self._closed:
             raise RuntimeError("engine is shut down")
         if key is None:
             key = jax.random.PRNGKey(0)
+        adapter_slot = 0
+        if adapter_id is not None:
+            if self._registry is None:
+                raise ValueError(
+                    f"adapter_id={adapter_id!r} requires an engine built with "
+                    f"lora=AdapterRegistry(...)"
+                )
+            adapter_slot = self._registry.slot(adapter_id)
         reg = registry()
         try:
             req = self.scheduler.submit(
                 prompt, max_new_tokens, key=key, deadline_s=deadline, stream_cb=stream_cb,
+                adapter_id=adapter_id, adapter_slot=adapter_slot,
             )
         except AdmissionError:
             reg.counter("serving.requests.rejected").inc()
@@ -500,10 +542,14 @@ class ServingEngine:
         mesh = self.mesh_stats()
         return {
             **({"mesh": mesh} if mesh is not None else {}),
+            **({"lora": self._registry.state_snapshot()} if self._registry is not None else {}),
             "queue_depth": len(self.scheduler.queue),
             "running": len(self.scheduler.running),
             "pool_free_blocks": self.pool.num_free,
+            "pool_free_blocks_low_water": self.pool.free_blocks_low_water,
             "pool_utilization": self.pool.utilization(),
+            "kv_dtype": str(self.pool.kv_dtype),
+            "arena_bytes": self.pool.arena_bytes(),
             "decode_steps": self.decode_steps,
             "prefill_runs": self.prefill_runs,
             "tokens_generated": self.tokens_generated,
@@ -675,12 +721,13 @@ class ServingEngine:
             # the dispatch phase is named by its dominant cost: a fresh
             # program pays the XLA compile here, a cached one only dispatches
             tr.begin(req.rid, "prefill.compile" if compiled else "prefill.dispatch")
-        tok, k_arena, v_arena, key = prog(
+        tok, arenas, key, qerr = prog(
             self.params, jnp.asarray(toks)[None], jnp.int32(pos), jnp.int32(len(remainder)),
-            pool.k_arena, pool.v_arena, jnp.asarray(table), jnp.asarray(dest),
+            pool.arenas, jnp.asarray(table), jnp.asarray(dest),
             jnp.asarray(req.key),
+            self._lora_arenas(), jnp.asarray([req.adapter_slot], dtype=jnp.int32),
         )
-        pool.update_arenas(k_arena, v_arena)
+        pool.set_arenas(arenas)
         if tr is not None:
             tr.end(req.rid, "prefill.compile" if compiled else "prefill.dispatch")
             tr.begin(req.rid, "prefill.host")
@@ -697,6 +744,10 @@ class ServingEngine:
         reg = registry()
         reg.counter("serving.steps.prefill").inc()
         reg.counter("serving.tokens").inc()
+        if pool.quantized_kv:
+            # measured int8 quantization error of THIS prefill's written
+            # blocks (sum|dq-x|/sum|x| over non-sink destinations)
+            reg.gauge("serving.kv_quant.rel_err").set(float(np.asarray(qerr)))
         if compiled:
             # cold-compile TTFT outliers must be distinguishable from queue
             # delay: count prefill RUNS that paid a compile (vs
@@ -725,6 +776,7 @@ class ServingEngine:
         dest_block = np.full(Bb, SINK_BLOCK, dtype=np.int32)
         dest_slot = np.zeros(Bb, dtype=np.int32)
         keys = np.zeros((Bb, *np.shape(running[0].key)), dtype=np.asarray(running[0].key).dtype)
+        slots = np.zeros(Bb, dtype=np.int32)               # padding rows: base slot
         for i, r in enumerate(running):
             wpos = r.prompt_len + len(r.generated) - 1     # slot this step writes
             toks[i] = r.generated[-1]
@@ -733,25 +785,27 @@ class ServingEngine:
             dest_block[i] = r.block_table[wpos // bs]
             dest_slot[i] = wpos % bs
             keys[i] = r.key
+            slots[i] = r.adapter_slot
         prog, compiled = self._program("decode", Bb, nbb)
+        lora_arenas = self._lora_arenas()
         if self.mesh is not None and self._mesh_collectives is None:
             # census BEFORE the call: the arenas are donated by it
             self._mesh_collectives = self._collective_census(
                 ("decode", Bb, nbb), prog,
-                (self.params, toks, pos, tables, pool.k_arena, pool.v_arena,
-                 dest_block, dest_slot, keys),
+                (self.params, toks, pos, tables, pool.arenas,
+                 dest_block, dest_slot, keys, lora_arenas, slots),
             )
         tr = self._tracer
         if tr is not None:
             for r in running:
                 tr.begin(r.rid, "decode", step=self.decode_steps,
                          compile=compiled, bucket=[Bb, nbb])
-        nxt, new_keys, k_arena, v_arena = prog(
+        nxt, new_keys, arenas = prog(
             self.params, jnp.asarray(toks), jnp.asarray(pos), jnp.asarray(tables),
-            pool.k_arena, pool.v_arena, jnp.asarray(dest_block), jnp.asarray(dest_slot),
-            jnp.asarray(keys),
+            pool.arenas, jnp.asarray(dest_block), jnp.asarray(dest_slot),
+            jnp.asarray(keys), lora_arenas, jnp.asarray(slots),
         )
-        pool.update_arenas(k_arena, v_arena)
+        pool.set_arenas(arenas)
         nxt = np.asarray(nxt)
         new_keys = np.asarray(new_keys)
         if tr is not None:                                 # tokens host-visible
@@ -819,6 +873,15 @@ class ServingEngine:
             reg.histogram("serving.tpot_s").observe(res.tpot_s)
         if res.tokens_per_sec is not None:
             reg.histogram("serving.tokens_per_sec").observe(res.tokens_per_sec)
+        if req.adapter_id is not None:
+            # per-tenant accounting: which adapter consumed the tokens and
+            # what latency its requests saw
+            reg.counter(f"serving.tenant.{req.adapter_id}.tokens").inc(len(req.generated))
+            reg.counter(f"serving.tenant.{req.adapter_id}.requests").inc()
+            if res.ttft_s is not None:
+                reg.histogram(f"serving.tenant.{req.adapter_id}.ttft_s").observe(res.ttft_s)
+            if res.e2e_s is not None:
+                reg.histogram(f"serving.tenant.{req.adapter_id}.e2e_s").observe(res.e2e_s)
         if self.telemetry is not None:
             self.telemetry.log_request(
                 rid=req.rid,
@@ -864,10 +927,19 @@ class ServingEngine:
         reg.gauge("serving.running").set(len(self.scheduler.running))
         reg.gauge("serving.pool.utilization").set(self.pool.utilization())
         reg.gauge("serving.pool.free_blocks").set(self.pool.num_free)
+        # the post-mortem capacity floor: how close the pool ever came to
+        # exhaustion (also in the flight-recorder pool snapshot)
+        reg.gauge("serving.pool.free_blocks_low_water").set(self.pool.free_blocks_low_water)
 
     #
     # compiled bucket programs
     #
+
+    def _lora_arenas(self) -> dict:
+        """The registry's stacked factor arenas as a program argument
+        ({} without a registry — an empty pytree, zero buffers).  Fetched
+        per call so registrations/evictions land without recompiling."""
+        return self._registry.arenas if self._registry is not None else {}
 
     def _static_key(self) -> tuple | None:
         """Global program-cache key for everything baked into a bucket
@@ -875,15 +947,19 @@ class ServingEngine:
         when a custom ``model_fn`` makes the closure unkeyable.  Mesh
         engines extend the key with the mesh fingerprint (axis layout +
         device ids), so programs compile once per (mesh, bucket) and a
-        different device set never reuses a stale placement."""
+        different device set never reuses a stale placement.  The LoRA
+        component is the registry *geometry* only — adapter ids and factor
+        values are program arguments, so a batch mixing tenants can never
+        grow the program set."""
         if self._forward is not forward_with_cache:
             return None
         import dataclasses
 
         return (
             tuple(sorted(dataclasses.asdict(self.cfg).items())),
-            self.pool.block_size, str(self.pool.dtype),
+            self.pool.block_size, str(self.pool.dtype), str(self.pool.kv_dtype),
             self.temperature, self.quantized,
+            self._registry.geometry if self._registry is not None else None,
             self._mesh_key,
         )
 
@@ -908,7 +984,12 @@ class ServingEngine:
                                       "cause": f"new {kind} geometry"})
             registry().counter(f"serving.compiles.{kind}").inc()
             if gkey is not None:
-                if len(_program_cache) >= 32:  # LRU-ish bound, same as _generate_cache
+                # LRU-ish bound (the _generate_cache idiom).  64, not 32: a
+                # multi-tenant deployment legitimately runs several static
+                # configs at once (f32 + int8 pools, per-registry-geometry
+                # LoRA variants), and evicting a live config's programs
+                # re-pays its compiles on the next request
+                if len(_program_cache) >= 64:
                     _program_cache.pop(next(iter(_program_cache)))
                 _program_cache[gkey] = prog
         self._programs[key] = prog
@@ -943,37 +1024,76 @@ class ServingEngine:
         registry().gauge("serving.mesh.collectives.decode").set(got.get("total", 0))
         return got
 
+    def _fwd_kwargs(self, lora_arenas, slots) -> dict:
+        """The forward kwargs one bucket step adds on top of the base call:
+        weight quantization (``quantized=``, PR-era int8 matmuls) plus the
+        per-request LoRA factors gathered by slot — called inside the jit
+        trace, so the gather is part of the compiled step."""
+        kw = {"quantized": self.quantized}
+        if self._registry is not None:
+            kw["lora"] = gather_adapter_slots(lora_arenas, slots)
+            kw["lora_scaling"] = self._registry.scaling
+        return kw
+
     def _build_prefill(self, Tb: int, nbb: int) -> Callable:
-        cfg, fwd, temp, quant = self.cfg, self._forward, self.temperature, self.quantized
+        cfg, fwd, temp = self.cfg, self._forward, self.temperature
+        qkv = self.pool.quantized_kv
+        cdtype = jnp.dtype(self.pool.dtype)
         cap = self.pool.capacity_tokens(nbb)
         cos_all, sin_all = build_rope_cache(cfg, cap)
 
-        @partial(jax.jit, donate_argnums=(4, 5), **self._jit_kwargs("prefill"))
-        def prefill(params, toks, pos, n_real, k_arena, v_arena, table, dest, key):
-            kd, vd = gather_dense(k_arena, v_arena, table[None, :])
+        @partial(jax.jit, donate_argnums=(4,), **self._jit_kwargs("prefill"))
+        def prefill(params, toks, pos, n_real, arenas, table, dest, key, lora, slot):
+            if qkv:
+                kd, vd = gather_dense_q(
+                    arenas["k"], arenas["v"], arenas["k_scale"], arenas["v_scale"],
+                    table[None, :], cdtype,
+                )
+            else:
+                kd, vd = gather_dense(arenas["k"], arenas["v"], table[None, :])
             logits, cache = fwd(
-                params, toks, pos, {"k": kd, "v": vd}, cos_all, sin_all, cfg, quantized=quant
+                params, toks, pos, {"k": kd, "v": vd}, cos_all, sin_all, cfg,
+                **self._fwd_kwargs(lora, slot),
             )
             last = jax.lax.dynamic_index_in_dim(logits, n_real - 1, axis=1, keepdims=False)
             key, sub = jax.random.split(key)
             tok = sample_token(last, temp, sub)            # (1,) — solo-prefill parity
-            k_arena = scatter_blocks(k_arena, cache["k"], dest)
-            v_arena = scatter_blocks(v_arena, cache["v"], dest)
-            return tok, k_arena, v_arena, key
+            if qkv:
+                k_arena, k_scale, k_err = scatter_blocks_q(
+                    arenas["k"], arenas["k_scale"], cache["k"], dest)
+                v_arena, v_scale, v_err = scatter_blocks_q(
+                    arenas["v"], arenas["v_scale"], cache["v"], dest)
+                arenas = {"k": k_arena, "v": v_arena,
+                          "k_scale": k_scale, "v_scale": v_scale}
+                qerr = 0.5 * (k_err + v_err)
+            else:
+                arenas = {"k": scatter_blocks(arenas["k"], cache["k"], dest),
+                          "v": scatter_blocks(arenas["v"], cache["v"], dest)}
+                qerr = jnp.float32(0.0)
+            return tok, arenas, key, qerr
 
         return prefill
 
     def _build_decode(self, Bb: int, nbb: int) -> Callable:
-        cfg, fwd, temp, quant = self.cfg, self._forward, self.temperature, self.quantized
+        cfg, fwd, temp = self.cfg, self._forward, self.temperature
+        qkv = self.pool.quantized_kv
+        cdtype = jnp.dtype(self.pool.dtype)
         cap = self.pool.capacity_tokens(nbb)
         cos_all, sin_all = build_rope_cache(cfg, cap)
 
-        @partial(jax.jit, donate_argnums=(4, 5), **self._jit_kwargs("decode"))
-        def decode(params, toks, pos, tables, k_arena, v_arena, dest_block, dest_slot, keys):
-            kd, vd = gather_dense(k_arena, v_arena, tables)
+        @partial(jax.jit, donate_argnums=(4,), **self._jit_kwargs("decode"))
+        def decode(params, toks, pos, tables, arenas, dest_block, dest_slot, keys,
+                   lora, slots):
+            if qkv:
+                kd, vd = gather_dense_q(
+                    arenas["k"], arenas["v"], arenas["k_scale"], arenas["v_scale"],
+                    tables, cdtype,
+                )
+            else:
+                kd, vd = gather_dense(arenas["k"], arenas["v"], tables)
             logits, cache = fwd(
                 params, toks[:, None], pos, {"k": kd, "v": vd}, cos_all, sin_all, cfg,
-                quantized=quant,
+                **self._fwd_kwargs(lora, slots),
             )
             sp = jax.vmap(jax.random.split)(keys)          # per-request key chains
             new_keys, subs = sp[:, 0], sp[:, 1]
@@ -986,9 +1106,20 @@ class ServingEngine:
             pick = jax.vmap(
                 lambda c, p: jax.lax.dynamic_index_in_dim(c, p, axis=2, keepdims=False)
             )
-            k_arena = scatter_token(k_arena, pick(kc, pos), dest_block, dest_slot)
-            v_arena = scatter_token(v_arena, pick(vc, pos), dest_block, dest_slot)
-            return nxt, new_keys, k_arena, v_arena
+            if qkv:
+                # the picked values are THIS step's freshly computed K/V (the
+                # dense cache write at pos), so quantize-on-scatter sees exact
+                # inputs — no requantization drift across steps
+                k_arena, k_scale = scatter_token_q(
+                    arenas["k"], arenas["k_scale"], pick(kc, pos), dest_block, dest_slot)
+                v_arena, v_scale = scatter_token_q(
+                    arenas["v"], arenas["v_scale"], pick(vc, pos), dest_block, dest_slot)
+                arenas = {"k": k_arena, "v": v_arena,
+                          "k_scale": k_scale, "v_scale": v_scale}
+            else:
+                arenas = {"k": scatter_token(arenas["k"], pick(kc, pos), dest_block, dest_slot),
+                          "v": scatter_token(arenas["v"], pick(vc, pos), dest_block, dest_slot)}
+            return nxt, new_keys, arenas
 
         return decode
 
@@ -1005,5 +1136,14 @@ def serve(model_fn, params, cfg, **kwargs) -> ServingEngine:
     dim over ``tp`` (:func:`thunder_tpu.distributed.kv_cache_spec`), and
     every bucket program compiles once per (mesh, bucket) with explicit
     shardings and per-shard arena donation.  Served tokens stay
-    bit-identical to solo ``generate(..., mesh=mesh)`` on the same mesh."""
+    bit-identical to solo ``generate(..., mesh=mesh)`` on the same mesh.
+
+    Multi-tenant serving: ``kv_dtype="int8"`` stores the KV block arenas
+    quantized (~``hs*itemsize/(hs+4)``x the resident requests per arena
+    byte, quantize-on-scatter / dequant-on-gather inside the bucket
+    programs, measured error in the ``serving.kv_quant.rel_err`` gauge);
+    ``lora=AdapterRegistry(...)`` lets ``submit(..., adapter_id=...)``
+    route each request through a registered LoRA adapter — batches freely
+    mix tenants, and the compiled-program set grows only with the registry
+    *geometry* (rank, slots, targets), never with adapter ids."""
     return ServingEngine(params, cfg, model_fn=model_fn, **kwargs)

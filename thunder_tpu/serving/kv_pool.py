@@ -22,8 +22,13 @@ Design points:
   block-aligned prompt prefix map their leading table entries to the same
   physical blocks (``share``), and a block returns to the free list only
   when its last owner releases it.
+- **Quantized block storage** (``kv_dtype="int8"``): the arenas store int8
+  values plus a float32 scale arena at per-block-slot, per-head granularity
+  (:mod:`thunder_tpu.serving.quant`) — ~``hs*itemsize/(hs+4)``× the
+  resident requests per arena byte, with quantize-on-scatter and
+  dequant-on-gather inside the jitted programs.
 - The pool owns only the *allocator* state (host-side, O(num_blocks) ints)
-  and the two arena arrays.  All array movement (gather/scatter) is pure
+  and the arena arrays.  All array movement (gather/scatter) is pure
   jnp code in :mod:`thunder_tpu.serving.engine`'s jitted bucket programs,
   which donate the arenas so updates stay in place.
 - Sliding-window models keep the plain positional layout (slot = position);
@@ -40,6 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from thunder_tpu.models.generate import kv_block_shape
+from thunder_tpu.serving.quant import resolve_kv_dtype
 
 __all__ = ["PoolExhaustedError", "ArenaMismatchError", "PagedKVPool"]
 
@@ -52,37 +58,46 @@ class PoolExhaustedError(RuntimeError):
 
 
 class ArenaMismatchError(ValueError):
-    """A program handed :meth:`PagedKVPool.update_arenas` an arena that
-    does not match the pool's geometry (shape/dtype) or placement
-    (sharding).  Caught at the swap, not steps later as garbage KV.
+    """An arena (or arena write) does not match the pool's geometry
+    (shape/dtype) or placement (sharding).  Caught at the swap/scatter, not
+    steps later as garbage KV.
 
-    Attributes: ``arena`` ("k" | "v"), ``field`` ("shape" | "dtype" |
-    "sharding"), ``expected``, ``got``."""
+    Attributes: ``arena`` ("k" | "v" | "k_scale" | "v_scale" | "scatter"),
+    ``field`` ("shape" | "dtype" | "sharding"), ``expected``, ``got``."""
 
-    def __init__(self, arena: str, field: str, expected, got):
+    def __init__(self, arena: str, field: str, expected, got, *, msg: str | None = None):
         self.arena = arena
         self.field = field
         self.expected = expected
         self.got = got
         super().__init__(
-            f"refusing to install {arena}-arena with mismatched {field}: "
-            f"program returned {got!r}, pool expects {expected!r} — the "
-            f"producing bucket program is writing a different arena "
-            f"geometry/placement than this pool owns"
+            msg if msg is not None else (
+                f"refusing to install {arena}-arena with mismatched {field}: "
+                f"program returned {got!r}, pool expects {expected!r} — the "
+                f"producing bucket program is writing a different arena "
+                f"geometry/placement than this pool owns"
+            )
         )
 
 
 class PagedKVPool:
     """Block arena + free-list allocator + per-block reference counts.
 
+    ``dtype`` is the **compute** dtype the model consumes (what
+    ``gather_dense*`` hands ``forward_with_cache``); ``kv_dtype`` selects
+    the **storage** dtype — ``None`` stores at ``dtype`` (full-width),
+    ``"int8"`` stores quantized blocks plus float32 scale arenas of shape
+    ``(num_blocks, L, n_query_groups, block_size)``.
+
     With ``mesh``, the arenas carry a ``NamedSharding`` splitting the
     KV-heads dim over ``axis`` (the shared ``distributed.kv_cache_spec``
-    rule) — the *bytes* live sharded across the mesh while every allocator
-    decision (free list, refcounts, prefix sharing) stays host-side and
-    identical to the single-device pool."""
+    rule; the scale arenas keep the heads dim at axis 2 too, so ONE rule
+    places all four arrays) — the *bytes* live sharded across the mesh
+    while every allocator decision (free list, refcounts, prefix sharing)
+    stays host-side and identical to the single-device pool."""
 
     def __init__(self, cfg, num_blocks: int, block_size: int, dtype=jnp.bfloat16,
-                 *, mesh=None, axis: str = "tp"):
+                 *, kv_dtype=None, mesh=None, axis: str = "tp"):
         if num_blocks < 2:
             raise ValueError(f"num_blocks must be >= 2 (block 0 is the sink), got {num_blocks}")
         if block_size < 1:
@@ -90,30 +105,46 @@ class PagedKVPool:
         self.cfg = cfg
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
-        self.dtype = dtype
+        self.dtype = dtype                              # compute/dequant dtype
+        self.kv_dtype = resolve_kv_dtype(kv_dtype, dtype)  # storage dtype
+        self.quantized_kv = self.kv_dtype == jnp.dtype(jnp.int8)
         self.mesh = mesh
         shape = (self.num_blocks, *kv_block_shape(cfg, self.block_size))
         self._arena_shape = shape
+        self._scale_shape = shape[:-1]                  # absmax over hs
         if mesh is not None:
             from thunder_tpu.serving.mesh import arena_sharding
 
             self.arena_sharding = arena_sharding(cfg, mesh, axis=axis)
             # shard-local allocation: no device ever materializes the full
-            # arena (the whole point — a model/cache too big for one chip)
-            zeros = jax.jit(
-                lambda: jnp.zeros(shape, dtype=dtype), out_shardings=self.arena_sharding
-            )
-            self.k_arena = zeros()
-            self.v_arena = zeros()
+            # arena (the whole point — a model/cache too big for one chip).
+            # The spec (heads at axis 2) is a valid prefix for the rank-4
+            # scale arenas too, so one sharding object places everything.
+            def zeros(shp, dt):
+                return jax.jit(
+                    lambda: jnp.zeros(shp, dtype=dt), out_shardings=self.arena_sharding
+                )()
         else:
             self.arena_sharding = None
-            # two independent buffers (no copy traffic between K and V updates)
-            self.k_arena = jnp.zeros(shape, dtype=dtype)
-            self.v_arena = jnp.zeros(shape, dtype=dtype)
+
+            def zeros(shp, dt):
+                return jnp.zeros(shp, dtype=dt)
+
+        # independent buffers (no copy traffic between K and V updates)
+        self.k_arena = zeros(shape, self.kv_dtype)
+        self.v_arena = zeros(shape, self.kv_dtype)
+        if self.quantized_kv:
+            self.k_scale = zeros(self._scale_shape, jnp.float32)
+            self.v_scale = zeros(self._scale_shape, jnp.float32)
+        else:
+            self.k_scale = self.v_scale = None
         # block 0 is permanently leased to the sink
         self._refcount = np.zeros(self.num_blocks, dtype=np.int32)
         self._refcount[SINK_BLOCK] = 1
         self._free: list[int] = list(range(self.num_blocks - 1, SINK_BLOCK, -1))  # pop() -> lowest id
+        # capacity-exhaustion post-mortems need the floor, not the current
+        # value: the low-water mark survives into the flight-recorder dump
+        self._free_low_water = len(self._free)
 
     #
     # allocator
@@ -127,6 +158,11 @@ class PagedKVPool:
     def num_usable(self) -> int:
         """Allocatable blocks (arena minus the sink)."""
         return self.num_blocks - 1
+
+    @property
+    def free_blocks_low_water(self) -> int:
+        """Fewest free blocks ever observed (capacity headroom floor)."""
+        return self._free_low_water
 
     def utilization(self) -> float:
         """Fraction of usable blocks currently leased."""
@@ -149,6 +185,7 @@ class PagedKVPool:
         out = [self._free.pop() for _ in range(n)]
         for b in out:
             self._refcount[b] = 1
+        self._free_low_water = min(self._free_low_water, len(self._free))
         return out
 
     def share(self, blocks: Sequence[int]) -> list[int]:
@@ -190,10 +227,13 @@ class PagedKVPool:
             "num_blocks": self.num_blocks,
             "block_size": self.block_size,
             "num_free": self.num_free,
+            "free_blocks_low_water": self._free_low_water,
             "utilization": self.utilization(),
             "leased_blocks": int((counts > 0).sum()),
             "shared_blocks": int((counts > 1).sum()),
             "lease_refs": int(counts.sum()),
+            "kv_dtype": str(self.kv_dtype),
+            "arena_bytes": self.arena_bytes(),
         }
         if self.arena_sharding is not None:
             snap["arena_spec"] = str(self.arena_sharding.spec)
@@ -212,6 +252,19 @@ class PagedKVPool:
         L, ng, bs, hs = kv_block_shape(self.cfg, self.block_size)
         return (L, B, ng, n_blocks * bs, hs)
 
+    def block_bytes(self) -> int:
+        """Bytes one block costs across all arenas (K+V data, plus the
+        scale arenas on the quantized path) — the unit of byte-based
+        admission/capacity accounting."""
+        total = int(self.k_arena.nbytes) + int(self.v_arena.nbytes)
+        if self.quantized_kv:
+            total += int(self.k_scale.nbytes) + int(self.v_scale.nbytes)
+        return total // self.num_blocks
+
+    def arena_bytes(self) -> int:
+        """Total bytes of every arena array this pool owns."""
+        return self.block_bytes() * self.num_blocks
+
     def per_shard_bytes(self) -> int:
         """Bytes of ONE K arena on one device (what a chip's HBM must
         hold; ×2 for K+V).  Equals ``k_arena.nbytes`` unsharded."""
@@ -219,11 +272,24 @@ class PagedKVPool:
 
         return per_shard_bytes(self.k_arena)
 
+    @property
+    def arenas(self) -> dict:
+        """The arena pytree a bucket program takes (and returns donated):
+        ``{"k", "v"}`` plus ``{"k_scale", "v_scale"}`` on the int8 path."""
+        out = {"k": self.k_arena, "v": self.v_arena}
+        if self.quantized_kv:
+            out["k_scale"] = self.k_scale
+            out["v_scale"] = self.v_scale
+        return out
+
     def _check_arena(self, name: str, new: jax.Array) -> None:
-        if tuple(new.shape) != self._arena_shape:
-            raise ArenaMismatchError(name, "shape", self._arena_shape, tuple(new.shape))
-        if new.dtype != jnp.dtype(self.dtype):
-            raise ArenaMismatchError(name, "dtype", jnp.dtype(self.dtype), new.dtype)
+        scale = name.endswith("_scale")
+        want_shape = self._scale_shape if scale else self._arena_shape
+        want_dtype = jnp.dtype(jnp.float32) if scale else jnp.dtype(self.kv_dtype)
+        if tuple(new.shape) != want_shape:
+            raise ArenaMismatchError(name, "shape", want_shape, tuple(new.shape))
+        if new.dtype != want_dtype:
+            raise ArenaMismatchError(name, "dtype", want_dtype, new.dtype)
         if self.arena_sharding is not None:
             got = getattr(new, "sharding", None)
             ok = got is not None and (
@@ -233,17 +299,37 @@ class PagedKVPool:
             if not ok:
                 raise ArenaMismatchError(name, "sharding", self.arena_sharding, got)
 
-    def update_arenas(self, k_arena: jax.Array, v_arena: jax.Array) -> None:
-        """Installs the arenas a donated program returned (in-place update).
+    def set_arenas(self, arenas: dict) -> None:
+        """Installs the arena pytree a donated program returned (in-place
+        update).  Validates geometry, dtype, and (mesh mode) sharding
+        first: a buggy program's mismatched arena would otherwise surface
+        steps later as garbage KV — :class:`ArenaMismatchError` names the
+        offending arena at the swap instead."""
+        expected = set(self.arenas)
+        if set(arenas) != expected:
+            raise ArenaMismatchError(
+                "arenas", "shape", sorted(expected), sorted(arenas),
+                msg=f"program returned arena keys {sorted(arenas)}, pool "
+                    f"expects {sorted(expected)} (kv_dtype={self.kv_dtype})",
+            )
+        for name, arr in arenas.items():
+            self._check_arena(name, arr)
+        self.k_arena = arenas["k"]
+        self.v_arena = arenas["v"]
+        if self.quantized_kv:
+            self.k_scale = arenas["k_scale"]
+            self.v_scale = arenas["v_scale"]
 
-        Validates geometry, dtype, and (mesh mode) sharding first: a buggy
-        program's mismatched arena would otherwise surface steps later as
-        garbage KV — :class:`ArenaMismatchError` names the offending arena
-        at the swap instead."""
-        self._check_arena("k", k_arena)
-        self._check_arena("v", v_arena)
-        self.k_arena = k_arena
-        self.v_arena = v_arena
+    def update_arenas(self, k_arena: jax.Array, v_arena: jax.Array,
+                      k_scale: jax.Array | None = None,
+                      v_scale: jax.Array | None = None) -> None:
+        """Positional convenience over :meth:`set_arenas` (kept for the
+        pre-quantization call sites and tests)."""
+        arenas = {"k": k_arena, "v": v_arena}
+        if k_scale is not None or v_scale is not None:
+            arenas["k_scale"] = k_scale
+            arenas["v_scale"] = v_scale
+        self.set_arenas(arenas)
 
 
 def gather_dense(k_arena, v_arena, tables):
@@ -266,7 +352,15 @@ def scatter_token(arena, new_kv, dest_block, dest_slot):
 
     ``new_kv``: (B, L, ng, hs); ``dest_block``/``dest_slot``: (B,) int32
     (sink-routed for padding rows).  Pure jnp; call inside jit on a donated
-    arena."""
+    arena.  The source dtype must already match the arena (int8 arenas go
+    through :func:`quant.scatter_token_q` instead)."""
+    if jnp.dtype(new_kv.dtype) != jnp.dtype(arena.dtype):
+        raise ArenaMismatchError(
+            "scatter", "dtype", jnp.dtype(arena.dtype), jnp.dtype(new_kv.dtype),
+            msg=f"scatter_token source dtype {jnp.dtype(new_kv.dtype)} != arena "
+                f"dtype {jnp.dtype(arena.dtype)} — route int8 arenas through "
+                f"quant.scatter_token_q; anything else is a silent truncation",
+        )
     return arena.at[dest_block, :, :, dest_slot, :].set(new_kv)
 
 
@@ -275,8 +369,21 @@ def scatter_blocks(arena, dense, dest_table):
 
     ``dense``: (L, 1, ng, nb*bs, hs) (B=1 prefill layout); ``dest_table``:
     (nb,) int32 — entries equal to the sink absorb padding/garbage blocks.
-    Duplicate sink entries are benign (last write wins into garbage)."""
+    Duplicate sink entries are benign (last write wins into garbage).
+
+    The source dtype must match the arena exactly: the pre-quantization
+    code silently ``astype``'d here, which would truncate an f32 cache into
+    a narrower arena without a trace — now any mismatch raises
+    :class:`ArenaMismatchError` at trace time, and int8 arenas route
+    through the explicit quantize path (:func:`quant.scatter_blocks_q`)."""
+    if jnp.dtype(dense.dtype) != jnp.dtype(arena.dtype):
+        raise ArenaMismatchError(
+            "scatter", "dtype", jnp.dtype(arena.dtype), jnp.dtype(dense.dtype),
+            msg=f"scatter_blocks source dtype {jnp.dtype(dense.dtype)} != arena "
+                f"dtype {jnp.dtype(arena.dtype)} — route int8 arenas through "
+                f"quant.scatter_blocks_q; anything else is a silent truncation",
+        )
     L, B, ng, cap, hs = dense.shape
     bs = arena.shape[3]
     blocks = dense[:, 0].reshape(L, ng, cap // bs, bs, hs).transpose(2, 0, 1, 3, 4)
-    return arena.at[dest_table].set(blocks.astype(arena.dtype))
+    return arena.at[dest_table].set(blocks)

@@ -11,7 +11,9 @@ over a :class:`jax.sharding.Mesh`:
   :func:`thunder_tpu.distributed.kv_cache_spec` rule the dense
   ``generate()`` cache uses (heads dim at axis 2 in both layouts), so each
   device holds only its heads' blocks while the host-side allocator
-  (free list, refcounts, prefix index) is untouched;
+  (free list, refcounts, prefix index) is untouched; the int8 pool's
+  float32 scale arenas keep the heads dim at axis 2 too, so the one spec
+  places them as a pytree prefix;
 - **explicit program shardings**: per-bucket prefill/decode programs get
   ``in_shardings``/``out_shardings`` (params as placed, arenas per the
   arena sharding, every host-built table/token array replicated), with
@@ -91,29 +93,33 @@ def program_shardings(kind: str, params, mesh: Mesh, arena_sh: NamedSharding) ->
     """``in_shardings``/``out_shardings`` for a bucket program.
 
     Everything the host builds per step (token/pos/table/dest arrays, PRNG
-    keys) is replicated — O(batch) ints, negligible next to the arenas;
-    params keep their placement; arenas carry ``arena_sh`` in AND out so
-    the donated update is shard-local (no resharding between steps).
+    keys, LoRA factor arenas + slot indices) is replicated — small next to
+    the arenas; params keep their placement; the arena pytree carries
+    ``arena_sh`` as a *prefix* sharding in AND out so the donated update is
+    shard-local (no resharding between steps).  The one
+    ``kv_cache_spec``-derived sharding covers the whole arena dict: the
+    int8 path's float32 scale arenas keep the heads dim at axis 2 just
+    like the data arenas, so the spec applies to both ranks.
 
     Argument orders match ``ServingEngine._build_prefill`` /
     ``_build_decode`` exactly:
 
-    - prefill: ``(params, toks, pos, n_real, k, v, table, dest, key)``
-      → ``(tok, k, v, key)``
-    - decode:  ``(params, toks, pos, tables, k, v, dest_block, dest_slot,
-      keys)`` → ``(nxt, new_keys, k, v)``
+    - prefill: ``(params, toks, pos, n_real, arenas, table, dest, key,
+      lora, slot)`` → ``(tok, arenas, key, qerr)``
+    - decode:  ``(params, toks, pos, tables, arenas, dest_block, dest_slot,
+      keys, lora, slots)`` → ``(nxt, new_keys, arenas)``
     """
     repl = NamedSharding(mesh, P())
     param_sh = jax.tree_util.tree_map(lambda x: x.sharding, params)
     if kind == "prefill":
         return dict(
-            in_shardings=(param_sh, repl, repl, repl, arena_sh, arena_sh, repl, repl, repl),
-            out_shardings=(repl, arena_sh, arena_sh, repl),
+            in_shardings=(param_sh, repl, repl, repl, arena_sh, repl, repl, repl, repl, repl),
+            out_shardings=(repl, arena_sh, repl, repl),
         )
     assert kind == "decode", kind
     return dict(
-        in_shardings=(param_sh, repl, repl, repl, arena_sh, arena_sh, repl, repl, repl),
-        out_shardings=(repl, repl, arena_sh, arena_sh),
+        in_shardings=(param_sh, repl, repl, repl, arena_sh, repl, repl, repl, repl, repl),
+        out_shardings=(repl, repl, arena_sh),
     )
 
 

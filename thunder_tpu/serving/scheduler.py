@@ -93,6 +93,10 @@ class Request:
     deadline_t: float | None = None         # absolute, engine clock
     stream_cb: Callable | None = None
     submit_t: float = 0.0
+    # multi-tenant routing: which LoRA adapter (registry slot) this request
+    # decodes through; slot 0 is the reserved base (no-adapter) slot
+    adapter_id: str | None = None
+    adapter_slot: int = 0
     # cache state
     block_table: list[int] = field(default_factory=list)
     n_shared_blocks: int = 0                # leading table entries leased via share()
@@ -160,8 +164,16 @@ class Scheduler:
         conservative so a running request can never be starved of blocks)."""
         return self.pool.blocks_for_tokens(req.total_capacity)
 
+    def bytes_needed(self, req: Request) -> int:
+        """The reservation in **stored arena bytes** — block count × the
+        pool's per-block cost at its storage dtype (int8 blocks plus their
+        scale arenas cost ~4x less than f32, which is where quantized
+        capacity shows up in admission accounting)."""
+        return self.blocks_needed(req) * self.pool.block_bytes()
+
     def submit(self, prompt, max_new_tokens: int, *, key, deadline_s: float | None = None,
-               stream_cb=None) -> Request:
+               stream_cb=None, adapter_id: str | None = None,
+               adapter_slot: int = 0) -> Request:
         prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -176,6 +188,8 @@ class Scheduler:
             deadline_t=(now + deadline_s) if deadline_s is not None else None,
             stream_cb=stream_cb,
             submit_t=now,
+            adapter_id=adapter_id,
+            adapter_slot=int(adapter_slot),
         )
         hard_cap = min(self.pool.num_usable, self.block_buckets[-1])
         if self.blocks_needed(req) > hard_cap:
@@ -277,7 +291,9 @@ class Scheduler:
                 "max_new_tokens": r.max_new_tokens,
                 "pos": r.pos,
                 "blocks": len(r.block_table),
+                "reserved_bytes": self.bytes_needed(r),
                 "shared_blocks": r.n_shared_blocks,
+                "adapter_id": r.adapter_id,
                 "prefill_compiled": r.prefill_compiled,
                 "deadline_t": r.deadline_t,
             }

@@ -31,6 +31,7 @@ __all__ = [
     "check_serving_targets",
     "check_serving_mesh_targets",
     "check_tracing_targets",
+    "check_capacity_targets",
 ]
 
 # generous: CI hosts jitter, and the gate exists to catch the donate=False
@@ -196,6 +197,68 @@ def check_tracing_targets(artifact: dict | None = None, *,
         f"tracing-off drive regressed: {r['off_overhead_x']:.3f}x > "
         f"{max_off_ratio}x vs the default engine — serving observability "
         f"must cost nothing when off (is-None checks only)"
+    )
+    return artifact
+
+
+def check_capacity_targets(artifact: dict | None = None, *,
+                           min_ratio: float = 3.0,
+                           max_rel_err: float = 0.05) -> dict:
+    """Validates the BENCH_CAPACITY.json artifact: schema, the int8-pool
+    headline (>= ``min_ratio``x the concurrently admitted requests of the
+    full-width pool at EQUAL arena bytes — the reason quantized block
+    storage exists), exact greedy token parity vs the f32 cache (a
+    capacity win from a diverging cache is meaningless), the measured
+    quantization error inside the documented tolerance, the compile bound,
+    and the multi-tenant contract: >= 3 distinct adapter_ids shared one
+    batch and registering a NEW adapter compiled zero fresh programs
+    (adapters are data, only registry geometry is program identity).
+    Returns the artifact for chaining."""
+    if artifact is None:
+        artifact = load_artifact("BENCH_CAPACITY.json")
+    assert "backend" in artifact and "results" in artifact, sorted(artifact)
+    r = artifact["results"]
+    for key in (
+        "arena_budget_bytes", "baseline_num_blocks", "int8_num_blocks",
+        "baseline_admitted_peak", "int8_admitted_peak", "admitted_ratio",
+        "token_parity_exact", "kv_quant_rel_err", "prefill_compiles",
+        "decode_compiles", "bucket_bound", "base_tokens_per_sec",
+        "adapter_mix_tokens_per_sec", "adapter_mix_max_distinct",
+        "adapter_mix_new_programs_after_register",
+    ):
+        assert key in r, (key, sorted(r))
+    assert r["int8_admitted_peak"] > r["baseline_admitted_peak"], (
+        f"int8 pool admitted {r['int8_admitted_peak']} <= baseline "
+        f"{r['baseline_admitted_peak']} at equal arena bytes — quantized "
+        f"storage bought no capacity"
+    )
+    assert r["admitted_ratio"] >= min_ratio, (
+        f"int8 admitted-concurrency ratio {r['admitted_ratio']:.2f}x < "
+        f"{min_ratio}x at equal arena bytes — the quantized pool is not "
+        f"delivering its capacity multiple"
+    )
+    assert r["token_parity_exact"] is True, (
+        "int8-cache greedy tokens diverged from the f32 cache — the "
+        "capacity comparison is void (served tokens changed)"
+    )
+    assert 0 < r["kv_quant_rel_err"] <= max_rel_err, (
+        f"measured KV quantization error {r['kv_quant_rel_err']} outside "
+        f"(0, {max_rel_err}] — either nothing was quantized or the error "
+        f"exceeds the documented int8 tolerance"
+    )
+    compiles = r["prefill_compiles"] + r["decode_compiles"]
+    assert compiles <= r["bucket_bound"], (
+        f"{compiles} compiled programs exceed the bucket bound {r['bucket_bound']}"
+    )
+    assert r["base_tokens_per_sec"] > 0 and r["adapter_mix_tokens_per_sec"] > 0, r
+    assert r["adapter_mix_max_distinct"] >= 3, (
+        f"only {r['adapter_mix_max_distinct']} distinct adapters shared a "
+        f"batch — the multi-tenant mixing claim was not exercised"
+    )
+    assert r["adapter_mix_new_programs_after_register"] == 0, (
+        f"registering a new adapter compiled "
+        f"{r['adapter_mix_new_programs_after_register']} fresh programs — "
+        f"adapter identity leaked into the program cache key"
     )
     return artifact
 

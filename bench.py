@@ -817,6 +817,32 @@ def main():
             "results": out["results"],
         }))
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "serving_async":
+        # async-engine serving bench: short-cohort TTFT p95 under
+        # long-prompt contention, the async event-loop engine (chunked
+        # prefill + deferred materialization) vs the synchronous engine,
+        # exact token parity asserted.  Host work only, no TPU probe;
+        # artifact uses the BENCH_MICRO schema.
+        from thunder_tpu._platform import force_cpu
+
+        force_cpu()
+        from thunder_tpu.benchmarks.serving_async import serving_async_bench
+
+        out = serving_async_bench(on_tpu=False)
+        artifact = {"backend": jax.default_backend(), **out}
+        with open("BENCH_SERVING_ASYNC.json", "w") as f:
+            json.dump(artifact, f, indent=1)
+        for k, v in out["results"].items():
+            log(f"serving_async {k}: {v}")
+        print(json.dumps({
+            "metric": "async_short_ttft_p95_improvement_x",
+            "value": out["results"]["ttft_p95_improvement_x"],
+            "unit": "x",
+            # the synchronous engine IS the baseline of this ratio
+            "vs_baseline": out["results"]["ttft_p95_improvement_x"],
+            "results": out["results"],
+        }))
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "serving_mesh":
         # mesh-parallel serving bench: the SPMD engine (TP-sharded params,
         # heads-over-tp block arena, pjit bucket programs) vs the

@@ -400,6 +400,77 @@ class TestServingTargets:
         assert out["results"]["mean_batch_occupancy"] > 1.0
 
 
+class TestServingAsyncTargets:
+    def test_serving_async_gate_on_committed_artifact(self):
+        """BENCH_SERVING_ASYNC.json must keep showing the async core's
+        reason to exist: short-cohort TTFT p95 under long-prompt contention
+        >= 2x better than the synchronous engine, with EXACT token parity,
+        real chunking and overlap, and compiles inside the chunk-extended
+        bucket bound.  A regression recorded into the artifact fails
+        here."""
+        from tools.bench_targets import check_serving_async_targets
+
+        art = check_serving_async_targets()
+        assert art["backend"] in ("cpu", "tpu")
+        assert art["results"]["ttft_p95_improvement_x"] >= 2.0
+
+    def test_serving_async_gate_rejects_regressions(self):
+        from tools.bench_targets import check_serving_async_targets, load_artifact
+
+        good = load_artifact("BENCH_SERVING_ASYNC.json")
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["ttft_p95_improvement_x"] = 1.5
+        with pytest.raises(AssertionError, match="not protecting TTFT"):
+            check_serving_async_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["token_parity_exact"] = False
+        with pytest.raises(AssertionError, match="diverged"):
+            check_serving_async_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["chunk_runs"] = 0
+        with pytest.raises(AssertionError, match="not actually chunked"):
+            check_serving_async_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["overlap_frac_mean"] = 0.0
+        with pytest.raises(AssertionError, match="not overlapping"):
+            check_serving_async_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["decode_compiles"] = bad["results"]["bucket_bound"] + 1
+        with pytest.raises(AssertionError, match="bucket"):
+            check_serving_async_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["cold_compile_prefills_measured"] = 1
+        with pytest.raises(AssertionError, match="cold"):
+            check_serving_async_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        del bad["results"]["async_short_ttft_p95_s"]
+        with pytest.raises(AssertionError):
+            check_serving_async_targets(bad)
+
+    @pytest.mark.slow
+    def test_serving_async_bench_live_smoke(self):
+        """The bench harness itself at smoke shapes: schema + parity +
+        chunking must hold live (the TTFT ratio is not gated at smoke
+        shapes on a jittery CI host; the committed full-shape artifact
+        carries that gate)."""
+        from thunder_tpu.benchmarks.serving_async import serving_async_bench
+        from tools.bench_targets import check_serving_async_targets
+
+        out = serving_async_bench(on_tpu=False, smoke=True)
+        art = {"backend": jax.default_backend(), **out}
+        check_serving_async_targets(art, min_improvement=0.0)
+        assert out["results"]["smoke"] is True
+        assert out["results"]["token_parity_exact"] is True
+        assert out["results"]["chunk_runs"] > 0
+
+
 class TestCapacityTargets:
     def test_capacity_gate_on_committed_artifact(self):
         """BENCH_CAPACITY.json must keep showing ROADMAP item 5's gates:

@@ -106,6 +106,34 @@ class TestQuantPrimitives:
         with pytest.raises(ValueError, match="unsupported kv_dtype"):
             resolve_kv_dtype(jnp.float16, jnp.float32)  # silent truncation class
 
+    def test_resolve_kv_dtype_fp8_aliases(self):
+        for alias in ("fp8", "e4m3", "float8_e4m3fn", jnp.float8_e4m3fn):
+            assert resolve_kv_dtype(alias, jnp.float32) == jnp.dtype(jnp.float8_e4m3fn)
+
+    def test_fp8_roundtrip_error_bound(self):
+        """e4m3 has 3 mantissa bits: expect a few-percent mean relative
+        error — worse than int8's uniform grid at the top of the range,
+        but still inside the serving tolerance the gauge documents."""
+        x = jax.random.normal(jax.random.PRNGKey(0), (3, 2, 5, 16), dtype=jnp.float32)
+        q, s = quantize_kv(x, jnp.float8_e4m3fn)
+        assert q.dtype == jnp.float8_e4m3fn and s.dtype == jnp.float32
+        assert q.shape == x.shape and s.shape == x.shape[:-1]
+        dq = dequantize_kv(q, s)
+        rel = float(jnp.sum(jnp.abs(dq - x)) / jnp.sum(jnp.abs(x)))
+        assert 0 < rel < 0.05
+        # the absmax element lands exactly on ±448 — representable, so the
+        # per-row max survives the round trip bit-exactly
+        amax_in = jnp.max(jnp.abs(x), axis=-1)
+        amax_out = jnp.max(jnp.abs(dq), axis=-1)
+        np.testing.assert_allclose(np.asarray(amax_out), np.asarray(amax_in), rtol=1e-6)
+
+    def test_fp8_deterministic_per_token(self):
+        row = jax.random.normal(jax.random.PRNGKey(1), (6, 16), dtype=jnp.float32)
+        alone = quantize_kv(row, jnp.float8_e4m3fn)
+        batched = quantize_kv(jnp.stack([row, row * 7.0 + 1.0]), jnp.float8_e4m3fn)
+        np.testing.assert_array_equal(alone[0], batched[0][0])
+        np.testing.assert_array_equal(alone[1], batched[1][0])
+
 
 #
 # quantized pool geometry + capacity math
@@ -342,6 +370,63 @@ class TestQuantizedEngine:
         assert ratio == pytest.approx(hs * 4 / (hs + 4))
         row = i8.scheduler.state_snapshot()["requests"][0]
         assert row["reserved_bytes"] == i8.scheduler.bytes_needed(ri)
+
+
+class TestFp8Engine:
+    """fp8 e4m3 block storage behind the same ``kv_dtype=`` seam (ROADMAP
+    item 5 remainder): identical arena geometry and capacity bytes as int8,
+    differential greedy parity, measured rel err inside tolerance."""
+
+    def test_pool_geometry_and_capacity_bytes_match_int8(self, micro):
+        cfg, _ = micro
+        fp8 = PagedKVPool(cfg, num_blocks=8, block_size=4, dtype=jnp.float32,
+                          kv_dtype="fp8")
+        assert fp8.quantized_kv and fp8.kv_dtype == jnp.dtype(jnp.float8_e4m3fn)
+        assert fp8.k_arena.dtype == jnp.float8_e4m3fn
+        assert fp8.k_scale.shape == fp8.k_arena.shape[:-1]
+        assert set(fp8.arenas) == {"k", "v", "k_scale", "v_scale"}
+        # both 1-byte storages + f32 scales: identical capacity math, so
+        # the admitted-concurrency multiple carries over unchanged
+        assert fp8.block_bytes() == arena_block_bytes(cfg, 4, jnp.float32,
+                                                      kv_dtype="int8")
+        assert arena_block_bytes(cfg, 4, jnp.float32, kv_dtype="fp8") == (
+            arena_block_bytes(cfg, 4, jnp.float32, kv_dtype="int8"))
+
+    def test_greedy_parity_and_rel_err_gauge(self, micro):
+        """Acceptance: fp8-cache served tokens equal the f32 engine AND
+        solo generate() exactly, and the measured per-prefill error lands
+        in the gauge inside the documented tolerance."""
+        cfg, params = micro
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+                   for n in (3, 5, 9)]
+        eng = _engine(cfg, params, kv_dtype="fp8")
+        results = eng.run([{"prompt": p, "max_new_tokens": 5} for p in prompts])
+        snap = tt.metrics_snapshot()
+        f32 = _engine(cfg, params).run(
+            [{"prompt": p, "max_new_tokens": 5} for p in prompts])
+        for p, r8, r32 in zip(prompts, results, f32):
+            solo = _solo(params, p, cfg, 5)
+            np.testing.assert_array_equal(r8.tokens, solo)
+            np.testing.assert_array_equal(r8.tokens, r32.tokens)
+        err = snap.get("serving.kv_quant.rel_err")
+        assert err is not None and 0 < err < 0.05
+        assert eng.stats()["kv_dtype"] == "float8_e4m3fn"
+
+    def test_temperature_parity_on_fp8(self, micro):
+        cfg, params = micro
+        key = jax.random.PRNGKey(11)
+        p = (np.arange(7) * 5 + 2).astype(np.int32) % cfg.vocab_size
+        mixed = _engine(cfg, params, kv_dtype="fp8", temperature=0.7)
+        ha = mixed.submit(p, max_new_tokens=4, key=key)
+        mixed.submit((p * 3 + 1) % cfg.vocab_size, max_new_tokens=4,
+                     key=jax.random.PRNGKey(5))
+        mixed.drain()
+        alone = _engine(cfg, params, kv_dtype="fp8", temperature=0.7)
+        np.testing.assert_array_equal(
+            ha.result(drive=False).tokens,
+            alone.submit(p, max_new_tokens=4, key=key).result().tokens,
+        )
 
 
 @pytest.mark.slow

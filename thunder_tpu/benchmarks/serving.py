@@ -17,6 +17,9 @@ the dominant term at any serving width).  Width 128 keeps the model tiny
 Both paths are warmed to steady state first (solo ``generate`` caches its
 prefill/scan pair per shape; the engine's bucket programs land in the
 module program cache), so the measured window is compile-free for both.
+Timing is interleaved best-of-``reps`` for BOTH paths (the tracing-bench
+methodology): CI hosts jitter 2-3x run to run, and the ratio of two
+single-shot samples inherits both samples' noise.
 """
 from __future__ import annotations
 
@@ -27,7 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def serving_bench(on_tpu: bool = False, *, smoke: bool = False) -> dict:
+def serving_bench(on_tpu: bool = False, *, smoke: bool = False, reps: int = 3) -> dict:
     """Returns ``{"results": {...}}`` in the BENCH_MICRO artifact shape."""
     import thunder_tpu as tt
     from thunder_tpu.models import generate as gen
@@ -36,6 +39,7 @@ def serving_bench(on_tpu: bool = False, *, smoke: bool = False) -> dict:
     if smoke:
         n_requests, max_new, max_batch, lens = 4, 8, 4, (4, 6, 8)
         overrides = dict(n_embd=128, intermediate_size=344)
+        reps = min(reps, 2)
     else:
         n_requests, max_new, max_batch, lens = 8, 32, 8, (8, 12, 16, 24)
         overrides = dict(n_embd=128, intermediate_size=344)
@@ -56,18 +60,11 @@ def serving_bench(on_tpu: bool = False, *, smoke: bool = False) -> dict:
             max_batch=max_batch, cache_dtype=jnp.float32,
         )
 
-    # -- sequential baseline: solo generate per request, steady state
-    for p in prompts:  # warm every (T_prompt, max_new) shape
-        gen.generate(params, p[None], cfg, max_new, cache_dtype=jnp.float32)
-    t0 = time.perf_counter()
-    out = None
+    # -- warm both paths: solo generate caches its prefill/scan pair per
+    # shape; the warm engine compiles the bucket programs into the module
+    # cache so every measured engine below is compile-free
     for p in prompts:
-        out = gen.generate(params, p[None], cfg, max_new, cache_dtype=jnp.float32)
-    np.asarray(out)  # host fetch fences the loop
-    seq_s = time.perf_counter() - t0
-    seq_tps = n_requests * max_new / seq_s
-
-    # -- continuous batching: warm engine compiles the bucket programs...
+        gen.generate(params, p[None], cfg, max_new, cache_dtype=jnp.float32)
     warm = make_engine()
     warm_results = warm.run([dict(r) for r in reqs])
     compile_counts = dict(warm.stats()["compile_counts"])
@@ -76,12 +73,33 @@ def serving_bench(on_tpu: bool = False, *, smoke: bool = False) -> dict:
     # engine (the cold-TTFT outlier population, distinguishable from queue
     # delay via the per-request compile tag)
     cold_prefills_warm = sum(1 for r in warm_results if r.prefill_compiled)
-    # ...the measured engine reuses them (program cache) and only times the
-    # drive loop + compute
-    eng = make_engine()
-    t0 = time.perf_counter()
-    results = eng.run([dict(r) for r in reqs])
-    srv_s = time.perf_counter() - t0
+
+    def seq_once() -> float:
+        t0 = time.perf_counter()
+        out = None
+        for p in prompts:
+            out = gen.generate(params, p[None], cfg, max_new, cache_dtype=jnp.float32)
+        np.asarray(out)  # host fetch fences the loop
+        return time.perf_counter() - t0
+
+    def srv_once():
+        eng = make_engine()
+        t0 = time.perf_counter()
+        results = eng.run([dict(r) for r in reqs])
+        return time.perf_counter() - t0, eng, results
+
+    # -- interleaved best-of-reps: each rep times the sequential loop and a
+    # fresh (program-cache-warm) engine back to back, so host jitter hits
+    # both sides of the ratio alike
+    seq_s = float("inf")
+    srv_s = float("inf")
+    eng = results = None
+    for _ in range(max(int(reps), 1)):
+        seq_s = min(seq_s, seq_once())
+        dt, e, res = srv_once()
+        if dt < srv_s:
+            srv_s, eng, results = dt, e, res
+    seq_tps = n_requests * max_new / seq_s
     n_tokens = sum(len(r.new_tokens) for r in results)
     srv_tps = n_tokens / srv_s
     stats = eng.stats()

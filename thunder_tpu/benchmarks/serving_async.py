@@ -1,0 +1,132 @@
+"""Async-engine serving benchmark: TTFT under long-prompt contention.
+
+The claim under test is the async core's reason to exist: with long
+prompts in the admission wave, short requests' TTFT must no longer pay for
+whole long prefills.  The synchronous engine admits and prefills each
+request back-to-back, host-blocked — a short request admitted behind two
+256-token prompts waits out both full prefills before its own first token.
+The async engine (``async_step=True`` + ``prefill_chunk``) dispatches long
+prompts as chunks and defers every materialization, so the short cohort's
+first tokens arrive after only one chunk per long plus their own prefills.
+
+Workload: at occupancy 8 (the committed BENCH_SERVING.json operating
+point), 2 long prompts are submitted first and 6 short prompts behind them
+— strict FIFO admits all 8 into one wave, so every short pays maximal
+contention.  The gated metric is the **short-cohort TTFT p95** ratio
+sync/async (the long requests' own TTFT is a different trade: chunking
+spreads their prefill across steps by design, buying the batch's TPOT).
+Exact token parity between the two engines is asserted request-by-request
+— a latency win from a diverging engine is meaningless — and the compiled
+program count must stay inside the chunk-extended bucket bound.
+
+Config note: tiny-llama at ``n_embd=128`` (the BENCH_SERVING.json width,
+where CPU compute beats dispatch); both engines are warmed to steady state
+first so the measured windows are compile-free.
+"""
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def serving_async_bench(on_tpu: bool = False, *, smoke: bool = False) -> dict:
+    """Returns ``{"results": {...}}`` in the BENCH_MICRO artifact shape."""
+    import thunder_tpu as tt
+    from thunder_tpu.models import llama
+
+    if smoke:
+        n_long, long_len, n_short, short_lens = 1, 64, 3, (6, 8, 10)
+        max_new, max_batch, chunk, block_size = 6, 4, 16, 8
+        overrides = dict(n_embd=128, intermediate_size=344)
+    else:
+        n_long, long_len, n_short, short_lens = 2, 512, 6, (8, 10, 12, 14, 16, 12)
+        max_new, max_batch, chunk, block_size = 16, 8, 64, 16
+        overrides = dict(n_embd=128, intermediate_size=344)
+    cfg = llama.Config.from_name("tiny-llama-debug", **overrides)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    longs = [rng.integers(0, cfg.vocab_size, (long_len,)).astype(np.int32)
+             for _ in range(n_long)]
+    shorts = [rng.integers(0, cfg.vocab_size, (short_lens[i % len(short_lens)],))
+              .astype(np.int32) for i in range(n_short)]
+    # longs first: strict FIFO puts every short behind every long prefill
+    prompts = longs + shorts
+    reqs = [{"prompt": p, "max_new_tokens": max_new} for p in prompts]
+    per_req = max(-(-(long_len + max_new) // block_size),
+                  -(-(max(len(s) for s in shorts) + max_new) // block_size))
+    num_blocks = (n_long * (-(-(long_len + max_new) // block_size))
+                  + n_short * (-(-(max(len(s) for s in shorts) + max_new) // block_size))
+                  + per_req + 1)
+
+    def make_engine(async_step: bool):
+        kw = dict(block_size=block_size, num_blocks=num_blocks,
+                  max_batch=max_batch, cache_dtype=jnp.float32)
+        if async_step:
+            kw["prefill_chunk"] = chunk
+        else:
+            kw["async_step"] = False
+        return tt.serve(None, params, cfg, **kw)
+
+    def drive(async_step: bool):
+        eng = make_engine(async_step)
+        t0 = time.perf_counter()
+        results = eng.run([dict(r) for r in reqs])
+        dt = time.perf_counter() - t0
+        return eng, results, dt
+
+    # warm both engines: the bucket programs land in the module cache, so
+    # the measured engines below pay zero XLA compiles (asserted)
+    for mode in (False, True):
+        drive(mode)
+
+    sync_eng, sync_results, sync_s = drive(False)
+    async_eng, async_results, async_s = drive(True)
+
+    parity = all(
+        np.array_equal(a.tokens, s.tokens)
+        for a, s in zip(async_results, sync_results)
+    )
+    cold_async = sum(1 for r in async_results if r.prefill_compiled)
+    cold_sync = sum(1 for r in sync_results if r.prefill_compiled)
+
+    def short_ttft_p95(results):
+        ttfts = sorted(r.ttft_s for r in results[n_long:])
+        return float(np.percentile(ttfts, 95))
+
+    sync_p95 = short_ttft_p95(sync_results)
+    async_p95 = short_ttft_p95(async_results)
+    stats = async_eng.stats()
+    n_tokens = sum(len(r.new_tokens) for r in async_results)
+
+    return {
+        "results": {
+            "sync_short_ttft_p95_s": round(sync_p95, 6),
+            "async_short_ttft_p95_s": round(async_p95, 6),
+            "ttft_p95_improvement_x": round(sync_p95 / async_p95, 3),
+            "sync_tokens_per_sec": round(n_tokens / sync_s, 1),
+            "async_tokens_per_sec": round(n_tokens / async_s, 1),
+            "throughput_ratio": round(sync_s / async_s, 3),
+            "token_parity_exact": bool(parity),
+            "mean_batch_occupancy": round(stats["mean_batch_occupancy"], 3),
+            "overlap_frac_mean": round(stats["overlap_frac_mean"], 3),
+            "decode_stall_s_mean": round(stats["decode_stall_s_mean"], 6),
+            "chunk_runs": stats["chunk_runs"],
+            "prefill_compiles": stats["compile_counts"]["prefill"],
+            "prefill_chunk_compiles": stats["compile_counts"]["prefill_chunk"],
+            "decode_compiles": stats["compile_counts"]["decode"],
+            "bucket_bound": stats["bucket_bound"],
+            # the measured (steady-state) engines must pay no XLA compile:
+            # their TTFT percentiles are compile-free by construction
+            "cold_compile_prefills_measured": cold_async + cold_sync,
+            "n_long": n_long,
+            "long_prompt_tokens": long_len,
+            "n_short": n_short,
+            "prefill_chunk": chunk,
+            "max_new_tokens": max_new,
+            "config": f"tiny-llama n_embd={cfg.n_embd} n_layer={cfg.n_layer}",
+            "smoke": smoke,
+        }
+    }

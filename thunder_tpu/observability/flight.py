@@ -5,10 +5,14 @@ The aviation black-box model: when a serving engine crashes mid-flight —
 an :class:`AnomalyError` out of the model, a pool invariant violation, a
 broken stream callback — the postmortem needs what the engine was *doing*,
 not just the traceback.  The recorder keeps the last N engine events
-(submit/admit/prefill/decode/expire/finish, each a tiny host-side dict) in
-a ring, and on demand snapshots the scheduler/pool state: batch occupancy,
-free-list and sharing (fragmentation) accounting, prefix-share hit rate,
-and which bucket geometries compiled when (the per-bucket compile causes).
+(submit/admit/prefill/prefill_chunk/decode/expire/finish, each a tiny
+host-side dict) in a ring, and on demand snapshots the scheduler/pool
+state: batch occupancy, free-list and sharing (fragmentation) accounting,
+prefix-share hit rate, which bucket geometries compiled when (the
+per-bucket compile causes), and — on the async engine — the per-lane
+state: the in-flight decode/prefill futures and every partially-prefilled
+request (``state["lanes"]``), so a crash mid-overlap shows what was still
+on the device.
 
 Dump paths:
 

@@ -10,11 +10,18 @@ spans (``ph: "b"/"e"`` keyed by ``id=rid``) for every request phase:
 - ``prefill``    — admission → first token on the host, annotated with
   ``compile`` (this run paid an XLA compile) vs ``cached``, split into
   ``prefill.compile``/``prefill.dispatch`` and ``prefill.host``
-  (device dispatch vs host materialization);
+  (device dispatch vs host materialization); a chunked prefill adds one
+  ``prefill.chunk`` child span per intermediate piece;
 - ``decode``     — one span per request per decode step (batched requests
   share wall time; each still gets its own span so a request's row reads
   start-to-finish), annotated with the step index;
 - an instant ``finish``/``deadline``/``evicted``/``eos`` marker.
+
+Spans from the async engine carry a ``lane`` arg (:data:`LANE_DECODE` /
+:data:`LANE_PREFILL`) so a Perfetto query can split a request's time by
+lane; under ``async_step=True`` a ``decode``/``prefill`` span covers
+dispatch → harvest (the true token latency including the deliberately
+deferred materialization), not just the host call.
 
 Engine drive-loop work lands as synchronous ``engine.step`` spans on a
 dedicated ``engine`` track.  Everything goes into the shared event ring, so
@@ -41,13 +48,18 @@ from thunder_tpu.observability.events import (
     register_thread_name,
 )
 
-__all__ = ["RequestTracer", "serving_pid", "ENGINE_TID", "REQUEST_TID_BASE"]
+__all__ = ["RequestTracer", "serving_pid", "ENGINE_TID", "REQUEST_TID_BASE",
+           "LANE_DECODE", "LANE_PREFILL"]
 
 # synthetic display tracks: the serving process row is the real pid shifted
 # into a namespace no OS pid collides with (Linux pid_max < 2**22)
 _SERVING_PID_OFFSET = 1 << 24
 ENGINE_TID = 0
 REQUEST_TID_BASE = 1
+
+# lane tags the async engine stamps on lifecycle spans (span arg "lane")
+LANE_DECODE = "decode"
+LANE_PREFILL = "prefill"
 
 
 def serving_pid() -> int:

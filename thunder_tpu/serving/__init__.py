@@ -11,6 +11,13 @@ Entry point: ``tt.serve(model_fn, params, cfg, ...)`` (or construct
 :class:`ServingEngine` directly).  Everything is strictly additive — no
 other compiled program changes by importing or using this package.
 
+The drive loop is an async event loop by default (``async_step=True``):
+decode for batch *k* dispatches and the host admits/schedules/streams
+batch *k−1* before blocking, and ``prefill_chunk=N`` splits long prompts
+into block-aligned pieces interleaved between decode dispatches so they
+stop stalling running requests.  Served tokens are bit-identical to the
+synchronous path (``async_step=False``) and to solo ``generate()``.
+
 With ``mesh=`` the engine is SPMD end to end (:mod:`serving.mesh`): params
 placed once, the block arena's KV-heads dim sharded over ``tp`` via the
 ``distributed.kv_cache_spec`` rule, and every bucket program pjit-compiled
@@ -18,8 +25,9 @@ once per (mesh, bucket) — served tokens bit-identical to solo sharded
 ``generate()`` on the same mesh.
 
 Multi-tenancy (:mod:`serving.quant` + :mod:`serving.lora`):
-``kv_dtype="int8"`` stores the block arenas quantized (per-token absmax
-scales, ~4x the resident requests per arena byte vs f32), and
+``kv_dtype="int8"`` / ``"fp8"`` stores the block arenas quantized
+(per-token absmax scales, ~4x the resident requests per arena byte vs
+f32), and
 ``lora=AdapterRegistry(...)`` + ``submit(..., adapter_id=...)`` serves many
 LoRA fine-tunes off one base model — adapters are program *data*, so
 batches mix tenants without recompiling and each request's tokens match

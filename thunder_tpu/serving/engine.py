@@ -30,9 +30,29 @@ per decode step; per-row sampling under ``vmap`` is bit-equivalent to the
 unbatched call), so a request's tokens do not depend on what else shares
 the batch — and greedy tokens match ``generate()`` exactly.
 
-The drive loop is synchronous and explicit: ``step()`` runs one scheduler
-iteration (expire → admit+prefill → one decode step); ``run()``/``drain()``
-loop it.  No threads — integrate into any host loop.
+The drive loop is an explicit, threadless **event loop** with two lanes
+(``async_step=True``, the default):
+
+- the **decode lane** dispatches the jitted decode program for batch *k*
+  and — exploiting JAX's async dispatch, which the CPU backend shares —
+  returns to the host immediately; admissions, scheduling, chunk
+  dispatches, and token streaming for batch *k−1* all run while the device
+  computes, and the next ``step()`` harvests the in-flight tokens (the
+  only host block, measured into ``serving.decode.stall_s`` and the
+  ``serving.step.overlap_frac`` gauge);
+- the **prefill lane** splits prompts longer than ``prefill_chunk`` into
+  block-aligned pow-2 chunks (program kind ``prefill_chunk``, bounded by
+  the same ``_table_widths``/bucket accounting) and dispatches at most one
+  chunk per request per step, interleaved between decode dispatches — a
+  long prompt can no longer stall TPOT for running requests.
+
+``async_step=False`` keeps the original fully synchronous path
+byte-identical (admit → prefill → one decode → block on host
+materialization); either way ``step()`` runs one scheduler iteration and
+``run()``/``drain()`` loop it.  Served tokens are bit-identical across the
+two modes and to solo ``generate()`` — deferred materialization reorders
+host work, never device math, and each request's PRNG chain still splits
+exactly like the solo path.  No threads — integrate into any host loop.
 
 Serving-plane observability (all off by default; the off path is an
 ``is None`` check per touch point):
@@ -52,6 +72,7 @@ Serving-plane observability (all off by default; the off path is an
 """
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from functools import partial
 from typing import Any, Callable, Sequence
@@ -76,6 +97,7 @@ from thunder_tpu.observability.tracing import RequestTracer
 from thunder_tpu.serving.kv_pool import (
     SINK_BLOCK,
     PagedKVPool,
+    chunk_tables,
     gather_dense,
     scatter_blocks,
     scatter_token,
@@ -231,9 +253,17 @@ class ServingEngine:
         flight_recorder=None,
         mesh=None,
         shardings=None,
+        async_step: bool = True,
+        prefill_chunk: int | None = None,
     ):
         if shardings is not None and mesh is None:
             raise ValueError("shardings= requires mesh= (param placement needs a mesh)")
+        self.async_step = bool(async_step)
+        if prefill_chunk is not None and not self.async_step:
+            raise ValueError(
+                "prefill_chunk= requires async_step=True — the chunked "
+                "prefill lane lives in the async event loop"
+            )
         self.mesh = mesh
         if mesh is not None:
             # SPMD serving: place params once (tp_fsdp-style rules unless
@@ -286,6 +316,7 @@ class ServingEngine:
             block_buckets=block_buckets,
             prefill_buckets=prefill_buckets,
             sliding_window=cfg.sliding_window,
+            prefill_chunk=prefill_chunk,
         )
         if getattr(cfg, "learned_pos_embedding", False):
             # wpe has block_size rows and dynamic_slice clamps silently past
@@ -307,8 +338,11 @@ class ServingEngine:
             # a block-aligned resume point near block_size would push the
             # padded prefill window past the wpe table (dynamic_slice clamps
             # the start — real tokens would read shifted embeddings), so
-            # suffix prefill is off the table for learned-pos models
+            # suffix prefill is off the table for learned-pos models; that
+            # rules out chunked prefill too (every chunk past the first is a
+            # suffix resume)
             self.prefix_sharing = False
+            sch.prefill_chunk = None
         self._table_widths = self._table_width_buckets()
         # telemetry: a StepLogger, a path for one, or None
         self._owns_telemetry = isinstance(telemetry, (str, bytes)) or hasattr(telemetry, "__fspath__")
@@ -327,9 +361,37 @@ class ServingEngine:
         # drive-loop accounting (mirrored into the registry as it changes)
         self.decode_steps = 0
         self.prefill_runs = 0
+        self.chunk_runs = 0
+        self.step_calls = 0
         self.tokens_generated = 0
         self._occupancy_sum = 0
-        self.compile_counts = {"prefill": 0, "decode": 0}
+        self.compile_counts = {"prefill": 0, "prefill_chunk": 0, "decode": 0}
+        # async lanes: the in-flight futures table — one deferred decode
+        # record plus any deferred prefill-piece records, harvested at the
+        # top of the next step (the only place the host blocks)
+        self._inflight_decode: dict | None = None
+        self._inflight_prefill: list[dict] = []
+        self._stall_s_sum = 0.0
+        self._overlap_frac_sum = 0.0
+        self._overlap_obs = 0
+        # chained decode inputs: while the batch and tables are unchanged,
+        # each decode step consumes the previous step's device outputs
+        # directly (no host->device transfer); see _decode_dispatch
+        self._decode_state: dict | None = None
+        # per-step metric handles resolved once (registry().reset() zeroes
+        # values but keeps objects, so these survive observability resets)
+        reg0 = registry()
+        self._m_steps_decode = reg0.counter("serving.steps.decode")
+        self._m_occupancy = reg0.histogram("serving.batch_occupancy")
+        self._m_tokens = reg0.counter("serving.tokens")
+        self._m_queue_depth = reg0.gauge("serving.queue_depth")
+        self._m_running = reg0.gauge("serving.running")
+        self._m_pool_util = reg0.gauge("serving.pool.utilization")
+        self._m_pool_free = reg0.gauge("serving.pool.free_blocks")
+        self._m_pool_low_water = reg0.gauge("serving.pool.free_blocks_low_water")
+        if self.async_step:
+            self._m_stall = reg0.histogram("serving.decode.stall_s")
+            self._m_overlap = reg0.gauge("serving.step.overlap_frac")
         self._compile_log: list[dict] = []               # per-bucket compile causes
         self._prefix_lookups = 0
         self._prefix_hits = 0
@@ -422,21 +484,26 @@ class ServingEngine:
         return handle
 
     def step(self) -> bool:
-        """One scheduler iteration: expire deadlines, admit + prefill while
-        capacity allows, then one decode step for the running batch.
-        Returns whether any work happened.  When a flight recorder is armed,
-        any exception out of the step auto-dumps the flight record before
-        propagating; when tracing is on, the step lands as an
-        ``engine.step`` span."""
+        """One event-loop iteration.  Async (default): harvest the in-flight
+        decode/prefill futures from step *k−1* (the one host block — the
+        idle backoff of every drive loop is this wait on the futures table,
+        never a busy poll), expire deadlines, dispatch decode for batch *k*,
+        then admit + dispatch prefill pieces while the device computes.
+        Sync (``async_step=False``): the original expire → admit+prefill →
+        one blocking decode.  Returns whether any work happened.  When a
+        flight recorder is armed, any exception out of the step auto-dumps
+        the flight record before propagating; when tracing is on, the step
+        lands as an ``engine.step`` span."""
         if self._closed:
             raise RuntimeError("engine is shut down")
+        self.step_calls += 1
         tr = self._tracer
         if tr is not None:
             tr.engine_begin("engine.step",
                             queued=len(self.scheduler.queue),
                             running=len(self.scheduler.running))
         try:
-            worked = self._step_inner()
+            worked = self._step_async() if self.async_step else self._step_inner()
         except Exception as e:
             if self._flight is not None:
                 self._flight.crash_dump(e)
@@ -448,6 +515,8 @@ class ServingEngine:
         return worked
 
     def _step_inner(self) -> bool:
+        """The synchronous scheduler iteration (``async_step=False``):
+        byte-identical to the pre-async engine."""
         worked = False
         for req in self.scheduler.deadline_expired():
             self._finish(req, FINISH_DEADLINE)
@@ -458,6 +527,68 @@ class ServingEngine:
             self._decode_once()
             worked = True
         self._update_gauges()
+        return worked
+
+    def _step_async(self) -> bool:
+        """One event-loop turn.  Phase order is the overlap contract:
+
+        1. **harvest** — materialize the previous step's in-flight decode
+           tokens and prefill pieces (stream callbacks, finishes, window
+           expiry land here, one device-latency late but in order);
+        2. expire deadlines (a request finished here is skipped by any
+           in-flight record that still names it);
+        3. **decode dispatch** for the decode-ready batch — the device
+           starts on step *k* while the host continues;
+        4. admissions + chunked-prefill advancement — all host/dispatch
+           work that overlaps the device's decode.
+        """
+        worked = self._harvest()
+        for req in self.scheduler.deadline_expired():
+            self._finish(req, FINISH_DEADLINE)
+            worked = True
+        if self.scheduler.decode_ready():
+            self._decode_once()
+            worked = True
+        while self._try_admit():
+            worked = True
+        if self._advance_prefills():
+            worked = True
+        self._update_gauges()
+        return worked
+
+    def _harvest(self) -> bool:
+        """Materializes every in-flight future (decode first: it was
+        dispatched before the prefill pieces, so the device finishes it
+        first).  This is where the host blocks — drive loops calling
+        ``step()`` back off *inside* this wait instead of busy-polling."""
+        worked = False
+        rec, self._inflight_decode = self._inflight_decode, None
+        if rec is not None:
+            self._decode_harvest(rec)
+            worked = True
+        pending, self._inflight_prefill = self._inflight_prefill, []
+        for prec in pending:
+            self._prefill_harvest(prec)
+            worked = True
+        if worked:
+            # every record above materialized at least one output of its
+            # program, so all of last step's donated-arena consumers have
+            # completed — dropping the parked handles is free now (doing it
+            # at dispatch would block the host for the whole device step)
+            self.pool.release_retired()
+        return worked
+
+    def _advance_prefills(self) -> bool:
+        """The prefill lane: dispatches the next chunk for every running
+        request whose prompt is not yet resident and has no piece already
+        in flight — at most one piece per request per step, so chunks
+        interleave 1:1 with decode dispatches."""
+        worked = False
+        inflight = {rec["req"].rid for rec in self._inflight_prefill}
+        for r in list(self.scheduler.running):
+            if r.pos < r.prompt_len and r.rid not in inflight:
+                self._inflight_prefill.append(self._prefill_dispatch(r))
+                worked = True
         return worked
 
     def run(self, requests: Sequence, *, max_new_tokens: int | None = None) -> list[RequestResult]:
@@ -486,9 +617,13 @@ class ServingEngine:
         return [h.result(drive=False) for h in handles]
 
     def drain(self) -> None:
-        """Steps until every submitted request has finished.  A stall (work
-        remains but no step can progress) raises :class:`EngineStalledError`
-        carrying the flight-recorder state snapshot."""
+        """Steps until every submitted request has finished.  Never a busy
+        poll: when every request is blocked on device work, the next
+        ``step()`` backs off *inside* the harvest of the in-flight futures
+        table (a bounded number of ``step()`` calls per token, asserted by
+        regression test).  A stall (work remains but no step can progress)
+        raises :class:`EngineStalledError` carrying the flight-recorder
+        state snapshot."""
         while self.scheduler.queue or self.scheduler.running:
             if not self.step():
                 raise EngineStalledError(
@@ -540,25 +675,35 @@ class ServingEngine:
         """Host-side engine statistics (registry-independent)."""
         occ = (self._occupancy_sum / self.decode_steps) if self.decode_steps else 0.0
         mesh = self.mesh_stats()
+        sch = self.scheduler
+        # program kinds a bucket may instantiate: decode per batch bucket,
+        # prefill per prefill bucket, plus the chunk kind when chunking is on
+        kinds = len(sch.batch_buckets) + len(sch.prefill_buckets) * (
+            2 if sch.prefill_chunk is not None else 1
+        )
+        n = self._overlap_obs
         return {
             **({"mesh": mesh} if mesh is not None else {}),
             **({"lora": self._registry.state_snapshot()} if self._registry is not None else {}),
-            "queue_depth": len(self.scheduler.queue),
-            "running": len(self.scheduler.running),
+            "queue_depth": len(sch.queue),
+            "running": len(sch.running),
             "pool_free_blocks": self.pool.num_free,
             "pool_free_blocks_low_water": self.pool.free_blocks_low_water,
             "pool_utilization": self.pool.utilization(),
             "kv_dtype": str(self.pool.kv_dtype),
             "arena_bytes": self.pool.arena_bytes(),
+            "async_step": self.async_step,
+            "prefill_chunk": sch.prefill_chunk,
             "decode_steps": self.decode_steps,
             "prefill_runs": self.prefill_runs,
+            "chunk_runs": self.chunk_runs,
+            "step_calls": self.step_calls,
             "tokens_generated": self.tokens_generated,
             "mean_batch_occupancy": occ,
+            "decode_stall_s_mean": (self._stall_s_sum / n) if n else None,
+            "overlap_frac_mean": (self._overlap_frac_sum / n) if n else None,
             "compile_counts": dict(self.compile_counts),
-            "bucket_bound": (
-                (len(self.scheduler.batch_buckets) + len(self.scheduler.prefill_buckets))
-                * len(self._table_widths)
-            ),
+            "bucket_bound": kinds * len(self._table_widths),
             "prefix_lookups": self._prefix_lookups,
             "prefix_hits": self._prefix_hits,
         }
@@ -575,10 +720,30 @@ class ServingEngine:
     def _flight_state(self) -> dict:
         """State snapshot the flight recorder embeds in every dump."""
         lookups = self._prefix_lookups
+        dec = self._inflight_decode
         return {
             "engine": self.stats(),                      # includes "mesh" when SPMD
             "scheduler": self.scheduler.state_snapshot(),
             "pool": self.pool.state_snapshot(),
+            # what each lane was doing: the in-flight futures plus every
+            # partially-prefilled request (a crash mid-overlap is
+            # undiagnosable without knowing what was still on the device)
+            "lanes": {
+                "async_step": self.async_step,
+                "decode_inflight": (
+                    {"step": dec["step"], "bucket": dec["bucket"],
+                     "rids": [r.rid for r in dec["running"]]}
+                    if dec is not None else None
+                ),
+                "prefill_inflight": [
+                    {"rid": rec["req"].rid, "kind": rec["kind"]}
+                    for rec in self._inflight_prefill
+                ],
+                "prefilling": [
+                    {"rid": r.rid, "pos": r.pos, "prompt_tokens": r.prompt_len}
+                    for r in self.scheduler.running if r.pos < r.prompt_len
+                ],
+            },
             "prefix_share_hit_rate": (self._prefix_hits / lookups) if lookups else None,
             "compiles": list(self._compile_log),         # per-bucket compile causes
             "slo": self.slo_report(),
@@ -594,26 +759,31 @@ class ServingEngine:
         ``sliding_window`` (which ``forward_with_cache`` would interpret as
         the ring layout — the pool always uses the plain slot-=-position
         layout; the window lives in the keep-mask), then extended so a
-        shared-prefix resume point plus prefill-bucket padding past the
-        largest block bucket still rounds up into the set.  ``stats()``'s
-        ``bucket_bound`` counts these widths, so :meth:`_nbb` may never
-        produce one outside them."""
+        shared-prefix or chunked-prefill resume point plus prefill-bucket
+        padding past the largest block bucket still rounds up into the set.
+        ``stats()``'s ``bucket_bound`` counts these widths, so :meth:`_nbb`
+        may never produce one outside them."""
         sch, bs = self.scheduler, self.pool.block_size
         W = self.cfg.sliding_window
+        chunk = sch.prefill_chunk
 
         def dodge(b: int) -> int:
             return b + 1 if W is not None and self.pool.capacity_tokens(b) == W else b
 
         widths = {dodge(b) for b in sch.block_buckets}
-        # widest dense window a prefill can touch: the largest block-aligned
-        # resume point plus a padded prefill bucket (prompts are capped by
-        # both the prefill buckets and the admission hard cap on blocks)
-        max_prompt = min(
-            sch.prefill_buckets[-1],
-            self.pool.capacity_tokens(min(self.pool.num_usable, sch.block_buckets[-1])),
+        # widest dense window a prefill piece can touch: the largest
+        # block-aligned resume point (shared prefix OR an earlier chunk)
+        # plus a padded prefill bucket.  Without chunking, prompts are
+        # capped by the prefill buckets; with it, only by the admission
+        # hard cap on blocks — but every piece is at most one chunk wide.
+        cap_tokens = self.pool.capacity_tokens(
+            min(self.pool.num_usable, sch.block_buckets[-1])
         )
-        max_resume = ((max_prompt - 1) // bs) * bs if self.prefix_sharing else 0
-        need = -(-(max_resume + pick_bucket(max_prompt, sch.prefill_buckets)) // bs)
+        max_prompt = cap_tokens if chunk is not None else min(sch.prefill_buckets[-1], cap_tokens)
+        resumes = self.prefix_sharing or chunk is not None
+        max_resume = ((max_prompt - 1) // bs) * bs if resumes else 0
+        piece = chunk if chunk is not None else pick_bucket(max_prompt, sch.prefill_buckets)
+        need = -(-(max_resume + piece) // bs)
         b = max(widths)
         while b < need:
             b *= 2
@@ -679,12 +849,19 @@ class ServingEngine:
             return False
         return all(t == b != SINK_BLOCK for t, b in zip(owner.block_table, blocks))
 
-    def _register_prefix(self, req: Request) -> None:
+    def _register_prefix(self, req: Request, upto: int | None = None) -> None:
+        """Registers ``req``'s block-aligned prompt prefixes.  ``upto``
+        bounds registration to tokens already *written* (a chunked prefill
+        registers after each piece; a sharer's later-dispatched program is
+        ordered behind the writes on the device stream, so it never gathers
+        an unwritten block)."""
         if not self.prefix_sharing:
             return
         bs = self.pool.block_size
+        limit = req.prompt_len if upto is None else min(upto, req.prompt_len)
+        hi = min((limit // bs) * bs, ((req.prompt_len - 1) // bs) * bs)
         toks = req.prompt.tolist()
-        for k in range(bs, ((req.prompt_len - 1) // bs) * bs + 1, bs):
+        for k in range(bs, hi + 1, bs):
             key = tuple(toks[:k])
             cur = self._prefix_index.get(key)
             if cur is None or not self._prefix_alive(cur):
@@ -697,67 +874,134 @@ class ServingEngine:
                 del self._prefix_index[k]
 
     def _prefill(self, req: Request) -> None:
+        """Admission-time prefill entry.  Sync: dispatch the whole prompt
+        and materialize inline (the original path).  Async: dispatch the
+        first piece (a chunk when the prompt exceeds ``prefill_chunk``,
+        else the whole remainder) and defer the harvest to the next step."""
+        rec = self._prefill_dispatch(req)
+        if self.async_step:
+            self._inflight_prefill.append(rec)
+        else:
+            self._prefill_harvest(rec)
+            self.pool.release_retired()     # token materialized: consumer done
+
+    def _prefill_dispatch(self, req: Request) -> dict:
+        """Dispatches the next prefill piece for ``req`` and returns its
+        in-flight record.  A piece is either a full ``prefill`` (samples
+        token 0, splits the request key exactly like solo ``generate()``)
+        or an intermediate ``prefill_chunk`` (writes KV only — no sampling,
+        no key split, so the final piece's draw stays bit-identical to the
+        unchunked prefill)."""
         sch, pool = self.scheduler, self.pool
         bs = pool.block_size
-        pos = req.n_shared_blocks * bs                     # block-aligned resume point
-        remainder = req.prompt[pos:]
-        Tb = sch.prefill_bucket(len(remainder))
+        pos = req.pos                                      # block-aligned resume point
+        remainder = req.prompt_len - pos
+        chunk = sch.prefill_chunk
+        final = chunk is None or remainder <= chunk
+        n_real = remainder if final else chunk
+        first = pos == req.n_shared_blocks * bs            # the admission piece
+        Tb = sch.prefill_bucket(n_real)
         nbb = self._nbb(max(len(req.block_table), -(-(pos + Tb) // bs)))
         toks = np.zeros(Tb, dtype=np.int32)
-        toks[: len(remainder)] = remainder
-        table = np.full(nbb, SINK_BLOCK, dtype=np.int32)
-        table[: len(req.block_table)] = req.block_table
-        # scatter back only the freshly written block range; everything else
-        # (shared prefix, future decode blocks, bucket padding) sinks
-        dest = np.full(nbb, SINK_BLOCK, dtype=np.int32)
-        lo, hi = pos // bs, min(len(req.block_table), -(-(pos + Tb) // bs))
-        dest[lo:hi] = req.block_table[lo:hi]
-        prog, compiled = self._program("prefill", Tb, nbb)
-        req.prefill_compiled = compiled
+        toks[:n_real] = req.prompt[pos:pos + n_real]
+        # gather the whole table; scatter back only the freshly written
+        # block range — everything else (shared prefix, earlier chunks,
+        # bucket padding) sinks (chunk granularity, see kv_pool.chunk_tables)
+        table, dest = chunk_tables(req.block_table, pos, Tb, nbb, bs)
+        kind = "prefill" if final else "prefill_chunk"
+        prog, compiled = self._program(kind, Tb, nbb)
+        req.prefill_compiled = req.prefill_compiled or compiled
+        # the dispatch phase is named by its dominant cost: a fresh program
+        # pays the XLA compile here, a cached one only dispatches
+        name = ("prefill.chunk" if not final
+                else "prefill.compile" if compiled else "prefill.dispatch")
         tr = self._tracer
         if tr is not None:
-            tr.begin(req.rid, "prefill", compile=compiled, bucket=[Tb, nbb],
-                     shared_blocks=req.n_shared_blocks)
-            # the dispatch phase is named by its dominant cost: a fresh
-            # program pays the XLA compile here, a cached one only dispatches
-            tr.begin(req.rid, "prefill.compile" if compiled else "prefill.dispatch")
-        tok, arenas, key, qerr = prog(
-            self.params, jnp.asarray(toks)[None], jnp.int32(pos), jnp.int32(len(remainder)),
-            pool.arenas, jnp.asarray(table), jnp.asarray(dest),
-            jnp.asarray(req.key),
-            self._lora_arenas(), jnp.asarray([req.adapter_slot], dtype=jnp.int32),
-        )
+            if first:
+                tr.begin(req.rid, "prefill", compile=compiled, bucket=[Tb, nbb],
+                         shared_blocks=req.n_shared_blocks, lane="prefill",
+                         chunked=not final)
+            tr.begin(req.rid, name, lane="prefill")
+        if final:
+            tok, arenas, key, qerr = prog(
+                self.params, jnp.asarray(toks)[None], jnp.int32(pos), jnp.int32(n_real),
+                pool.arenas, jnp.asarray(table), jnp.asarray(dest),
+                jnp.asarray(req.key),
+                self._lora_arenas(), jnp.asarray([req.adapter_slot], dtype=jnp.int32),
+            )
+            rec = {"kind": "prefill", "req": req, "tok": tok, "key": key,
+                   "qerr": qerr, "compiled": compiled, "span": name}
+        else:
+            arenas, qerr = prog(
+                self.params, jnp.asarray(toks)[None], jnp.int32(pos),
+                pool.arenas, jnp.asarray(table), jnp.asarray(dest),
+                self._lora_arenas(), jnp.asarray([req.adapter_slot], dtype=jnp.int32),
+            )
+            rec = {"kind": "chunk", "req": req, "qerr": qerr,
+                   "compiled": compiled, "span": name}
         pool.set_arenas(arenas)
-        if tr is not None:
-            tr.end(req.rid, "prefill.compile" if compiled else "prefill.dispatch")
-            tr.begin(req.rid, "prefill.host")
-        req.key = np.asarray(key)
-        req.pos = req.prompt_len                           # prompt KV resident
-        tok0 = int(np.asarray(tok)[0])                     # blocks until the device delivers
-        req.first_token_t = sch.clock()                    # TTFT = token availability, not dispatch
-        if tr is not None:
-            tr.end(req.rid, "prefill.host")
-            tr.end(req.rid, "prefill", compile=compiled)
-        self.prefill_runs += 1
-        self.tokens_generated += 1                         # prefill samples token 0
-        self._register_prefix(req)
+        req.pos = pos + n_real                             # written (device-ordered)
+        self._register_prefix(req, upto=req.pos)
         reg = registry()
-        reg.counter("serving.steps.prefill").inc()
-        reg.counter("serving.tokens").inc()
-        if pool.quantized_kv:
-            # measured int8 quantization error of THIS prefill's written
-            # blocks (sum|dq-x|/sum|x| over non-sink destinations)
-            reg.gauge("serving.kv_quant.rel_err").set(float(np.asarray(qerr)))
+        if final:
+            self.prefill_runs += 1
+            reg.counter("serving.steps.prefill").inc()
+        else:
+            self.chunk_runs += 1
+            reg.counter("serving.steps.prefill_chunk").inc()
         if compiled:
             # cold-compile TTFT outliers must be distinguishable from queue
             # delay: count prefill RUNS that paid a compile (vs
             # serving.compiles.prefill, which counts program builds)
             reg.counter("serving.prefill.compiles").inc()
-        if req.n_shared_blocks:
+        if first and req.n_shared_blocks:
             reg.counter("serving.prefix.shared_blocks").inc(req.n_shared_blocks)
         if self._flight is not None:
-            self._flight.record("prefill", rid=req.rid, compiled=compiled,
-                                bucket=[Tb, nbb], shared_blocks=req.n_shared_blocks)
+            self._flight.record("prefill" if final else "prefill_chunk",
+                                rid=req.rid, compiled=compiled,
+                                bucket=[Tb, nbb], pos=pos,
+                                shared_blocks=req.n_shared_blocks)
+        return rec
+
+    def _prefill_harvest(self, rec: dict) -> None:
+        """Materializes one prefill-piece record: chunks only settle the
+        measured quantization error; the final piece delivers token 0
+        (TTFT stamps here — token availability, not dispatch)."""
+        req, pool = rec["req"], self.pool
+        tr = self._tracer
+        if rec["kind"] == "chunk":
+            # the scalar fetch doubles as the fence on the chunk execution
+            # (release_retired relies on every harvested record having
+            # materialized an output of its program)
+            qerr = float(np.asarray(rec["qerr"]))
+            if pool.quantized_kv:
+                registry().gauge("serving.kv_quant.rel_err").set(qerr)
+            if tr is not None:
+                tr.end(req.rid, rec["span"], lane="prefill")
+            return
+        if tr is not None:
+            tr.end(req.rid, rec["span"])
+            tr.begin(req.rid, "prefill.host")
+        if req.state != "running":
+            # finished (deadline/evict) while the piece was in flight: the
+            # sampled token was never promised — drop it, close the span
+            if tr is not None:
+                tr.end(req.rid, "prefill.host")
+                tr.end(req.rid, "prefill", aborted=True)
+            return
+        req.key = np.asarray(rec["key"])
+        tok0 = int(np.asarray(rec["tok"])[0])              # blocks until the device delivers
+        req.first_token_t = self.scheduler.clock()         # TTFT = token availability, not dispatch
+        if tr is not None:
+            tr.end(req.rid, "prefill.host")
+            tr.end(req.rid, "prefill", compile=req.prefill_compiled)
+        self.tokens_generated += 1                         # prefill samples token 0
+        reg = registry()
+        reg.counter("serving.tokens").inc()
+        if pool.quantized_kv:
+            # measured quantization error of THIS prefill's written blocks
+            # (sum|dq-x|/sum|x| over non-sink destinations)
+            reg.gauge("serving.kv_quant.rel_err").set(float(np.asarray(rec["qerr"])))
         self._emit_token(req, tok0)
 
     #
@@ -765,76 +1009,140 @@ class ServingEngine:
     #
 
     def _decode_once(self) -> None:
+        """One decode-lane turn: dispatch the bucketed decode program for
+        the decode-ready batch; sync harvests inline, async parks the
+        record in the in-flight table for the next step's harvest."""
+        rec = self._decode_dispatch()
+        if self.async_step:
+            self._inflight_decode = rec
+        else:
+            self._decode_harvest(rec)
+            self.pool.release_retired()     # tokens materialized: consumer done
+
+    def _decode_dispatch(self) -> dict:
         sch, pool = self.scheduler, self.pool
-        running = list(sch.running)                        # FIFO admission order
-        Bb, _nbb_raw = sch.decode_bucket()
+        running = (sch.decode_ready() if self.async_step
+                   else list(sch.running))                 # FIFO admission order
+        Bb, _nbb_raw = sch.decode_bucket(running)
         nbb = self._nbb(_nbb_raw)
         bs = pool.block_size
-        toks = np.zeros(Bb, dtype=np.int32)
-        pos = np.zeros(Bb, dtype=np.int32)
-        tables = np.full((Bb, nbb), SINK_BLOCK, dtype=np.int32)
-        dest_block = np.full(Bb, SINK_BLOCK, dtype=np.int32)
-        dest_slot = np.zeros(Bb, dtype=np.int32)
-        keys = np.zeros((Bb, *np.shape(running[0].key)), dtype=np.asarray(running[0].key).dtype)
-        slots = np.zeros(Bb, dtype=np.int32)               # padding rows: base slot
-        for i, r in enumerate(running):
-            wpos = r.prompt_len + len(r.generated) - 1     # slot this step writes
-            toks[i] = r.generated[-1]
-            pos[i] = wpos
-            tables[i, : len(r.block_table)] = r.block_table
-            dest_block[i] = r.block_table[wpos // bs]
-            dest_slot[i] = wpos % bs
-            keys[i] = r.key
-            slots[i] = r.adapter_slot
+        sig = (tuple(r.rid for r in running), Bb, nbb)
+        st = self._decode_state
+        if st is not None and st["sig"] == sig:
+            # steady state: the batch composition and tables are unchanged
+            # since the last step, so this step's inputs ARE the previous
+            # step's device outputs (toks=nxt, keys=new_keys, pos=pos+1)
+            # plus the cached tables/slots — zero host->device transfers
+            toks_d, pos_d = st["toks"], st["pos"]
+            tables_d, keys_d, slots_d = st["tables"], st["keys"], st["slots"]
+            host_pos = st["host_pos"] + 1
+        else:
+            toks = np.zeros(Bb, dtype=np.int32)
+            host_pos = np.zeros(Bb, dtype=np.int32)
+            tables = np.full((Bb, nbb), SINK_BLOCK, dtype=np.int32)
+            keys = np.zeros((Bb, *np.shape(running[0].key)),
+                            dtype=np.asarray(running[0].key).dtype)
+            slots = np.zeros(Bb, dtype=np.int32)           # padding rows: base slot
+            for i, r in enumerate(running):
+                wpos = r.prompt_len + len(r.generated) - 1  # slot this step writes
+                toks[i] = r.generated[-1]
+                host_pos[i] = wpos
+                tables[i, : len(r.block_table)] = r.block_table
+                keys[i] = r.key
+                slots[i] = r.adapter_slot
+            # commit once; the chained steps reuse these device buffers
+            toks_d, pos_d = jnp.asarray(toks), jnp.asarray(host_pos)
+            tables_d, keys_d = jnp.asarray(tables), jnp.asarray(keys)
+            slots_d = jnp.asarray(slots)
         prog, compiled = self._program("decode", Bb, nbb)
         lora_arenas = self._lora_arenas()
         if self.mesh is not None and self._mesh_collectives is None:
             # census BEFORE the call: the arenas are donated by it
             self._mesh_collectives = self._collective_census(
                 ("decode", Bb, nbb), prog,
-                (self.params, toks, pos, tables, pool.arenas,
-                 dest_block, dest_slot, keys, lora_arenas, slots),
+                (self.params, toks_d, pos_d, tables_d, pool.arenas,
+                 keys_d, lora_arenas, slots_d),
             )
         tr = self._tracer
         if tr is not None:
             for r in running:
                 tr.begin(r.rid, "decode", step=self.decode_steps,
-                         compile=compiled, bucket=[Bb, nbb])
-        nxt, new_keys, arenas = prog(
-            self.params, jnp.asarray(toks), jnp.asarray(pos), jnp.asarray(tables),
-            pool.arenas, jnp.asarray(dest_block), jnp.asarray(dest_slot),
-            jnp.asarray(keys), lora_arenas, jnp.asarray(slots),
+                         compile=compiled, bucket=[Bb, nbb], lane="decode")
+        nxt, new_keys, new_pos, arenas = prog(
+            self.params, toks_d, pos_d, tables_d, pool.arenas,
+            keys_d, lora_arenas, slots_d,
         )
         pool.set_arenas(arenas)
-        nxt = np.asarray(nxt)
-        new_keys = np.asarray(new_keys)
+        self._decode_state = {
+            "sig": sig, "toks": nxt, "pos": new_pos, "tables": tables_d,
+            "keys": new_keys, "slots": slots_d, "host_pos": host_pos,
+        }
+        rec = {"kind": "decode", "running": running, "nxt": nxt,
+               "new_keys": new_keys, "pos": host_pos, "bucket": [Bb, nbb],
+               "compiled": compiled, "step": self.decode_steps,
+               "t_disp": time.perf_counter()}
+        self.decode_steps += 1
+        self._occupancy_sum += len(running)
+        self._m_steps_decode.inc()
+        self._m_occupancy.observe(len(running))
+        return rec
+
+    def _decode_harvest(self, rec: dict) -> None:
+        sch = self.scheduler
+        running = rec["running"]
+        t0 = time.perf_counter()
+        nxt = np.asarray(rec["nxt"])                       # the host block
+        new_keys = np.asarray(rec["new_keys"])
+        if self.async_step:
+            # overlap accounting: host work since dispatch vs the residual
+            # device wait the materialization just paid
+            stall = time.perf_counter() - t0
+            overlapped = t0 - rec["t_disp"]
+            frac = overlapped / (overlapped + stall) if (overlapped + stall) > 0 else 0.0
+            self._stall_s_sum += stall
+            self._overlap_frac_sum += frac
+            self._overlap_obs += 1
+            self._m_stall.observe(stall)
+            self._m_overlap.set(frac)
+        tr = self._tracer
         if tr is not None:                                 # tokens host-visible
             for r in running:
                 tr.end(r.rid, "decode")
         if self._flight is not None:
-            self._flight.record("decode", step=self.decode_steps,
-                                batch=len(running), bucket=[Bb, nbb],
-                                compiled=compiled,
+            self._flight.record("decode", step=rec["step"],
+                                batch=len(running), bucket=rec["bucket"],
+                                compiled=rec["compiled"],
                                 rids=[r.rid for r in running])
-        self.decode_steps += 1
-        self._occupancy_sum += len(running)
-        self.tokens_generated += len(running)
-        reg = registry()
-        reg.counter("serving.steps.decode").inc()
-        reg.counter("serving.tokens").inc(len(running))
-        reg.histogram("serving.batch_occupancy").observe(len(running))
+        pos = rec["pos"]
+        emitted = 0
+        invalidate = False
         for i, r in enumerate(running):
+            if r.state != "running":
+                invalidate = True                          # finished mid-flight: token never promised
+                continue
             r.key = new_keys[i]
             r.pos = int(pos[i]) + 1
             released = sch.expire_window_blocks(r)
             if released:
                 # every registered prefix of r starts at its (just-sunk)
-                # leading blocks — scrub before anyone can share them
+                # leading blocks — scrub before anyone can share them; the
+                # cached device tables are stale too
+                invalidate = True
                 self._unregister_prefix(r)
                 if self._flight is not None:
                     self._flight.record("window_expire", rid=r.rid,
                                         released=released)
+            emitted += 1
             self._emit_token(r, int(nxt[i]))
+            if r.state != "running":
+                invalidate = True                          # finished at this token
+        self.tokens_generated += emitted
+        if emitted:
+            self._m_tokens.inc(emitted)
+        if invalidate:
+            # the chained decode inputs assumed an unchanged batch/tables;
+            # the next dispatch rebuilds from host state
+            self._decode_state = None
 
     #
     # finishing / results
@@ -922,14 +1230,13 @@ class ServingEngine:
         )
 
     def _update_gauges(self) -> None:
-        reg = registry()
-        reg.gauge("serving.queue_depth").set(len(self.scheduler.queue))
-        reg.gauge("serving.running").set(len(self.scheduler.running))
-        reg.gauge("serving.pool.utilization").set(self.pool.utilization())
-        reg.gauge("serving.pool.free_blocks").set(self.pool.num_free)
+        self._m_queue_depth.set(len(self.scheduler.queue))
+        self._m_running.set(len(self.scheduler.running))
+        self._m_pool_util.set(self.pool.utilization())
+        self._m_pool_free.set(self.pool.num_free)
         # the post-mortem capacity floor: how close the pool ever came to
         # exhaustion (also in the flight-recorder pool snapshot)
-        reg.gauge("serving.pool.free_blocks_low_water").set(self.pool.free_blocks_low_water)
+        self._m_pool_low_water.set(self.pool.free_blocks_low_water)
 
     #
     # compiled bucket programs
@@ -977,7 +1284,10 @@ class ServingEngine:
         prog = _program_cache.get(gkey) if gkey is not None else None
         compiled = prog is None
         if compiled:
-            prog = self._build_prefill(a, b) if kind == "prefill" else self._build_decode(a, b)
+            build = {"prefill": self._build_prefill,
+                     "prefill_chunk": self._build_prefill_chunk,
+                     "decode": self._build_decode}[kind]
+            prog = build(a, b)
             # a genuinely new program for this geometry: count the compile
             self.compile_counts[kind] += 1
             self._compile_log.append({"kind": kind, "bucket": [a, b],
@@ -1074,16 +1384,69 @@ class ServingEngine:
 
         return prefill
 
-    def _build_decode(self, Bb: int, nbb: int) -> Callable:
-        cfg, fwd, temp = self.cfg, self._forward, self.temperature
+    def _build_prefill_chunk(self, Tb: int, nbb: int) -> Callable:
+        """An intermediate chunked-prefill piece: writes the chunk's KV into
+        the arenas and nothing else — no sampling, no key split (the final
+        ``prefill`` piece does both, so the request's draw stays
+        bit-identical to an unchunked prefill).  The logits head is traced
+        but unused, so XLA dead-code-eliminates the lm_head matmul — a
+        chunk is strictly cheaper than a same-width prefill."""
+        cfg, fwd = self.cfg, self._forward
         qkv = self.pool.quantized_kv
         cdtype = jnp.dtype(self.pool.dtype)
         cap = self.pool.capacity_tokens(nbb)
         cos_all, sin_all = build_rope_cache(cfg, cap)
 
+        @partial(jax.jit, donate_argnums=(3,), **self._jit_kwargs("prefill_chunk"))
+        def prefill_chunk(params, toks, pos, arenas, table, dest, lora, slot):
+            if qkv:
+                kd, vd = gather_dense_q(
+                    arenas["k"], arenas["v"], arenas["k_scale"], arenas["v_scale"],
+                    table[None, :], cdtype,
+                )
+            else:
+                kd, vd = gather_dense(arenas["k"], arenas["v"], table[None, :])
+            _logits, cache = fwd(
+                params, toks, pos, {"k": kd, "v": vd}, cos_all, sin_all, cfg,
+                **self._fwd_kwargs(lora, slot),
+            )
+            if qkv:
+                k_arena, k_scale, k_err = scatter_blocks_q(
+                    arenas["k"], arenas["k_scale"], cache["k"], dest)
+                v_arena, v_scale, v_err = scatter_blocks_q(
+                    arenas["v"], arenas["v_scale"], cache["v"], dest)
+                arenas = {"k": k_arena, "v": v_arena,
+                          "k_scale": k_scale, "v_scale": v_scale}
+                qerr = 0.5 * (k_err + v_err)
+            else:
+                arenas = {"k": scatter_blocks(arenas["k"], cache["k"], dest),
+                          "v": scatter_blocks(arenas["v"], cache["v"], dest)}
+                qerr = jnp.float32(0.0)
+            return arenas, qerr
+
+        return prefill_chunk
+
+    def _build_decode(self, Bb: int, nbb: int) -> Callable:
+        cfg, fwd, temp = self.cfg, self._forward, self.temperature
+        qkv = self.pool.quantized_kv
+        cdtype = jnp.dtype(self.pool.dtype)
+        bs = self.pool.block_size
+        cap = self.pool.capacity_tokens(nbb)
+        cos_all, sin_all = build_rope_cache(cfg, cap)
+
+        # The scatter destination is DERIVED inside the program (block =
+        # table[pos // bs], slot = pos % bs) and the program returns pos+1,
+        # so a steady-state decode step consumes only its predecessor's
+        # device outputs (toks=nxt, keys=new_keys, pos=new_pos) plus the
+        # cached tables/slots — zero host->device transfers per step (the
+        # engine's _decode_state chain).  Padding rows carry all-sink
+        # tables, and out-of-range block indices clamp to the row's last
+        # (sink) entry, so derived destinations stay sink-routed.
         @partial(jax.jit, donate_argnums=(4,), **self._jit_kwargs("decode"))
-        def decode(params, toks, pos, tables, arenas, dest_block, dest_slot, keys,
-                   lora, slots):
+        def decode(params, toks, pos, tables, arenas, keys, lora, slots):
+            dest_block = jnp.take_along_axis(
+                tables, (pos // bs)[:, None], axis=1)[:, 0]
+            dest_slot = pos % bs
             if qkv:
                 kd, vd = gather_dense_q(
                     arenas["k"], arenas["v"], arenas["k_scale"], arenas["v_scale"],
@@ -1119,7 +1482,7 @@ class ServingEngine:
             else:
                 arenas = {"k": scatter_token(arenas["k"], pick(kc, pos), dest_block, dest_slot),
                           "v": scatter_token(arenas["v"], pick(vc, pos), dest_block, dest_slot)}
-            return nxt, new_keys, arenas
+            return nxt, new_keys, pos + 1, arenas
 
         return decode
 
@@ -1145,5 +1508,15 @@ def serve(model_fn, params, cfg, **kwargs) -> ServingEngine:
     ``lora=AdapterRegistry(...)`` lets ``submit(..., adapter_id=...)``
     route each request through a registered LoRA adapter — batches freely
     mix tenants, and the compiled-program set grows only with the registry
-    *geometry* (rank, slots, targets), never with adapter ids."""
+    *geometry* (rank, slots, targets), never with adapter ids.
+
+    Async serving: ``async_step=True`` (default) runs ``step()`` as an
+    event loop — decode for batch *k* is dispatched and the host admits,
+    schedules, and streams batch *k−1*'s tokens before blocking
+    (``serving.step.overlap_frac`` measures the win); ``prefill_chunk=N``
+    additionally splits prompts longer than N into block-aligned chunks
+    dispatched one per step between decodes, so a long prompt neither
+    stalls running requests' TPOT nor hits the prompt-length admission cap.
+    ``async_step=False`` keeps the original fully synchronous loop
+    byte-identical; served tokens are bit-identical either way."""
     return ServingEngine(params, cfg, model_fn=model_fn, **kwargs)

@@ -22,11 +22,16 @@ Design points:
   block-aligned prompt prefix map their leading table entries to the same
   physical blocks (``share``), and a block returns to the free list only
   when its last owner releases it.
-- **Quantized block storage** (``kv_dtype="int8"``): the arenas store int8
-  values plus a float32 scale arena at per-block-slot, per-head granularity
-  (:mod:`thunder_tpu.serving.quant`) — ~``hs*itemsize/(hs+4)``× the
-  resident requests per arena byte, with quantize-on-scatter and
-  dequant-on-gather inside the jitted programs.
+- **Quantized block storage** (``kv_dtype="int8"`` or ``"fp8"``): the
+  arenas store 1-byte values plus a float32 scale arena at per-block-slot,
+  per-head granularity (:mod:`thunder_tpu.serving.quant`) —
+  ~``hs*itemsize/(hs+4)``× the resident requests per arena byte, with
+  quantize-on-scatter and dequant-on-gather inside the jitted programs.
+- **Chunk scatter granularity**: a prefill piece (whole prompt, shared-
+  prefix suffix, or one chunk of a chunked prefill) writes only the block
+  range its tokens cover — :func:`chunk_tables` builds the sink-padded
+  gather/scatter tables for any ``[pos, pos + n)`` token window, so the
+  prefill and chunked-prefill lanes share one granularity rule.
 - The pool owns only the *allocator* state (host-side, O(num_blocks) ints)
   and the arena arrays.  All array movement (gather/scatter) is pure
   jnp code in :mod:`thunder_tpu.serving.engine`'s jitted bucket programs,
@@ -45,9 +50,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from thunder_tpu.models.generate import kv_block_shape
-from thunder_tpu.serving.quant import resolve_kv_dtype
+from thunder_tpu.serving.quant import is_quantized_kv, resolve_kv_dtype
 
-__all__ = ["PoolExhaustedError", "ArenaMismatchError", "PagedKVPool"]
+__all__ = ["PoolExhaustedError", "ArenaMismatchError", "PagedKVPool", "chunk_tables"]
 
 SINK_BLOCK = 0  # reserved physical block for padding/expired table entries
 
@@ -107,7 +112,7 @@ class PagedKVPool:
         self.block_size = int(block_size)
         self.dtype = dtype                              # compute/dequant dtype
         self.kv_dtype = resolve_kv_dtype(kv_dtype, dtype)  # storage dtype
-        self.quantized_kv = self.kv_dtype == jnp.dtype(jnp.int8)
+        self.quantized_kv = is_quantized_kv(self.kv_dtype, dtype)
         self.mesh = mesh
         shape = (self.num_blocks, *kv_block_shape(cfg, self.block_size))
         self._arena_shape = shape
@@ -138,6 +143,9 @@ class PagedKVPool:
             self.v_scale = zeros(self._scale_shape, jnp.float32)
         else:
             self.k_scale = self.v_scale = None
+        # outgoing donated arena handles, parked until their consumer
+        # completes (see set_arenas/release_retired)
+        self._retired: list = []
         # block 0 is permanently leased to the sink
         self._refcount = np.zeros(self.num_blocks, dtype=np.int32)
         self._refcount[SINK_BLOCK] = 1
@@ -314,11 +322,26 @@ class PagedKVPool:
             )
         for name, arr in arenas.items():
             self._check_arena(name, arr)
+        # park the outgoing handles instead of letting them die here:
+        # dropping the LAST reference to a jax Array that was DONATED to a
+        # still-running execution blocks the host until that execution
+        # completes — measured ~the full device step, i.e. it silently
+        # serializes the async engine's overlap.  The engine calls
+        # release_retired() at harvest, when the consumer has finished and
+        # the deref costs microseconds.
+        self._retired.append((self.k_arena, self.v_arena,
+                              self.k_scale, self.v_scale))
         self.k_arena = arenas["k"]
         self.v_arena = arenas["v"]
         if self.quantized_kv:
             self.k_scale = arenas["k_scale"]
             self.v_scale = arenas["v_scale"]
+
+    def release_retired(self) -> None:
+        """Drops the parked donated-arena handles (cheap once their
+        consuming executions have completed — call after materializing any
+        later output of the same device stream)."""
+        self._retired.clear()
 
     def update_arenas(self, k_arena: jax.Array, v_arena: jax.Array,
                       k_scale: jax.Array | None = None,
@@ -330,6 +353,39 @@ class PagedKVPool:
             arenas["k_scale"] = k_scale
             arenas["v_scale"] = v_scale
         self.set_arenas(arenas)
+
+
+def chunk_tables(block_table, pos: int, n_tokens: int, nbb: int,
+                 block_size: int) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side gather/scatter tables for one prefill piece at **chunk
+    granularity**.
+
+    A prefill piece (a whole prompt, a shared-prefix suffix, or one chunk
+    of a chunked prefill) computes K/V for the ``n_tokens`` positions at
+    ``[pos, pos + n_tokens)`` of a request holding ``block_table``.
+    Returns ``(table, dest)`` int32 arrays of width ``nbb`` (the padded
+    program table width):
+
+    - ``table`` — the gather side: every leased block, sink-padded to
+      ``nbb``, so the dense window the program reassembles covers the
+      already-written prefix (earlier chunks / shared blocks);
+    - ``dest`` — the scatter side: only the block range
+      ``[pos // bs, ceil((pos + n_tokens) / bs))`` this piece writes;
+      every other entry (shared prefix, earlier chunks, bucket padding
+      beyond the leased table) routes to the sink block, so a piece never
+      writes blocks another piece owns the write for.  ``n_tokens`` may be
+      the *padded* bucket width: trailing padding that spills into leased
+      future-decode blocks writes garbage that decode overwrites slot by
+      slot before ever attending it (the same invariant padding always
+      relied on).
+    """
+    bs = block_size
+    table = np.full(nbb, SINK_BLOCK, dtype=np.int32)
+    table[: len(block_table)] = block_table
+    dest = np.full(nbb, SINK_BLOCK, dtype=np.int32)
+    lo, hi = pos // bs, min(len(block_table), -(-(pos + n_tokens) // bs))
+    dest[lo:hi] = block_table[lo:hi]
+    return table, dest
 
 
 def gather_dense(k_arena, v_arena, tables):

@@ -102,12 +102,23 @@ def program_shardings(kind: str, params, mesh: Mesh, arena_sh: NamedSharding) ->
     like the data arenas, so the spec applies to both ranks.
 
     Argument orders match ``ServingEngine._build_prefill`` /
-    ``_build_decode`` exactly:
+    ``_build_prefill_chunk`` / ``_build_decode`` exactly:
 
     - prefill: ``(params, toks, pos, n_real, arenas, table, dest, key,
       lora, slot)`` → ``(tok, arenas, key, qerr)``
-    - decode:  ``(params, toks, pos, tables, arenas, dest_block, dest_slot,
-      keys, lora, slots)`` → ``(nxt, new_keys, arenas)``
+    - prefill_chunk: ``(params, toks, pos, arenas, table, dest, lora,
+      slot)`` → ``(arenas, qerr)``
+    - decode:  ``(params, toks, pos, tables, arenas, keys, lora, slots)``
+      → ``(nxt, new_keys, new_pos, arenas)`` (scatter destinations are
+      derived in-program from ``tables``/``pos``, and the returned device
+      outputs chain into the next step's inputs)
+
+    Donation composes with the async engine's deferred materialization:
+    the returned arena pytree carries the same per-shard sharding in and
+    out, so while the host defers ``np.asarray`` on the small replicated
+    outputs (tokens/keys), the donated shard-local arena buffers chain
+    directly into the next dispatched program — no reshard, no gather,
+    whether or not anything has materialized yet.
     """
     repl = NamedSharding(mesh, P())
     param_sh = jax.tree_util.tree_map(lambda x: x.sharding, params)
@@ -116,10 +127,15 @@ def program_shardings(kind: str, params, mesh: Mesh, arena_sh: NamedSharding) ->
             in_shardings=(param_sh, repl, repl, repl, arena_sh, repl, repl, repl, repl, repl),
             out_shardings=(repl, arena_sh, repl, repl),
         )
+    if kind == "prefill_chunk":
+        return dict(
+            in_shardings=(param_sh, repl, repl, arena_sh, repl, repl, repl, repl),
+            out_shardings=(arena_sh, repl),
+        )
     assert kind == "decode", kind
     return dict(
-        in_shardings=(param_sh, repl, repl, repl, arena_sh, repl, repl, repl, repl, repl),
-        out_shardings=(repl, repl, arena_sh),
+        in_shardings=(param_sh, repl, repl, repl, arena_sh, repl, repl, repl),
+        out_shardings=(repl, repl, repl, arena_sh),
     )
 
 

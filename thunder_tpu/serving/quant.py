@@ -1,12 +1,14 @@
-"""Int8 KV block storage for the paged serving pool.
+"""Quantized (int8 / fp8-e4m3) KV block storage for the paged serving pool.
 
 Pool capacity is the admission-control bottleneck of the serving subsystem,
 and capacity is bytes: every block stored at full ``dtype`` width caps how
 many requests can be resident at once.  This module stores the K/V arenas
-as **int8** with a float32 scale arena at **per-block-slot, per-head**
-granularity — one symmetric absmax scale for each ``(block, layer,
-kv_group, slot)`` coordinate, i.e. an absmax over the ``head_size`` values
-of one token's K (or V) for one head:
+in a **1-byte storage dtype** — ``int8`` (symmetric absmax) or
+``float8_e4m3fn`` (absmax-scaled to the e4m3 dynamic range; ``fp8``) — with
+a float32 scale arena at **per-block-slot, per-head** granularity — one
+symmetric absmax scale for each ``(block, layer, kv_group, slot)``
+coordinate, i.e. an absmax over the ``head_size`` values of one token's K
+(or V) for one head:
 
 - ``quantize_kv``: symmetric absmax int8 over the last (``hs``) dim —
   deterministic per token, so a request's stored KV never depends on what
@@ -19,16 +21,20 @@ of one token's K (or V) for one head:
   :func:`models.generate.cache_shape` layout ``forward_with_cache``
   consumes, in the pool's compute dtype.
 
-Capacity math: a stored slot-head costs ``hs`` bytes of int8 plus 4 bytes
-of scale instead of ``hs * itemsize`` — ``hs*4 / (hs+4)`` more blocks per
-arena byte vs a float32 pool (3.2x at ``hs=16``, 3.76x at ``hs=64``;
-``bench.py capacity`` gates the measured admitted-concurrency win).
+Capacity math: a stored slot-head costs ``hs`` bytes (int8 or fp8) plus 4
+bytes of scale instead of ``hs * itemsize`` — ``hs*4 / (hs+4)`` more blocks
+per arena byte vs a float32 pool (3.2x at ``hs=16``, 3.76x at ``hs=64``;
+``bench.py capacity`` gates the measured admitted-concurrency win).  int8
+and fp8 cost identical bytes; they differ only in error shape.
 
 Error model: absmax int8 keeps ~2 decimal digits; expect ~1e-2 relative
 error on the stored KV (the ``serving.kv_quant.rel_err`` gauge reports the
-measured value per prefill).  Greedy tokens match the full-precision cache
-whenever logit margins exceed that noise — the tiny-llama greedy
-differential test asserts exact argmax-token parity.
+measured value per prefill).  fp8 e4m3 has 3 mantissa bits (~3e-2 relative
+per element) but a sign-magnitude float grid, so small-magnitude values
+keep relative precision where int8's uniform grid loses them.  Greedy
+tokens match the full-precision cache whenever logit margins exceed that
+noise — the tiny-llama greedy differential tests assert exact argmax-token
+parity for both storage dtypes.
 
 In mesh mode the scale arenas shard by the same
 ``distributed.kv_cache_spec`` rule as the data arenas (heads dim at axis 2
@@ -42,6 +48,7 @@ from thunder_tpu.models.generate import kv_block_shape
 
 __all__ = [
     "resolve_kv_dtype",
+    "is_quantized_kv",
     "quantize_kv",
     "dequantize_kv",
     "gather_dense_q",
@@ -53,35 +60,81 @@ __all__ = [
 
 _SINK = 0  # kv_pool.SINK_BLOCK (not imported: kv_pool imports this module)
 
+# fp8 storage is gated on the jax build actually shipping the dtype (the
+# ml_dtypes extended-float set); older builds fall back to a clear error
+_FP8_DTYPE = getattr(jnp, "float8_e4m3fn", None)
+_FP8_ALIASES = ("fp8", "e4m3", "float8_e4m3fn")
+
+
+def _qmax(storage) -> float:
+    """Largest representable magnitude of a quantized storage dtype — the
+    absmax scale divisor (127 for int8, 448 for fp8 e4m3)."""
+    storage = jnp.dtype(storage)
+    if storage == jnp.dtype(jnp.int8):
+        return 127.0
+    return float(jnp.finfo(storage).max)          # 448.0 for e4m3fn
+
 
 def resolve_kv_dtype(kv_dtype, dtype):
     """Storage dtype of the block arenas: ``None`` keeps today's behavior
     (store at the compute ``dtype``); ``"int8"``/``jnp.int8`` selects the
-    quantized path.  Any other storage dtype is rejected — silent float
-    truncation is exactly what this module replaces."""
+    int8 quantized path; ``"fp8"``/``"e4m3"``/``jnp.float8_e4m3fn`` the
+    fp8 one.  Any other storage dtype is rejected — silent float truncation
+    is exactly what this module replaces."""
     if kv_dtype is None:
         return jnp.dtype(dtype)
+    if isinstance(kv_dtype, str) and kv_dtype.lower() in _FP8_ALIASES:
+        if _FP8_DTYPE is None:
+            raise ValueError(
+                "kv_dtype='fp8' requires a jax build with float8_e4m3fn "
+                "(jax.numpy.float8_e4m3fn is missing here)"
+            )
+        return jnp.dtype(_FP8_DTYPE)
     kd = jnp.dtype(kv_dtype)
     if kd == jnp.dtype(jnp.int8):
+        return kd
+    if _FP8_DTYPE is not None and kd == jnp.dtype(_FP8_DTYPE):
         return kd
     if kd == jnp.dtype(dtype):
         return kd
     raise ValueError(
         f"unsupported kv_dtype {kv_dtype!r}: use None (store at the compute "
-        f"dtype {jnp.dtype(dtype)}) or 'int8' (quantized block storage)"
+        f"dtype {jnp.dtype(dtype)}), 'int8', or 'fp8' (quantized block "
+        f"storage)"
     )
 
 
-def quantize_kv(x):
-    """Symmetric absmax int8 over the last (``hs``) dim.
+def is_quantized_kv(kv_dtype, dtype) -> bool:
+    """Whether a resolved storage dtype takes the quantize/scale-arena path
+    (1-byte storage that is NOT the compute dtype itself)."""
+    kd = jnp.dtype(kv_dtype)
+    if kd == jnp.dtype(dtype):
+        return False
+    if kd == jnp.dtype(jnp.int8):
+        return True
+    return _FP8_DTYPE is not None and kd == jnp.dtype(_FP8_DTYPE)
 
-    Returns ``(q, scale)`` with ``q`` int8 shaped like ``x`` and ``scale``
-    float32 shaped ``x.shape[:-1]``.  All-zero rows get scale 1.0 (exact).
-    Pure jnp; call inside jit."""
+
+def quantize_kv(x, storage=jnp.int8):
+    """Symmetric absmax quantization over the last (``hs``) dim into
+    ``storage`` (int8: round-and-clip to ±127; fp8 e4m3: scale the absmax
+    onto ±448 and let the cast round).
+
+    Returns ``(q, scale)`` with ``q`` in ``storage`` shaped like ``x`` and
+    ``scale`` float32 shaped ``x.shape[:-1]``.  All-zero rows get scale 1.0
+    (exact).  Deterministic per token either way, so a request's stored KV
+    never depends on batch composition.  Pure jnp; call inside jit."""
+    storage = jnp.dtype(storage)
+    qmax = _qmax(storage)
     xf = x.astype(jnp.float32)
     amax = jnp.max(jnp.abs(xf), axis=-1)
-    scale = jnp.where(amax == 0.0, 1.0, amax / 127.0)
-    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127).astype(jnp.int8)
+    scale = jnp.where(amax == 0.0, 1.0, amax / qmax)
+    if storage == jnp.dtype(jnp.int8):
+        q = jnp.clip(jnp.round(xf / scale[..., None]), -qmax, qmax).astype(storage)
+    else:
+        # the scaled max lands exactly on ±qmax (representable in e4m3);
+        # the cast rounds everything else to the nearest fp8 grid point
+        q = (xf / scale[..., None]).astype(storage)
     return q, scale
 
 
@@ -92,7 +145,8 @@ def dequantize_kv(q, scale, dtype=jnp.float32):
 
 def gather_dense_q(k_arena, v_arena, k_scale, v_scale, tables, dtype):
     """Quantized twin of :func:`kv_pool.gather_dense`: reassembles dense
-    caches from int8 block tables, dequantizing into ``dtype``.
+    caches from quantized (int8 or fp8) block arenas, dequantizing into
+    ``dtype``.
 
     ``tables``: (B, nb) int32 physical-block ids (sink-padded).  Returns
     ``k, v`` of shape (L, B, ng, nb*bs, hs) — the layout
@@ -114,9 +168,10 @@ def scatter_token_q(arena, scale_arena, new_kv, dest_block, dest_slot):
     token's K (or V) per batch row and writes value + scale.
 
     ``new_kv``: (B, L, ng, hs) in compute dtype; ``dest_block``/``dest_slot``:
-    (B,) int32 (sink-routed for padding rows).  Pure jnp; call inside jit on
-    donated arenas."""
-    q, s = quantize_kv(new_kv)                     # (B, L, ng, hs) / (B, L, ng)
+    (B,) int32 (sink-routed for padding rows).  The storage dtype comes from
+    the arena itself (int8 or fp8).  Pure jnp; call inside jit on donated
+    arenas."""
+    q, s = quantize_kv(new_kv, arena.dtype)        # (B, L, ng, hs) / (B, L, ng)
     arena = arena.at[dest_block, :, :, dest_slot, :].set(q)
     scale_arena = scale_arena.at[dest_block, :, :, dest_slot].set(s)
     return arena, scale_arena
@@ -137,13 +192,13 @@ def scatter_blocks_q(arena, scale_arena, dense, dest_table):
 
         raise ArenaMismatchError(
             "scatter", "dtype", "floating source", jnp.dtype(dense.dtype),
-            msg=f"scatter_blocks_q quantizes a float dense cache into an int8 "
-                f"arena; got source dtype {jnp.dtype(dense.dtype)}",
+            msg=f"scatter_blocks_q quantizes a float dense cache into a "
+                f"quantized arena; got source dtype {jnp.dtype(dense.dtype)}",
         )
     L, B, ng, cap, hs = dense.shape
     bs = arena.shape[3]
     blocks = dense[:, 0].reshape(L, ng, cap // bs, bs, hs).transpose(2, 0, 1, 3, 4)
-    q, s = quantize_kv(blocks)                     # (nb, L, ng, bs, hs) / (nb, L, ng, bs)
+    q, s = quantize_kv(blocks, arena.dtype)        # (nb, L, ng, bs, hs) / (nb, L, ng, bs)
     dq = q.astype(jnp.float32) * s[..., None]
     xf = blocks.astype(jnp.float32)
     m = (dest_table != _SINK).astype(jnp.float32)[:, None, None, None, None]
@@ -165,7 +220,7 @@ def arena_block_bytes(cfg, block_size: int, dtype, kv_dtype=None) -> int:
     L, ng, bs, hs = kv_block_shape(cfg, block_size)
     storage = resolve_kv_dtype(kv_dtype, dtype)
     per_side = L * ng * bs * hs * storage.itemsize
-    if storage == jnp.dtype(jnp.int8):
+    if is_quantized_kv(storage, dtype):
         per_side += L * ng * bs * 4                # float32 scale per slot-head
     return 2 * per_side
 

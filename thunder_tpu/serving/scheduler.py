@@ -24,6 +24,14 @@ finished — and every policy decision:
   has slid out of the attention window are released back to the pool and
   the table entry falls back to the sink block (the positional keep-mask
   already excludes those slots, so correctness is unaffected).
+- **Chunked prefill** (``prefill_chunk=``): long prompts prefill in
+  block-aligned pieces of at most ``prefill_chunk`` tokens, one piece per
+  engine step, so a long prompt never monopolizes a step.  A request whose
+  prompt is not yet fully resident is *running but not decode-ready*
+  (``pos < prompt_len``); :meth:`Scheduler.decode_ready` filters the batch
+  the decode lane dispatches.  With chunking enabled the prompt-length
+  admission cap is the pool/block-bucket capacity, not the largest prefill
+  bucket — each piece is bounded by the bucket set instead.
 """
 from __future__ import annotations
 
@@ -137,6 +145,7 @@ class Scheduler:
         block_buckets: Sequence[int] | None = None,
         prefill_buckets: Sequence[int] | None = None,
         sliding_window: int | None = None,
+        prefill_chunk: int | None = None,
     ):
         self.pool = pool
         self.max_batch = int(max_batch)
@@ -150,6 +159,23 @@ class Scheduler:
             tuple(prefill_buckets) if prefill_buckets
             else pow2_buckets(min(8, pool.block_size), pool.capacity_tokens(max_blocks))
         )
+        if prefill_chunk is not None:
+            prefill_chunk = int(prefill_chunk)
+            bs = pool.block_size
+            if prefill_chunk < bs or prefill_chunk % bs:
+                raise ValueError(
+                    f"prefill_chunk={prefill_chunk} must be a positive "
+                    f"multiple of the pool block_size ({bs}) so every chunk "
+                    f"boundary is block-aligned"
+                )
+            if pick_bucket(prefill_chunk, self.prefill_buckets) != prefill_chunk:
+                raise ValueError(
+                    f"prefill_chunk={prefill_chunk} is not itself a prefill "
+                    f"bucket ({self.prefill_buckets}); intermediate chunks "
+                    f"must bucket to exactly their own length (zero padding) "
+                    f"so a chunk never writes past its block range"
+                )
+        self.prefill_chunk = prefill_chunk
         self.queue: deque[Request] = deque()
         self.running: list[Request] = []     # admission order == FIFO batch order
         self._ids = itertools.count()
@@ -197,7 +223,10 @@ class Scheduler:
                 f"request needs {self.blocks_needed(req)} blocks; the pool/bucket "
                 f"cap is {hard_cap} — it can never be admitted"
             )
-        if req.prompt_len > self.prefill_buckets[-1]:
+        if self.prefill_chunk is None and req.prompt_len > self.prefill_buckets[-1]:
+            # with chunking enabled the prompt prefills in pieces bounded by
+            # the bucket set, so only the pool/block-bucket capacity (checked
+            # above) caps prompt length
             raise AdmissionError(
                 f"prompt of {req.prompt_len} tokens exceeds the largest prefill "
                 f"bucket {self.prefill_buckets[-1]} — it can never be admitted"
@@ -226,6 +255,10 @@ class Scheduler:
         self.queue.popleft()
         req.block_table = block_table
         req.n_shared_blocks = n_shared
+        # the block-aligned prefill resume point: tokens below it are
+        # resident via the shared prefix; prefill pieces advance pos from
+        # here (chunked prefill dispatches one piece per engine step)
+        req.pos = n_shared * self.pool.block_size
         req.state = "running"
         req.admit_t = self.clock()
         self.running.append(req)
@@ -290,6 +323,7 @@ class Scheduler:
                 "generated": len(r.generated),
                 "max_new_tokens": r.max_new_tokens,
                 "pos": r.pos,
+                "prefilled": r.pos >= r.prompt_len,
                 "blocks": len(r.block_table),
                 "reserved_bytes": self.bytes_needed(r),
                 "shared_blocks": r.n_shared_blocks,
@@ -306,6 +340,7 @@ class Scheduler:
             "batch_buckets": list(self.batch_buckets),
             "block_buckets": list(self.block_buckets),
             "prefill_buckets": list(self.prefill_buckets),
+            "prefill_chunk": self.prefill_chunk,
             "requests": [row(r) for r in (*self.running, *self.queue)],
         }
 
@@ -313,10 +348,20 @@ class Scheduler:
     # bucket selection
     #
 
-    def decode_bucket(self) -> tuple[int, int]:
-        """(batch bucket, table-width bucket) for the current running set."""
-        B = pick_bucket(len(self.running), self.batch_buckets)
-        widest = max(len(r.block_table) for r in self.running)
+    def decode_ready(self) -> list[Request]:
+        """Running requests the decode lane may advance this step, in FIFO
+        admission order: the prompt is fully resident AND the first token
+        exists (a chunked prefill in progress, or a final chunk whose token
+        is still in flight, keeps the request out of the decode batch)."""
+        return [r for r in self.running if r.generated and r.pos >= r.prompt_len]
+
+    def decode_bucket(self, ready: Sequence[Request] | None = None) -> tuple[int, int]:
+        """(batch bucket, table-width bucket) for the decode batch
+        (``ready`` defaults to the whole running set — the synchronous
+        engine, where running implies decode-ready)."""
+        rows = list(ready) if ready is not None else self.running
+        B = pick_bucket(len(rows), self.batch_buckets)
+        widest = max(len(r.block_table) for r in rows)
         return B, pick_bucket(widest, self.block_buckets)
 
     def prefill_bucket(self, n_tokens: int) -> int:

@@ -29,6 +29,7 @@ __all__ = [
     "check_donation_off_overhead",
     "check_micro_baseline_schema",
     "check_serving_targets",
+    "check_serving_async_targets",
     "check_serving_mesh_targets",
     "check_tracing_targets",
     "check_capacity_targets",
@@ -108,6 +109,67 @@ def check_serving_targets(artifact: dict | None = None, *, min_ratio: float = 1.
             f"paid an XLA compile — the steady-state TTFT numbers are "
             f"polluted by cold starts"
         )
+    return artifact
+
+
+def check_serving_async_targets(artifact: dict | None = None, *,
+                                min_improvement: float = 2.0) -> dict:
+    """Validates the BENCH_SERVING_ASYNC.json artifact: schema, sanity
+    (the batch actually shared decode steps; the async engine actually
+    chunked and overlapped — an engine that silently fell back to the sync
+    path would "win" a 1.0x ratio), the headline claim (short-cohort TTFT
+    p95 under long-prompt contention at least ``min_improvement``x better
+    than the synchronous engine), **exact** token parity between the two
+    engines (a latency win from a diverging engine is meaningless), the
+    chunk-extended bucket bound, and the compile-free measured window.
+    Returns the artifact for chaining."""
+    if artifact is None:
+        artifact = load_artifact("BENCH_SERVING_ASYNC.json")
+    assert "backend" in artifact and "results" in artifact, sorted(artifact)
+    r = artifact["results"]
+    for key in (
+        "sync_short_ttft_p95_s", "async_short_ttft_p95_s",
+        "ttft_p95_improvement_x", "token_parity_exact",
+        "mean_batch_occupancy", "overlap_frac_mean", "chunk_runs",
+        "prefill_compiles", "prefill_chunk_compiles", "decode_compiles",
+        "bucket_bound", "cold_compile_prefills_measured",
+    ):
+        assert key in r, (key, sorted(r))
+    assert r["sync_short_ttft_p95_s"] > 0 and r["async_short_ttft_p95_s"] > 0, r
+    assert r["token_parity_exact"] is True, (
+        "async-served tokens diverged from the synchronous engine — the "
+        "TTFT comparison is void (deferred materialization must reorder "
+        "host work, never device math)"
+    )
+    assert r["mean_batch_occupancy"] > 1.0, (
+        f"mean batch occupancy {r['mean_batch_occupancy']} <= 1: requests "
+        f"never actually shared a decode step"
+    )
+    assert r["chunk_runs"] > 0, (
+        "the async engine ran zero prefill chunks — the long prompts were "
+        "not actually chunked, so this measured nothing"
+    )
+    assert 0 < r["overlap_frac_mean"] <= 1.0, (
+        f"overlap_frac_mean {r['overlap_frac_mean']} outside (0, 1] — the "
+        f"host did no work while the device computed, i.e. the async "
+        f"engine is not overlapping"
+    )
+    assert r["ttft_p95_improvement_x"] >= min_improvement, (
+        f"async short-cohort TTFT p95 only {r['ttft_p95_improvement_x']:.2f}x "
+        f"better than the sync engine under long-prompt contention "
+        f"(< {min_improvement}x) — chunked prefill is not protecting TTFT"
+    )
+    compiles = (r["prefill_compiles"] + r["prefill_chunk_compiles"]
+                + r["decode_compiles"])
+    assert compiles <= r["bucket_bound"], (
+        f"{compiles} compiled programs exceed the chunk-extended bucket "
+        f"bound {r['bucket_bound']} — chunking is leaking program shapes"
+    )
+    assert r["cold_compile_prefills_measured"] == 0, (
+        f"{r['cold_compile_prefills_measured']} measured-engine prefills "
+        f"paid an XLA compile — the TTFT percentiles are polluted by cold "
+        f"starts"
+    )
     return artifact
 
 

@@ -921,6 +921,32 @@ def main():
             "results": out["results"],
         }))
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "recovery":
+        # fault-tolerance bench: re-prefill recovery vs a cold engine
+        # restart at the same resume point, injected-fault token parity
+        # (retry + arena-rebuild paths both fire), and the armed-but-silent
+        # FaultPlan overhead.  Host work only, no TPU probe; artifact uses
+        # the BENCH_MICRO schema.
+        from thunder_tpu._platform import force_cpu
+
+        force_cpu()
+        from thunder_tpu.benchmarks.recovery import recovery_bench
+
+        out = recovery_bench(on_tpu=False)
+        artifact = {"backend": jax.default_backend(), **out}
+        with open("BENCH_RECOVERY.json", "w") as f:
+            json.dump(artifact, f, indent=1)
+        for k, v in out["results"].items():
+            log(f"recovery {k}: {v}")
+        print(json.dumps({
+            "metric": "recovery_vs_cold_restart_speedup_x",
+            "value": out["results"]["speedup_x"],
+            "unit": "x",
+            # the cold restart IS the baseline of this ratio
+            "vs_baseline": out["results"]["speedup_x"],
+            "results": out["results"],
+        }))
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "cost":
         # analytic companion to the measured headline (no TPU needed): XLA's
         # own cost model on the compiled loss+grad at headline geometry, and

@@ -667,3 +667,81 @@ class TestTracingTargets:
         check_tracing_targets(art, max_off_ratio=100.0)
         assert out["results"]["async_spans"] > 0
         assert out["results"]["slo_dimensions"] == 4
+
+
+class TestRecoveryTargets:
+    def test_recovery_gate_on_committed_artifact(self):
+        """BENCH_RECOVERY.json must keep showing ISSUE 12's gates: an
+        armed-but-silent FaultPlan costs <= 1.05x the unarmed engine and
+        compiles zero extra programs, injected faults (retry + arena
+        rebuild) drain bit-identical tokens with the pool clean, and
+        re-prefill recovery beats a cold restart to the same resume point.
+        A regression recorded into the artifact fails here."""
+        from tools.bench_targets import check_recovery_targets
+
+        art = check_recovery_targets()
+        assert art["backend"] in ("cpu", "tpu")
+        assert art["results"]["faults_off_overhead_x"] <= 1.05
+        assert art["results"]["injected_fault_token_parity"] is True
+        assert art["results"]["speedup_x"] >= 1.0
+
+    def test_recovery_gate_rejects_regressions(self):
+        from tools.bench_targets import check_recovery_targets, load_artifact
+
+        good = load_artifact("BENCH_RECOVERY.json")
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["faults_off_overhead_x"] = 1.2
+        with pytest.raises(AssertionError, match="unfaulted hot path"):
+            check_recovery_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["programs_added_when_armed"] = 1
+        with pytest.raises(AssertionError, match="byte-identical"):
+            check_recovery_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["injected_fault_token_parity"] = False
+        with pytest.raises(AssertionError, match="recovery guarantee"):
+            check_recovery_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["injected_fault_recoveries"] = 0
+        with pytest.raises(AssertionError, match="never recovered"):
+            check_recovery_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["pool_clean_after_faulted_drain"] = False
+        with pytest.raises(AssertionError, match="leaking blocks"):
+            check_recovery_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["recovered_token_parity"] = False
+        with pytest.raises(AssertionError, match="re-prefill replay"):
+            check_recovery_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["speedup_x"] = 0.5
+        with pytest.raises(AssertionError, match="reason to exist"):
+            check_recovery_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        del bad["results"]["recovery_s"]
+        with pytest.raises(AssertionError):
+            check_recovery_targets(bad)
+
+    @pytest.mark.slow
+    def test_recovery_bench_live_smoke(self):
+        """The bench harness itself at smoke shapes: parity, the
+        zero-extra-programs contract, and pool hygiene must hold live (the
+        overhead and speedup ratios are not gated at smoke shapes on a
+        jittery CI host; the committed full-shape artifact carries those
+        gates)."""
+        from thunder_tpu.benchmarks.recovery import recovery_bench
+        from tools.bench_targets import check_recovery_targets
+
+        out = recovery_bench(on_tpu=False, smoke=True)
+        art = {"backend": jax.default_backend(), **out}
+        check_recovery_targets(art, max_off_ratio=100.0, min_speedup=0.0)
+        assert out["results"]["smoke"] is True
+        assert out["results"]["injected_fault_recoveries"] >= 1

@@ -93,6 +93,10 @@ class SLOMonitor:
     def _classify(self, dim: str, result) -> bool:
         """True = bad.  A missing latency (the request died before the
         measurement existed) counts bad: the user never got the token."""
+        if result.finish_reason == "error":
+            # a quarantined request is a bad event on every dim — the user
+            # got an error, whatever the partial latencies say
+            return True
         if dim == "deadline":
             return result.finish_reason == "deadline"
         value = getattr(result, dim)

@@ -32,13 +32,34 @@ f32), and
 LoRA fine-tunes off one base model — adapters are program *data*, so
 batches mix tenants without recompiling and each request's tokens match
 its solo single-adapter run bit-exactly.
+
+Fault tolerance (:mod:`serving.faults`): ``fault_plan=FaultPlan(...)``
+injects deterministic seeded faults at the engine's named fault points for
+chaos testing; at runtime a classified step exception quarantines just the
+offending request (``finish_reason="error"``), transient dispatch failures
+retry with bounded exponential backoff, and engine-class faults trigger
+re-prefill recovery — fresh arenas plus a sampling-free replay of every
+surviving request's known tokens, after which streams continue
+bit-identical to an uninterrupted run.
 """
 from thunder_tpu.serving.engine import (  # noqa: F401
     EngineStalledError,
+    RecoveryError,
     RequestHandle,
     RequestResult,
     ServingEngine,
     serve,
+)
+from thunder_tpu.serving.faults import (  # noqa: F401
+    FaultError,
+    FaultPlan,
+    FaultSpec,
+    RetryPolicy,
+    DeviceOOMFault,
+    HarvestHangFault,
+    RequestAnomalyFault,
+    TransientDispatchFault,
+    WatchdogTimeout,
 )
 from thunder_tpu.serving.kv_pool import (  # noqa: F401
     ArenaMismatchError,
@@ -81,4 +102,14 @@ __all__ = [
     "blocks_for_arena_bytes",
     "pick_bucket",
     "pow2_buckets",
+    "FaultPlan",
+    "FaultSpec",
+    "RetryPolicy",
+    "FaultError",
+    "TransientDispatchFault",
+    "RequestAnomalyFault",
+    "DeviceOOMFault",
+    "HarvestHangFault",
+    "WatchdogTimeout",
+    "RecoveryError",
 ]

@@ -94,6 +94,20 @@ from thunder_tpu.observability.flight import FlightRecorder
 from thunder_tpu.observability.metrics import registry
 from thunder_tpu.observability.slo import resolve_slo
 from thunder_tpu.observability.tracing import RequestTracer
+from thunder_tpu.serving.faults import (
+    CLASS_REQUEST,
+    CLASS_TRANSIENT,
+    FP_DECODE,
+    FP_HARVEST,
+    FP_PREFILL,
+    FP_SCATTER,
+    RecoveryError,
+    RetryPolicy,
+    WatchdogTimeout,
+    classify_fault,
+    fault_cause,
+    resolve_fault_plan,
+)
 from thunder_tpu.serving.kv_pool import (
     SINK_BLOCK,
     PagedKVPool,
@@ -111,6 +125,7 @@ from thunder_tpu.serving.quant import (
 from thunder_tpu.serving.scheduler import (
     FINISH_DEADLINE,
     FINISH_EOS,
+    FINISH_ERROR,
     FINISH_EVICTED,
     FINISH_LENGTH,
     AdmissionError,
@@ -126,6 +141,7 @@ __all__ = [
     "RequestResult",
     "AdmissionError",
     "EngineStalledError",
+    "RecoveryError",
 ]
 
 
@@ -163,7 +179,7 @@ class RequestResult:
     rid: int
     prompt: np.ndarray
     new_tokens: tuple[int, ...]
-    finish_reason: str                      # length | eos | deadline | evicted
+    finish_reason: str                      # length | eos | deadline | evicted | error
     ttft_s: float | None                    # submit → first token
     tpot_s: float | None                    # mean per-token after the first
     tokens_per_sec: float | None
@@ -171,6 +187,7 @@ class RequestResult:
     e2e_s: float | None                     # submit → finish wall time
     shared_prefix_blocks: int
     prefill_compiled: bool = False          # the prefill run paid an XLA compile
+    error: dict | None = None               # structured cause when quarantined
 
     @property
     def tokens(self) -> np.ndarray:
@@ -255,6 +272,9 @@ class ServingEngine:
         shardings=None,
         async_step: bool = True,
         prefill_chunk: int | None = None,
+        fault_plan=None,
+        retry: RetryPolicy | None = None,
+        watchdog_timeout_s: float | None = None,
     ):
         if shardings is not None and mesh is None:
             raise ValueError("shardings= requires mesh= (param placement needs a mesh)")
@@ -344,6 +364,15 @@ class ServingEngine:
             self.prefix_sharing = False
             sch.prefill_chunk = None
         self._table_widths = self._table_width_buckets()
+        # fault tolerance: the chaos plan (None = unarmed — one `is None`
+        # check per fault point, compiled programs byte-identical either
+        # way), the retry/backoff policy, and the harvest watchdog on the
+        # scheduler's (injectable) clock
+        self._faults = resolve_fault_plan(fault_plan)
+        self._retry = retry if retry is not None else RetryPolicy()
+        self.watchdog_timeout_s = watchdog_timeout_s
+        self._retry_streak = 0                             # consecutive transient faults
+        self.recoveries = 0
         # telemetry: a StepLogger, a path for one, or None
         self._owns_telemetry = isinstance(telemetry, (str, bytes)) or hasattr(telemetry, "__fspath__")
         if self._owns_telemetry:
@@ -504,12 +533,26 @@ class ServingEngine:
                             running=len(self.scheduler.running))
         try:
             worked = self._step_async() if self.async_step else self._step_inner()
+            self._retry_streak = 0                         # a clean step resets the budget
         except Exception as e:
-            if self._flight is not None:
-                self._flight.crash_dump(e)
-            if tr is not None:
-                tr.engine_end("engine.step", error=type(e).__name__)
-            raise
+            # blast-radius containment: classified faults are absorbed —
+            # quarantine / retry / recover — and the loop keeps serving;
+            # anything unclassified keeps the crash-dump-and-raise contract
+            try:
+                handled = self._absorb_fault(e)
+            except Exception as e2:
+                if self._flight is not None:
+                    self._flight.crash_dump(e2)
+                if tr is not None:
+                    tr.engine_end("engine.step", error=type(e2).__name__)
+                raise
+            if not handled:
+                if self._flight is not None:
+                    self._flight.crash_dump(e)
+                if tr is not None:
+                    tr.engine_end("engine.step", error=type(e).__name__)
+                raise
+            worked = True
         if tr is not None:
             tr.engine_end("engine.step", worked=worked)
         return worked
@@ -523,6 +566,14 @@ class ServingEngine:
             worked = True
         while self._try_admit():
             worked = True
+        for r in list(self.scheduler.running):
+            if not r.generated and r.state == "running":
+                # a request stranded without token 0 (its admission prefill
+                # was absorbed as a fault, or recovery reset it): re-prefill
+                # before the decode batch consumes generated[-1]
+                self._prefill_harvest(self._prefill_dispatch(r))
+                self.pool.release_retired()
+                worked = True
         if self.scheduler.running:
             self._decode_once()
             worked = True
@@ -561,6 +612,21 @@ class ServingEngine:
         dispatched before the prefill pieces, so the device finishes it
         first).  This is where the host blocks — drive loops calling
         ``step()`` back off *inside* this wait instead of busy-polling."""
+        wd = self.watchdog_timeout_s
+        if wd is not None:
+            # the watchdog: an in-flight record that aged past the timeout
+            # on the engine clock without being harvested is a hung step —
+            # convert the silent stall into the recovery path
+            now = self.scheduler.clock()
+            inflight = list(self._inflight_prefill)
+            if self._inflight_decode is not None:
+                inflight.append(self._inflight_decode)
+            for wrec in inflight:
+                age = now - wrec["t_clock"]
+                if age > wd:
+                    rids = ([r.rid for r in wrec["running"]]
+                            if wrec["kind"] == "decode" else [wrec["req"].rid])
+                    raise WatchdogTimeout(FP_HARVEST, rids, age_s=age)
         worked = False
         rec, self._inflight_decode = self._inflight_decode, None
         if rec is not None:
@@ -637,12 +703,17 @@ class ServingEngine:
             self._finish(handle._req, FINISH_EVICTED)
 
     def shutdown(self, *, drain: bool = True) -> None:
-        """Graceful stop: optionally drains, evicts whatever remains, closes
-        owned telemetry, and rejects further submits."""
+        """Graceful stop: optionally drains, discards whatever is still in
+        flight, evicts whatever remains, closes owned telemetry, and
+        rejects further submits.  The in-flight discard matters on the
+        non-drain path: an async decode/chunk future still on the device —
+        and the donated-arena handles parked for it — must be dropped
+        before the engine closes, or they leak past shutdown."""
         if self._closed:
             return
         if drain:
             self.drain()
+        self._discard_inflight()
         for req in (*self.scheduler.running, *self.scheduler.queue):
             self._finish(req, FINISH_EVICTED)
         self._closed = True
@@ -677,9 +748,10 @@ class ServingEngine:
         mesh = self.mesh_stats()
         sch = self.scheduler
         # program kinds a bucket may instantiate: decode per batch bucket,
-        # prefill per prefill bucket, plus the chunk kind when chunking is on
+        # prefill per prefill bucket, plus the chunk kind when chunking is
+        # on — or once recovery has replayed through the chunk programs
         kinds = len(sch.batch_buckets) + len(sch.prefill_buckets) * (
-            2 if sch.prefill_chunk is not None else 1
+            2 if (sch.prefill_chunk is not None or self.chunk_runs > 0) else 1
         )
         n = self._overlap_obs
         return {
@@ -706,6 +778,8 @@ class ServingEngine:
             "bucket_bound": kinds * len(self._table_widths),
             "prefix_lookups": self._prefix_lookups,
             "prefix_hits": self._prefix_hits,
+            "recoveries": self.recoveries,
+            "faults": self._faults.snapshot() if self._faults is not None else None,
         }
 
     def slo_report(self) -> dict:
@@ -784,6 +858,16 @@ class ServingEngine:
         max_resume = ((max_prompt - 1) // bs) * bs if resumes else 0
         piece = chunk if chunk is not None else pick_bucket(max_prompt, sch.prefill_buckets)
         need = -(-(max_resume + piece) // bs)
+        # re-prefill recovery replays prompt + emitted tokens through the
+        # chunk programs on ANY engine (chunked or not): its resume points
+        # reach to one token short of the full reservation capacity, and
+        # its pieces are the widest block-aligned prefill bucket — those
+        # widths must be in the set too, or a recovery would mint a table
+        # width bucket_bound never counted
+        aligned = [t for t in sch.prefill_buckets if t % bs == 0]
+        replay_piece = max(aligned) if aligned else sch.prefill_buckets[-1]
+        replay_resume = ((cap_tokens - 1) // bs) * bs
+        need = max(need, -(-(replay_resume + replay_piece) // bs))
         b = max(widths)
         while b < need:
             b *= 2
@@ -892,6 +976,7 @@ class ServingEngine:
         or an intermediate ``prefill_chunk`` (writes KV only — no sampling,
         no key split, so the final piece's draw stays bit-identical to the
         unchunked prefill)."""
+        self._fault_point(FP_PREFILL, (req.rid,))
         sch, pool = self.scheduler, self.pool
         bs = pool.block_size
         pos = req.pos                                      # block-aligned resume point
@@ -930,7 +1015,8 @@ class ServingEngine:
                 self._lora_arenas(), jnp.asarray([req.adapter_slot], dtype=jnp.int32),
             )
             rec = {"kind": "prefill", "req": req, "tok": tok, "key": key,
-                   "qerr": qerr, "compiled": compiled, "span": name}
+                   "qerr": qerr, "compiled": compiled, "span": name,
+                   "t_clock": sch.clock()}
         else:
             arenas, qerr = prog(
                 self.params, jnp.asarray(toks)[None], jnp.int32(pos),
@@ -938,7 +1024,11 @@ class ServingEngine:
                 self._lora_arenas(), jnp.asarray([req.adapter_slot], dtype=jnp.int32),
             )
             rec = {"kind": "chunk", "req": req, "qerr": qerr,
-                   "compiled": compiled, "span": name}
+                   "compiled": compiled, "span": name,
+                   "t_clock": sch.clock()}
+        # a fault here is past the point of no return: the program call
+        # above consumed the donated arenas, so absorb routes to recovery
+        self._fault_point(FP_SCATTER, (req.rid,))
         pool.set_arenas(arenas)
         req.pos = pos + n_real                             # written (device-ordered)
         self._register_prefix(req, upto=req.pos)
@@ -968,6 +1058,7 @@ class ServingEngine:
         measured quantization error; the final piece delivers token 0
         (TTFT stamps here — token availability, not dispatch)."""
         req, pool = rec["req"], self.pool
+        self._fault_point(FP_HARVEST, (req.rid,))
         tr = self._tracer
         if rec["kind"] == "chunk":
             # the scalar fetch doubles as the fence on the chunk execution
@@ -1023,6 +1114,7 @@ class ServingEngine:
         sch, pool = self.scheduler, self.pool
         running = (sch.decode_ready() if self.async_step
                    else list(sch.running))                 # FIFO admission order
+        self._fault_point(FP_DECODE, tuple(r.rid for r in running))
         Bb, _nbb_raw = sch.decode_bucket(running)
         nbb = self._nbb(_nbb_raw)
         bs = pool.block_size
@@ -1072,6 +1164,8 @@ class ServingEngine:
             self.params, toks_d, pos_d, tables_d, pool.arenas,
             keys_d, lora_arenas, slots_d,
         )
+        # past the point of no return: the call consumed the donated arenas
+        self._fault_point(FP_SCATTER, tuple(r.rid for r in running))
         pool.set_arenas(arenas)
         self._decode_state = {
             "sig": sig, "toks": nxt, "pos": new_pos, "tables": tables_d,
@@ -1080,7 +1174,7 @@ class ServingEngine:
         rec = {"kind": "decode", "running": running, "nxt": nxt,
                "new_keys": new_keys, "pos": host_pos, "bucket": [Bb, nbb],
                "compiled": compiled, "step": self.decode_steps,
-               "t_disp": time.perf_counter()}
+               "t_disp": time.perf_counter(), "t_clock": sch.clock()}
         self.decode_steps += 1
         self._occupancy_sum += len(running)
         self._m_steps_decode.inc()
@@ -1090,6 +1184,7 @@ class ServingEngine:
     def _decode_harvest(self, rec: dict) -> None:
         sch = self.scheduler
         running = rec["running"]
+        self._fault_point(FP_HARVEST, tuple(r.rid for r in running))
         t0 = time.perf_counter()
         nxt = np.asarray(rec["nxt"])                       # the host block
         new_keys = np.asarray(rec["new_keys"])
@@ -1168,8 +1263,12 @@ class ServingEngine:
         if self._tracer is not None:
             if never_admitted:                             # died in the queue
                 self._tracer.end(req.rid, "queued", finish_reason=reason)
-            self._tracer.instant(req.rid, "finish", reason=reason,
-                                 new_tokens=len(req.generated))
+            self._tracer.instant(
+                req.rid, "finish", reason=reason,
+                new_tokens=len(req.generated),
+                **({"error": req.error_cause.get("type")}
+                   if req.error_cause else {}),
+            )
         if self._flight is not None:
             self._flight.record("finish", rid=req.rid, reason=reason,
                                 new_tokens=len(req.generated))
@@ -1203,6 +1302,7 @@ class ServingEngine:
                 e2e_s=res.e2e_s,
                 prefill_compiled=req.prefill_compiled,
                 shared_prefix_blocks=req.n_shared_blocks,
+                error=req.error_cause,
             )
 
     def _result(self, req: Request) -> RequestResult:
@@ -1227,6 +1327,7 @@ class ServingEngine:
             e2e_s=(req.finish_t - req.submit_t) if req.finish_t is not None else None,
             shared_prefix_blocks=req.n_shared_blocks,
             prefill_compiled=req.prefill_compiled,
+            error=req.error_cause,
         )
 
     def _update_gauges(self) -> None:
@@ -1237,6 +1338,228 @@ class ServingEngine:
         # the post-mortem capacity floor: how close the pool ever came to
         # exhaustion (also in the flight-recorder pool snapshot)
         self._m_pool_low_water.set(self.pool.free_blocks_low_water)
+
+    #
+    # fault containment + re-prefill recovery
+    #
+
+    def _fault_point(self, point: str, rids: Sequence[int] = ()) -> None:
+        """One injectable fault point (unarmed engines pay one ``is None``
+        test — the compiled programs never see the plan)."""
+        if self._faults is not None:
+            self._faults.check(point, rids)
+
+    def _absorb_fault(self, exc: Exception) -> bool:
+        """Blast-radius containment for one classified step exception.
+        Returns False for anything the recovery layer must not absorb
+        (programming errors keep the crash-dump-and-raise contract).
+
+        - **request** class: quarantine the offending rids (finish with
+          ``"error"`` + structured cause, blocks freed, prefix scrubbed)
+          and keep serving; a harvest/scatter fault additionally recovers
+          (the step's tokens / donated arenas are already lost);
+        - **transient** class: bounded retry with exponential backoff on
+          the policy's injectable sleep; a *donated* failure (scatter /
+          harvest) may have consumed its inputs, so it routes through
+          recovery instead of re-submitting stale handles; retry
+          exhaustion escalates to recovery;
+        - **engine** class (OOM / hang / watchdog): straight to recovery.
+        """
+        cls = classify_fault(exc)
+        if cls is None:
+            return False
+        cause = fault_cause(exc)
+        point = cause.get("point")
+        reg = registry()
+        reg.counter("serving.faults.observed").inc()
+        if self._flight is not None:
+            self._flight.record("fault", fault_class=cls, cause=cause,
+                                rids=cause.get("rids", []))
+        lossy = point in (FP_HARVEST, FP_SCATTER)
+        if cls == CLASS_REQUEST:
+            for rid in cause.get("rids", ()):
+                self._quarantine(rid, cause)
+            if lossy:
+                self._recover(cause)
+        elif cls == CLASS_TRANSIENT:
+            self._retry_streak += 1
+            if self._retry_streak > self._retry.max_retries:
+                self._retry_streak = 0
+                self._recover(cause)
+            else:
+                reg.counter("serving.faults.retries").inc()
+                self._retry.sleep(self._retry.backoff(self._retry_streak))
+                if lossy:
+                    self._recover(cause)
+        else:
+            self._recover(cause)
+        return True
+
+    def _quarantine(self, rid: int, cause: dict) -> None:
+        """Finishes one poisoned request with ``finish_reason="error"`` and
+        the structured cause; its blocks free and its prefix-index entries
+        scrub through the normal ``_finish`` path, so the rest of the batch
+        keeps serving."""
+        req = next((r for r in (*self.scheduler.running, *self.scheduler.queue)
+                    if r.rid == rid), None)
+        if req is None or req.state == "finished":
+            return
+        req.error_cause = cause
+        registry().counter("serving.faults.quarantined").inc()
+        if self._flight is not None:
+            self._flight.record("quarantine", rid=rid, cause=cause)
+        self._finish(req, FINISH_ERROR)
+
+    def recover(self) -> None:
+        """Rebuilds the KV arenas and re-prefills every running request
+        from its prompt + already-emitted tokens (the engine triggers this
+        automatically on engine-class faults and retry exhaustion; it is
+        public for operational use — e.g. after an external device reset).
+
+        The recovery guarantee: a request's PRNG key advances only when a
+        token is harvested, so the KV arena is *soft state* — replaying the
+        already-known tokens through the sampling-free chunked-prefill
+        program rebuilds exactly the cache an uninterrupted run would hold,
+        and every subsequent draw is bit-identical."""
+        self._recover({"type": "manual", "point": None, "kind": None,
+                       "rids": [], "injected": False,
+                       "message": "engine.recover()"})
+
+    def _recover(self, cause: dict) -> None:
+        reg = registry()
+        t0 = time.perf_counter()
+        tr = self._tracer
+        if tr is not None:
+            tr.engine_begin("engine.recover", cause=cause.get("type"))
+        if self._flight is not None:
+            self._flight.record("recover", cause=cause,
+                                rids=[r.rid for r in self.scheduler.running])
+        attempts = 0
+        while True:
+            try:
+                self._recover_once()
+                break
+            except Exception as e:
+                ecls = classify_fault(e)
+                if ecls is None:
+                    if tr is not None:
+                        tr.engine_end("engine.recover", error=type(e).__name__)
+                    raise
+                if ecls == CLASS_REQUEST:
+                    # a poison request resurfaced during its own replay:
+                    # quarantining it IS progress, so it never consumes
+                    # the bounded retry budget
+                    ecause = fault_cause(e)
+                    for rid in ecause.get("rids", ()):
+                        self._quarantine(rid, ecause)
+                    continue
+                attempts += 1
+                if attempts > self._retry.max_retries:
+                    if tr is not None:
+                        tr.engine_end("engine.recover", error="RecoveryError")
+                    raise RecoveryError(
+                        f"re-prefill recovery failed {attempts} times "
+                        f"(last: {type(e).__name__}: {e})"
+                    ) from e
+                self._retry.sleep(self._retry.backoff(attempts))
+        self.recoveries += 1
+        self._retry_streak = 0
+        dt = time.perf_counter() - t0
+        reg.counter("serving.faults.recoveries").inc()
+        reg.histogram("serving.recovery.duration_s").observe(dt)
+        if self._flight is not None:
+            self._flight.record("recovered", duration_s=dt,
+                                rids=[r.rid for r in self.scheduler.running])
+        if tr is not None:
+            tr.engine_end("engine.recover", duration_s=dt)
+
+    def _recover_once(self) -> None:
+        """One recovery attempt: drop in-flight work, rebuild fresh zeroed
+        arenas (allocator state — tables, refcounts, prefix sharing — is
+        host-side and survives untouched), then replay every surviving
+        request's known tokens back into its own blocks.  Requests still
+        waiting on token 0 reset to pos=0 and re-run the normal prefill
+        path (their key was never split, so token 0 is unchanged); shared-
+        prefix blocks are rewritten by every co-owner with bit-identical
+        content (the forward pass is deterministic)."""
+        self._discard_inflight()
+        self.pool.rebuild_arenas()
+        for req in list(self.scheduler.running):
+            req.pos = 0
+            if req.generated:
+                self._replay_request(req)
+        if not self.async_step:
+            # the sync loop has no prefill lane; re-prefill token-0
+            # requests inline so the next decode batch has a history row
+            # for every running request
+            for req in list(self.scheduler.running):
+                if req.state == "running" and not req.generated:
+                    self._prefill_harvest(self._prefill_dispatch(req))
+                    self.pool.release_retired()
+
+    def _replay_request(self, req: Request) -> None:
+        """Replays ``req``'s known sequence (prompt + all but the last
+        emitted token) into its blocks through the sampling-free
+        ``prefill_chunk`` program.  After the replay the written KV covers
+        exactly ``[0, prompt_len + n - 1)`` — the state an uninterrupted
+        run holds before its next decode step — and the key chain is
+        untouched, so the next draw is bit-identical.  Window-expired
+        (sunk) table entries route their writes to the sink exactly like
+        live padding; the keep-mask already excludes those positions."""
+        sch, pool = self.scheduler, self.pool
+        bs = pool.block_size
+        n = len(req.generated)
+        seq = np.concatenate([
+            req.prompt, np.asarray(req.generated[:n - 1], dtype=np.int32),
+        ])
+        target = req.prompt_len + n - 1
+        aligned = [t for t in sch.prefill_buckets if t % bs == 0]
+        piece = max(aligned) if aligned else sch.prefill_buckets[-1]
+        if getattr(self.cfg, "learned_pos_embedding", False):
+            # suffix resume is off the table for learned-pos models (the
+            # wpe dynamic_slice clamps past its rows); their capacity is
+            # capped at cfg.block_size, so one piece from 0 always fits
+            piece = max(piece, target)
+        pos = 0
+        while pos < target:
+            n_real = min(target - pos, piece)
+            Tb = sch.prefill_bucket(n_real)
+            nbb = self._nbb(max(len(req.block_table), -(-(pos + Tb) // bs)))
+            toks = np.zeros(Tb, dtype=np.int32)
+            toks[:n_real] = seq[pos:pos + n_real]
+            table, dest = chunk_tables(req.block_table, pos, Tb, nbb, bs)
+            prog, _compiled = self._program("prefill_chunk", Tb, nbb)
+            arenas, qerr = prog(
+                self.params, jnp.asarray(toks)[None], jnp.int32(pos),
+                pool.arenas, jnp.asarray(table), jnp.asarray(dest),
+                self._lora_arenas(),
+                jnp.asarray([req.adapter_slot], dtype=jnp.int32),
+            )
+            pool.set_arenas(arenas)
+            req.pos = pos = pos + n_real
+            float(np.asarray(qerr))        # fence this piece before the next
+            pool.release_retired()
+            self.chunk_runs += 1
+            registry().counter("serving.steps.prefill_chunk").inc()
+
+    def _discard_inflight(self) -> None:
+        """Drops every in-flight future record (their tokens were never
+        promised) plus the parked donated-arena handles: recovery and
+        ``shutdown()`` must not leak futures or retired handles past the
+        engine's life.  The derefs may block briefly until the consuming
+        executions finish — this is the slow path, correctness over
+        overlap."""
+        rec, self._inflight_decode = self._inflight_decode, None
+        tr = self._tracer
+        if rec is not None and tr is not None:
+            for r in rec["running"]:
+                tr.end(r.rid, "decode", aborted=True)
+        pending, self._inflight_prefill = self._inflight_prefill, []
+        if tr is not None:
+            for prec in pending:
+                tr.end(prec["req"].rid, prec["span"], aborted=True)
+        self._decode_state = None
+        self.pool.release_retired()
 
     #
     # compiled bucket programs
@@ -1518,5 +1841,20 @@ def serve(model_fn, params, cfg, **kwargs) -> ServingEngine:
     dispatched one per step between decodes, so a long prompt neither
     stalls running requests' TPOT nor hits the prompt-length admission cap.
     ``async_step=False`` keeps the original fully synchronous loop
-    byte-identical; served tokens are bit-identical either way."""
+    byte-identical; served tokens are bit-identical either way.
+
+    Fault tolerance: a classified step exception no longer kills the
+    engine — per-request anomalies quarantine just the offending request
+    (``finish_reason="error"`` + structured cause, blocks freed, prefix
+    index scrubbed), transient dispatch failures retry with exponential
+    backoff (``retry=RetryPolicy(...)``), and engine-class faults (OOM,
+    hangs caught by ``watchdog_timeout_s=...``, retry exhaustion) trigger
+    **re-prefill recovery**: fresh arenas are rebuilt and every surviving
+    request is replayed from its prompt + emitted tokens, after which the
+    decode stream continues bit-identical to an uninterrupted run (the
+    PRNG chain only advances at harvest, so the KV arena is soft state).
+    ``fault_plan=FaultPlan(...)`` (or ``THUNDER_TPU_FAULT_PLAN`` JSON)
+    injects deterministic seeded faults at the named fault points for
+    chaos testing; ``fault_plan=None`` leaves every compiled program
+    byte-identical — the plan lives purely on the host side."""
     return ServingEngine(params, cfg, model_fn=model_fn, **kwargs)

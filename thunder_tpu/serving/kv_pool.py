@@ -125,22 +125,15 @@ class PagedKVPool:
             # arena (the whole point — a model/cache too big for one chip).
             # The spec (heads at axis 2) is a valid prefix for the rank-4
             # scale arenas too, so one sharding object places everything.
-            def zeros(shp, dt):
-                return jax.jit(
-                    lambda: jnp.zeros(shp, dtype=dt), out_shardings=self.arena_sharding
-                )()
         else:
             self.arena_sharding = None
 
-            def zeros(shp, dt):
-                return jnp.zeros(shp, dtype=dt)
-
         # independent buffers (no copy traffic between K and V updates)
-        self.k_arena = zeros(shape, self.kv_dtype)
-        self.v_arena = zeros(shape, self.kv_dtype)
+        self.k_arena = self._zeros(shape, self.kv_dtype)
+        self.v_arena = self._zeros(shape, self.kv_dtype)
         if self.quantized_kv:
-            self.k_scale = zeros(self._scale_shape, jnp.float32)
-            self.v_scale = zeros(self._scale_shape, jnp.float32)
+            self.k_scale = self._zeros(self._scale_shape, jnp.float32)
+            self.v_scale = self._zeros(self._scale_shape, jnp.float32)
         else:
             self.k_scale = self.v_scale = None
         # outgoing donated arena handles, parked until their consumer
@@ -342,6 +335,29 @@ class PagedKVPool:
         consuming executions have completed — call after materializing any
         later output of the same device stream)."""
         self._retired.clear()
+
+    def _zeros(self, shp: tuple, dt) -> jax.Array:
+        """A zeroed arena buffer, shard-local under a mesh (no device ever
+        materializes the full arena)."""
+        if self.mesh is not None:
+            return jax.jit(
+                lambda: jnp.zeros(shp, dtype=dt), out_shardings=self.arena_sharding
+            )()
+        return jnp.zeros(shp, dtype=dt)
+
+    def rebuild_arenas(self) -> None:
+        """Replaces the device arenas with fresh zeroed buffers, dropping
+        whatever the old handles held (re-prefill recovery: the KV content
+        is soft state the engine rebuilds by replaying known tokens).
+        Allocator state — block tables, refcounts, prefix sharing, the
+        free list — is host-side and survives untouched; under a mesh the
+        new buffers come up with the same shard-local placement."""
+        self._retired.clear()
+        self.k_arena = self._zeros(self._arena_shape, self.kv_dtype)
+        self.v_arena = self._zeros(self._arena_shape, self.kv_dtype)
+        if self.quantized_kv:
+            self.k_scale = self._zeros(self._scale_shape, jnp.float32)
+            self.v_scale = self._zeros(self._scale_shape, jnp.float32)
 
     def update_arenas(self, k_arena: jax.Array, v_arena: jax.Array,
                       k_scale: jax.Array | None = None,

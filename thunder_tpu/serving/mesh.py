@@ -71,7 +71,10 @@ def arena_sharding(cfg, mesh: Mesh, *, axis: str = "tp") -> NamedSharding:
     """NamedSharding of the paged K/V arenas: heads-over-``axis`` via the
     shared :func:`kv_cache_spec` rule (the arena keeps the heads dim at
     axis 2 just like the dense cache, so one spec serves both layouts);
-    replicated when the rule degrades."""
+    replicated when the rule degrades.  Re-prefill recovery reuses this
+    same sharding when it rebuilds arenas (``PagedKVPool._zeros`` allocates
+    shard-local through it), so a recovered mesh engine keeps the exact
+    placement the bucket programs were compiled against."""
     return NamedSharding(mesh, kv_cache_spec(cfg, mesh, axis=axis))
 
 

@@ -51,6 +51,7 @@ __all__ = [
     "FINISH_EOS",
     "FINISH_DEADLINE",
     "FINISH_EVICTED",
+    "FINISH_ERROR",
     "Request",
     "Scheduler",
     "pick_bucket",
@@ -61,6 +62,7 @@ FINISH_LENGTH = "length"
 FINISH_EOS = "eos"
 FINISH_DEADLINE = "deadline"
 FINISH_EVICTED = "evicted"
+FINISH_ERROR = "error"
 
 
 class AdmissionError(RuntimeError):
@@ -117,6 +119,7 @@ class Request:
     first_token_t: float | None = None
     finish_t: float | None = None
     prefill_compiled: bool = False          # this request's prefill paid an XLA compile
+    error_cause: dict | None = None         # structured cause when quarantined
 
     @property
     def prompt_len(self) -> int:

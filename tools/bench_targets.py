@@ -33,6 +33,7 @@ __all__ = [
     "check_serving_mesh_targets",
     "check_tracing_targets",
     "check_capacity_targets",
+    "check_recovery_targets",
 ]
 
 # generous: CI hosts jitter, and the gate exists to catch the donate=False
@@ -322,6 +323,68 @@ def check_capacity_targets(artifact: dict | None = None, *,
         f"{r['adapter_mix_new_programs_after_register']} fresh programs — "
         f"adapter identity leaked into the program cache key"
     )
+    return artifact
+
+
+def check_recovery_targets(artifact: dict | None = None, *,
+                           max_off_ratio: float = 1.05,
+                           min_speedup: float = 1.0) -> dict:
+    """Validates the BENCH_RECOVERY.json artifact: schema, the
+    faults-off contract (an armed-but-silent FaultPlan costs at most
+    ``max_off_ratio`` of the unarmed engine and compiles zero extra
+    programs — the plan must live outside the program-cache key), the
+    differential recovery guarantee asserted in-bench (injected faults —
+    retry path AND arena-rebuild path — drained tokens bit-identical to
+    the fault-free run, with recovery actually exercised and the pool
+    drained clean), and the headline claim: re-prefill recovery beats a
+    cold engine restart to the same resume point by at least
+    ``min_speedup``x (the replay packs known tokens into few wide
+    chunked-prefill dispatches; a cold restart re-decodes them one step at
+    a time).  Returns the artifact for chaining."""
+    if artifact is None:
+        artifact = load_artifact("BENCH_RECOVERY.json")
+    assert "backend" in artifact and "results" in artifact, sorted(artifact)
+    r = artifact["results"]
+    for key in (
+        "faults_off_overhead_x", "programs_added_when_armed",
+        "injected_fault_token_parity", "injected_fault_recoveries",
+        "pool_clean_after_faulted_drain", "recovery_s", "cold_restart_s",
+        "speedup_x", "recovered_token_parity", "tokens_replayed",
+    ):
+        assert key in r, (key, sorted(r))
+    assert r["faults_off_overhead_x"] <= max_off_ratio, (
+        f"armed-but-silent FaultPlan costs {r['faults_off_overhead_x']:.3f}x "
+        f"the unarmed engine (> {max_off_ratio}x) — the fault checks are "
+        f"leaking cost onto the unfaulted hot path"
+    )
+    assert r["programs_added_when_armed"] == 0, (
+        f"arming a FaultPlan compiled {r['programs_added_when_armed']} fresh "
+        f"programs — the plan leaked into the program cache key, so "
+        f"fault_plan=None is no longer byte-identical"
+    )
+    assert r["injected_fault_token_parity"] is True, (
+        "tokens drained through injected faults diverged from the "
+        "fault-free run — the recovery guarantee is broken"
+    )
+    assert r["injected_fault_recoveries"] >= 1, (
+        "the injected-fault drive never recovered — the OOM spec did not "
+        "exercise the arena-rebuild path, so the parity above proves nothing"
+    )
+    assert r["pool_clean_after_faulted_drain"] is True, (
+        "the pool did not drain clean after the faulted run — quarantine/"
+        "recovery is leaking blocks"
+    )
+    assert r["recovered_token_parity"] is True, (
+        "streams after engine.recover() diverged from the uninterrupted "
+        "run — re-prefill replay is not rebuilding the exact KV state"
+    )
+    assert r["recovery_s"] > 0 and r["cold_restart_s"] > 0, r
+    assert r["speedup_x"] >= min_speedup, (
+        f"re-prefill recovery ({r['recovery_s']}s) is not beating a cold "
+        f"restart ({r['cold_restart_s']}s): {r['speedup_x']:.2f}x < "
+        f"{min_speedup}x — the replay has lost its reason to exist"
+    )
+    assert r["tokens_replayed"] > 0, r
     return artifact
 
 

@@ -947,6 +947,31 @@ def main():
             "results": out["results"],
         }))
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "paged_attn":
+        # paged-attention decode bench: attn="paged" (Pallas flash-decoding
+        # off the block arena, interpret mode on CPU) vs attn="gather" —
+        # token parity + program purity gated, analytic arena-traffic
+        # ratio gated >1; wall-clock informational until a real TPU window.
+        from thunder_tpu._platform import force_cpu
+
+        force_cpu()
+        from thunder_tpu.benchmarks.paged_attention import paged_attention_bench
+
+        out = paged_attention_bench(on_tpu=False)
+        artifact = {"backend": jax.default_backend(), **out}
+        with open("BENCH_PAGED_ATTN.json", "w") as f:
+            json.dump(artifact, f, indent=1)
+        for k, v in out["results"].items():
+            log(f"paged_attn {k}: {v}")
+        print(json.dumps({
+            "metric": "paged_attn_arena_traffic_ratio_x",
+            "value": out["results"]["arena_traffic_ratio_x"],
+            "unit": "x",
+            # the gather path's per-step arena bytes ARE the baseline
+            "vs_baseline": out["results"]["arena_traffic_ratio_x"],
+            "results": out["results"],
+        }))
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "cost":
         # analytic companion to the measured headline (no TPU needed): XLA's
         # own cost model on the compiled loss+grad at headline geometry, and

@@ -745,3 +745,60 @@ class TestRecoveryTargets:
         check_recovery_targets(art, max_off_ratio=100.0, min_speedup=0.0)
         assert out["results"]["smoke"] is True
         assert out["results"]["injected_fault_recoveries"] >= 1
+
+
+class TestPagedAttnTargets:
+    def test_paged_attn_gate_on_committed_artifact(self):
+        """BENCH_PAGED_ATTN.json must keep showing token parity, a
+        gather/scatter-free paged decode program (with the gather program
+        as live positive control), and an arena-traffic ratio > 1.  A
+        regression recorded into the artifact fails here."""
+        from tools.bench_targets import check_paged_attn_targets
+
+        art = check_paged_attn_targets()
+        assert art["backend"] in ("cpu", "tpu")
+        assert art["results"]["parity_ok"] is True
+        assert art["results"]["paged_arena_gathers"] == 0
+
+    def test_paged_attn_gate_rejects_regressions(self):
+        from tools.bench_targets import check_paged_attn_targets, load_artifact
+
+        good = load_artifact("BENCH_PAGED_ATTN.json")
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["parity_ok"] = False
+        with pytest.raises(AssertionError, match="bit-exactness contract"):
+            check_paged_attn_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["paged_scatters"] = 3
+        with pytest.raises(AssertionError, match="leaked into the paged"):
+            check_paged_attn_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["gather_arena_gathers"] = 0
+        with pytest.raises(AssertionError, match="positive control went blind"):
+            check_paged_attn_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["arena_traffic_ratio_x"] = 0.9
+        with pytest.raises(AssertionError, match="fewer arena bytes"):
+            check_paged_attn_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        del bad["results"]["kernel_steps"]
+        with pytest.raises(AssertionError):
+            check_paged_attn_targets(bad)
+
+    @pytest.mark.slow
+    def test_paged_attn_bench_live_smoke(self):
+        """The bench harness itself at reduced reps: parity and program
+        purity must hold live (wall-clock is informational — the CPU run
+        interprets the kernel; the committed artifact carries the gates)."""
+        from thunder_tpu.benchmarks.paged_attention import paged_attention_bench
+        from tools.bench_targets import check_paged_attn_targets
+
+        out = paged_attention_bench(on_tpu=False, reps=1, n_requests=2, max_new=4)
+        art = {"backend": jax.default_backend(), **out}
+        check_paged_attn_targets(art)
+        assert out["results"]["parity_ok"] is True

@@ -1,0 +1,348 @@
+"""Paged-attention decode: Pallas kernel over the KV block arena (ISSUE 13).
+
+The load-bearing guarantee is differential and bit-exact at the token
+level: an engine with ``attn="paged"`` (flash-decoding kernel reading K/V
+straight from the block arena) must serve tokens identical to
+``attn="gather"`` (dense gather/scatter round-trip) and to solo
+``generate()`` — greedy AND temperature, int8/fp8 KV, LoRA mixes, chunked
+prefill, prefix sharing, and fault-recovery replay.  Logits are only
+ulp-close (online vs full softmax reorder), so every assertion here
+compares tokens, never arena bytes.
+
+The second pillar is structural: the compiled ``decode_paged`` program
+must contain **zero** arena-sized gather primitives and zero scatters
+(asserted on the jaxpr, with the gather program as positive control), and
+physical block 0 (the sink / table padding target) must be dead weight —
+poisoning it mid-run changes nothing on either path.
+
+Everything runs on CPU with the kernels in Pallas interpret mode
+(``attn="paged"`` forces the kernel regardless of backend), so tier-1
+exercises the real kernel math, not a stand-in.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import thunder_tpu as tt
+from thunder_tpu.models import generate as gen
+from thunder_tpu.models import llama
+from thunder_tpu.serving import AdapterRegistry, FaultPlan, FaultSpec, make_lora_factors
+from thunder_tpu.serving.faults import FP_DECODE
+from thunder_tpu.serving.lora import valid_targets
+from thunder_tpu.serving.paged_attention import paged_supported
+
+# 2 layers (layer-indexed arena reads), GQA 4:2 (in-kernel q-group
+# replication), tiny widths so interpret-mode kernels stay cheap
+MICRO = dict(
+    n_layer=2, n_head=4, n_query_groups=2, n_embd=32,
+    intermediate_size=64, vocab_size=64, block_size=64,
+)
+BUCKETS = dict(batch_buckets=(4,), block_buckets=(6,), prefill_buckets=(16,))
+
+_FP8 = getattr(jnp, "float8_e4m3fn", None)
+
+
+@pytest.fixture(scope="module")
+def micro():
+    cfg = llama.Config.from_name("tiny-llama-debug", **MICRO)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    return cfg, params
+
+
+def _engine(cfg, params, **kw):
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 32)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("cache_dtype", jnp.float32)
+    for k, v in BUCKETS.items():
+        kw.setdefault(k, v)
+    return tt.serve(None, params, cfg, **kw)
+
+
+def _prompts(cfg, lens=(3, 5, 9, 14), seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32) for n in lens]
+
+
+def _drive(eng, prompts, n=5, keys=None, **submit_kw):
+    handles = []
+    for i, p in enumerate(prompts):
+        kw = dict(submit_kw)
+        if keys is not None:
+            kw["key"] = keys[i]
+        handles.append(eng.submit(p, max_new_tokens=n, **kw))
+    eng.drain()
+    return [tuple(h.result(drive=False).tokens) for h in handles]
+
+
+def _both(cfg, params, prompts, n=5, keys=None, engine_kw=None, submit_kw=None):
+    """Tokens from a gather engine and a paged engine, same workload."""
+    engine_kw = engine_kw or {}
+    submit_kw = submit_kw or {}
+    tg = _drive(_engine(cfg, params, attn="gather", **engine_kw), prompts, n,
+                keys=keys, **submit_kw)
+    tp = _drive(_engine(cfg, params, attn="paged", **engine_kw), prompts, n,
+                keys=keys, **submit_kw)
+    return tg, tp
+
+
+#
+# differential parity: the acceptance bar
+#
+
+
+class TestPagedParity:
+    def test_greedy_vs_gather_and_solo(self, micro):
+        cfg, params = micro
+        prompts = _prompts(cfg)
+        tg, tp = _both(cfg, params, prompts)
+        assert tg == tp
+        for p, t in zip(prompts, tp):
+            solo = np.asarray(
+                gen.generate(params, np.asarray(p)[None], cfg, 5,
+                             cache_dtype=jnp.float32))[0]
+            assert tuple(solo) == t
+
+    def test_temperature_with_request_keys(self, micro):
+        cfg, params = micro
+        prompts = _prompts(cfg, lens=(4, 11))
+        keys = [jax.random.PRNGKey(42), jax.random.PRNGKey(7)]
+        tg, tp = _both(cfg, params, prompts, keys=keys,
+                       engine_kw=dict(temperature=0.7))
+        assert tg == tp
+
+    def test_int8_kv(self, micro):
+        cfg, params = micro
+        tg, tp = _both(cfg, params, _prompts(cfg), engine_kw=dict(kv_dtype="int8"))
+        assert tg == tp
+
+    @pytest.mark.skipif(_FP8 is None, reason="jax build lacks float8_e4m3fn")
+    def test_fp8_kv(self, micro):
+        cfg, params = micro
+        tg, tp = _both(cfg, params, _prompts(cfg, lens=(3, 7)),
+                       engine_kw=dict(kv_dtype="fp8", max_batch=2))
+        assert tg == tp
+
+    def test_lora_mix_with_mlp_targets(self, micro):
+        cfg, params = micro
+        targets = ("wq", "wk", "wv", "wo", "fc_1", "fc_2", "proj")
+
+        def serve_one(attn):
+            reg = AdapterRegistry(cfg, rank=2, max_adapters=2, targets=targets)
+            reg.register("alice", make_lora_factors(
+                cfg, 2, jax.random.PRNGKey(9), targets, std=0.5))
+            eng = _engine(cfg, params, lora=reg, attn=attn)
+            prompts = _prompts(cfg, lens=(3, 6, 10))
+            hs = [eng.submit(prompts[0], max_new_tokens=5, adapter_id="alice"),
+                  eng.submit(prompts[1], max_new_tokens=5),
+                  eng.submit(prompts[2], max_new_tokens=5, adapter_id="alice")]
+            eng.drain()
+            return [tuple(h.result(drive=False).tokens) for h in hs]
+
+        assert serve_one("gather") == serve_one("paged")
+
+    def test_chunked_prefill(self, micro):
+        cfg, params = micro
+        tg, tp = _both(cfg, params, _prompts(cfg, lens=(13, 14, 9)),
+                       engine_kw=dict(prefill_chunk=8, prefill_buckets=(8, 16)))
+        assert tg == tp
+
+    def test_prefix_sharing(self, micro):
+        cfg, params = micro
+        base = (np.arange(10) * 7 + 3).astype(np.int32) % cfg.vocab_size
+
+        def serve_one(attn):
+            eng = _engine(cfg, params, attn=attn, max_batch=2)
+            ha = eng.submit(base, max_new_tokens=4)
+            eng.step()                               # prefill A, register prefix
+            hb = eng.submit(base.copy(), max_new_tokens=4)
+            eng.step()                               # admit B via shared blocks
+            eng.drain()
+            ra, rb = ha.result(drive=False), hb.result(drive=False)
+            assert rb.shared_prefix_blocks == 2      # sharing actually happened
+            return tuple(ra.tokens), tuple(rb.tokens)
+
+        assert serve_one("gather") == serve_one("paged")
+
+    def test_fault_recovery_replay(self, micro):
+        """Re-prefill recovery rebuilds the arena, then decode resumes on
+        the kernel path — tokens still match the fault-free gather run."""
+        cfg, params = micro
+        p = (np.arange(6) * 3 + 1).astype(np.int32) % cfg.vocab_size
+        ref = _drive(_engine(cfg, params, attn="gather"), [p], n=8)
+        eng = _engine(
+            cfg, params, attn="paged",
+            fault_plan=FaultPlan(specs=[FaultSpec(point=FP_DECODE, kind="oom", at=3)]),
+        )
+        got = _drive(eng, [p], n=8)
+        assert got == ref
+        assert eng.recoveries == 1
+
+    def test_sliding_window(self):
+        cfg = llama.Config.from_name("tiny-llama-debug", **MICRO, sliding_window=5)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+        tg, tp = _both(cfg, params, _prompts(cfg, lens=(3, 9)), n=8)
+        assert tg == tp
+
+
+#
+# sink-block hygiene (satellite): physical block 0 is dead weight
+#
+
+
+class TestSinkBlockHygiene:
+    @pytest.mark.parametrize("attn", ["gather", "paged"])
+    def test_tokens_invariant_to_block0_garbage(self, micro, attn):
+        """Block 0 backs every table's padding; neither decode path may
+        ever read it into scores.  Poison it mid-run: tokens unchanged."""
+        cfg, params = micro
+        prompts = _prompts(cfg, lens=(3, 7))
+        ref = _drive(_engine(cfg, params, attn=attn, max_batch=2), prompts, n=6)
+
+        eng = _engine(cfg, params, attn=attn, max_batch=2, async_step=False)
+        handles = [eng.submit(p, max_new_tokens=6) for p in prompts]
+        for _ in range(3):
+            eng.step()                                # past prefill, mid-decode
+        arenas = dict(eng.pool.arenas)
+        arenas["k"] = arenas["k"].at[0].set(997.0)
+        arenas["v"] = arenas["v"].at[0].set(-997.0)
+        eng.pool.set_arenas(arenas)
+        eng.drain()
+        got = [tuple(h.result(drive=False).tokens) for h in handles]
+        assert got == ref
+
+
+#
+# structural: the paged decode program really is gather/scatter-free
+#
+
+
+def _prim_names(jaxpr, *, skip=("pallas_call",)):
+    """All primitive names in a jaxpr, recursing into sub-jaxprs (pjit,
+    custom_vjp, scan, ...) but not into pallas kernel bodies."""
+    names = []
+    for eqn in jaxpr.eqns:
+        names.append((eqn.primitive.name, eqn))
+        if eqn.primitive.name in skip:
+            continue
+        for v in eqn.params.values():
+            sub = getattr(v, "jaxpr", None)
+            if sub is not None and hasattr(sub, "eqns"):
+                names.extend(_prim_names(sub, skip=skip))
+            elif hasattr(v, "eqns"):
+                names.extend(_prim_names(v, skip=skip))
+    return names
+
+
+def _decode_args(eng, Bb, nbb):
+    cfg = eng.cfg
+    key = jax.random.PRNGKey(0)
+    return (
+        eng.params,
+        jnp.zeros((Bb,), jnp.int32),
+        jnp.zeros((Bb,), jnp.int32),
+        jnp.zeros((Bb, nbb), jnp.int32),
+        eng.pool.arenas,
+        jnp.zeros((Bb, *key.shape), key.dtype),
+        eng._lora_arenas(),
+        jnp.zeros((Bb,), jnp.int32),
+    )
+
+
+def _census(eng, kind, Bb=4, nbb=4):
+    prog, _ = eng._program(kind, Bb, nbb)
+    jaxpr = jax.make_jaxpr(prog)(*_decode_args(eng, Bb, nbb)).jaxpr
+    arena_shapes = {tuple(a.shape) for a in jax.tree_util.tree_leaves(eng.pool.arenas)}
+    arena_gathers = scatters = 0
+    for name, eqn in _prim_names(jaxpr):
+        if name == "gather" and tuple(eqn.invars[0].aval.shape) in arena_shapes:
+            arena_gathers += 1
+        if name.startswith("scatter"):
+            scatters += 1
+    return arena_gathers, scatters
+
+
+class TestProgramPurity:
+    def test_paged_decode_has_zero_arena_gathers_and_scatters(self, micro):
+        cfg, params = micro
+        eng = _engine(cfg, params, attn="paged")
+        assert _census(eng, "decode_paged") == (0, 0)
+
+    def test_gather_decode_is_the_positive_control(self, micro):
+        """The same census on the gather program finds both op families —
+        proving the walk actually sees through pjit into the program."""
+        cfg, params = micro
+        eng = _engine(cfg, params, attn="gather")
+        arena_gathers, scatters = _census(eng, "decode")
+        assert arena_gathers > 0 and scatters > 0
+
+    def test_quantized_paged_program_is_pure_too(self, micro):
+        cfg, params = micro
+        eng = _engine(cfg, params, attn="paged", kv_dtype="int8")
+        assert _census(eng, "decode_paged") == (0, 0)
+
+
+#
+# knob resolution + observability
+#
+
+
+class TestAttnKnob:
+    def test_paged_stats_counters_and_census(self, micro):
+        cfg, params = micro
+        eng = _engine(cfg, params, attn="paged")
+        _drive(eng, _prompts(cfg, lens=(3, 5)), n=4)
+        st = eng.stats()["attn"]
+        assert st["mode"] == "paged" and st["requested"] == "paged"
+        assert st["fallback_reason"] is None
+        assert st["kernel_steps"] > 0 and st["fallback_steps"] == 0
+        # the module program cache may satisfy this engine's decode_paged
+        # program from an earlier engine; the census key exists either way
+        assert "decode_paged" in eng.compile_counts
+        assert eng.compile_counts["decode"] == 0
+        snap = tt.metrics_snapshot()
+        assert snap["serving.attn.kernel_steps"] == st["kernel_steps"]
+
+    def test_gather_mode_counts_nothing(self, micro):
+        cfg, params = micro
+        eng = _engine(cfg, params, attn="gather")
+        _drive(eng, _prompts(cfg, lens=(3,)), n=4)
+        st = eng.stats()["attn"]
+        assert st["mode"] == "gather" and st["requested"] == "gather"
+        assert st["kernel_steps"] == 0 and st["fallback_steps"] == 0
+        assert st["fallback_reason"] is None
+
+    def test_auto_falls_back_on_cpu_and_counts(self, micro, monkeypatch):
+        """Without THUNDER_TPU_PALLAS_INTERPRET=1, auto on CPU keeps the
+        gather path (tier-1 speed) and counts every decode as a fallback."""
+        monkeypatch.delenv("THUNDER_TPU_PALLAS_INTERPRET", raising=False)
+        cfg, params = micro
+        eng = _engine(cfg, params, attn="auto")
+        _drive(eng, _prompts(cfg, lens=(3,)), n=4)
+        st = eng.stats()["attn"]
+        assert st["mode"] == "gather" and st["requested"] == "auto"
+        assert st["fallback_reason"]
+        assert st["fallback_steps"] > 0
+        assert tt.metrics_snapshot()["serving.attn.fallback_steps"] == st["fallback_steps"]
+
+    def test_forced_paged_rejects_custom_model_fn(self, micro):
+        cfg, params = micro
+        with pytest.raises(ValueError, match="custom model_fn"):
+            tt.serve(lambda *a, **k: None, params, cfg, block_size=4,
+                     num_blocks=16, max_batch=2, cache_dtype=jnp.float32,
+                     attn="paged")
+
+    def test_invalid_knob_value(self, micro):
+        cfg, params = micro
+        with pytest.raises(ValueError, match="attn="):
+            _engine(cfg, params, attn="fancy")
+
+    def test_paged_supported_reasons(self, micro):
+        cfg, _ = micro
+        ok, why = paged_supported(cfg, True)
+        assert ok and why == ""
+        ok, why = paged_supported(cfg, False)
+        assert not ok and "model_fn" in why

@@ -90,7 +90,10 @@ class TestAdapterRegistry:
         with pytest.raises(ValueError, match="shapes"):
             reg.register("x", bad)
         with pytest.raises(ValueError, match="unknown LoRA targets"):
-            AdapterRegistry(cfg, rank=RANK, targets=("wq", "fc_1"))
+            AdapterRegistry(cfg, rank=RANK, targets=("wq", "wq2"))
+        with pytest.raises(ValueError, match="unknown LoRA targets"):
+            # gated-MLP config: GptNeox-style "fc" is not a valid target
+            AdapterRegistry(cfg, rank=RANK, targets=("fc",))
 
     def test_bounded_register_evict_cycle(self, micro):
         cfg, _ = micro
@@ -184,14 +187,16 @@ class TestMixedTenantBatches:
         assert sum(stats["compile_counts"].values()) <= stats["bucket_bound"]
         eng2 = _engine(cfg, params, lora=registry)
         eng2.run([{"prompt": prompts[0], "max_new_tokens": 3, "adapter_id": "bob"}])
-        assert eng2.compile_counts == {"prefill": 0, "prefill_chunk": 0, "decode": 0}
+        assert eng2.compile_counts == {"prefill": 0, "prefill_chunk": 0, "decode": 0,
+                                          "decode_paged": 0}
         registry.register("dave", make_lora_factors(cfg, RANK, jax.random.PRNGKey(99),
                                                     std=0.5))
         try:
             eng3 = _engine(cfg, params, lora=registry)
             eng3.run([{"prompt": prompts[1], "max_new_tokens": 3,
                        "adapter_id": "dave"}])
-            assert eng3.compile_counts == {"prefill": 0, "prefill_chunk": 0, "decode": 0}
+            assert eng3.compile_counts == {"prefill": 0, "prefill_chunk": 0, "decode": 0,
+                                          "decode_paged": 0}
         finally:
             registry.evict("dave")                          # keep the fixture clean
 
@@ -285,6 +290,74 @@ def test_llama_attention_lora_hook(micro):
     np.testing.assert_allclose(
         np.asarray(out_hook), np.asarray(out_merged), rtol=2e-4, atol=2e-4
     )
+
+
+class TestMLPTargets:
+    """LoRA beyond attention (ISSUE 13 satellite): fc/proj matmul deltas."""
+
+    FULL = ("wq", "wk", "wv", "wo", "fc_1", "fc_2", "proj")
+
+    def test_valid_targets_by_mlp_class(self, micro):
+        from thunder_tpu.serving.lora import valid_targets
+
+        cfg, _ = micro                                     # LLaMAMLP (gated)
+        assert valid_targets(cfg) == ("wq", "wk", "wv", "wo", "fc_1", "fc_2", "proj")
+        neox = llama.Config.from_name("tiny-llama-debug", **MICRO,
+                                      mlp_class="GptNeoxMLP")
+        assert valid_targets(neox) == ("wq", "wk", "wv", "wo", "fc", "proj")
+
+    def test_solo_equals_mixed_bit_exact_with_mlp_targets(self, micro):
+        """A full-coverage adapter (attention + MLP) keeps the mixed-tenant
+        determinism contract: tokens identical solo vs batched, and the MLP
+        deltas are live (full-coverage tokens differ from attention-only)."""
+        cfg, params = micro
+        reg = AdapterRegistry(cfg, rank=RANK, max_adapters=2, targets=self.FULL)
+        reg.register("full", make_lora_factors(cfg, RANK, jax.random.PRNGKey(21),
+                                               self.FULL, std=0.5))
+        rng = np.random.default_rng(3)
+        prompts = [rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+                   for n in (4, 7, 9)]
+        ids = ["full", None, "full"]
+        eng = _engine(cfg, params, lora=reg)
+        hs = [eng.submit(p, max_new_tokens=5, adapter_id=a)
+              for p, a in zip(prompts, ids)]
+        eng.drain()
+        mixed = [h.result(drive=False).tokens for h in hs]
+        for p, a, t in zip(prompts, ids, mixed):
+            solo = _engine(cfg, params, lora=reg)
+            s = solo.submit(p, max_new_tokens=5, adapter_id=a).result()
+            np.testing.assert_array_equal(t, s.tokens)
+
+        # the MLP rows do work: same factors minus the MLP targets move the
+        # logits (token argmax can coincide on a micro model, logits can't)
+        from thunder_tpu.models.generate import build_rope_cache, forward_with_cache
+        from thunder_tpu.serving.lora import gather_adapter_slots
+
+        full = make_lora_factors(cfg, RANK, jax.random.PRNGKey(21), self.FULL, std=0.5)
+        attn_only = AdapterRegistry(cfg, rank=RANK, max_adapters=2)
+        attn_only.register("full", {t: full[t] for t in attn_only.targets})
+        cos, sin = build_rope_cache(cfg, 8)
+        idx = jnp.asarray(prompts[0][None, :4], jnp.int32)
+        cache = {k: jnp.zeros((1, cfg.n_layer, cfg.n_query_groups, 8, cfg.head_size))
+                 for k in ("k", "v")}
+        slot = jnp.asarray([1], jnp.int32)
+        lf, _ = forward_with_cache(params, idx, jnp.zeros((1,), jnp.int32), cache,
+                                   cos, sin, cfg,
+                                   lora=gather_adapter_slots(reg.arenas, slot),
+                                   lora_scaling=reg.scaling)
+        la, _ = forward_with_cache(params, idx, jnp.zeros((1,), jnp.int32), cache,
+                                   cos, sin, cfg,
+                                   lora=gather_adapter_slots(attn_only.arenas, slot),
+                                   lora_scaling=attn_only.scaling)
+        assert float(jnp.max(jnp.abs(lf - la))) > 1e-3
+
+    def test_geometry_distinguishes_target_sets(self, micro):
+        cfg, params = micro
+        reg_full = AdapterRegistry(cfg, rank=RANK, max_adapters=2, targets=self.FULL)
+        reg_attn = AdapterRegistry(cfg, rank=RANK, max_adapters=2)
+        assert reg_full.geometry != reg_attn.geometry
+        assert (_engine(cfg, params, lora=reg_full)._static_key()
+                != _engine(cfg, params, lora=reg_attn)._static_key())
 
 
 @pytest.mark.slow

@@ -205,7 +205,8 @@ class TestMeshEngine:
         eng2 = _engine(cfg, params, mesh)
         h = eng2.submit(base, max_new_tokens=4)
         h.result()
-        assert eng2.compile_counts == {"prefill": 0, "prefill_chunk": 0, "decode": 0}
+        assert eng2.compile_counts == {"prefill": 0, "prefill_chunk": 0, "decode": 0,
+                                       "decode_paged": 0}
 
     def test_distinct_device_sets_never_share_programs(self, mesh_served, micro):
         """A same-shape mesh over different devices fingerprints — and
@@ -268,3 +269,46 @@ class TestMeshEngine:
         np.testing.assert_array_equal(r.tokens, _solo_sharded(p_tp, base, cfg, 4, mesh))
         # the donated update preserved the scale placement
         assert eng.pool.k_scale.sharding.is_equivalent_to(want, eng.pool.k_scale.ndim)
+
+
+class TestMeshPagedAttention:
+    """attn="paged" under SPMD (ISSUE 13): the kernels run shard_map-local
+    over tp with heads-local specs matching kv_cache_spec, and mesh-served
+    tokens stay identical to the gather path."""
+
+    def _drive(self, cfg, params, mesh, **kw):
+        eng = _engine(cfg, params, mesh, max_batch=2, **kw)
+        prompts = [(np.arange(n) * 5 + 2).astype(np.int32) % cfg.vocab_size
+                   for n in (3, 8)]
+        hs = [eng.submit(p, max_new_tokens=4) for p in prompts]
+        eng.drain()
+        return [tuple(h.result(drive=False).tokens) for h in hs], eng
+
+    def test_paged_parity_on_mesh(self, micro, tp2):
+        cfg, params = micro
+        mesh, _ = tp2
+        tg, _ = self._drive(cfg, params, mesh, attn="gather")
+        tp_, eng = self._drive(cfg, params, mesh, attn="paged")
+        assert tg == tp_
+        st = eng.stats()["attn"]
+        assert st["mode"] == "paged" and st["kernel_steps"] > 0
+
+    def test_paged_int8_parity_on_mesh(self, micro, tp2):
+        cfg, params = micro
+        mesh, _ = tp2
+        tg, _ = self._drive(cfg, params, mesh, attn="gather", kv_dtype="int8")
+        tp_, _ = self._drive(cfg, params, mesh, attn="paged", kv_dtype="int8")
+        assert tg == tp_
+
+    def test_unshardable_heads_rejected(self, tp2):
+        """tp=2 with n_query_groups=1: kv_cache_spec would degrade to
+        replicated while the shard_map specs split heads — forcing the
+        kernel must refuse instead of silently disagreeing."""
+        mesh, _ = tp2
+        cfg = llama.Config.from_name(
+            "tiny-llama-debug", n_layer=1, n_head=3, n_query_groups=1,
+            n_embd=24, intermediate_size=32, vocab_size=32, block_size=64)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+        with pytest.raises(ValueError, match="heads do not shard"):
+            tt.serve(None, params, cfg, mesh=mesh, block_size=4, num_blocks=16,
+                     max_batch=2, cache_dtype=jnp.float32, attn="paged")

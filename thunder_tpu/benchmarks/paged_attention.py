@@ -1,0 +1,151 @@
+"""Paged-attention decode bench (`bench.py paged_attn`).
+
+Three claims, one artifact (BENCH_PAGED_ATTN.json):
+
+1. **Token parity** — the gated claim on every backend: an ``attn="paged"``
+   engine serves tokens bit-identical to ``attn="gather"`` over a mixed
+   greedy workload (the kernel's online softmax + fused fresh-token fold
+   reproduces the dense math at the token level).
+2. **Program purity** — gated: the compiled ``decode_paged`` program
+   contains zero arena-sized gather primitives and zero scatters, while the
+   gather program (the positive control, proving the census sees through
+   pjit) contains both.
+3. **Arena traffic** — the *why*: the gather decode path moves the whole
+   bucketed cache per step (arena→dense gather, dense re-write, plus the
+   scatter's full-arena copy under donation semantics) where the kernel
+   reads blocks once and writes one slot.  The byte counts are analytic
+   (shapes are static), the ratio is gated >1; wall-clock per step is
+   recorded but only informational — on CPU the kernel runs in Pallas
+   interpret mode, so throughput claims are reserved for real TPU windows.
+"""
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _prim_census(jaxpr, arena_shapes, *, skip=("pallas_call",)):
+    """(arena_gathers, scatters) over a jaxpr, recursing into sub-jaxprs
+    but not pallas kernel bodies — same walk tests/test_paged_attention.py
+    gates on."""
+    arena_gathers = scatters = 0
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name == "gather" and tuple(eqn.invars[0].aval.shape) in arena_shapes:
+            arena_gathers += 1
+        if name.startswith("scatter"):
+            scatters += 1
+        if name in skip:
+            continue
+        for v in eqn.params.values():
+            sub = getattr(v, "jaxpr", None)
+            if sub is None and hasattr(v, "eqns"):
+                sub = v
+            if sub is not None and hasattr(sub, "eqns"):
+                g, s = _prim_census(sub, arena_shapes, skip=skip)
+                arena_gathers += g
+                scatters += s
+    return arena_gathers, scatters
+
+
+def _program_census(eng, kind: str, Bb: int, nbb: int):
+    prog, _ = eng._program(kind, Bb, nbb)
+    key = jax.random.PRNGKey(0)
+    args = (
+        eng.params,
+        jnp.zeros((Bb,), jnp.int32),
+        jnp.zeros((Bb,), jnp.int32),
+        jnp.zeros((Bb, nbb), jnp.int32),
+        eng.pool.arenas,
+        jnp.zeros((Bb, *key.shape), key.dtype),
+        eng._lora_arenas(),
+        jnp.zeros((Bb,), jnp.int32),
+    )
+    jaxpr = jax.make_jaxpr(prog)(*args).jaxpr
+    shapes = {tuple(a.shape) for a in jax.tree_util.tree_leaves(eng.pool.arenas)}
+    return _prim_census(jaxpr, shapes)
+
+
+def paged_attention_bench(on_tpu: bool = False, *, reps: int = 3,
+                          n_requests: int = 4, max_new: int = 8) -> dict:
+    """Returns ``{"shapes": ..., "results": ...}`` in the BENCH_MICRO
+    artifact shape."""
+    import thunder_tpu as tt
+    from thunder_tpu.models import llama
+
+    cfg = llama.Config.from_name(
+        "tiny-llama-debug",
+        n_layer=2, n_head=4, n_query_groups=2, n_embd=32,
+        intermediate_size=64, vocab_size=64, block_size=64,
+    )
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(0, cfg.vocab_size, (3 + (i % 3) * 4,)).astype(np.int32)
+        for i in range(n_requests)
+    ]
+    Bb, nbb, bs = 4, 6, 4
+    base_kw = dict(block_size=bs, num_blocks=32, max_batch=4,
+                   cache_dtype=jnp.float32, batch_buckets=(Bb,),
+                   block_buckets=(nbb,), prefill_buckets=(16,))
+
+    def drive(attn):
+        eng = tt.serve(None, params, cfg, attn=attn, **base_kw)
+        hs = [eng.submit(p, max_new_tokens=max_new) for p in prompts]
+        t0 = time.perf_counter()
+        eng.drain()
+        dt = time.perf_counter() - t0
+        return [tuple(h.result(drive=False).tokens) for h in hs], dt, eng
+
+    # warm both program sets, collect tokens + census off the warm engines
+    toks_g, _, eng_g = drive("gather")
+    toks_p, _, eng_p = drive("paged")
+    parity_ok = toks_g == toks_p
+    tokens_checked = sum(len(t) for t in toks_g)
+    g_gathers, g_scatters = _program_census(eng_g, "decode", Bb, nbb)
+    p_gathers, p_scatters = _program_census(eng_p, "decode_paged", Bb, nbb)
+    kernel_steps = eng_p.stats()["attn"]["kernel_steps"]
+
+    # interleaved best-of-reps: informational on CPU (interpret-mode kernel)
+    t_g, t_p = [], []
+    for _ in range(reps):
+        t_g.append(drive("gather")[1])
+        t_p.append(drive("paged")[1])
+    gather_s, paged_s = min(t_g), min(t_p)
+
+    # analytic arena traffic per decode step (static shapes, f32):
+    # gather path: arena->dense gather (K+V), the dense cache write, the
+    # dense read inside attention, and the scatter's full-arena copy under
+    # donation; paged path: the kernel reads each table block once and the
+    # write touches one slot per layer/group
+    L, ng, hs_ = cfg.n_layer, cfg.n_query_groups, cfg.head_size
+    itm = 4
+    dense_elems = Bb * nbb * bs * L * ng * hs_          # one K or V dense cache
+    arena_elems = 32 * L * ng * bs * hs_                # one whole arena
+    dense_bytes = 2 * itm * (3 * dense_elems + arena_elems)
+    paged_bytes = 2 * itm * (dense_elems + Bb * L * ng * hs_)
+    ratio = dense_bytes / paged_bytes
+
+    return {
+        "shapes": {"cfg": "tiny-llama-debug(2L,4h,2g)", "n_requests": n_requests,
+                   "max_new_tokens": max_new, "reps": reps,
+                   "bucket": [Bb, nbb], "block_size": bs},
+        "results": {
+            "parity_ok": bool(parity_ok),
+            "tokens_checked": int(tokens_checked),
+            "kernel_steps": int(kernel_steps),
+            "paged_arena_gathers": int(p_gathers),
+            "paged_scatters": int(p_scatters),
+            "gather_arena_gathers": int(g_gathers),
+            "gather_scatters": int(g_scatters),
+            "drive_gather_ms": round(gather_s * 1e3, 3),
+            "drive_paged_ms": round(paged_s * 1e3, 3),
+            "paged_vs_gather_x": round(gather_s / paged_s, 4),
+            "dense_bytes_per_step": int(dense_bytes),
+            "paged_bytes_per_step": int(paged_bytes),
+            "arena_traffic_ratio_x": round(ratio, 3),
+        },
+    }

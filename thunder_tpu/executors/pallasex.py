@@ -41,7 +41,10 @@ except ImportError:  # pragma: no cover
 from thunder_tpu.core.prims import PrimIDs, prim_lookup
 from thunder_tpu.extend import OperatorExecutor, add_default_executor, register_executor
 
-__all__ = ["ex", "pallas_ex", "flash_sdpa", "flash_sdpa_backward"]
+__all__ = [
+    "ex", "pallas_ex", "flash_sdpa", "flash_sdpa_backward",
+    "paged_attn_decode", "paged_token_write", "paged_available",
+]
 
 # exp(MASK_VALUE - lse) underflows to 0 without the inf-inf NaN hazard of -inf
 _MASK_VALUE = -0.7 * float(np.finfo(np.float32).max)
@@ -1027,6 +1030,239 @@ def _ce_checker(logits, target):
 
 
 ex.register_implementation(PrimIDs.CROSS_ENTROPY_FWD, _ce_op, checker=_ce_checker)
+
+# ---------------------------------------------------------------------------
+# Paged-attention decode: flash-decoding over the serving KV block arena.
+#
+# The serving engine's decode step historically paid gather_dense/scatter —
+# one full-cache copy per token per request — to reassemble the paged arena
+# into the dense layout forward_with_cache wants.  These two kernels read and
+# write the arena *in place*:
+#
+# - ``paged_attn_decode``: grid (request, kv-group, kv-block); the block
+#   table and positions ride in as **scalar-prefetch** operands so the
+#   BlockSpec index maps fetch each request's physical arena blocks directly
+#   (no gather primitive anywhere in the program).  Online softmax
+#   accumulates across blocks in VMEM scratch; the positional keep-mask
+#   (strictly-older slots, optional sliding window) and the int8/fp8 dequant
+#   from the scale arenas are fused in-kernel; GQA is native (q reshaped to
+#   (B, ng, rep, hs), one grid step per KV group).  The *fresh* token's K/V
+#   (this step's projection, at the cache compute dtype — exactly what the
+#   dense path would have written before attending) joins as the final
+#   online-softmax term, so every row has at least one kept key and the
+#   quantized path attends the diagonal at full precision, matching
+#   quantize-on-scatter semantics bit-for-bit.
+# - ``paged_token_write``: the scatter_token replacement — one grid step per
+#   request lands the fresh K/V (or its quantization scale) in its
+#   ``table[pos // bs]``/``pos % bs`` arena slot via an aliased output
+#   (``input_output_aliases``), so the update is in place and the decode
+#   program stays scatter-free.
+#
+# Both run under the Pallas interpreter off-TPU, so CPU tier-1 tests execute
+# the real kernels (``tt.serve(..., attn="paged")``).
+# ---------------------------------------------------------------------------
+
+
+def paged_available() -> bool:
+    """Whether the paged decode kernels can run here: Pallas enabled (TPU, or
+    interpret mode opted in) and the TPU lowering package imports (scalar
+    prefetch and VMEM scratch come from ``pallas.tpu`` even when
+    interpreted)."""
+    return _pallas_available() and pltpu is not None
+
+
+def _paged_kernel(tab_ref, pos_ref, q_ref, k_ref, v_ref, *rest, bs, window,
+                  quantized, cdtype, sm):
+    if quantized:
+        ks_ref, vs_ref, fk_ref, fv_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        ks_ref = vs_ref = None
+        fk_ref, fv_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    i, j = pl.program_id(0), pl.program_id(2)
+    nb = pl.num_programs(2)
+    p_i = pos_ref[i]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _MASK_VALUE)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    def _dequant(x_ref, s_ref, dt):
+        x = x_ref[0, 0, 0]                                 # (bs, hs) storage dtype
+        if s_ref is not None:
+            x = (x.astype(jnp.float32) * s_ref[0, 0, 0][:, None]).astype(cdtype)
+        return x.astype(dt)
+
+    def _online(s, v, dt):
+        # one online-softmax step: fold scores ``s`` (rep, n) / values ``v``
+        # (n, hs) into the running (m, l, acc) scratch
+        m_prev, l_prev = m_ref[...], l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        m_ref[...] = m_new
+        l_ref[...] = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+            p.astype(dt), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    # skip blocks with no kept slot: entirely future (sink-padded table
+    # entries included), or entirely beyond the sliding window.  Every block
+    # that *does* run keeps >= 1 slot, so exp() never sees an all-masked row.
+    run = (j * bs) < p_i
+    if window is not None:
+        run = jnp.logical_and(run, (j * bs + bs - 1) > (p_i - window))
+
+    @pl.when(run)
+    def _block():
+        q = q_ref[0, 0]                                    # (rep, hs)
+        k = _dequant(k_ref, ks_ref, q.dtype)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) / sm                                             # (rep, bs)
+        posn = j * bs + jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1)
+        keep = posn < p_i                                  # strictly older: the
+        if window is not None:                             # fresh token is the
+            keep = jnp.logical_and(keep, posn > p_i - window)  # final term below
+        s = jnp.where(keep, s, _MASK_VALUE)
+        _online(s, _dequant(v_ref, vs_ref, q.dtype), q.dtype)
+
+    @pl.when(j == nb - 1)
+    def _finalize():
+        q = q_ref[0, 0]
+        fk = fk_ref[0, 0].astype(q.dtype)                  # (hs,) at cdtype
+        fv = fv_ref[0, 0].astype(q.dtype)
+        s_f = jax.lax.dot_general(
+            q, fk[None, :], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) / sm                                             # (rep, 1), never masked
+        _online(s_f, fv[None, :], q.dtype)
+        o_ref[0, 0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+
+
+def paged_attn_decode(q, k_arena, v_arena, fresh_k, fresh_v, tables, pos, *,
+                      layer, k_scale=None, v_scale=None, window=None):
+    """Single-token attention straight off the KV block arena, one layer.
+
+    ``q``: (B, nh, hs) queries at the compute dtype; ``k_arena``/``v_arena``:
+    the FULL (num_blocks, L, ng, bs, hs) serving-pool arenas (storage dtype;
+    int8/fp8 when quantized) — ``layer`` picks the layer *inside the BlockSpec
+    index map*, so no per-layer arena slice (a full-arena copy) ever
+    materializes; ``fresh_k``/``fresh_v``: (B, ng, hs) this step's projected
+    K/V at the cache compute dtype (NOT yet in the arena — the caller lands
+    them with :func:`paged_token_write` afterwards); ``tables``: (B, nbb)
+    int32 sink-padded block tables; ``pos``: (B,) int32 global positions;
+    ``k_scale``/``v_scale``: (num_blocks, L, ng, bs) float32 dequant scales
+    (both or neither); ``window``: ``cfg.sliding_window``.  Returns
+    (B, nh, hs) attention outputs at ``q.dtype``.
+    """
+    B, nh, hs = q.shape
+    num_blocks, _L, ng, bs, _ = k_arena.shape
+    nbb = int(tables.shape[1])
+    rep = nh // ng
+    assert rep * ng == nh, (nh, ng)
+    quantized = k_scale is not None
+    q4 = q.reshape(B, ng, rep, hs)
+
+    arena_spec = pl.BlockSpec(
+        (1, 1, 1, bs, hs), lambda i, g, j, tab, p: (tab[i, j], layer, g, 0, 0))
+    scale_spec = pl.BlockSpec(
+        (1, 1, 1, bs), lambda i, g, j, tab, p: (tab[i, j], layer, g, 0))
+    fresh_spec = pl.BlockSpec((1, 1, hs), lambda i, g, j, tab, p: (i, g, 0))
+    q_spec = pl.BlockSpec((1, 1, rep, hs), lambda i, g, j, tab, p: (i, g, 0, 0))
+
+    in_specs = [q_spec, arena_spec, arena_spec]
+    args = [q4, k_arena, v_arena]
+    if quantized:
+        in_specs += [scale_spec, scale_spec]
+        args += [k_scale, v_scale]
+    in_specs += [fresh_spec, fresh_spec]
+    args += [fresh_k, fresh_v]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, ng, nbb),
+        in_specs=in_specs,
+        out_specs=q_spec,
+        scratch_shapes=[
+            pltpu.VMEM((rep, 1), jnp.float32),
+            pltpu.VMEM((rep, 1), jnp.float32),
+            pltpu.VMEM((rep, hs), jnp.float32),
+        ],
+    )
+    kwargs = {}
+    if not _interpret():
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_kernel, bs=bs, window=window, quantized=quantized,
+            cdtype=fresh_k.dtype, sm=float(np.sqrt(hs)),
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, ng, rep, hs), q.dtype),
+        interpret=_interpret(),
+        **kwargs,
+    )(tables, pos, *args)
+    return out.reshape(B, nh, hs)
+
+
+def _paged_write_kernel(tab_ref, pos_ref, a_ref, v_ref, o_ref, *, rank5):
+    del tab_ref, pos_ref, a_ref  # routing happens in the BlockSpec index maps
+    if rank5:
+        o_ref[0, :, :, 0, :] = v_ref[0]
+    else:
+        o_ref[0, :, :, 0] = v_ref[0]
+
+
+def paged_token_write(arena, vals, tables, pos, *, block_size):
+    """In-place single-token arena write (the scatter_token replacement).
+
+    ``arena``: (num_blocks, L, ng, bs, hs) K/V arena — or (num_blocks, L, ng,
+    bs) scale arena; ``vals``: (B, L, ng, hs) (or (B, L, ng)) at the arena
+    dtype — quantize *before* calling (``quant.quantize_kv``), so the stored
+    values match scatter_token_q exactly.  Each request's destination block
+    and slot (``tables[i, pos[i] // bs]``, ``pos[i] % bs``) are computed in
+    the BlockSpec index map; the arena aliases the output, so untouched
+    blocks keep their bytes and no scatter primitive appears in the program.
+    Padding rows (all-sink tables, pos 0) land in sink block 0, whose
+    contents are never attended.
+    """
+    bs = block_size
+    B = vals.shape[0]
+    if arena.ndim == 5:
+        _, L, ng, _, hs = arena.shape
+        a_spec = pl.BlockSpec(
+            (1, L, ng, 1, hs),
+            lambda i, tab, p: (tab[i, p[i] // bs], 0, 0, p[i] % bs, 0))
+        v_spec = pl.BlockSpec((1, L, ng, hs), lambda i, tab, p: (i, 0, 0, 0))
+    else:
+        _, L, ng, _ = arena.shape
+        a_spec = pl.BlockSpec(
+            (1, L, ng, 1),
+            lambda i, tab, p: (tab[i, p[i] // bs], 0, 0, p[i] % bs))
+        v_spec = pl.BlockSpec((1, L, ng), lambda i, tab, p: (i, 0, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B,),
+        in_specs=[a_spec, v_spec],
+        out_specs=a_spec,
+    )
+    kwargs = {}
+    if not _interpret():
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",))
+    return pl.pallas_call(
+        functools.partial(_paged_write_kernel, rank5=arena.ndim == 5),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(arena.shape, arena.dtype),
+        input_output_aliases={2: 0},   # arena in == arena out (in-place)
+        interpret=_interpret(),
+        **kwargs,
+    )(tables, pos, arena, vals)
+
 
 # install the fast paths so XLA fusion regions and TrainStep trace evaluation
 # reach the same kernels

@@ -79,9 +79,11 @@ def _rope(x, cos, sin):
     return (x * cos + rotated * sin).astype(x.dtype)
 
 
-def _mlp(mp, x, cfg: Config, *, quantized=False):
+def _mlp(mp, x, cfg: Config, *, quantized=False, lora=None, lora_scaling=1.0):
     lin = partial(_linear, quantized=quantized)
     if cfg.mlp_class == "LLaMAMoE":
+        # stacked per-expert weights: per-request LoRA deltas are not
+        # supported here (AdapterRegistry rejects MoE MLP targets)
         E, k = cfg.n_expert, cfg.n_expert_per_token
         router = x.astype(jnp.float32) @ mp["gate"].T.astype(jnp.float32)
         top_logits, top_idx = jax.lax.top_k(router, k)
@@ -93,20 +95,28 @@ def _mlp(mp, x, cfg: Config, *, quantized=False):
             contrib = xe * w_e[..., None].astype(x.dtype)
             y = contrib if y is None else y + contrib
         return y
+
+    def ll(name, inp, bias=None):
+        # one targeted matmul: the per-request LoRA delta rides on the
+        # matmul INPUT (same placement rule as _project_qkv / wo)
+        o = lin(inp, mp[name], mp.get(bias) if bias else None)
+        if lora is not None and name in lora:
+            o = o + _lora_delta(inp, *lora[name], lora_scaling)
+        return o
+
     if cfg.mlp_class == "LLaMAMLP":
-        return lin(
-            jax.nn.silu(lin(x, mp["fc_1"], mp.get("fc_1_b"))) * lin(x, mp["fc_2"], mp.get("fc_2_b")),
-            mp["proj"], mp.get("proj_b"),
-        )
+        return ll("proj", jax.nn.silu(ll("fc_1", x, "fc_1_b")) * ll("fc_2", x, "fc_2_b"), "proj_b")
     if cfg.mlp_class == "GemmaMLP":
-        return lin(
-            jax.nn.gelu(lin(x, mp["fc_1"], mp.get("fc_1_b")), approximate=cfg.gelu_approximate == "tanh")
-            * lin(x, mp["fc_2"], mp.get("fc_2_b")),
-            mp["proj"], mp.get("proj_b"),
+        return ll(
+            "proj",
+            jax.nn.gelu(ll("fc_1", x, "fc_1_b"), approximate=cfg.gelu_approximate == "tanh")
+            * ll("fc_2", x, "fc_2_b"),
+            "proj_b",
         )
-    return lin(
-        jax.nn.gelu(lin(x, mp["fc"], mp.get("fc_b")), approximate=cfg.gelu_approximate == "tanh"),
-        mp["proj"], mp.get("proj_b"),
+    return ll(
+        "proj",
+        jax.nn.gelu(ll("fc", x, "fc_b"), approximate=cfg.gelu_approximate == "tanh"),
+        "proj_b",
     )
 
 
@@ -360,10 +370,12 @@ def forward_with_cache(params, idx, pos, cache, cos_all, sin_all, cfg: Config, *
         new_v.append(cv)
         if cfg.parallel_residual:
             n2 = n1 if cfg.shared_attention_norm else _norm(x, bp["norm_2"], cfg, bp.get("norm_2_b"))
-            x = x + h + _mlp(bp["mlp"], n2, cfg, quantized=quantized)
+            x = x + h + _mlp(bp["mlp"], n2, cfg, quantized=quantized,
+                             lora=lora_l, lora_scaling=lora_scaling)
         else:
             x = x + h
-            x = x + _mlp(bp["mlp"], _norm(x, bp["norm_2"], cfg, bp.get("norm_2_b")), cfg, quantized=quantized)
+            x = x + _mlp(bp["mlp"], _norm(x, bp["norm_2"], cfg, bp.get("norm_2_b")), cfg,
+                         quantized=quantized, lora=lora_l, lora_scaling=lora_scaling)
 
     cache = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
     x = _norm(x, params["ln_f"], cfg, params.get("ln_f_b"))

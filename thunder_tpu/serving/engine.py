@@ -270,6 +270,7 @@ class ServingEngine:
         flight_recorder=None,
         mesh=None,
         shardings=None,
+        attn: str = "auto",
         async_step: bool = True,
         prefill_chunk: int | None = None,
         fault_plan=None,
@@ -313,6 +314,35 @@ class ServingEngine:
             cfg, num_blocks=num_blocks, block_size=block_size, dtype=dtype,
             kv_dtype=kv_dtype, mesh=mesh,
         )
+        # decode attention path, resolved ONCE at construction (each engine
+        # builds exactly one decode program kind, so the program-set bound
+        # in stats() is unchanged): "paged" runs the Pallas flash-decoding
+        # kernel straight off the block arena (interpret mode off-TPU),
+        # "gather" keeps the dense gather/scatter pair, "auto" takes the
+        # kernel when it is structurally supported AND Pallas is enabled on
+        # this backend (TPU, or THUNDER_TPU_PALLAS_INTERPRET=1), else falls
+        # back to gather and counts serving.attn.fallback_steps
+        if attn not in ("auto", "paged", "gather"):
+            raise ValueError(
+                f"attn= must be 'auto', 'paged', or 'gather', got {attn!r}")
+        from thunder_tpu.executors.pallasex import paged_available
+        from thunder_tpu.serving.paged_attention import paged_supported
+
+        ok, why = paged_supported(cfg, self._forward is forward_with_cache, mesh)
+        self._attn_requested = attn
+        if attn == "paged":
+            if not ok:
+                raise ValueError(f"attn='paged' is unsupported here: {why}")
+            self.attn, self._attn_fallback_reason = "paged", None
+        elif attn == "auto" and ok and paged_available():
+            self.attn, self._attn_fallback_reason = "paged", None
+        elif attn == "auto":
+            self.attn = "gather"
+            self._attn_fallback_reason = why or "pallas disabled on this backend"
+        else:
+            self.attn, self._attn_fallback_reason = "gather", None
+        self.attn_kernel_steps = 0
+        self.attn_fallback_steps = 0
         # multi-tenant LoRA: a bounded AdapterRegistry shared across engines;
         # its stacked factor arenas are program *arguments* (register/evict
         # are data writes), only its geometry enters the program identity
@@ -394,7 +424,8 @@ class ServingEngine:
         self.step_calls = 0
         self.tokens_generated = 0
         self._occupancy_sum = 0
-        self.compile_counts = {"prefill": 0, "prefill_chunk": 0, "decode": 0}
+        self.compile_counts = {"prefill": 0, "prefill_chunk": 0, "decode": 0,
+                               "decode_paged": 0}
         # async lanes: the in-flight futures table — one deferred decode
         # record plus any deferred prefill-piece records, harvested at the
         # top of the next step (the only place the host blocks)
@@ -418,6 +449,8 @@ class ServingEngine:
         self._m_pool_util = reg0.gauge("serving.pool.utilization")
         self._m_pool_free = reg0.gauge("serving.pool.free_blocks")
         self._m_pool_low_water = reg0.gauge("serving.pool.free_blocks_low_water")
+        self._m_attn_kernel = reg0.counter("serving.attn.kernel_steps")
+        self._m_attn_fallback = reg0.counter("serving.attn.fallback_steps")
         if self.async_step:
             self._m_stall = reg0.histogram("serving.decode.stall_s")
             self._m_overlap = reg0.gauge("serving.step.overlap_frac")
@@ -775,6 +808,13 @@ class ServingEngine:
             "decode_stall_s_mean": (self._stall_s_sum / n) if n else None,
             "overlap_frac_mean": (self._overlap_frac_sum / n) if n else None,
             "compile_counts": dict(self.compile_counts),
+            "attn": {
+                "mode": self.attn,
+                "requested": self._attn_requested,
+                "fallback_reason": self._attn_fallback_reason,
+                "kernel_steps": self.attn_kernel_steps,
+                "fallback_steps": self.attn_fallback_steps,
+            },
             "bucket_bound": kinds * len(self._table_widths),
             "prefix_lookups": self._prefix_lookups,
             "prefix_hits": self._prefix_hits,
@@ -1146,20 +1186,29 @@ class ServingEngine:
             toks_d, pos_d = jnp.asarray(toks), jnp.asarray(host_pos)
             tables_d, keys_d = jnp.asarray(tables), jnp.asarray(keys)
             slots_d = jnp.asarray(slots)
-        prog, compiled = self._program("decode", Bb, nbb)
+        kind = "decode_paged" if self.attn == "paged" else "decode"
+        prog, compiled = self._program(kind, Bb, nbb)
         lora_arenas = self._lora_arenas()
         if self.mesh is not None and self._mesh_collectives is None:
             # census BEFORE the call: the arenas are donated by it
             self._mesh_collectives = self._collective_census(
-                ("decode", Bb, nbb), prog,
+                (kind, Bb, nbb), prog,
                 (self.params, toks_d, pos_d, tables_d, pool.arenas,
                  keys_d, lora_arenas, slots_d),
             )
+        if self.attn == "paged":
+            self.attn_kernel_steps += 1
+            self._m_attn_kernel.inc()
+        elif self._attn_requested == "auto":
+            # auto resolved to gather: every decode step is a fallback step
+            self.attn_fallback_steps += 1
+            self._m_attn_fallback.inc()
         tr = self._tracer
         if tr is not None:
             for r in running:
                 tr.begin(r.rid, "decode", step=self.decode_steps,
-                         compile=compiled, bucket=[Bb, nbb], lane="decode")
+                         compile=compiled, bucket=[Bb, nbb], lane="decode",
+                         attn=self.attn)
         nxt, new_keys, new_pos, arenas = prog(
             self.params, toks_d, pos_d, tables_d, pool.arenas,
             keys_d, lora_arenas, slots_d,
@@ -1609,7 +1658,8 @@ class ServingEngine:
         if compiled:
             build = {"prefill": self._build_prefill,
                      "prefill_chunk": self._build_prefill_chunk,
-                     "decode": self._build_decode}[kind]
+                     "decode": self._build_decode,
+                     "decode_paged": self._build_decode_paged}[kind]
             prog = build(a, b)
             # a genuinely new program for this geometry: count the compile
             self.compile_counts[kind] += 1
@@ -1809,6 +1859,42 @@ class ServingEngine:
 
         return decode
 
+    def _build_decode_paged(self, Bb: int, nbb: int) -> Callable:
+        """The kernel twin of :meth:`_build_decode`: same signature, same
+        sampling/key-chain math, same returns — but attention runs the
+        Pallas paged kernel straight off the arenas (scalar-prefetch block
+        tables, in-kernel keep-mask + dequant) and the fresh token lands via
+        the aliased write kernel, so the compiled program contains zero
+        gather/scatter primitives (tests assert this on the jaxpr) and no
+        dense cache ever materializes."""
+        from thunder_tpu.serving.paged_attention import forward_paged, write_fresh_kv
+
+        cfg, temp = self.cfg, self.temperature
+        qkv = self.pool.quantized_kv
+        cdtype = jnp.dtype(self.pool.dtype)
+        kv_dtype = jnp.dtype(self.pool.kv_dtype) if qkv else None
+        bs = self.pool.block_size
+        cap = self.pool.capacity_tokens(nbb)
+        cos_all, sin_all = build_rope_cache(cfg, cap)
+        mesh = self.mesh
+
+        @partial(jax.jit, donate_argnums=(4,), **self._jit_kwargs("decode_paged"))
+        def decode_paged(params, toks, pos, tables, arenas, keys, lora, slots):
+            logits, fresh = forward_paged(
+                params, toks[:, None], pos, arenas, tables, cos_all, sin_all,
+                cfg, cdtype=cdtype, mesh=mesh, **self._fwd_kwargs(lora, slots),
+            )
+            sp = jax.vmap(jax.random.split)(keys)          # per-request key chains
+            new_keys, subs = sp[:, 0], sp[:, 1]
+            nxt = jax.vmap(lambda l, k: sample_token(l[None], temp, k)[0])(
+                logits[:, 0], subs
+            )
+            arenas = write_fresh_kv(arenas, fresh, tables, pos, block_size=bs,
+                                    kv_dtype=kv_dtype, mesh=mesh)
+            return nxt, new_keys, pos + 1, arenas
+
+        return decode_paged
+
 
 def serve(model_fn, params, cfg, **kwargs) -> ServingEngine:
     """Builds a :class:`ServingEngine` over ``model_fn`` (``None`` → the
@@ -1832,6 +1918,17 @@ def serve(model_fn, params, cfg, **kwargs) -> ServingEngine:
     route each request through a registered LoRA adapter — batches freely
     mix tenants, and the compiled-program set grows only with the registry
     *geometry* (rank, slots, targets), never with adapter ids.
+
+    Paged-attention decode: ``attn="paged"`` runs decode through the Pallas
+    flash-decoding kernel straight off the KV block arena (scalar-prefetch
+    block tables, in-kernel keep-mask and int8/fp8 dequant, aliased
+    in-place fresh-token write) — the compiled decode program contains zero
+    gather/scatter primitives and no dense cache copy.  ``attn="auto"``
+    (default) takes the kernel when structurally supported and Pallas is
+    enabled (TPU, or ``THUNDER_TPU_PALLAS_INTERPRET=1`` for interpret mode
+    on CPU), else falls back to the gather path, counting
+    ``serving.attn.fallback_steps``; ``attn="gather"`` pins the dense
+    gather/scatter pair.  Served tokens are bit-identical across all three.
 
     Async serving: ``async_step=True`` (default) runs ``step()`` as an
     event loop — decode for batch *k* is dispatched and the host admits,

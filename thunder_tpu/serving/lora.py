@@ -28,8 +28,11 @@ Design points:
   whether it runs alone or batched with other tenants (tested
   differentially, same contract as the base engine).
 
-Targets are the attention projections (``wq``/``wk``/``wv``/``wo``) —
-the classic LoRA placement; pass a subset to shrink the arenas.
+Default targets are the attention projections (``wq``/``wk``/``wv``/``wo``)
+— the classic LoRA placement; pass a subset to shrink the arenas, or add
+the MLP matmuls (``fc_1``/``fc_2``/``proj`` for gated MLPs, ``fc``/``proj``
+for GptNeox-style; MoE's stacked expert weights are unsupported) for
+full-coverage adapters.
 """
 from __future__ import annotations
 
@@ -43,6 +46,7 @@ __all__ = [
     "RegistryFullError",
     "gather_adapter_slots",
     "make_lora_factors",
+    "valid_targets",
 ]
 
 BASE_SLOT = 0  # reserved all-zero adapter slot (requests without adapter_id)
@@ -55,14 +59,32 @@ class RegistryFullError(RuntimeError):
     Evict an adapter (or build a bigger registry) first."""
 
 
+def valid_targets(cfg) -> tuple[str, ...]:
+    """Every LoRA target the model class supports: the attention
+    projections always, plus the MLP matmuls by ``mlp_class`` — gated MLPs
+    (LLaMA/Gemma) expose ``fc_1``/``fc_2``/``proj``, GptNeox-style exposes
+    ``fc``/``proj``, and MoE exposes none (its expert weights are stacked
+    ``(E, ...)`` tensors; a per-request delta has no single matmul to ride)."""
+    if cfg.mlp_class == "LLaMAMoE":
+        return _TARGETS
+    if cfg.mlp_class in ("LLaMAMLP", "GemmaMLP"):
+        return _TARGETS + ("fc_1", "fc_2", "proj")
+    return _TARGETS + ("fc", "proj")
+
+
 def _target_features(cfg, target: str) -> tuple[int, int]:
-    """(in_features, out_features) of one attention target weight."""
+    """(in_features, out_features) of one target weight."""
     hs, nh, ng, C = cfg.head_size, cfg.n_head, cfg.n_query_groups, cfg.n_embd
+    I = cfg.intermediate_size
     return {
         "wq": (C, nh * hs),
         "wk": (C, ng * hs),
         "wv": (C, ng * hs),
         "wo": (nh * hs, C),
+        "fc_1": (C, I),
+        "fc_2": (C, I),
+        "fc": (C, I),
+        "proj": (I, C),
     }[target]
 
 
@@ -84,9 +106,13 @@ class AdapterRegistry:
             raise ValueError(f"rank must be >= 1, got {rank}")
         if max_adapters < 1:
             raise ValueError(f"max_adapters must be >= 1, got {max_adapters}")
-        unknown = [t for t in targets if t not in _TARGETS]
+        supported = valid_targets(cfg)
+        unknown = [t for t in targets if t not in supported]
         if unknown:
-            raise ValueError(f"unknown LoRA targets {unknown}; supported: {_TARGETS}")
+            raise ValueError(
+                f"unknown LoRA targets {unknown}; supported for "
+                f"mlp_class={cfg.mlp_class!r}: {supported}"
+            )
         self.cfg = cfg
         self.rank = int(rank)
         self.max_adapters = int(max_adapters)

@@ -115,6 +115,9 @@ def program_shardings(kind: str, params, mesh: Mesh, arena_sh: NamedSharding) ->
       → ``(nxt, new_keys, new_pos, arenas)`` (scatter destinations are
       derived in-program from ``tables``/``pos``, and the returned device
       outputs chain into the next step's inputs)
+    - decode_paged: same row as decode — the kernel path keeps the exact
+      decode signature/returns; inside the program the paged kernels run
+      under ``shard_map`` with heads-local specs matching ``arena_sh``
 
     Donation composes with the async engine's deferred materialization:
     the returned arena pytree carries the same per-shard sharding in and
@@ -135,7 +138,7 @@ def program_shardings(kind: str, params, mesh: Mesh, arena_sh: NamedSharding) ->
             in_shardings=(param_sh, repl, repl, arena_sh, repl, repl, repl, repl),
             out_shardings=(arena_sh, repl),
         )
-    assert kind == "decode", kind
+    assert kind in ("decode", "decode_paged"), kind
     return dict(
         in_shardings=(param_sh, repl, repl, repl, arena_sh, repl, repl, repl),
         out_shardings=(repl, repl, repl, arena_sh),

@@ -1,0 +1,223 @@
+"""Paged-attention decode: the serving forward that never densifies the KV.
+
+The gather decode path (``engine._build_decode``) reassembles every
+request's KV from the block arena into the dense ``forward_with_cache``
+layout and scatters the fresh token back — one full-cache copy per token
+per request, in *both* directions.  This module is the kernel-backed twin:
+:func:`forward_paged` runs the same per-layer math as
+``models.generate.forward_with_cache`` (norms, QKV projection + rope, LoRA
+deltas, MLP, head) but attention reads K/V **directly from the arena** via
+``executors.pallasex.paged_attn_decode`` (flash-decoding over the block
+table, positional keep-mask and int8/fp8 dequant fused in-kernel), and
+:func:`write_fresh_kv` lands the step's fresh K/V in place via
+``paged_token_write`` — so the compiled decode program contains zero
+gather/scatter primitives (asserted in tests/test_paged_attention.py).
+
+Parity contract (the serving bit-exactness bar): the kernel scores the
+arena's strictly-older slots and folds the *fresh* token — at the cache
+compute dtype, exactly what the dense path would have just written — as the
+final online-softmax term, so greedy/temperature tokens match the gather
+path and solo ``generate()`` across f32/bf16 caches, int8/fp8 KV, LoRA
+mixes, and meshes.  Quantization happens outside the kernels with the same
+``quant.quantize_kv`` call ``scatter_token_q`` uses, so stored bytes are
+bit-identical too.
+
+Mesh: the kernels are plain ``pallas_call``s with no SPMD rule, so under a
+mesh each call is wrapped in ``jax.shard_map`` over the ``tp`` axis with
+heads-local specs matching ``distributed.kv_cache_spec`` (arena heads at
+axis 2, query heads at axis 1) — attention stays device-local, exactly like
+the gathered path.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from thunder_tpu.executors.pallasex import (
+    paged_attn_decode,
+    paged_token_write,
+    pltpu as _pltpu,
+)
+from thunder_tpu.models.generate import (
+    _linear,
+    _lora_delta,
+    _mlp,
+    _norm,
+    _project_qkv,
+)
+from thunder_tpu.serving.quant import quantize_kv
+
+__all__ = ["forward_paged", "write_fresh_kv", "paged_supported"]
+
+
+def _smap(fn, mesh, in_specs, out_specs):
+    """shard_map across jax versions: ``jax.shard_map`` (check_vma) when
+    present, else ``jax.experimental.shard_map`` (check_rep)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     check_rep=False)
+
+
+def paged_supported(cfg, model_fn_is_default: bool, mesh=None) -> tuple[bool, str]:
+    """Structural support check for the paged decode path: ``(ok, why)``.
+
+    The kernel mirrors ``forward_with_cache``'s math, so a custom
+    ``model_fn`` can't ride it; the TPU lowering package must import (scalar
+    prefetch / VMEM scratch live in ``pallas.tpu`` even when interpreted);
+    and under a mesh the heads must actually shard over ``tp`` the way
+    ``kv_cache_spec`` lays the arena out (a degraded/replicated spec would
+    silently disagree with the shard_map specs here)."""
+    if not model_fn_is_default:
+        return False, "custom model_fn (kernel mirrors forward_with_cache)"
+    if _pltpu is None:
+        return False, "pallas TPU lowering package unavailable"
+    if mesh is not None:
+        if "tp" not in mesh.axis_names:
+            return False, "mesh has no tp axis"
+        tp = int(mesh.shape["tp"])
+        if tp > 1 and (cfg.n_query_groups % tp != 0 or cfg.n_head % tp != 0):
+            return False, (
+                f"heads do not shard: n_head={cfg.n_head} "
+                f"n_query_groups={cfg.n_query_groups} vs tp={tp}"
+            )
+    return True, ""
+
+
+def _attn_paged(q, arenas, fresh_k, fresh_v, tables, pos, *, layer, window, mesh):
+    """One layer's kernel call, shard_map-wrapped under a mesh (specs match
+    ``kv_cache_spec``: arena/scale heads at axis 2, q/fresh heads at axis 1)."""
+    quantized = "k_scale" in arenas
+    if mesh is None:
+        return paged_attn_decode(
+            q, arenas["k"], arenas["v"], fresh_k, fresh_v, tables, pos,
+            layer=layer, window=window,
+            k_scale=arenas.get("k_scale"), v_scale=arenas.get("v_scale"),
+        )
+    hspec = P(None, "tp", None)                    # (B, heads, hs)
+    aspec = P(None, None, "tp", None, None)        # (nb, L, ng, bs, hs)
+    sspec = P(None, None, "tp", None)              # (nb, L, ng, bs)
+    if quantized:
+        def local(q_, ka, va, ks, vs, fk, fv, t, p):
+            return paged_attn_decode(q_, ka, va, fk, fv, t, p, layer=layer,
+                                     window=window, k_scale=ks, v_scale=vs)
+
+        in_specs = (hspec, aspec, aspec, sspec, sspec, hspec, hspec, P(None, None), P(None))
+        args = (q, arenas["k"], arenas["v"], arenas["k_scale"], arenas["v_scale"],
+                fresh_k, fresh_v, tables, pos)
+    else:
+        def local(q_, ka, va, fk, fv, t, p):
+            return paged_attn_decode(q_, ka, va, fk, fv, t, p, layer=layer,
+                                     window=window)
+
+        in_specs = (hspec, aspec, aspec, hspec, hspec, P(None, None), P(None))
+        args = (q, arenas["k"], arenas["v"], fresh_k, fresh_v, tables, pos)
+    return _smap(local, mesh, in_specs, hspec)(*args)
+
+
+def forward_paged(params, idx, pos, arenas, tables, cos_all, sin_all, cfg, *,
+                  cdtype, quantized=False, lora=None, lora_scaling=1.0,
+                  mesh=None):
+    """Single-token decode forward straight off the KV block arenas.
+
+    Mirrors ``forward_with_cache`` (vec-pos, T=1) except attention: instead
+    of consuming a gathered dense cache, each layer calls the paged kernel
+    against the arenas + block tables.  ``idx``: (B, 1) tokens; ``pos``:
+    (B,) int32; ``arenas``: the pool's ``{"k","v"(,"k_scale","v_scale")}``;
+    ``tables``: (B, nbb) sink-padded block tables; ``cdtype``: the cache
+    compute dtype (fresh K/V are cast to it before attending, matching the
+    dense path's cache write).  Returns ``(logits (B, 1, V), fresh)`` with
+    ``fresh = {"k"/"v": (B, L, ng, hs) at cdtype}`` — the caller persists it
+    with :func:`write_fresh_kv` (same step, after sampling's logits are
+    taken; order doesn't matter as the kernel already attended it)."""
+    B, T = idx.shape
+    assert T == 1, "forward_paged is the decode (single-token) forward"
+    hs, nh = cfg.head_size, cfg.n_head
+    window = cfg.sliding_window
+    x = params["wte"][idx]
+    if cfg.scale_embedding:
+        x = x * (cfg.n_embd ** 0.5)
+    if cfg.learned_pos_embedding:
+        x = x + jax.vmap(
+            lambda p: jax.lax.dynamic_slice_in_dim(params["wpe"], p, T, axis=0))(pos)
+    cos_t = jax.vmap(lambda p: jax.lax.dynamic_slice_in_dim(cos_all, p, T, axis=0))(pos)[:, None]
+    sin_t = jax.vmap(lambda p: jax.lax.dynamic_slice_in_dim(sin_all, p, T, axis=0))(pos)[:, None]
+
+    lin = partial(_linear, quantized=quantized)
+    fresh_k, fresh_v = [], []
+    for l, bp in enumerate(params["blocks"]):
+        n1 = _norm(x, bp["norm_1"], cfg, bp.get("norm_1_b"))
+        lora_l = None
+        if lora:
+            lora_l = {t: (ab["a"][:, l], ab["b"][:, l]) for t, ab in lora.items()}
+        q, k, v = _project_qkv(bp["attn"], n1, cos_t, sin_t, cfg, lin=lin,
+                               lora=lora_l, lora_scaling=lora_scaling)
+        # q: (B, nh, 1, hs) → (B, nh, hs); fresh K/V at the cache compute
+        # dtype — the exact values the dense path writes before attending
+        fk = k[:, :, 0].astype(cdtype)
+        fv = v[:, :, 0].astype(cdtype)
+        y = _attn_paged(q[:, :, 0], arenas, fk, fv, tables, pos,
+                        layer=l, window=window, mesh=mesh)
+        y = y.reshape(B, 1, nh * hs)
+        h = lin(y, bp["attn"]["wo"], bp["attn"].get("bo"))
+        if lora_l is not None and "wo" in lora_l:
+            h = h + _lora_delta(y, *lora_l["wo"], lora_scaling)
+        fresh_k.append(fk)
+        fresh_v.append(fv)
+        if cfg.parallel_residual:
+            n2 = n1 if cfg.shared_attention_norm else _norm(x, bp["norm_2"], cfg, bp.get("norm_2_b"))
+            x = x + h + _mlp(bp["mlp"], n2, cfg, quantized=quantized,
+                             lora=lora_l, lora_scaling=lora_scaling)
+        else:
+            x = x + h
+            x = x + _mlp(bp["mlp"], _norm(x, bp["norm_2"], cfg, bp.get("norm_2_b")), cfg,
+                         quantized=quantized, lora=lora_l, lora_scaling=lora_scaling)
+
+    x = _norm(x, params["ln_f"], cfg, params.get("ln_f_b"))
+    head = params["wte"] if cfg.tie_embeddings else params["lm_head"]
+    logits = (_linear(x, head, params.get("lm_head_b"), quantized=quantized)).astype(jnp.float32)
+    fresh = {"k": jnp.stack(fresh_k, axis=1), "v": jnp.stack(fresh_v, axis=1)}
+    return logits, fresh
+
+
+def _write(arena, vals, tables, pos, *, block_size, mesh):
+    if mesh is None:
+        return paged_token_write(arena, vals, tables, pos, block_size=block_size)
+    rank5 = arena.ndim == 5
+    aspec = P(None, None, "tp", None, None) if rank5 else P(None, None, "tp", None)
+    vspec = P(None, None, "tp", None) if rank5 else P(None, None, "tp")
+    return _smap(
+        lambda a, v, t, p: paged_token_write(a, v, t, p, block_size=block_size),
+        mesh, (aspec, vspec, P(None, None), P(None)), aspec,
+    )(arena, vals, tables, pos)
+
+
+def write_fresh_kv(arenas, fresh, tables, pos, *, block_size, kv_dtype=None,
+                   mesh=None):
+    """Lands one decode step's fresh K/V in the arenas, in place.
+
+    ``fresh``: ``{"k"/"v": (B, L, ng, hs) at the compute dtype}`` from
+    :func:`forward_paged`.  ``kv_dtype``: the storage dtype when the pool is
+    quantized (int8/fp8) — quantization runs *here* with the same
+    ``quantize_kv`` call ``scatter_token_q`` makes, so the stored bytes are
+    bit-identical to the gather path's; the kernels then write precomputed
+    values + scales.  Returns the updated arenas dict (aliased buffers: no
+    scatter primitive, untouched blocks keep their bytes; padding rows land
+    in sink block 0, never attended)."""
+    w = partial(_write, tables=tables, pos=pos, block_size=block_size, mesh=mesh)
+    if kv_dtype is None:
+        return {"k": w(arenas["k"], fresh["k"]), "v": w(arenas["v"], fresh["v"])}
+    kq, ks = quantize_kv(fresh["k"], kv_dtype)
+    vq, vs = quantize_kv(fresh["v"], kv_dtype)
+    return {
+        "k": w(arenas["k"], kq),
+        "v": w(arenas["v"], vq),
+        "k_scale": w(arenas["k_scale"], ks),
+        "v_scale": w(arenas["v_scale"], vs),
+    }

@@ -34,6 +34,7 @@ __all__ = [
     "check_tracing_targets",
     "check_capacity_targets",
     "check_recovery_targets",
+    "check_paged_attn_targets",
 ]
 
 # generous: CI hosts jitter, and the gate exists to catch the donate=False
@@ -398,4 +399,52 @@ def check_micro_baseline_schema(artifact: dict | None = None) -> dict:
     assert artifact["results"], "BENCH_MICRO.json has no result rows"
     for name, row in artifact["results"].items():
         assert "thunder_ms" in row and row["thunder_ms"] > 0, (name, row)
+    return artifact
+
+
+def check_paged_attn_targets(artifact: dict | None = None, *,
+                             min_traffic_ratio: float = 1.0) -> dict:
+    """Validates the BENCH_PAGED_ATTN.json artifact: schema, the gated
+    token-parity claim (``attn="paged"`` tokens identical to
+    ``attn="gather"`` over the driven workload), program purity (zero
+    arena-sized gathers and zero scatters in the compiled ``decode_paged``
+    program, with the gather program as positive control — proving the
+    jaxpr census actually sees the ops it gates on), and the analytic
+    arena-traffic ratio > ``min_traffic_ratio``.  Wall-clock fields are
+    schema-checked but not gated: on CPU the kernel runs in Pallas
+    interpret mode, so throughput gates wait for a real TPU window.
+    Returns the artifact for chaining."""
+    if artifact is None:
+        artifact = load_artifact("BENCH_PAGED_ATTN.json")
+    assert "backend" in artifact and "results" in artifact, sorted(artifact)
+    r = artifact["results"]
+    for key in (
+        "parity_ok", "tokens_checked", "kernel_steps",
+        "paged_arena_gathers", "paged_scatters",
+        "gather_arena_gathers", "gather_scatters",
+        "drive_gather_ms", "drive_paged_ms", "paged_vs_gather_x",
+        "dense_bytes_per_step", "paged_bytes_per_step",
+        "arena_traffic_ratio_x",
+    ):
+        assert key in r, (key, sorted(r))
+    assert r["tokens_checked"] > 0 and r["kernel_steps"] > 0, r
+    assert r["parity_ok"], (
+        "paged decode tokens diverged from the gather path — the kernel "
+        "broke the serving bit-exactness contract"
+    )
+    assert r["paged_arena_gathers"] == 0 and r["paged_scatters"] == 0, (
+        f"gather/scatter leaked into the paged decode program "
+        f"(arena_gathers={r['paged_arena_gathers']}, "
+        f"scatters={r['paged_scatters']}) — the kernel path must read the "
+        f"arena in place"
+    )
+    assert r["gather_arena_gathers"] > 0 and r["gather_scatters"] > 0, (
+        "the positive control went blind: the gather decode program shows "
+        "no arena gathers/scatters, so the census is not seeing the ops"
+    )
+    assert r["arena_traffic_ratio_x"] > min_traffic_ratio, (
+        f"paged decode must move fewer arena bytes per step than the dense "
+        f"round-trip: ratio {r['arena_traffic_ratio_x']} <= {min_traffic_ratio}"
+    )
+    assert r["drive_gather_ms"] > 0 and r["drive_paged_ms"] > 0, r
     return artifact

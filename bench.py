@@ -972,6 +972,32 @@ def main():
             "results": out["results"],
         }))
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "serving_spec":
+        # speculative-serving bench: draft/verify lane vs the plain decode
+        # engine at occupancy 8 with a high-acceptance draft (the 1-layer
+        # prefix of a residual-no-op'd 4-layer target), exact token parity
+        # asserted request-by-request.  Host work only, no TPU probe;
+        # artifact uses the BENCH_MICRO schema.
+        from thunder_tpu._platform import force_cpu
+
+        force_cpu()
+        from thunder_tpu.benchmarks.serving_spec import serving_spec_bench
+
+        out = serving_spec_bench(on_tpu=False)
+        artifact = {"backend": jax.default_backend(), **out}
+        with open("BENCH_SERVING_SPEC.json", "w") as f:
+            json.dump(artifact, f, indent=1)
+        for k, v in out["results"].items():
+            log(f"serving_spec {k}: {v}")
+        print(json.dumps({
+            "metric": "serving_spec_vs_plain_throughput_x",
+            "value": out["results"]["speedup_x"],
+            "unit": "x",
+            # the plain continuous-batching engine IS the baseline
+            "vs_baseline": out["results"]["speedup_x"],
+            "results": out["results"],
+        }))
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "cost":
         # analytic companion to the measured headline (no TPU needed): XLA's
         # own cost model on the compiled loss+grad at headline geometry, and

@@ -802,3 +802,74 @@ class TestPagedAttnTargets:
         art = {"backend": jax.default_backend(), **out}
         check_paged_attn_targets(art)
         assert out["results"]["parity_ok"] is True
+
+
+class TestServingSpecTargets:
+    def test_serving_spec_gate_on_committed_artifact(self):
+        """BENCH_SERVING_SPEC.json must keep showing the speculative lane's
+        throughput win at occupancy 8 (>= 1.2x the plain engine with the
+        high-acceptance draft pair), exact token parity, a live acceptance
+        histogram, and a compile-free measured window.  A regression
+        recorded into the artifact fails here."""
+        from tools.bench_targets import check_serving_spec_targets
+
+        art = check_serving_spec_targets()
+        assert art["backend"] in ("cpu", "tpu")
+        assert art["results"]["speedup_x"] >= 1.2
+        assert art["results"]["acceptance_rate"] >= 0.5
+
+    def test_serving_spec_gate_rejects_regressions(self):
+        from tools.bench_targets import check_serving_spec_targets, load_artifact
+
+        good = load_artifact("BENCH_SERVING_SPEC.json")
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["speedup_x"] = 1.1
+        with pytest.raises(AssertionError, match="not\\s+amortizing"):
+            check_serving_spec_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["token_parity_exact"] = False
+        with pytest.raises(AssertionError, match="diverged"):
+            check_serving_spec_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["spec_rounds"] = 0
+        with pytest.raises(AssertionError, match="never engaged"):
+            check_serving_spec_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["acceptance_rate"] = 0.1
+        with pytest.raises(AssertionError, match="not proposing"):
+            check_serving_spec_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["draft_decode_compiles"] = bad["results"]["bucket_bound"] + 1
+        with pytest.raises(AssertionError, match="bucket"):
+            check_serving_spec_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["cold_compile_prefills_measured"] = 2
+        with pytest.raises(AssertionError, match="cold"):
+            check_serving_spec_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        del bad["results"]["accept_len_hist"]
+        with pytest.raises(AssertionError):
+            check_serving_spec_targets(bad)
+
+    @pytest.mark.slow
+    def test_serving_spec_bench_live_smoke(self):
+        """The bench harness itself at smoke shapes: schema + parity +
+        acceptance + compile bound must hold live (the throughput ratio is
+        not gated at smoke shapes on a jittery CI host; the committed
+        full-shape artifact carries that gate)."""
+        from thunder_tpu.benchmarks.serving_spec import serving_spec_bench
+        from tools.bench_targets import check_serving_spec_targets
+
+        out = serving_spec_bench(on_tpu=False, smoke=True)
+        art = {"backend": jax.default_backend(), **out}
+        check_serving_spec_targets(art, min_ratio=0.0)
+        assert out["results"]["smoke"] is True
+        assert out["results"]["token_parity_exact"] is True
+        assert out["results"]["acceptance_rate"] == 1.0

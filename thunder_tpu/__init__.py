@@ -949,6 +949,11 @@ def serve(model_fn, params, cfg, **kwargs):
     per-request lifecycle spans in ``tt.export_chrome_trace``, ``slo={...}``
     for burn-rate monitoring via ``engine.slo_report()``, and
     ``flight_recorder=True`` for crash dumps (``tt.flight_record``).
+    Speculative serving: ``speculative=serving.SpecConfig(draft_params,
+    draft_cfg, K=...)`` runs a draft/verify lane over the paged arena —
+    each decode turn drafts K tokens with the cheap model and verifies
+    them in ONE target forward, emitting 1..K+1 tokens per round with
+    served tokens bit-identical to solo ``speculative_generate()``.
     Strictly additive: nothing else in the pipeline changes by building an
     engine (the import is deferred to keep the off-path cost at zero).  See
     GUIDE.md "Serving" and ``thunder_tpu.serving``."""

@@ -1264,6 +1264,207 @@ def paged_token_write(arena, vals, tables, pos, *, block_size):
     )(tables, pos, arena, vals)
 
 
+def _paged_verify_kernel(tab_ref, pos_ref, q_ref, k_ref, v_ref, *rest, bs, T,
+                         quantized, cdtype, sm):
+    """Multi-token-query variant of ``_paged_kernel`` for the speculative
+    verify step: T = K+1 chunk queries per request share one pass over the
+    arena blocks, with the causal intra-chunk mask folded into the final
+    online-softmax term.  Queries ride flattened as (rep*T, hs) rows so the
+    arena phase is the single-token kernel's math at a wider row count."""
+    if quantized:
+        ks_ref, vs_ref, fk_ref, fv_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        ks_ref = vs_ref = None
+        fk_ref, fv_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    i, j = pl.program_id(0), pl.program_id(2)
+    nb = pl.num_programs(2)
+    p_i = pos_ref[i]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _MASK_VALUE)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    def _dequant(x_ref, s_ref, dt):
+        x = x_ref[0, 0, 0]                                 # (bs, hs) storage dtype
+        if s_ref is not None:
+            x = (x.astype(jnp.float32) * s_ref[0, 0, 0][:, None]).astype(cdtype)
+        return x.astype(dt)
+
+    def _online(s, v, dt):
+        m_prev, l_prev = m_ref[...], l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        m_ref[...] = m_new
+        l_ref[...] = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+            p.astype(dt), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    # arena phase: the arena holds only the committed strictly-older prefix
+    # (rejected speculative slots are never written), so every chunk query —
+    # at positions p_i .. p_i+T-1 — may see all slots < p_i and the keep-mask
+    # is query-independent, exactly the single-token kernel's
+    run = (j * bs) < p_i
+
+    @pl.when(run)
+    def _block():
+        q = q_ref[0, 0]                                    # (rep*T, hs)
+        k = _dequant(k_ref, ks_ref, q.dtype)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) / sm                                             # (rep*T, bs)
+        posn = j * bs + jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1)
+        s = jnp.where(posn < p_i, s, _MASK_VALUE)
+        _online(s, _dequant(v_ref, vs_ref, q.dtype), q.dtype)
+
+    @pl.when(j == nb - 1)
+    def _finalize():
+        q = q_ref[0, 0]
+        rows = q.shape[0]                                  # rep * T
+        fk = fk_ref[0, 0].astype(q.dtype)                  # (T, hs) at cdtype
+        fv = fv_ref[0, 0].astype(q.dtype)
+        s_f = jax.lax.dot_general(
+            q, fk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) / sm                                             # (rep*T, T)
+        # causal intra-chunk mask: flattened row r is the query at chunk
+        # offset t = r % T and sees fresh keys at offsets <= t; the diagonal
+        # is always kept, so no row is ever all-masked
+        t_of = jax.lax.broadcasted_iota(jnp.int32, (rows, T), 0) % T
+        col = jax.lax.broadcasted_iota(jnp.int32, (rows, T), 1)
+        s_f = jnp.where(col <= t_of, s_f, _MASK_VALUE)
+        _online(s_f, fv, q.dtype)
+        o_ref[0, 0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+
+
+def paged_attn_verify(q, k_arena, v_arena, fresh_k, fresh_v, tables, pos, *,
+                      layer, k_scale=None, v_scale=None):
+    """Multi-token-query attention off the KV block arena, one layer — the
+    speculative verify step's kernel (ROADMAP item 3's reserved variant).
+
+    ``q``: (B, nh, T, hs) chunk queries at global positions
+    ``[pos, pos+T)``; ``fresh_k``/``fresh_v``: (B, ng, T, hs) the chunk's own
+    projected K/V at the cache compute dtype (not yet in the arena — the
+    caller commits the accepted prefix with :func:`paged_token_write_masked`
+    afterwards).  Arena/scale/table/pos arguments as
+    :func:`paged_attn_decode`.  Sliding-window models are rejected upstream
+    (speculation needs full caches).  Returns (B, nh, T, hs) at ``q.dtype``.
+    """
+    B, nh, T, hs = q.shape
+    num_blocks, _L, ng, bs, _ = k_arena.shape
+    nbb = int(tables.shape[1])
+    rep = nh // ng
+    assert rep * ng == nh, (nh, ng)
+    quantized = k_scale is not None
+    # (B, nh, T, hs) -> (B, ng, rep*T, hs): nh splits as (ng, rep), then the
+    # adjacent (rep, T) dims fold — row r = rep_idx*T + t
+    qf = q.reshape(B, ng, rep * T, hs)
+
+    arena_spec = pl.BlockSpec(
+        (1, 1, 1, bs, hs), lambda i, g, j, tab, p: (tab[i, j], layer, g, 0, 0))
+    scale_spec = pl.BlockSpec(
+        (1, 1, 1, bs), lambda i, g, j, tab, p: (tab[i, j], layer, g, 0))
+    fresh_spec = pl.BlockSpec((1, 1, T, hs), lambda i, g, j, tab, p: (i, g, 0, 0))
+    q_spec = pl.BlockSpec((1, 1, rep * T, hs), lambda i, g, j, tab, p: (i, g, 0, 0))
+
+    in_specs = [q_spec, arena_spec, arena_spec]
+    args = [qf, k_arena, v_arena]
+    if quantized:
+        in_specs += [scale_spec, scale_spec]
+        args += [k_scale, v_scale]
+    in_specs += [fresh_spec, fresh_spec]
+    args += [fresh_k, fresh_v]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, ng, nbb),
+        in_specs=in_specs,
+        out_specs=q_spec,
+        scratch_shapes=[
+            pltpu.VMEM((rep * T, 1), jnp.float32),
+            pltpu.VMEM((rep * T, 1), jnp.float32),
+            pltpu.VMEM((rep * T, hs), jnp.float32),
+        ],
+    )
+    kwargs = {}
+    if not _interpret():
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_verify_kernel, bs=bs, T=T, quantized=quantized,
+            cdtype=fresh_k.dtype, sm=float(np.sqrt(hs)),
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, ng, rep * T, hs), q.dtype),
+        interpret=_interpret(),
+        **kwargs,
+    )(tables, pos, *args)
+    return out.reshape(B, nh, T, hs)
+
+
+def _paged_write_masked_kernel(tab_ref, pos_ref, ne_ref, a_ref, v_ref, o_ref, *, rank5):
+    del tab_ref, pos_ref, ne_ref, a_ref  # routing happens in the index maps
+    if rank5:
+        o_ref[0, :, :, 0, :] = v_ref[0]
+    else:
+        o_ref[0, :, :, 0] = v_ref[0]
+
+
+def paged_token_write_masked(arena, vals, tables, pos, n_emit, offset, *, block_size):
+    """Keep-masked arena write for the speculative verify commit.
+
+    Request ``i`` lands ``vals[i]`` — the K/V (or scale) of chunk offset
+    ``offset`` — at arena slot ``pos[i] + offset`` iff ``offset <
+    n_emit[i]``; rejected offsets route to sink block 0 slot 0 (whose bytes
+    are never attended), so rejected-draft KV stays invisible without a
+    scatter primitive in the program.  ``offset`` is static (one call per
+    chunk position); ``n_emit`` rides as a scalar-prefetch operand so the
+    routing happens in the BlockSpec index map.
+    """
+    bs = block_size
+    B = vals.shape[0]
+    k = offset
+    if arena.ndim == 5:
+        _, L, ng, _, hs = arena.shape
+        a_spec = pl.BlockSpec(
+            (1, L, ng, 1, hs),
+            lambda i, tab, p, ne: (
+                jnp.where(k < ne[i], tab[i, (p[i] + k) // bs], 0), 0, 0,
+                jnp.where(k < ne[i], (p[i] + k) % bs, 0), 0))
+        v_spec = pl.BlockSpec((1, L, ng, hs), lambda i, tab, p, ne: (i, 0, 0, 0))
+    else:
+        _, L, ng, _ = arena.shape
+        a_spec = pl.BlockSpec(
+            (1, L, ng, 1),
+            lambda i, tab, p, ne: (
+                jnp.where(k < ne[i], tab[i, (p[i] + k) // bs], 0), 0, 0,
+                jnp.where(k < ne[i], (p[i] + k) % bs, 0)))
+        v_spec = pl.BlockSpec((1, L, ng), lambda i, tab, p, ne: (i, 0, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B,),
+        in_specs=[a_spec, v_spec],
+        out_specs=a_spec,
+    )
+    kwargs = {}
+    if not _interpret():
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",))
+    return pl.pallas_call(
+        functools.partial(_paged_write_masked_kernel, rank5=arena.ndim == 5),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(arena.shape, arena.dtype),
+        input_output_aliases={3: 0},   # arena in == arena out (in-place)
+        interpret=_interpret(),
+        **kwargs,
+    )(tables, pos, n_emit.astype(jnp.int32), arena, vals)
+
+
 # install the fast paths so XLA fusion regions and TrainStep trace evaluation
 # reach the same kernels
 from thunder_tpu.executors import jaxex as _jaxex

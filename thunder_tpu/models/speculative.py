@@ -33,10 +33,10 @@ import jax.numpy as jnp
 from thunder_tpu.models.generate import _cache_len, forward_with_cache, init_cache
 from thunder_tpu.models.llama import Config, build_rope_cache
 
-__all__ = ["speculative_generate"]
+__all__ = ["speculative_generate", "accept_tokens"]
 
 
-def _accept_tokens(key, drafts, p_all, q_rows):
+def accept_tokens(key, drafts, p_all, q_rows):
     """Speculative-sampling acceptance (Leviathan et al.): accept draft i
     with prob min(1, p_i(x_i)/q_i(x_i)); at the first rejection m resample
     from the normalized residual max(p_m - q_m, 0); if every draft is
@@ -66,12 +66,19 @@ def _accept_tokens(key, drafts, p_all, q_rows):
     return m, y
 
 
-def _spec_step(cfg, draft_cfg, cos, sin, cos_d, sin_d, K, quantized, temperature):
+# the serving verify program and older call sites import the private name;
+# both MUST resolve to the one implementation (single source of truth for
+# the acceptance math — pinned by tests/test_serving_spec.py)
+_accept_tokens = accept_tokens
+
+
+def _spec_step(cfg, draft_cfg, cos, sin, cos_d, sin_d, K, quantized, temperature,
+               lora_scaling=1.0):
     """One speculate/verify round over B independent rows (traced inside
     decode_all's while_loop).  Positions are per-row (B,): each row accepts
     its own prefix length, so rows advance at different rates."""
 
-    def step(params, draft_params, tcache, dcache, cur, pos, key):
+    def step(params, draft_params, tcache, dcache, cur, pos, key, lora=None):
         B = cur.shape[0]
         key, kd = jax.random.split(key)
 
@@ -106,8 +113,11 @@ def _spec_step(cfg, draft_cfg, cos, sin, cos_d, sin_d, K, quantized, temperature
 
         # verify: one target forward over [cur, d_1..d_K] = K+1 positions
         chunk = jnp.concatenate([cur[:, None], drafts], axis=1)  # (B, K+1)
+        # LoRA rides the TARGET forwards only: the draft is a cheap base
+        # proposal model and the acceptance rule corrects any q/p mismatch
         tlogits, tcache2 = forward_with_cache(
             params, chunk, pos, tcache, cos, sin, cfg, quantized=quantized,
+            lora=lora, lora_scaling=lora_scaling,
         )
 
         if temperature == 0.0:
@@ -124,7 +134,7 @@ def _spec_step(cfg, draft_cfg, cos, sin, cos_d, sin_d, K, quantized, temperature
             p_all = jax.nn.softmax(tlogits / temperature, axis=-1)  # (B, K+1, V)
             key, ka = jax.random.split(key)
             q_rows = q_rows_x[:K].transpose(1, 0, 2)  # (B, K, V)
-            m, y = jax.vmap(_accept_tokens)(jax.random.split(ka, B), drafts, p_all, q_rows)
+            m, y = jax.vmap(accept_tokens)(jax.random.split(ka, B), drafts, p_all, q_rows)
         n_emit = m + 1  # accepted drafts + the resampled/correction/bonus token
 
         # fixed-shape emission: emitted[b, i] = drafts[b, i] for i < m_b, y_b
@@ -154,6 +164,8 @@ def speculative_generate(
     key=None,
     quantized: bool = False,
     cache_dtype=None,
+    lora=None,
+    lora_scaling: float = 1.0,
 ):
     """Speculative decoding; returns (B, T_prompt + max_new_tokens) tokens.
 
@@ -165,6 +177,12 @@ def speculative_generate(
 
     ``draft_params``/``draft_cfg``: the small proposal model (must share the
     tokenizer/vocab with the target).
+
+    ``lora``/``lora_scaling``: optional per-request LoRA factors applied to
+    the TARGET forwards only (``forward_with_cache`` layout,
+    ``{target: {"a": (B, L, r, fin), "b": (B, L, fout, r)}}``) — the draft
+    stays the base model; the acceptance rule corrects any q/p mismatch, so
+    the emitted distribution is exactly the adapted target's.
     """
     prompt = jnp.asarray(prompt)
     B, T_prompt = prompt.shape
@@ -193,19 +211,21 @@ def speculative_generate(
         key = jax.random.PRNGKey(0)
     prefill, decode_all = _compiled_speculative(
         cfg, draft_cfg, T_prompt, max_new_tokens, T_max, K, quantized, str(dtype),
-        float(temperature),
+        float(temperature), float(lora_scaling),
     )
 
     tcache = init_cache(cfg, B, T_max, dtype=dtype)
     dcache = init_cache(draft_cfg, B, T_max, dtype=dtype)
-    tcache, dcache, first_logits = prefill(params, draft_params, tcache, dcache, prompt)
+    tcache, dcache, first_logits = prefill(
+        params, draft_params, tcache, dcache, prompt, lora)
     from thunder_tpu.executors.donation import suppress_unusable_donation_warnings
 
     # decode_all returns only tokens/counters, so the donated caches
     # cannot alias an output; donation still frees them for scratch
     # (same pattern and rationale as generate.py's decode loop)
     with suppress_unusable_donation_warnings():
-        out, n, rounds = decode_all(params, draft_params, tcache, dcache, first_logits, key)
+        out, n, rounds = decode_all(
+            params, draft_params, tcache, dcache, first_logits, key, lora)
     #: mean over rows of (tokens emitted / that row's ACTIVE rounds), the
     #: prefill-seeded first token excluded and emission clamped to max_new —
     #: the acceptance diagnostic: K+1 means every draft accepted, 1.0 none
@@ -219,7 +239,7 @@ _prefill_cache: dict = {}
 
 
 def _compiled_speculative(cfg, draft_cfg, T_prompt, max_new, T_max, K, quantized, dtype_str,
-                          temperature=0.0):
+                          temperature=0.0, lora_scaling=1.0):
     """Jitted (prefill, decode_all) pair cached per static configuration —
     params are arguments, so repeated serving calls (and weight updates)
     reuse the compiled programs (the _generate_cache pattern, generate.py).
@@ -236,7 +256,8 @@ def _compiled_speculative(cfg, draft_cfg, T_prompt, max_new, T_max, K, quantized
     )
     # prefill does not depend on max_new: cache it separately so serving
     # callers varying max_new_tokens only recompile the decode loop
-    pre_key = (*cfg_key, T_prompt, T_max, K, quantized, dtype_str)
+    # (lora arrays are jit ARGUMENTS — only the static scaling keys here)
+    pre_key = (*cfg_key, T_prompt, T_max, K, quantized, dtype_str, lora_scaling)
     key = (*pre_key, max_new, temperature)
     cached = _spec_cache.get(key)
     prefill = _prefill_cache.get(pre_key)
@@ -252,23 +273,25 @@ def _compiled_speculative(cfg, draft_cfg, T_prompt, max_new, T_max, K, quantized
 
     if prefill is None:
         @partial(jax.jit, donate_argnums=(2, 3))
-        def prefill(params, draft_params, tcache, dcache, prompt):
+        def prefill(params, draft_params, tcache, dcache, prompt, lora=None):
             # returns the last-position target logits so decode_all can draw
             # the FIRST token in its own mode (argmax vs sample) — a greedy
             # seed under temperature>0 would break distribution preservation
             # at position 0
             tlogits, tcache = forward_with_cache(
-                params, prompt, 0, tcache, cos, sin, cfg, quantized=quantized)
+                params, prompt, 0, tcache, cos, sin, cfg, quantized=quantized,
+                lora=lora, lora_scaling=lora_scaling)
             _, dcache = forward_with_cache(
                 draft_params, prompt, 0, dcache, cos_d, sin_d, draft_cfg, quantized=quantized)
             return tcache, dcache, tlogits[:, -1]
 
         _prefill_cache[pre_key] = prefill
 
-    step = _spec_step(cfg, draft_cfg, cos, sin, cos_d, sin_d, K, quantized, temperature)
+    step = _spec_step(cfg, draft_cfg, cos, sin, cos_d, sin_d, K, quantized, temperature,
+                      lora_scaling)
 
     @partial(jax.jit, donate_argnums=(2, 3))
-    def decode_all(params, draft_params, tcache, dcache, first_logits, rng):
+    def decode_all(params, draft_params, tcache, dcache, first_logits, rng, lora=None):
         B = first_logits.shape[0]
         rng, kf = jax.random.split(rng)
         if temperature == 0.0:
@@ -295,7 +318,7 @@ def _compiled_speculative(cfg, draft_cfg, T_prompt, max_new, T_max, K, quantized
             # discarded either way
             pos_in = jnp.minimum(pos, T_max - K - 1)
             tcache, dcache, emitted, n_emit, cur2, pos2, rng = step(
-                params, draft_params, tcache, dcache, cur, pos_in, rng)
+                params, draft_params, tcache, dcache, cur, pos_in, rng, lora)
             pos2 = pos + (pos2 - pos_in)
             done = n >= max_new
             buf = jax.vmap(

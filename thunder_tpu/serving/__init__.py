@@ -41,6 +41,14 @@ retry with bounded exponential backoff, and engine-class faults trigger
 re-prefill recovery — fresh arenas plus a sampling-free replay of every
 surviving request's known tokens, after which streams continue
 bit-identical to an uninterrupted run.
+
+Speculative continuous batching (:mod:`serving.speculative`):
+``speculative=SpecConfig(draft_params, draft_cfg, K=...)`` adds a draft KV
+block arena beside the target arena (same block tables) and swaps each
+decode turn for a draft/verify round — K chained draft forwards propose, a
+single (K+1)-position target forward verifies via the shared rejection
+rule, and 1..K+1 tokens emit per round.  Served tokens stay bit-identical
+to solo ``speculative_generate()``, greedy or sampled.
 """
 from thunder_tpu.serving.engine import (  # noqa: F401
     EngineStalledError,
@@ -82,6 +90,7 @@ from thunder_tpu.serving.scheduler import (  # noqa: F401
     pick_bucket,
     pow2_buckets,
 )
+from thunder_tpu.serving.speculative import SpecConfig  # noqa: F401
 
 __all__ = [
     "serve",
@@ -102,6 +111,7 @@ __all__ = [
     "blocks_for_arena_bytes",
     "pick_bucket",
     "pow2_buckets",
+    "SpecConfig",
     "FaultPlan",
     "FaultSpec",
     "RetryPolicy",

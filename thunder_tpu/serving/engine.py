@@ -276,6 +276,7 @@ class ServingEngine:
         fault_plan=None,
         retry: RetryPolicy | None = None,
         watchdog_timeout_s: float | None = None,
+        speculative=None,
     ):
         if shardings is not None and mesh is None:
             raise ValueError("shardings= requires mesh= (param placement needs a mesh)")
@@ -357,6 +358,31 @@ class ServingEngine:
                     )
             if mesh is not None:
                 lora.place(mesh)   # placed once per mesh, like params
+        # speculative serving: a draft KV block arena BESIDE the target
+        # arena — its own PagedKVPool storage (same dtype/quantization/mesh
+        # sharding), but block ids are allocated once per request from the
+        # target pool and index both arenas (the draft pool's free list is
+        # never consulted), so the allocator/prefix machinery stays single
+        self.spec = speculative
+        if speculative is not None:
+            from thunder_tpu.serving.speculative import validate_spec
+
+            validate_spec(
+                speculative, cfg,
+                custom_forward=self._forward is not forward_with_cache,
+                sliding_window=cfg.sliding_window,
+            )
+            if mesh is not None:
+                from thunder_tpu.serving.mesh import place_params as _pp
+
+                speculative.draft_params = _pp(speculative.draft_params, mesh, None)
+            self.draft_pool = PagedKVPool(
+                speculative.draft_cfg, num_blocks=num_blocks,
+                block_size=block_size, dtype=dtype, kv_dtype=kv_dtype,
+                mesh=mesh,
+            )
+        else:
+            self.draft_pool = None
         self.scheduler = Scheduler(
             self.pool,
             max_batch=max_batch,
@@ -367,6 +393,9 @@ class ServingEngine:
             prefill_buckets=prefill_buckets,
             sliding_window=cfg.sliding_window,
             prefill_chunk=prefill_chunk,
+            # a speculative round's draft scan writes up to K slots past the
+            # last committed token — admission must reserve that overshoot
+            reserve_extra_tokens=speculative.K if speculative is not None else 0,
         )
         if getattr(cfg, "learned_pos_embedding", False):
             # wpe has block_size rows and dynamic_slice clamps silently past
@@ -425,7 +454,9 @@ class ServingEngine:
         self.tokens_generated = 0
         self._occupancy_sum = 0
         self.compile_counts = {"prefill": 0, "prefill_chunk": 0, "decode": 0,
-                               "decode_paged": 0}
+                               "decode_paged": 0, "spec_prefill": 0,
+                               "spec_prefill_chunk": 0, "draft_decode": 0,
+                               "verify": 0, "verify_paged": 0}
         # async lanes: the in-flight futures table — one deferred decode
         # record plus any deferred prefill-piece records, harvested at the
         # top of the next step (the only place the host blocks)
@@ -438,6 +469,16 @@ class ServingEngine:
         # each decode step consumes the previous step's device outputs
         # directly (no host->device transfer); see _decode_dispatch
         self._decode_state: dict | None = None
+        # the speculative lane's chained round inputs (toks=y, pos+n_emit)
+        # plus its acceptance accounting; see serving.speculative
+        self._spec_state: dict | None = None
+        self.spec_rounds = 0
+        self.spec_draft_tokens = 0
+        self.spec_accepted_tokens = 0
+        self._spec_accept_hist = (
+            np.zeros(speculative.K + 1, dtype=np.int64)
+            if speculative is not None else None
+        )
         # per-step metric handles resolved once (registry().reset() zeroes
         # values but keeps objects, so these survive observability resets)
         reg0 = registry()
@@ -451,6 +492,10 @@ class ServingEngine:
         self._m_pool_low_water = reg0.gauge("serving.pool.free_blocks_low_water")
         self._m_attn_kernel = reg0.counter("serving.attn.kernel_steps")
         self._m_attn_fallback = reg0.counter("serving.attn.fallback_steps")
+        if speculative is not None:
+            self._m_spec_rounds = reg0.counter("serving.spec.rounds")
+            self._m_spec_accepted = reg0.counter("serving.spec.accepted_tokens")
+            self._m_spec_accept_len = reg0.histogram("serving.spec.accept_len")
         if self.async_step:
             self._m_stall = reg0.histogram("serving.decode.stall_s")
             self._m_overlap = reg0.gauge("serving.step.overlap_frac")
@@ -605,7 +650,7 @@ class ServingEngine:
                 # was absorbed as a fault, or recovery reset it): re-prefill
                 # before the decode batch consumes generated[-1]
                 self._prefill_harvest(self._prefill_dispatch(r))
-                self.pool.release_retired()
+                self._release_retired()
                 worked = True
         if self.scheduler.running:
             self._decode_once()
@@ -674,8 +719,16 @@ class ServingEngine:
             # program, so all of last step's donated-arena consumers have
             # completed — dropping the parked handles is free now (doing it
             # at dispatch would block the host for the whole device step)
-            self.pool.release_retired()
+            self._release_retired()
         return worked
+
+    def _release_retired(self) -> None:
+        """Drops the parked donated-arena handles of every pool the engine
+        owns (target always; the draft arena too under speculative
+        serving — both are donated by the same harvested round)."""
+        self.pool.release_retired()
+        if self.draft_pool is not None:
+            self.draft_pool.release_retired()
 
     def _advance_prefills(self) -> bool:
         """The prefill lane: dispatches the next chunk for every running
@@ -780,10 +833,14 @@ class ServingEngine:
         occ = (self._occupancy_sum / self.decode_steps) if self.decode_steps else 0.0
         mesh = self.mesh_stats()
         sch = self.scheduler
-        # program kinds a bucket may instantiate: decode per batch bucket,
-        # prefill per prefill bucket, plus the chunk kind when chunking is
-        # on — or once recovery has replayed through the chunk programs
-        kinds = len(sch.batch_buckets) + len(sch.prefill_buckets) * (
+        # program kinds a bucket may instantiate: decode per batch bucket
+        # (doubled under speculative serving: each round runs draft_decode
+        # AND verify at the same bucket), prefill per prefill bucket, plus
+        # the chunk kind when chunking is on — or once recovery has
+        # replayed through the chunk programs
+        kinds = len(sch.batch_buckets) * (
+            2 if self.spec is not None else 1
+        ) + len(sch.prefill_buckets) * (
             2 if (sch.prefill_chunk is not None or self.chunk_runs > 0) else 1
         )
         n = self._overlap_obs
@@ -820,6 +877,27 @@ class ServingEngine:
             "prefix_hits": self._prefix_hits,
             "recoveries": self.recoveries,
             "faults": self._faults.snapshot() if self._faults is not None else None,
+            **({"spec": self._spec_stats()} if self.spec is not None else {}),
+        }
+
+    def _spec_stats(self) -> dict:
+        """Speculative-lane acceptance accounting: the histogram counts
+        rounds by tokens emitted (1..K+1); acceptance_rate is accepted
+        drafts / drafted tokens; tokens_per_round is the mean emission —
+        the solo ``speculative_generate.last_tokens_per_round`` analogue."""
+        hist = self._spec_accept_hist
+        rounds = int(hist.sum())
+        drafted = self.spec_draft_tokens
+        return {
+            "K": self.spec.K,
+            "rounds": self.spec_rounds,
+            "draft_tokens": drafted,
+            "accepted_tokens": self.spec_accepted_tokens,
+            "acceptance_rate": (self.spec_accepted_tokens / drafted) if drafted else None,
+            "accept_len_hist": {i + 1: int(hist[i]) for i in range(len(hist))},
+            "tokens_per_round": (
+                sum((i + 1) * int(hist[i]) for i in range(len(hist))) / rounds
+            ) if rounds else None,
         }
 
     def slo_report(self) -> dict:
@@ -857,6 +935,13 @@ class ServingEngine:
                     {"rid": r.rid, "pos": r.pos, "prompt_tokens": r.prompt_len}
                     for r in self.scheduler.running if r.pos < r.prompt_len
                 ],
+                "speculative": (
+                    {"K": self.spec.K,
+                     "chained": self._spec_state is not None,
+                     "rounds": self.spec_rounds,
+                     "acceptance_rate": self._spec_stats()["acceptance_rate"]}
+                    if self.spec is not None else None
+                ),
             },
             "prefix_share_hit_rate": (self._prefix_hits / lookups) if lookups else None,
             "compiles": list(self._compile_log),         # per-bucket compile causes
@@ -1007,7 +1092,7 @@ class ServingEngine:
             self._inflight_prefill.append(rec)
         else:
             self._prefill_harvest(rec)
-            self.pool.release_retired()     # token materialized: consumer done
+            self._release_retired()         # token materialized: consumer done
 
     def _prefill_dispatch(self, req: Request) -> dict:
         """Dispatches the next prefill piece for ``req`` and returns its
@@ -1033,7 +1118,10 @@ class ServingEngine:
         # block range — everything else (shared prefix, earlier chunks,
         # bucket padding) sinks (chunk granularity, see kv_pool.chunk_tables)
         table, dest = chunk_tables(req.block_table, pos, Tb, nbb, bs)
-        kind = "prefill" if final else "prefill_chunk"
+        if self.spec is not None:
+            kind = "spec_prefill" if final else "spec_prefill_chunk"
+        else:
+            kind = "prefill" if final else "prefill_chunk"
         prog, compiled = self._program(kind, Tb, nbb)
         req.prefill_compiled = req.prefill_compiled or compiled
         # the dispatch phase is named by its dominant cost: a fresh program
@@ -1047,7 +1135,19 @@ class ServingEngine:
                          shared_blocks=req.n_shared_blocks, lane="prefill",
                          chunked=not final)
             tr.begin(req.rid, name, lane="prefill")
-        if final:
+        darenas = None
+        if final and self.spec is not None:
+            tok, arenas, darenas, key, qerr = prog(
+                self.params, self.spec.draft_params,
+                jnp.asarray(toks)[None], jnp.int32(pos), jnp.int32(n_real),
+                pool.arenas, self.draft_pool.arenas,
+                jnp.asarray(table), jnp.asarray(dest), jnp.asarray(req.key),
+                self._lora_arenas(), jnp.asarray([req.adapter_slot], dtype=jnp.int32),
+            )
+            rec = {"kind": "prefill", "req": req, "tok": tok, "key": key,
+                   "qerr": qerr, "compiled": compiled, "span": name,
+                   "t_clock": sch.clock()}
+        elif final:
             tok, arenas, key, qerr = prog(
                 self.params, jnp.asarray(toks)[None], jnp.int32(pos), jnp.int32(n_real),
                 pool.arenas, jnp.asarray(table), jnp.asarray(dest),
@@ -1056,6 +1156,17 @@ class ServingEngine:
             )
             rec = {"kind": "prefill", "req": req, "tok": tok, "key": key,
                    "qerr": qerr, "compiled": compiled, "span": name,
+                   "t_clock": sch.clock()}
+        elif self.spec is not None:
+            arenas, darenas, qerr = prog(
+                self.params, self.spec.draft_params,
+                jnp.asarray(toks)[None], jnp.int32(pos),
+                pool.arenas, self.draft_pool.arenas,
+                jnp.asarray(table), jnp.asarray(dest),
+                self._lora_arenas(), jnp.asarray([req.adapter_slot], dtype=jnp.int32),
+            )
+            rec = {"kind": "chunk", "req": req, "qerr": qerr,
+                   "compiled": compiled, "span": name,
                    "t_clock": sch.clock()}
         else:
             arenas, qerr = prog(
@@ -1070,6 +1181,8 @@ class ServingEngine:
         # above consumed the donated arenas, so absorb routes to recovery
         self._fault_point(FP_SCATTER, (req.rid,))
         pool.set_arenas(arenas)
+        if darenas is not None:
+            self.draft_pool.set_arenas(darenas)
         req.pos = pos + n_real                             # written (device-ordered)
         self._register_prefix(req, upto=req.pos)
         reg = registry()
@@ -1143,12 +1256,17 @@ class ServingEngine:
         """One decode-lane turn: dispatch the bucketed decode program for
         the decode-ready batch; sync harvests inline, async parks the
         record in the in-flight table for the next step's harvest."""
-        rec = self._decode_dispatch()
+        if self.spec is not None:
+            from thunder_tpu.serving.speculative import spec_decode_dispatch
+
+            rec = spec_decode_dispatch(self)
+        else:
+            rec = self._decode_dispatch()
         if self.async_step:
             self._inflight_decode = rec
         else:
             self._decode_harvest(rec)
-            self.pool.release_retired()     # tokens materialized: consumer done
+            self._release_retired()         # tokens materialized: consumer done
 
     def _decode_dispatch(self) -> dict:
         sch, pool = self.scheduler, self.pool
@@ -1231,6 +1349,10 @@ class ServingEngine:
         return rec
 
     def _decode_harvest(self, rec: dict) -> None:
+        if rec.get("spec"):
+            from thunder_tpu.serving.speculative import spec_decode_harvest
+
+            return spec_decode_harvest(self, rec)
         sch = self.scheduler
         running = rec["running"]
         self._fault_point(FP_HARVEST, tuple(r.rid for r in running))
@@ -1533,6 +1655,11 @@ class ServingEngine:
         content (the forward pass is deterministic)."""
         self._discard_inflight()
         self.pool.rebuild_arenas()
+        if self.draft_pool is not None:
+            # the draft arena is soft state too: the replay below rebuilds
+            # it bit-identically (every attended slot holds the draft K/V
+            # of the emitted token at that position)
+            self.draft_pool.rebuild_arenas()
         for req in list(self.scheduler.running):
             req.pos = 0
             if req.generated:
@@ -1544,7 +1671,7 @@ class ServingEngine:
             for req in list(self.scheduler.running):
                 if req.state == "running" and not req.generated:
                     self._prefill_harvest(self._prefill_dispatch(req))
-                    self.pool.release_retired()
+                    self._release_retired()
 
     def _replay_request(self, req: Request) -> None:
         """Replays ``req``'s known sequence (prompt + all but the last
@@ -1577,17 +1704,31 @@ class ServingEngine:
             toks = np.zeros(Tb, dtype=np.int32)
             toks[:n_real] = seq[pos:pos + n_real]
             table, dest = chunk_tables(req.block_table, pos, Tb, nbb, bs)
-            prog, _compiled = self._program("prefill_chunk", Tb, nbb)
-            arenas, qerr = prog(
-                self.params, jnp.asarray(toks)[None], jnp.int32(pos),
-                pool.arenas, jnp.asarray(table), jnp.asarray(dest),
-                self._lora_arenas(),
-                jnp.asarray([req.adapter_slot], dtype=jnp.int32),
-            )
+            if self.spec is not None:
+                # the draft forward is deterministic, so the replay rebuilds
+                # the draft arena bit-identically alongside the target's
+                prog, _compiled = self._program("spec_prefill_chunk", Tb, nbb)
+                arenas, darenas, qerr = prog(
+                    self.params, self.spec.draft_params,
+                    jnp.asarray(toks)[None], jnp.int32(pos),
+                    pool.arenas, self.draft_pool.arenas,
+                    jnp.asarray(table), jnp.asarray(dest),
+                    self._lora_arenas(),
+                    jnp.asarray([req.adapter_slot], dtype=jnp.int32),
+                )
+                self.draft_pool.set_arenas(darenas)
+            else:
+                prog, _compiled = self._program("prefill_chunk", Tb, nbb)
+                arenas, qerr = prog(
+                    self.params, jnp.asarray(toks)[None], jnp.int32(pos),
+                    pool.arenas, jnp.asarray(table), jnp.asarray(dest),
+                    self._lora_arenas(),
+                    jnp.asarray([req.adapter_slot], dtype=jnp.int32),
+                )
             pool.set_arenas(arenas)
             req.pos = pos = pos + n_real
             float(np.asarray(qerr))        # fence this piece before the next
-            pool.release_retired()
+            self._release_retired()
             self.chunk_runs += 1
             registry().counter("serving.steps.prefill_chunk").inc()
 
@@ -1608,7 +1749,8 @@ class ServingEngine:
             for prec in pending:
                 tr.end(prec["req"].rid, prec["span"], aborted=True)
         self._decode_state = None
-        self.pool.release_retired()
+        self._spec_state = None
+        self._release_retired()
 
     #
     # compiled bucket programs
@@ -1640,6 +1782,11 @@ class ServingEngine:
             self.temperature, self.quantized,
             self._registry.geometry if self._registry is not None else None,
             self._mesh_key,
+            # the speculative component: K and the draft architecture are
+            # baked into every spec program (draft params are arguments)
+            (self.spec.K,
+             tuple(sorted(dataclasses.asdict(self.spec.draft_cfg).items())))
+            if self.spec is not None else None,
         )
 
     def _program(self, kind: str, a: int, b: int) -> tuple[Callable, bool]:
@@ -1656,10 +1803,22 @@ class ServingEngine:
         prog = _program_cache.get(gkey) if gkey is not None else None
         compiled = prog is None
         if compiled:
-            build = {"prefill": self._build_prefill,
-                     "prefill_chunk": self._build_prefill_chunk,
-                     "decode": self._build_decode,
-                     "decode_paged": self._build_decode_paged}[kind]
+            if kind in ("spec_prefill", "spec_prefill_chunk", "draft_decode",
+                        "verify", "verify_paged"):
+                from thunder_tpu.serving import speculative as _spec_mod
+
+                build = partial({
+                    "spec_prefill": _spec_mod.build_spec_prefill,
+                    "spec_prefill_chunk": _spec_mod.build_spec_prefill_chunk,
+                    "draft_decode": _spec_mod.build_draft_decode,
+                    "verify": _spec_mod.build_verify,
+                    "verify_paged": _spec_mod.build_verify_paged,
+                }[kind], self)
+            else:
+                build = {"prefill": self._build_prefill,
+                         "prefill_chunk": self._build_prefill_chunk,
+                         "decode": self._build_decode,
+                         "decode_paged": self._build_decode_paged}[kind]
             prog = build(a, b)
             # a genuinely new program for this geometry: count the compile
             self.compile_counts[kind] += 1
@@ -1687,6 +1846,12 @@ class ServingEngine:
             return {}
         from thunder_tpu.serving.mesh import program_shardings
 
+        if self.spec is not None:
+            return program_shardings(
+                kind, self.params, self.mesh, self.pool.arena_sharding,
+                draft_params=self.spec.draft_params,
+                draft_arena_sh=self.draft_pool.arena_sharding,
+            )
         return program_shardings(kind, self.params, self.mesh, self.pool.arena_sharding)
 
     def _collective_census(self, bucket_key: tuple, prog, example_args) -> dict:
@@ -1953,5 +2118,17 @@ def serve(model_fn, params, cfg, **kwargs) -> ServingEngine:
     ``fault_plan=FaultPlan(...)`` (or ``THUNDER_TPU_FAULT_PLAN`` JSON)
     injects deterministic seeded faults at the named fault points for
     chaos testing; ``fault_plan=None`` leaves every compiled program
-    byte-identical — the plan lives purely on the host side."""
+    byte-identical — the plan lives purely on the host side.
+
+    Speculative serving: ``speculative=SpecConfig(draft_params, draft_cfg,
+    K=...)`` swaps each decode turn for a draft/verify round — a draft KV
+    block arena rides beside the target arena (same block tables, same
+    ``kv_dtype``/mesh treatment), K chained draft forwards propose tokens,
+    one (K+1)-position target forward verifies them through the shared
+    rejection rule (``models.speculative.accept_tokens``), and 1..K+1
+    tokens emit per round.  PRNG keys advance only at harvest, so served
+    tokens are bit-identical to solo ``speculative_generate()`` — greedy
+    or sampled — and re-prefill recovery replays both arenas
+    deterministically.  ``speculative=None`` (default) leaves every
+    compiled program byte-identical to a spec-free engine."""
     return ServingEngine(params, cfg, model_fn=model_fn, **kwargs)

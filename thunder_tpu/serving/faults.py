@@ -5,8 +5,9 @@ and the only way to *test* that is to make failures reproducible.  This
 module is the chaos harness and the policy vocabulary the engine's recovery
 layer (:mod:`serving.engine`) speaks:
 
-- **Fault points** are the four places the event loop touches the device:
-  :data:`FP_PREFILL` / :data:`FP_DECODE` (program dispatch, before the call —
+- **Fault points** are the places the event loop touches the device:
+  :data:`FP_PREFILL` / :data:`FP_DECODE` / :data:`FP_DRAFT` /
+  :data:`FP_VERIFY` (program dispatch, before the call —
   host state is still consistent and the arenas are not yet donated),
   :data:`FP_SCATTER` (after the program call, before the returned arenas are
   installed — the donated inputs are already consumed, so a fault here can
@@ -49,6 +50,8 @@ from thunder_tpu.observability.metrics import registry
 __all__ = [
     "FP_PREFILL",
     "FP_DECODE",
+    "FP_DRAFT",
+    "FP_VERIFY",
     "FP_HARVEST",
     "FP_SCATTER",
     "FAULT_POINTS",
@@ -67,12 +70,18 @@ __all__ = [
     "resolve_fault_plan",
 ]
 
-# named fault points — where the event loop touches the device
+# named fault points — where the event loop touches the device.  The
+# speculative lane adds two dispatch sites: FP_DRAFT before the draft
+# program and FP_VERIFY between draft and verify — both pre-donation (the
+# draft rerun is deterministic and ``_spec_state`` only advances at
+# harvest), so they retry/quarantine/recover exactly like FP_DECODE.
 FP_PREFILL = "prefill.dispatch"
 FP_DECODE = "decode.dispatch"
+FP_DRAFT = "draft.dispatch"
+FP_VERIFY = "verify.dispatch"
 FP_HARVEST = "harvest"
 FP_SCATTER = "scatter"
-FAULT_POINTS = (FP_PREFILL, FP_DECODE, FP_HARVEST, FP_SCATTER)
+FAULT_POINTS = (FP_PREFILL, FP_DECODE, FP_DRAFT, FP_VERIFY, FP_HARVEST, FP_SCATTER)
 
 FAULT_KINDS = ("fail", "nan", "oom", "hang")
 

@@ -92,7 +92,8 @@ def place_params(params, mesh: Mesh, shardings=None):
     return apply_shardings(params, shardings)
 
 
-def program_shardings(kind: str, params, mesh: Mesh, arena_sh: NamedSharding) -> dict:
+def program_shardings(kind: str, params, mesh: Mesh, arena_sh: NamedSharding,
+                      *, draft_params=None, draft_arena_sh=None) -> dict:
     """``in_shardings``/``out_shardings`` for a bucket program.
 
     Everything the host builds per step (token/pos/table/dest arrays, PRNG
@@ -138,10 +139,44 @@ def program_shardings(kind: str, params, mesh: Mesh, arena_sh: NamedSharding) ->
             in_shardings=(param_sh, repl, repl, arena_sh, repl, repl, repl, repl),
             out_shardings=(arena_sh, repl),
         )
-    assert kind in ("decode", "decode_paged"), kind
+    if kind in ("decode", "decode_paged"):
+        return dict(
+            in_shardings=(param_sh, repl, repl, repl, arena_sh, repl, repl, repl),
+            out_shardings=(repl, repl, repl, arena_sh),
+        )
+    # the speculative lane (serving.speculative): draft params/arena carry
+    # their own placements; the host-built chunk arrays stay replicated
+    dparam_sh = jax.tree_util.tree_map(lambda x: x.sharding, draft_params)
+    if kind == "spec_prefill":
+        # (params, dparams, toks, pos, n_real, arenas, darenas, table,
+        #  dest, key, lora, slot) -> (tok, arenas, darenas, key, qerr)
+        return dict(
+            in_shardings=(param_sh, dparam_sh, repl, repl, repl, arena_sh,
+                          draft_arena_sh, repl, repl, repl, repl, repl),
+            out_shardings=(repl, arena_sh, draft_arena_sh, repl, repl),
+        )
+    if kind == "spec_prefill_chunk":
+        # (params, dparams, toks, pos, arenas, darenas, table, dest, lora,
+        #  slot) -> (arenas, darenas, qerr)
+        return dict(
+            in_shardings=(param_sh, dparam_sh, repl, repl, arena_sh,
+                          draft_arena_sh, repl, repl, repl, repl),
+            out_shardings=(arena_sh, draft_arena_sh, repl),
+        )
+    if kind == "draft_decode":
+        # (dparams, toks, pos, tables, darenas, keys)
+        #   -> (drafts, q_rows, keys_mid, darenas)
+        return dict(
+            in_shardings=(dparam_sh, repl, repl, repl, draft_arena_sh, repl),
+            out_shardings=(repl, repl, repl, draft_arena_sh),
+        )
+    assert kind in ("verify", "verify_paged"), kind
+    # (params, toks, pos, tables, arenas, drafts, q_rows, keys, lora,
+    #  slots) -> (emitted, n_emit, y, new_keys, new_pos, arenas)
     return dict(
-        in_shardings=(param_sh, repl, repl, repl, arena_sh, repl, repl, repl),
-        out_shardings=(repl, repl, repl, arena_sh),
+        in_shardings=(param_sh, repl, repl, repl, arena_sh, repl, repl,
+                      repl, repl, repl),
+        out_shardings=(repl, repl, repl, repl, repl, arena_sh),
     )
 
 

@@ -38,7 +38,9 @@ from jax.sharding import PartitionSpec as P
 
 from thunder_tpu.executors.pallasex import (
     paged_attn_decode,
+    paged_attn_verify,
     paged_token_write,
+    paged_token_write_masked,
     pltpu as _pltpu,
 )
 from thunder_tpu.models.generate import (
@@ -50,7 +52,8 @@ from thunder_tpu.models.generate import (
 )
 from thunder_tpu.serving.quant import quantize_kv
 
-__all__ = ["forward_paged", "write_fresh_kv", "paged_supported"]
+__all__ = ["forward_paged", "write_fresh_kv", "write_fresh_kv_masked",
+           "paged_supported"]
 
 
 def _smap(fn, mesh, in_specs, out_specs):
@@ -121,23 +124,57 @@ def _attn_paged(q, arenas, fresh_k, fresh_v, tables, pos, *, layer, window, mesh
     return _smap(local, mesh, in_specs, hspec)(*args)
 
 
+def _attn_paged_multi(q, arenas, fresh_k, fresh_v, tables, pos, *, layer, mesh):
+    """Multi-token-query (verify) kernel call: ``q`` (B, nh, T, hs), fresh
+    K/V (B, ng, T, hs).  Same mesh layout as :func:`_attn_paged` with the
+    query-position axis riding along unsharded.  No sliding window —
+    ``paged_supported`` already rejects windowed configs for speculation."""
+    quantized = "k_scale" in arenas
+    if mesh is None:
+        return paged_attn_verify(
+            q, arenas["k"], arenas["v"], fresh_k, fresh_v, tables, pos,
+            layer=layer,
+            k_scale=arenas.get("k_scale"), v_scale=arenas.get("v_scale"),
+        )
+    hspec = P(None, "tp", None, None)              # (B, heads, T, hs)
+    aspec = P(None, None, "tp", None, None)        # (nb, L, ng, bs, hs)
+    sspec = P(None, None, "tp", None)              # (nb, L, ng, bs)
+    if quantized:
+        def local(q_, ka, va, ks, vs, fk, fv, t, p):
+            return paged_attn_verify(q_, ka, va, fk, fv, t, p, layer=layer,
+                                     k_scale=ks, v_scale=vs)
+
+        in_specs = (hspec, aspec, aspec, sspec, sspec, hspec, hspec, P(None, None), P(None))
+        args = (q, arenas["k"], arenas["v"], arenas["k_scale"], arenas["v_scale"],
+                fresh_k, fresh_v, tables, pos)
+    else:
+        def local(q_, ka, va, fk, fv, t, p):
+            return paged_attn_verify(q_, ka, va, fk, fv, t, p, layer=layer)
+
+        in_specs = (hspec, aspec, aspec, hspec, hspec, P(None, None), P(None))
+        args = (q, arenas["k"], arenas["v"], fresh_k, fresh_v, tables, pos)
+    return _smap(local, mesh, in_specs, hspec)(*args)
+
+
 def forward_paged(params, idx, pos, arenas, tables, cos_all, sin_all, cfg, *,
                   cdtype, quantized=False, lora=None, lora_scaling=1.0,
                   mesh=None):
-    """Single-token decode forward straight off the KV block arenas.
+    """Decode/verify forward straight off the KV block arenas.
 
-    Mirrors ``forward_with_cache`` (vec-pos, T=1) except attention: instead
-    of consuming a gathered dense cache, each layer calls the paged kernel
-    against the arenas + block tables.  ``idx``: (B, 1) tokens; ``pos``:
-    (B,) int32; ``arenas``: the pool's ``{"k","v"(,"k_scale","v_scale")}``;
-    ``tables``: (B, nbb) sink-padded block tables; ``cdtype``: the cache
-    compute dtype (fresh K/V are cast to it before attending, matching the
-    dense path's cache write).  Returns ``(logits (B, 1, V), fresh)`` with
-    ``fresh = {"k"/"v": (B, L, ng, hs) at cdtype}`` — the caller persists it
-    with :func:`write_fresh_kv` (same step, after sampling's logits are
-    taken; order doesn't matter as the kernel already attended it)."""
+    Mirrors ``forward_with_cache`` (vec-pos) except attention: instead of
+    consuming a gathered dense cache, each layer calls the paged kernel
+    against the arenas + block tables.  ``idx``: (B, T) tokens — T=1 is the
+    decode step, T=K+1 the speculative verify chunk (causal intra-chunk mask
+    fused in-kernel); ``pos``: (B,) int32; ``arenas``: the pool's
+    ``{"k","v"(,"k_scale","v_scale")}``; ``tables``: (B, nbb) sink-padded
+    block tables; ``cdtype``: the cache compute dtype (fresh K/V are cast to
+    it before attending, matching the dense path's cache write).  Returns
+    ``(logits (B, T, V), fresh)`` with ``fresh = {"k"/"v": (B, L, ng, hs)}``
+    for T=1 or ``(B, L, ng, T, hs)`` for T>1, at cdtype — the caller
+    persists it with :func:`write_fresh_kv` / :func:`write_fresh_kv_masked`
+    (same step, after sampling's logits are taken; order doesn't matter as
+    the kernel already attended it)."""
     B, T = idx.shape
-    assert T == 1, "forward_paged is the decode (single-token) forward"
     hs, nh = cfg.head_size, cfg.n_head
     window = cfg.sliding_window
     x = params["wte"][idx]
@@ -158,13 +195,21 @@ def forward_paged(params, idx, pos, arenas, tables, cos_all, sin_all, cfg, *,
             lora_l = {t: (ab["a"][:, l], ab["b"][:, l]) for t, ab in lora.items()}
         q, k, v = _project_qkv(bp["attn"], n1, cos_t, sin_t, cfg, lin=lin,
                                lora=lora_l, lora_scaling=lora_scaling)
-        # q: (B, nh, 1, hs) → (B, nh, hs); fresh K/V at the cache compute
-        # dtype — the exact values the dense path writes before attending
-        fk = k[:, :, 0].astype(cdtype)
-        fv = v[:, :, 0].astype(cdtype)
-        y = _attn_paged(q[:, :, 0], arenas, fk, fv, tables, pos,
-                        layer=l, window=window, mesh=mesh)
-        y = y.reshape(B, 1, nh * hs)
+        # fresh K/V at the cache compute dtype — the exact values the dense
+        # path writes before attending
+        if T == 1:
+            # q: (B, nh, 1, hs) → (B, nh, hs)
+            fk = k[:, :, 0].astype(cdtype)
+            fv = v[:, :, 0].astype(cdtype)
+            y = _attn_paged(q[:, :, 0], arenas, fk, fv, tables, pos,
+                            layer=l, window=window, mesh=mesh)
+            y = y.reshape(B, 1, nh * hs)
+        else:
+            fk = k.astype(cdtype)                  # (B, ng, T, hs)
+            fv = v.astype(cdtype)
+            y = _attn_paged_multi(q, arenas, fk, fv, tables, pos,
+                                  layer=l, mesh=mesh)
+            y = y.transpose(0, 2, 1, 3).reshape(B, T, nh * hs)
         h = lin(y, bp["attn"]["wo"], bp["attn"].get("bo"))
         if lora_l is not None and "wo" in lora_l:
             h = h + _lora_delta(y, *lora_l["wo"], lora_scaling)
@@ -221,3 +266,45 @@ def write_fresh_kv(arenas, fresh, tables, pos, *, block_size, kv_dtype=None,
         "k_scale": w(arenas["k_scale"], ks),
         "v_scale": w(arenas["v_scale"], vs),
     }
+
+
+def _write_masked(arena, vals, tables, pos, n_emit, offset, *, block_size, mesh):
+    if mesh is None:
+        return paged_token_write_masked(arena, vals, tables, pos, n_emit,
+                                        offset, block_size=block_size)
+    rank5 = arena.ndim == 5
+    aspec = P(None, None, "tp", None, None) if rank5 else P(None, None, "tp", None)
+    vspec = P(None, None, "tp", None) if rank5 else P(None, None, "tp")
+    return _smap(
+        lambda a, v, t, p, n: paged_token_write_masked(
+            a, v, t, p, n, offset, block_size=block_size),
+        mesh, (aspec, vspec, P(None, None), P(None), P(None)), aspec,
+    )(arena, vals, tables, pos, n_emit)
+
+
+def write_fresh_kv_masked(arenas, fresh, tables, pos, n_emit, *, block_size,
+                          kv_dtype=None, mesh=None):
+    """Lands a verify step's accepted-prefix K/V in the arenas, in place.
+
+    ``fresh``: ``{"k"/"v": (B, L, ng, T, hs)}`` from a T=K+1
+    :func:`forward_paged` call; ``n_emit``: (B,) int32 accepted counts.  For
+    each chunk offset ``k`` only rows with ``k < n_emit`` commit at
+    ``pos + k``; the rest are sink-routed (block 0, never attended), so
+    rejected candidates leave no trace and the next round re-derives them
+    from scratch.  Quantization matches :func:`write_fresh_kv` — per-token
+    ``quantize_kv``, bit-identical bytes to the gather path's commits."""
+    T = fresh["k"].shape[3]
+    if kv_dtype is None:
+        pairs = {"k": fresh["k"], "v": fresh["v"]}
+    else:
+        kq, ks = quantize_kv(fresh["k"], kv_dtype)
+        vq, vs = quantize_kv(fresh["v"], kv_dtype)
+        pairs = {"k": kq, "v": vq, "k_scale": ks, "v_scale": vs}
+    out = dict(arenas)
+    for name, vals in pairs.items():
+        a = out[name]
+        for k in range(T):
+            a = _write_masked(a, vals[:, :, :, k], tables, pos, n_emit, k,
+                              block_size=block_size, mesh=mesh)
+        out[name] = a
+    return out

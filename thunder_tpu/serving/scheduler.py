@@ -149,12 +149,17 @@ class Scheduler:
         prefill_buckets: Sequence[int] | None = None,
         sliding_window: int | None = None,
         prefill_chunk: int | None = None,
+        reserve_extra_tokens: int = 0,
     ):
         self.pool = pool
         self.max_batch = int(max_batch)
         self.max_queue = int(max_queue)
         self.clock = clock if clock is not None else time.monotonic
         self.sliding_window = sliding_window
+        # extra cache slots reserved past prompt+max_new (speculative
+        # serving: a round's draft scan writes up to K slots past the last
+        # committed token, and those writes must land in owned blocks)
+        self.reserve_extra_tokens = int(reserve_extra_tokens)
         max_blocks = pool.num_usable
         self.batch_buckets = tuple(batch_buckets) if batch_buckets else pow2_buckets(1, self.max_batch)
         self.block_buckets = tuple(block_buckets) if block_buckets else pow2_buckets(1, max_blocks)
@@ -188,10 +193,12 @@ class Scheduler:
     #
 
     def blocks_needed(self, req: Request) -> int:
-        """Full reservation: blocks covering prompt + max_new (window models
-        reclaim early via :meth:`expire_window_blocks`, but admission is
-        conservative so a running request can never be starved of blocks)."""
-        return self.pool.blocks_for_tokens(req.total_capacity)
+        """Full reservation: blocks covering prompt + max_new plus any
+        engine-level overshoot reserve (window models reclaim early via
+        :meth:`expire_window_blocks`, but admission is conservative so a
+        running request can never be starved of blocks)."""
+        return self.pool.blocks_for_tokens(
+            req.total_capacity + self.reserve_extra_tokens)
 
     def bytes_needed(self, req: Request) -> int:
         """The reservation in **stored arena bytes** — block count × the

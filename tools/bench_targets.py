@@ -35,6 +35,7 @@ __all__ = [
     "check_capacity_targets",
     "check_recovery_targets",
     "check_paged_attn_targets",
+    "check_serving_spec_targets",
 ]
 
 # generous: CI hosts jitter, and the gate exists to catch the donate=False
@@ -447,4 +448,69 @@ def check_paged_attn_targets(artifact: dict | None = None, *,
         f"round-trip: ratio {r['arena_traffic_ratio_x']} <= {min_traffic_ratio}"
     )
     assert r["drive_gather_ms"] > 0 and r["drive_paged_ms"] > 0, r
+    return artifact
+
+
+def check_serving_spec_targets(artifact: dict | None = None, *,
+                               min_ratio: float = 1.2) -> dict:
+    """Validates the BENCH_SERVING_SPEC.json artifact: schema, sanity (the
+    lane actually speculated — rounds > 0 with a non-degenerate acceptance
+    histogram — and the batch actually shared rounds), **exact** token
+    parity between the speculative and plain engines (greedy speculation
+    that diverges is broken, whatever its throughput), the headline claim
+    (tokens/sec at occupancy 8 at least ``min_ratio``x the plain engine
+    with a high-acceptance draft), the spec-extended bucket bound, and the
+    compile-free measured window.  Returns the artifact for chaining."""
+    if artifact is None:
+        artifact = load_artifact("BENCH_SERVING_SPEC.json")
+    assert "backend" in artifact and "results" in artifact, sorted(artifact)
+    r = artifact["results"]
+    for key in (
+        "plain_tokens_per_sec", "spec_tokens_per_sec", "speedup_x", "K",
+        "acceptance_rate", "accept_len_hist", "tokens_per_round",
+        "spec_rounds", "token_parity_exact", "mean_batch_occupancy",
+        "draft_decode_compiles", "verify_compiles", "spec_prefill_compiles",
+        "decode_compiles", "bucket_bound", "cold_compile_prefills_measured",
+    ):
+        assert key in r, (key, sorted(r))
+    assert r["plain_tokens_per_sec"] > 0 and r["spec_tokens_per_sec"] > 0, r
+    assert r["token_parity_exact"] is True, (
+        "speculatively served tokens diverged from the plain engine — the "
+        "throughput comparison is void (greedy speculation must be "
+        "bit-identical to plain decode by construction)"
+    )
+    assert r["spec_rounds"] > 0, (
+        "zero speculative rounds ran — the lane never engaged, so this "
+        "measured nothing"
+    )
+    # the histogram counts per-(row, round) acceptance lengths; rounds
+    # counts dispatches — at occupancy > 1 the histogram is the bigger sum
+    hist = {int(k): v for k, v in r["accept_len_hist"].items()}
+    assert sum(hist.values()) >= r["spec_rounds"], (hist, r["spec_rounds"])
+    assert 0.0 <= r["acceptance_rate"] <= 1.0, r["acceptance_rate"]
+    assert r["acceptance_rate"] >= 0.5, (
+        f"acceptance rate {r['acceptance_rate']} < 0.5 with the distilled "
+        f"draft pair — the draft lane is not proposing what the solo rule "
+        f"accepts, so the speedup is not measuring speculation"
+    )
+    assert r["mean_batch_occupancy"] > 1.0, (
+        f"mean batch occupancy {r['mean_batch_occupancy']} <= 1: requests "
+        f"never actually shared a speculative round"
+    )
+    assert r["speedup_x"] >= min_ratio, (
+        f"speculative serving only {r['speedup_x']:.2f}x the plain engine "
+        f"at occupancy 8 (< {min_ratio}x) — the draft/verify round is not "
+        f"amortizing per-token dispatch"
+    )
+    compiles = (r["draft_decode_compiles"] + r["verify_compiles"]
+                + r["spec_prefill_compiles"] + r["decode_compiles"])
+    assert compiles <= r["bucket_bound"], (
+        f"{compiles} compiled programs exceed the spec-extended bucket "
+        f"bound {r['bucket_bound']} — the lane is leaking program shapes"
+    )
+    assert r["cold_compile_prefills_measured"] == 0, (
+        f"{r['cold_compile_prefills_measured']} measured-engine prefills "
+        f"paid an XLA compile — the throughput windows are polluted by "
+        f"cold starts"
+    )
     return artifact

@@ -843,6 +843,33 @@ def main():
             "results": out["results"],
         }))
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "serving_dp":
+        # data-parallel serving bench: 2 replicated engine lanes behind
+        # the prefix-affinity router vs one engine at equal total
+        # occupancy — the router co-locates the shared-prefix family so
+        # each lane decodes at its own block-table bucket (shape
+        # segregation), exact token parity asserted.  Host work only, no
+        # TPU probe; artifact uses the BENCH_MICRO schema.
+        from thunder_tpu._platform import force_cpu
+
+        force_cpu()
+        from thunder_tpu.benchmarks.serving_dp import serving_dp_bench
+
+        out = serving_dp_bench(on_tpu=False)
+        artifact = {"backend": jax.default_backend(), **out}
+        with open("BENCH_SERVING_DP.json", "w") as f:
+            json.dump(artifact, f, indent=1)
+        for k, v in out["results"].items():
+            log(f"serving_dp {k}: {v}")
+        print(json.dumps({
+            "metric": "serving_dp_vs_solo_throughput_x",
+            "value": out["results"]["throughput_ratio"],
+            "unit": "x",
+            # the solo engine IS the baseline of this ratio
+            "vs_baseline": out["results"]["throughput_ratio"],
+            "results": out["results"],
+        }))
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "serving_mesh":
         # mesh-parallel serving bench: the SPMD engine (TP-sharded params,
         # heads-over-tp block arena, pjit bucket programs) vs the

@@ -873,3 +873,79 @@ class TestServingSpecTargets:
         assert out["results"]["smoke"] is True
         assert out["results"]["token_parity_exact"] is True
         assert out["results"]["acceptance_rate"] == 1.0
+
+
+class TestServingDpTargets:
+    def test_serving_dp_gate_on_committed_artifact(self):
+        """BENCH_SERVING_DP.json must keep showing the routed 2-replica
+        fleet's shape-segregation win over a solo engine at equal total
+        occupancy (>= 1.6x), exact token parity, live routing on both
+        lanes with at least one affinity hit, and a compile-free measured
+        window.  A regression recorded into the artifact fails here."""
+        from tools.bench_targets import check_serving_dp_targets
+
+        art = check_serving_dp_targets()
+        assert art["backend"] in ("cpu", "tpu")
+        assert art["results"]["throughput_ratio"] >= 1.6
+        assert art["results"]["affinity_hits"] >= 1
+        assert art["results"]["imbalance"] == 0
+
+    def test_serving_dp_gate_rejects_regressions(self):
+        from tools.bench_targets import check_serving_dp_targets, load_artifact
+
+        good = load_artifact("BENCH_SERVING_DP.json")
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["throughput_ratio"] = 1.2
+        with pytest.raises(AssertionError, match="not paying for the router"):
+            check_serving_dp_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["token_parity_exact"] = False
+        with pytest.raises(AssertionError, match="diverged"):
+            check_serving_dp_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["affinity_hits"] = 0
+        with pytest.raises(AssertionError, match="affinity"):
+            check_serving_dp_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["routed_by_replica"] = [16, 0]
+        with pytest.raises(AssertionError, match="collapsed"):
+            check_serving_dp_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["routed"] = bad["results"]["routed"] - 1
+        with pytest.raises(AssertionError, match="never left"):
+            check_serving_dp_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["decode_compiles"] = bad["results"]["bucket_bound"] + 1
+        with pytest.raises(AssertionError, match="bucket"):
+            check_serving_dp_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["cold_compile_prefills_measured"] = 2
+        with pytest.raises(AssertionError, match="cold"):
+            check_serving_dp_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        del bad["results"]["routed_by_replica"]
+        with pytest.raises(AssertionError):
+            check_serving_dp_targets(bad)
+
+    @pytest.mark.slow
+    def test_serving_dp_bench_live_smoke(self):
+        """The bench harness itself at smoke shapes: schema + parity +
+        routing evidence + compile bound must hold live (the throughput
+        ratio is not gated at smoke shapes — the LLC-blowout effect needs
+        the full-shape tables; the committed artifact carries that gate)."""
+        from thunder_tpu.benchmarks.serving_dp import serving_dp_bench
+        from tools.bench_targets import check_serving_dp_targets
+
+        out = serving_dp_bench(on_tpu=False, smoke=True)
+        art = {"backend": jax.default_backend(), **out}
+        check_serving_dp_targets(art, min_ratio=0.0)
+        assert out["results"]["smoke"] is True
+        assert out["results"]["token_parity_exact"] is True

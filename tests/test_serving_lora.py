@@ -187,16 +187,14 @@ class TestMixedTenantBatches:
         assert sum(stats["compile_counts"].values()) <= stats["bucket_bound"]
         eng2 = _engine(cfg, params, lora=registry)
         eng2.run([{"prompt": prompts[0], "max_new_tokens": 3, "adapter_id": "bob"}])
-        assert eng2.compile_counts == {"prefill": 0, "prefill_chunk": 0, "decode": 0,
-                                          "decode_paged": 0}
+        assert sum(eng2.compile_counts.values()) == 0
         registry.register("dave", make_lora_factors(cfg, RANK, jax.random.PRNGKey(99),
                                                     std=0.5))
         try:
             eng3 = _engine(cfg, params, lora=registry)
             eng3.run([{"prompt": prompts[1], "max_new_tokens": 3,
                        "adapter_id": "dave"}])
-            assert eng3.compile_counts == {"prefill": 0, "prefill_chunk": 0, "decode": 0,
-                                          "decode_paged": 0}
+            assert sum(eng3.compile_counts.values()) == 0
         finally:
             registry.evict("dave")                          # keep the fixture clean
 

@@ -205,8 +205,7 @@ class TestMeshEngine:
         eng2 = _engine(cfg, params, mesh)
         h = eng2.submit(base, max_new_tokens=4)
         h.result()
-        assert eng2.compile_counts == {"prefill": 0, "prefill_chunk": 0, "decode": 0,
-                                       "decode_paged": 0}
+        assert sum(eng2.compile_counts.values()) == 0
 
     def test_distinct_device_sets_never_share_programs(self, mesh_served, micro):
         """A same-shape mesh over different devices fingerprints — and

@@ -1,0 +1,531 @@
+"""Data-parallel serving: replicated engine lanes + prefix-affinity router.
+
+The load-bearing guarantee is differential: tokens served through the
+2-replica routed fleet must be *identical* to the solo engine (and
+therefore to solo ``generate()``) for the same requests — greedy AND
+temperature, whatever lane each request lands on (per-request PRNG key
+chains make decode row-local, so batch composition cannot leak into
+tokens).  Policy behavior — affinity co-location, history routing,
+least-loaded spread, strict-FIFO waiting, router-side deadlines, replica
+eviction, replica-named stalls, replica-scoped fault plans, the process-0
+guard — is tested host-side on a micro model so the file stays CPU-fast.
+The ``replicas=1`` / no-``dp``-axis path must leave the module program
+cache untouched: a world without the router compiles byte-identical
+programs.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+import thunder_tpu as tt
+from thunder_tpu.models import generate as gen
+from thunder_tpu.models import llama
+from thunder_tpu.serving import (
+    AdmissionError,
+    EngineStalledError,
+    FaultPlan,
+    FaultSpec,
+    ReplicatedEngine,
+    RetryPolicy,
+)
+from thunder_tpu.serving.engine import ServingEngine
+from thunder_tpu.serving.faults import FP_DECODE
+from thunder_tpu.serving.mesh import mesh_fingerprint, split_mesh
+
+MICRO = dict(
+    n_layer=1, n_head=2, n_embd=16, intermediate_size=32, vocab_size=32, block_size=64,
+)
+
+
+@pytest.fixture(scope="module")
+def micro():
+    cfg = llama.Config.from_name("tiny-llama-debug", **MICRO)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    return cfg, params
+
+
+def _fleet(cfg, params, **kw):
+    kw.setdefault("replicas", 2)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 16)
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("cache_dtype", jnp.float32)
+    # pinned-small bucket sets keep the file inside the tier-1 budget (the
+    # test_serving_lora idiom): every engine config coalesces onto a
+    # handful of tiny programs instead of walking the pow2 ladders
+    kw.setdefault("batch_buckets", (4,))
+    kw.setdefault("block_buckets", (4, 16))
+    kw.setdefault("prefill_buckets", (8, 16, 64))
+    return tt.serve(None, params, cfg, **kw)
+
+
+def _prompt(seed, n, cfg):
+    return np.asarray(
+        jax.random.randint(jax.random.PRNGKey(seed), (n,), 0, cfg.vocab_size)
+    ).astype(np.int32)
+
+
+def _family(cfg, n, length=8, bs=4):
+    """n prompts sharing a block-aligned prefix (distinct last token)."""
+    base = _prompt(77, length, cfg)
+    out = []
+    for i in range(n):
+        p = base.copy()
+        p[-1] = (i + 1) % cfg.vocab_size
+        out.append(p)
+    return out
+
+
+#
+# dispatch: tt.serve() grows the dp entry points without changing solo
+#
+
+
+class TestServeDispatch:
+    def test_replicas_2_returns_replicated_engine(self, micro):
+        cfg, params = micro
+        eng = _fleet(cfg, params)
+        assert isinstance(eng, ReplicatedEngine)
+        assert eng.replicas == 2 and len(eng.engines) == 2
+        assert [e.replica_id for e in eng.engines] == [0, 1]
+        eng.shutdown()
+
+    def test_replicas_1_is_the_plain_engine(self, micro):
+        """No dp requested -> the solo engine type, not a 1-lane router
+        (the router must be impossible to pay for by accident)."""
+        cfg, params = micro
+        eng = _fleet(cfg, params, replicas=1)
+        assert isinstance(eng, ServingEngine)
+        assert not isinstance(eng, ReplicatedEngine)
+        eng.shutdown()
+
+    def test_dp_mesh_implies_replicas(self, micro):
+        cfg, params = micro
+        mesh = Mesh(np.array(jax.devices("cpu")[:2], dtype=object), ("dp",))
+        eng = _fleet(cfg, params, replicas=2, mesh=mesh)
+        assert isinstance(eng, ReplicatedEngine)
+        fps = [mesh_fingerprint(e.mesh) for e in eng.engines]
+        assert fps[0] != fps[1]
+        eng.shutdown()
+
+    def test_dp_mesh_replicas_conflict_rejected(self, micro):
+        cfg, params = micro
+        mesh = Mesh(np.array(jax.devices("cpu")[:2], dtype=object), ("dp",))
+        with pytest.raises(ValueError, match="dp"):
+            _fleet(cfg, params, replicas=3, mesh=mesh)
+
+    def test_fault_plan_kwarg_rejected_under_dp(self, micro):
+        cfg, params = micro
+        with pytest.raises(ValueError, match="fault_plans"):
+            _fleet(cfg, params, fault_plan=FaultPlan(specs=[FaultSpec(point=FP_DECODE)]))
+
+    def test_fault_plans_length_must_match(self, micro):
+        cfg, params = micro
+        with pytest.raises(ValueError, match="fault_plans"):
+            _fleet(cfg, params, fault_plans=[None])
+
+    def test_fault_plans_rejected_solo(self, micro):
+        cfg, params = micro
+        with pytest.raises(ValueError, match="fault_plan="):
+            _fleet(cfg, params, replicas=1, fault_plans=[None])
+
+
+class TestSplitMesh:
+    def test_dp_only_mesh_splits_to_single_device_lanes(self):
+        devs = jax.devices("cpu")[:2]
+        mesh = Mesh(np.array(devs, dtype=object), ("dp",))
+        subs = split_mesh(mesh)
+        assert len(subs) == 2
+        for sub, d in zip(subs, devs):
+            assert sub.axis_names == ("tp",)
+            assert [x.id for x in sub.devices.flat] == [d.id]
+        assert mesh_fingerprint(subs[0]) != mesh_fingerprint(subs[1])
+
+    def test_dp_tp_mesh_keeps_tp_per_lane(self):
+        devs = np.array(jax.devices("cpu")[:4], dtype=object).reshape(2, 2)
+        mesh = Mesh(devs, ("dp", "tp"))
+        subs = split_mesh(mesh)
+        assert len(subs) == 2
+        for i, sub in enumerate(subs):
+            assert sub.axis_names == ("tp",)
+            assert [x.id for x in sub.devices.flat] == [d.id for d in devs[i]]
+
+    def test_no_dp_axis_rejected(self):
+        mesh = Mesh(np.array(jax.devices("cpu")[:2], dtype=object), ("tp",))
+        with pytest.raises(ValueError, match="no 'dp' axis"):
+            split_mesh(mesh)
+
+
+#
+# token parity: routing must be invisible in the emitted tokens
+#
+
+
+class TestRoutedParity:
+    def test_greedy_matches_solo_engine_and_generate(self, micro):
+        cfg, params = micro
+        prompts = [_prompt(s, n, cfg) for s, n in [(1, 5), (2, 8), (3, 3), (4, 6)]]
+        reqs = [{"prompt": p, "max_new_tokens": 7} for p in prompts]
+        fleet = _fleet(cfg, params)
+        routed = fleet.run([dict(r) for r in reqs])
+        fleet.shutdown()
+        solo_eng = _fleet(cfg, params, replicas=1, max_batch=4, num_blocks=32)
+        solo = solo_eng.run([dict(r) for r in reqs])
+        solo_eng.shutdown()
+        for a, b, p in zip(routed, solo, prompts):
+            np.testing.assert_array_equal(a.tokens, b.tokens)
+            ref = np.asarray(gen.generate(
+                params, jnp.asarray(p)[None], cfg, 7, cache_dtype=jnp.float32))[0]
+            np.testing.assert_array_equal(a.tokens, ref)
+
+    def test_int8_kv_parity(self, micro):
+        cfg, params = micro
+        reqs = [{"prompt": _prompt(7 + i, 5 + i, cfg), "max_new_tokens": 5}
+                for i in range(3)]
+        fleet = _fleet(cfg, params, kv_dtype="int8")
+        routed = fleet.run([dict(r) for r in reqs])
+        fleet.shutdown()
+        solo_eng = _fleet(cfg, params, replicas=1, max_batch=4, num_blocks=32,
+                          kv_dtype="int8")
+        solo = solo_eng.run([dict(r) for r in reqs])
+        solo_eng.shutdown()
+        for a, b in zip(routed, solo):
+            np.testing.assert_array_equal(a.tokens, b.tokens)
+
+    def test_lora_parity_in_replicas_mode(self, micro):
+        """Per-request adapters work through the router (no-mesh mode: the
+        registry arena is shared host-placed data) and tokens match the
+        solo engine per tenant."""
+        from thunder_tpu.serving import AdapterRegistry, make_lora_factors
+
+        cfg, params = micro
+        reg = AdapterRegistry(cfg, rank=2, max_adapters=2)
+        reg.register("alice", make_lora_factors(cfg, 2, jax.random.PRNGKey(10), std=0.5))
+        reqs = [{"prompt": _prompt(11 + i, 5, cfg), "max_new_tokens": 5,
+                 "adapter_id": "alice" if i % 2 else None} for i in range(4)]
+        fleet = _fleet(cfg, params, lora=reg, max_batch=4, num_blocks=32)
+        routed = fleet.run([dict(r) for r in reqs])
+        fleet.shutdown()
+        solo_eng = _fleet(cfg, params, replicas=1, max_batch=4, num_blocks=32, lora=reg)
+        solo = solo_eng.run([dict(r) for r in reqs])
+        solo_eng.shutdown()
+        for a, b in zip(routed, solo):
+            np.testing.assert_array_equal(a.tokens, b.tokens)
+
+    def test_chunked_prefill_parity(self, micro):
+        cfg, params = micro
+        long = np.arange(37, dtype=np.int32) % cfg.vocab_size
+        reqs = [{"prompt": long, "max_new_tokens": 5},
+                {"prompt": _prompt(15, 4, cfg), "max_new_tokens": 5}]
+        fleet = _fleet(cfg, params, prefill_chunk=8, num_blocks=32)
+        routed = fleet.run([dict(r) for r in reqs])
+        fleet.shutdown()
+        solo_eng = _fleet(cfg, params, replicas=1, num_blocks=32, prefill_chunk=8)
+        solo = solo_eng.run([dict(r) for r in reqs])
+        solo_eng.shutdown()
+        for a, b in zip(routed, solo):
+            np.testing.assert_array_equal(a.tokens, b.tokens)
+
+    def test_speculative_parity(self, micro):
+        """The spec lane rides through the router: a perfect-draft fleet
+        serves tokens identical to the solo speculative engine."""
+        from thunder_tpu.serving import SpecConfig
+
+        cfg, params = micro
+        spec = SpecConfig(params, cfg, K=2)          # draft == target
+        reqs = [{"prompt": _prompt(16 + i, 5, cfg), "max_new_tokens": 6}
+                for i in range(3)]
+        fleet = _fleet(cfg, params, speculative=spec, num_blocks=32)
+        routed = fleet.run([dict(r) for r in reqs])
+        assert sum(e.stats()["spec"]["rounds"] for e in fleet.engines) > 0
+        fleet.shutdown()
+        solo_eng = _fleet(cfg, params, replicas=1, max_batch=4, num_blocks=64,
+                          speculative=spec)
+        solo = solo_eng.run([dict(r) for r in reqs])
+        solo_eng.shutdown()
+        for a, b in zip(routed, solo):
+            np.testing.assert_array_equal(a.tokens, b.tokens)
+
+    def test_temperature_key_chain_is_row_local(self, micro):
+        """Sampled requests carry their own key chain: tokens are the
+        same whichever lane (and batch company) the router picks."""
+        cfg, params = micro
+        p = _prompt(5, 6, cfg)
+        reqs = [{"prompt": p if i == 0 else _prompt(6 + i, 4 + i, cfg),
+                 "max_new_tokens": 6, "key": jax.random.PRNGKey(100 + i)}
+                for i in range(4)]
+        fleet = _fleet(cfg, params, temperature=0.8)
+        routed = fleet.run([dict(r) for r in reqs])
+        fleet.shutdown()
+        solo_eng = _fleet(cfg, params, replicas=1, max_batch=4, num_blocks=32,
+                          temperature=0.8)
+        solo = solo_eng.run([dict(r) for r in reqs])
+        solo_eng.shutdown()
+        for a, b in zip(routed, solo):
+            np.testing.assert_array_equal(a.tokens, b.tokens)
+
+
+#
+# routing policy
+#
+
+
+class TestRoutingPolicy:
+    def test_prefix_family_colocates_with_affinity_hits(self, micro):
+        cfg, params = micro
+        fleet = _fleet(cfg, params, max_batch=4, num_blocks=32)
+        fam = _family(cfg, 3)
+        handles = [fleet.submit(p, max_new_tokens=4) for p in fam]
+        fleet.drain()
+        lanes = {h.replica for h in handles}
+        assert len(lanes) == 1                       # the family stayed together
+        r = fleet.stats()["router"]
+        assert r["affinity_hits"] >= 2               # members 2..n hit
+        assert sorted(r["routed_by_replica"]) == [0, 3]
+        fleet.shutdown()
+
+    def test_history_routes_after_family_finished(self, micro):
+        """Nothing resident (family done, blocks freed): the routing
+        history still lands the next member on the old lane."""
+        cfg, params = micro
+        fleet = _fleet(cfg, params, max_batch=4, num_blocks=32,
+                       prefix_sharing=False)       # nothing stays resident
+        fam = _family(cfg, 2)
+        h0 = fleet.submit(fam[0], max_new_tokens=3)
+        fleet.drain()
+        before = fleet.stats()["router"]["affinity_hits"]
+        h1 = fleet.submit(fam[1], max_new_tokens=3)
+        fleet.drain()
+        assert h1.replica == h0.replica
+        assert fleet.stats()["router"]["affinity_hits"] == before + 1
+        fleet.shutdown()
+
+    def test_distinct_requests_spread_least_loaded(self, micro):
+        cfg, params = micro
+        fleet = _fleet(cfg, params)
+        fleet.run([{"prompt": _prompt(20 + i, 5, cfg), "max_new_tokens": 3}
+                   for i in range(4)])
+        assert sorted(fleet.stats()["router"]["routed_by_replica"]) == [2, 2]
+        fleet.shutdown()
+
+    def test_router_metrics_land_in_registry(self, micro):
+        cfg, params = micro
+        fleet = _fleet(cfg, params)
+        fleet.run([{"prompt": _prompt(30, 5, cfg), "max_new_tokens": 3}])
+        snap = tt.metrics_snapshot()
+        assert snap["serving.router.replicas"] == 2
+        assert snap["serving.router.routed"] >= 1
+        assert snap["serving.router.queue_depth"] == 0
+        assert "serving.router.imbalance" in snap
+        assert "serving.router.replica0.running" in snap
+        assert "serving.router.affinity_hits" in snap
+        fleet.shutdown()
+
+    def test_router_deadline_expires_unrouted_request(self, micro):
+        """A request whose deadline lapses while still in the global queue
+        gets a synthetic "deadline" result without touching any replica."""
+        cfg, params = micro
+        fleet = _fleet(cfg, params, max_batch=1, num_blocks=8)
+        p = _prompt(40, 4, cfg)
+        # both lanes fully occupied: the third request cannot route
+        busy = [fleet.submit(_prompt(41 + i, 4, cfg), max_new_tokens=12)
+                for i in range(2)]
+        fleet.step()
+        h = fleet.submit(p, max_new_tokens=4, deadline=1e-6)
+        fleet.drain()
+        res = h.result(drive=False)
+        assert res.finish_reason == "deadline"
+        assert res.new_tokens == () and h.replica is None
+        assert fleet.stats()["router"]["expired"] == 1
+        assert all(b.result(drive=False).finish_reason == "length" for b in busy)
+        fleet.shutdown()
+
+    def test_aggregate_admission_bound(self, micro):
+        cfg, params = micro
+        fleet = _fleet(cfg, params, max_queue=1)
+        with pytest.raises(AdmissionError, match="never be admitted"):
+            fleet.submit(_prompt(50, 4, cfg), max_new_tokens=10_000)
+        for i in range(2):                         # max_queue x replicas
+            fleet.submit(_prompt(51 + i, 4, cfg), max_new_tokens=2)
+        with pytest.raises(AdmissionError, match="router queue full"):
+            fleet.submit(_prompt(53, 4, cfg), max_new_tokens=2)
+        fleet.drain()
+        fleet.shutdown()
+
+
+#
+# stalls name the replica (satellite: EngineStalledError.replica)
+#
+
+
+class TestStalledReplicaNaming:
+    def test_stall_names_replica_and_carries_its_flight_state(self, micro):
+        cfg, params = micro
+        fleet = _fleet(cfg, params)
+        e0 = fleet.engines[0]
+        leak = e0.pool.alloc(e0.pool.num_free - 2)   # 2 blocks left on lane 0
+        h = e0.submit(np.arange(4, dtype=np.int32), max_new_tokens=8)
+        with pytest.raises(EngineStalledError) as ei:
+            fleet.drain()
+        err = ei.value
+        assert err.replica == 0
+        assert str(err).startswith("replica 0:")
+        assert err.state["pool"]["num_free"] == 2    # THAT replica's snapshot
+        assert [r["rid"] for r in err.state["scheduler"]["requests"]] == [h.rid]
+        e0.pool.free(leak)
+        fleet.drain()                                # unstuck: head admits
+        assert h.done()
+        fleet.shutdown()
+
+    def test_unroutable_queue_with_idle_fleet_names_router(self, micro):
+        cfg, params = micro
+        fleet = _fleet(cfg, params)
+        for e in fleet.engines:
+            e._leak = e.pool.alloc(e.pool.num_free - 1)
+        h = fleet.submit(_prompt(60, 4, cfg), max_new_tokens=8)
+        with pytest.raises(EngineStalledError) as ei:
+            fleet.drain()
+        err = ei.value
+        assert err.replica is None
+        assert "every replica is idle" in str(err)
+        assert err.state["pending"][0]["rid"] == h.rid
+        for e in fleet.engines:
+            e.pool.free(e._leak)
+        fleet.drain()
+        assert h.done()
+        fleet.shutdown()
+
+
+#
+# eviction returns capacity to the owning replica only (satellite)
+#
+
+
+class TestReplicaEviction:
+    def test_evict_mid_chunked_prefill_frees_owner_only(self, micro):
+        cfg, params = micro
+        fleet = _fleet(cfg, params, prefill_chunk=8, num_blocks=32,
+                       prefix_sharing=False)
+        p = np.arange(40, dtype=np.int32) % cfg.vocab_size
+        h = fleet.submit(p, max_new_tokens=8)
+        fleet.step()                                  # route + first chunk
+        assert h.replica is not None and not h.done()
+        own = fleet.engines[h.replica]
+        other = fleet.engines[1 - h.replica]
+        assert own.pool.num_free < own.pool.num_usable   # blocks held mid-flight
+        other_free = other.pool.num_free
+        fleet.evict(h)
+        res = h.result()
+        assert res.finish_reason == "evicted"
+        # the race under test: the partially-written blocks return to the
+        # OWNING replica's pool, the other lane is untouched
+        assert own.pool.num_free == own.pool.num_usable
+        assert other.pool.num_free == other_free
+        low = fleet.stats()["aggregate"]["pool_free_blocks_low_water"]
+        assert low[h.replica] < low[1 - h.replica]       # only one lane dipped
+        # capacity actually recovered: the same footprint admits and runs
+        # on the same lane (routing history sends it back)
+        h2 = fleet.submit(p, max_new_tokens=4)
+        r2 = h2.result()
+        assert h2.replica == h.replica
+        assert r2.finish_reason == "length"
+        fleet.shutdown()
+
+    def test_evict_pending_is_synthetic(self, micro):
+        cfg, params = micro
+        fleet = _fleet(cfg, params, max_batch=1, num_blocks=8)
+        busy = [fleet.submit(_prompt(70 + i, 4, cfg), max_new_tokens=10)
+                for i in range(2)]
+        fleet.step()
+        h = fleet.submit(_prompt(72, 4, cfg), max_new_tokens=4)
+        assert h.state == "queued" and h.replica is None
+        fleet.evict(h)
+        assert h.done()
+        assert h.result(drive=False).finish_reason == "evicted"
+        fleet.drain()
+        assert all(b.done() for b in busy)
+        fleet.shutdown()
+
+
+#
+# replica-scoped faults + multi-host guard
+#
+
+
+class TestReplicaScopedFaults:
+    def test_fault_plans_attach_per_replica(self, micro):
+        cfg, params = micro
+        plan = FaultPlan(specs=[FaultSpec(point=FP_DECODE, kind="fail", at=1, count=1)])
+        fleet = _fleet(cfg, params, fault_plans=[None, plan],
+                       retry=RetryPolicy(sleep=lambda s: None))
+        assert fleet.engines[0]._faults is None
+        assert fleet.engines[1]._faults is not None
+        # a short run still completes: the faulted lane retries, the clean
+        # lane never sees the plan
+        out = fleet.run([{"prompt": _prompt(80 + i, 5, cfg), "max_new_tokens": 4}
+                         for i in range(4)])
+        assert all(r.finish_reason == "length" for r in out)
+        fleet.shutdown()
+
+    def test_recovery_stays_replica_scoped(self, micro):
+        """An oom fault on replica 1 triggers *its* recover() path; replica 0
+        never recovers and the whole fleet still finishes every request."""
+        cfg, params = micro
+        plan = FaultPlan(specs=[FaultSpec(point=FP_DECODE, kind="oom", at=1, count=1)])
+        fleet = _fleet(cfg, params, fault_plans=[None, plan],
+                       retry=RetryPolicy(sleep=lambda s: None))
+        out = fleet.run([{"prompt": _prompt(84 + i, 5, cfg), "max_new_tokens": 4}
+                         for i in range(4)])
+        assert all(r.finish_reason == "length" for r in out)
+        assert fleet.engines[1].stats()["recoveries"] >= 1
+        assert fleet.engines[0].stats()["recoveries"] == 0
+        fleet.shutdown()
+
+
+class TestProcessZeroGuard:
+    def test_submit_rejected_off_process_zero(self, micro, monkeypatch):
+        cfg, params = micro
+        monkeypatch.setattr(jax, "process_index", lambda: 1)
+        fleet = _fleet(cfg, params)
+        with pytest.raises(RuntimeError, match="process 0"):
+            fleet.submit(_prompt(90, 4, cfg), max_new_tokens=2)
+        fleet.shutdown()
+
+
+#
+# the no-dp world stays byte-identical (shared module program cache)
+#
+
+
+class TestSharedProgramCache:
+    def test_fleet_shares_programs_and_solo_recompiles_nothing(self, micro):
+        """Replica lanes share the module program cache with each other
+        AND with solo engines: after a solo engine has compiled a shape,
+        a 2-replica fleet doing the same-shape work compiles nothing new,
+        and a fresh solo engine afterwards compiles nothing either — the
+        replicas=1 path runs byte-identical programs to a router-less
+        world."""
+        from thunder_tpu.serving import engine as engine_mod
+
+        cfg, params = micro
+        reqs = [{"prompt": _prompt(95 + i, 5, cfg), "max_new_tokens": 4}
+                for i in range(2)]
+        solo_a = _fleet(cfg, params, replicas=1)
+        solo_a.run([dict(r) for r in reqs])
+        solo_a.shutdown()
+        keys_before = set(engine_mod._program_cache.keys())
+
+        fleet = _fleet(cfg, params)
+        fleet.run([dict(r) for r in reqs])
+        assert sum(sum(e.compile_counts.values()) for e in fleet.engines) == 0
+        fleet.shutdown()
+        assert set(engine_mod._program_cache.keys()) == keys_before
+
+        solo_b = _fleet(cfg, params, replicas=1)
+        solo_b.run([dict(r) for r in reqs])
+        assert sum(solo_b.compile_counts.values()) == 0
+        solo_b.shutdown()

@@ -521,3 +521,47 @@ class TestSoak:
         st = eng.stats()
         assert st["spec"]["rounds"] > 0
         assert sum(st["compile_counts"].values()) <= st["bucket_bound"]
+
+
+class TestDraftKvDtype:
+    """``SpecConfig(draft_kv_dtype=)``: the draft arena quantizes
+    independently of the target arena (the draft's K/V is soft state — its
+    numerics only shape *proposals*, never emitted tokens, so an int8
+    draft over a float32 target must stay bit-identical to the all-float32
+    solo rule)."""
+
+    @staticmethod
+    def _spec_engine(models, *, K, draft_kv_dtype=None, **kw):
+        cfg, dcfg, tp, dp = models
+        kw.setdefault("block_size", 4)
+        kw.setdefault("num_blocks", 64)
+        kw.setdefault("max_batch", 4)
+        kw.setdefault("cache_dtype", jnp.float32)
+        for k, v in BUCKETS.items():
+            kw.setdefault(k, v)
+        spec = SpecConfig(dp, dcfg, K=K, draft_kv_dtype=draft_kv_dtype)
+        return tt.serve(None, tp, cfg, speculative=spec, **kw)
+
+    def test_int8_draft_f32_target_parity(self, models):
+        cfg = models[0]
+        eng = self._spec_engine(models, K=3, draft_kv_dtype="int8")
+        assert str(eng.draft_pool.kv_dtype) == "int8"
+        assert eng.pool.quantized_kv is False            # target untouched
+        p = _prompt(3, 7, cfg)
+        r = eng.submit(p, max_new_tokens=10).result()
+        np.testing.assert_array_equal(r.tokens, _solo(models, p, 10, K=3))
+        assert eng.stats()["spec"]["rounds"] > 0
+
+    def test_draft_dtype_is_program_identity(self, models):
+        """Two engines differing only in draft_kv_dtype must not alias
+        programs in the shared module cache (the draft gather/scatter
+        dtype is baked into the compiled round)."""
+        a = self._spec_engine(models, K=2)
+        b = self._spec_engine(models, K=2, draft_kv_dtype="int8")
+        assert a._static_key() != b._static_key()
+
+    def test_none_means_engine_kv_dtype(self, models):
+        """Unset draft_kv_dtype inherits the engine-wide kv_dtype — the
+        pre-field behavior, so existing configs are untouched."""
+        eng = self._spec_engine(models, K=2, kv_dtype="int8", quantized=True)
+        assert str(eng.draft_pool.kv_dtype) == "int8"

@@ -42,6 +42,16 @@ re-prefill recovery — fresh arenas plus a sampling-free replay of every
 surviving request's known tokens, after which streams continue
 bit-identical to an uninterrupted run.
 
+Data-parallel replication (:mod:`serving.router`): a ``mesh=`` with a
+``dp`` axis — or an explicit ``replicas=N`` — returns a
+:class:`ReplicatedEngine`: N engine lanes (one per submesh, each with its
+own arena / scheduler / in-flight lanes) behind one prefix-affinity
+router that keeps this exact submit/stream/drain/shutdown API.  Routing
+is least-loaded with resident-prefix and routing-history affinity, so
+request families stay co-located (prefix sharing — and the narrow decode
+buckets it buys — keep working at fleet scale); token streams stay
+bit-identical to a solo engine serving the same request.
+
 Speculative continuous batching (:mod:`serving.speculative`):
 ``speculative=SpecConfig(draft_params, draft_cfg, K=...)`` adds a draft KV
 block arena beside the target arena (same block tables) and swaps each
@@ -83,6 +93,10 @@ from thunder_tpu.serving.quant import (  # noqa: F401
     arena_block_bytes,
     blocks_for_arena_bytes,
 )
+from thunder_tpu.serving.router import (  # noqa: F401
+    ReplicatedEngine,
+    RoutedHandle,
+)
 from thunder_tpu.serving.scheduler import (  # noqa: F401
     AdmissionError,
     Request,
@@ -95,6 +109,8 @@ from thunder_tpu.serving.speculative import SpecConfig  # noqa: F401
 __all__ = [
     "serve",
     "ServingEngine",
+    "ReplicatedEngine",
+    "RoutedHandle",
     "RequestHandle",
     "RequestResult",
     "PagedKVPool",

@@ -111,6 +111,7 @@ from thunder_tpu.serving.faults import (
 from thunder_tpu.serving.kv_pool import (
     SINK_BLOCK,
     PagedKVPool,
+    PrefixIndex,
     chunk_tables,
     gather_dense,
     scatter_blocks,
@@ -152,9 +153,16 @@ class EngineStalledError(RuntimeError):
     Carries the flight-recorder state snapshot — queued/running request
     rows, pool free/lease counts, compile log — as ``.state`` and inlines
     the headline numbers in the message so a stall is diagnosable from the
-    traceback alone."""
+    traceback alone.  Under dp-replicated serving the router sets
+    ``replica`` to the stalled engine's index and passes THAT replica's
+    flight state, so a fleet stall names its culprit instead of assuming
+    one engine."""
 
-    def __init__(self, msg: str, state: dict | None = None):
+    def __init__(self, msg: str, state: dict | None = None, *,
+                 replica: int | None = None):
+        self.replica = replica
+        if replica is not None:
+            msg = f"replica {replica}: {msg}"
         self.state = state or {}
         sched = self.state.get("scheduler", {})
         pool = self.state.get("pool", {})
@@ -277,6 +285,7 @@ class ServingEngine:
         retry: RetryPolicy | None = None,
         watchdog_timeout_s: float | None = None,
         speculative=None,
+        replica_id: int | None = None,
     ):
         if shardings is not None and mesh is None:
             raise ValueError("shardings= requires mesh= (param placement needs a mesh)")
@@ -378,7 +387,11 @@ class ServingEngine:
                 speculative.draft_params = _pp(speculative.draft_params, mesh, None)
             self.draft_pool = PagedKVPool(
                 speculative.draft_cfg, num_blocks=num_blocks,
-                block_size=block_size, dtype=dtype, kv_dtype=kv_dtype,
+                block_size=block_size, dtype=dtype,
+                # the draft arena may quantize independently of the target
+                # (SpecConfig.draft_kv_dtype; None inherits kv_dtype)
+                kv_dtype=(speculative.draft_kv_dtype
+                          if speculative.draft_kv_dtype is not None else kv_dtype),
                 mesh=mesh,
             )
         else:
@@ -443,7 +456,11 @@ class ServingEngine:
             })
         self.telemetry = telemetry
         self._handles: dict[int, RequestHandle] = {}
-        self._prefix_index: dict[tuple, tuple[int, tuple[int, ...]]] = {}
+        # dp replication: which engine lane this is (None = solo); the
+        # router stamps it into stats/flight/spans so every artifact of a
+        # replicated fleet names its lane
+        self.replica_id = replica_id
+        self._prefix_index = PrefixIndex(self.pool.block_size)
         self._programs: dict[tuple, Callable] = {}
         self._closed = False
         # drive-loop accounting (mirrored into the registry as it changes)
@@ -500,8 +517,6 @@ class ServingEngine:
             self._m_stall = reg0.histogram("serving.decode.stall_s")
             self._m_overlap = reg0.gauge("serving.step.overlap_frac")
         self._compile_log: list[dict] = []               # per-bucket compile causes
-        self._prefix_lookups = 0
-        self._prefix_hits = 0
         # serving-plane observability (all off by default; the off path is
         # one `is None` check per touch point — measured by bench.py tracing)
         if trace is None:
@@ -845,6 +860,7 @@ class ServingEngine:
         )
         n = self._overlap_obs
         return {
+            **({"replica": self.replica_id} if self.replica_id is not None else {}),
             **({"mesh": mesh} if mesh is not None else {}),
             **({"lora": self._registry.state_snapshot()} if self._registry is not None else {}),
             "queue_depth": len(sch.queue),
@@ -914,6 +930,7 @@ class ServingEngine:
         lookups = self._prefix_lookups
         dec = self._inflight_decode
         return {
+            **({"replica": self.replica_id} if self.replica_id is not None else {}),
             "engine": self.stats(),                      # includes "mesh" when SPMD
             "scheduler": self.scheduler.state_snapshot(),
             "pool": self.pool.state_snapshot(),
@@ -1029,24 +1046,13 @@ class ServingEngine:
     def _find_shared_prefix(self, req: Request) -> list[int]:
         """Longest block-aligned prompt prefix already resident in a live
         request's blocks (the last prompt token always re-prefills, so the
-        share is capped one token short of the full prompt)."""
+        share is capped one token short of the full prompt).  The index
+        machinery itself lives in :class:`~thunder_tpu.serving.kv_pool.
+        PrefixIndex` so the dp router can probe residency without touching
+        engine internals."""
         if not self.prefix_sharing:
             return []
-        self._prefix_lookups += 1
-        bs = self.pool.block_size
-        max_share = ((req.prompt_len - 1) // bs) * bs
-        for k in range(max_share, 0, -bs):
-            key = tuple(req.prompt[:k].tolist())
-            hit = self._prefix_index.get(key)
-            if hit is None:
-                continue
-            if self._prefix_alive(hit):
-                self._prefix_hits += 1
-                return list(hit[1])
-            # stale snapshot (the owner's blocks were freed or sunk, e.g. by
-            # sliding-window expiry): sharing it would lease dead block ids
-            del self._prefix_index[key]
-        return []
+        return self._prefix_index.find(req.prompt, self._prefix_alive)
 
     def _prefix_alive(self, hit: tuple[int, tuple[int, ...]]) -> bool:
         """A registered prefix is shareable only while its owner is still
@@ -1066,21 +1072,27 @@ class ServingEngine:
         an unwritten block)."""
         if not self.prefix_sharing:
             return
-        bs = self.pool.block_size
-        limit = req.prompt_len if upto is None else min(upto, req.prompt_len)
-        hi = min((limit // bs) * bs, ((req.prompt_len - 1) // bs) * bs)
-        toks = req.prompt.tolist()
-        for k in range(bs, hi + 1, bs):
-            key = tuple(toks[:k])
-            cur = self._prefix_index.get(key)
-            if cur is None or not self._prefix_alive(cur):
-                self._prefix_index[key] = (req.rid, tuple(req.block_table[: k // bs]))
+        self._prefix_index.register(
+            req.rid, req.prompt, req.block_table, self._prefix_alive, upto=upto)
 
     def _unregister_prefix(self, req: Request) -> None:
-        if self._prefix_index:
-            stale = [k for k, (rid, _) in self._prefix_index.items() if rid == req.rid]
-            for k in stale:
-                del self._prefix_index[k]
+        self._prefix_index.unregister(req.rid)
+
+    def probe_prefix(self, prompt) -> int:
+        """Longest resident shared-prefix length (tokens) for ``prompt``,
+        without counting a lookup or mutating the index — the dp router's
+        affinity probe."""
+        if not self.prefix_sharing:
+            return 0
+        return self._prefix_index.probe(prompt, self._prefix_alive)
+
+    @property
+    def _prefix_lookups(self) -> int:
+        return self._prefix_index.lookups
+
+    @property
+    def _prefix_hits(self) -> int:
+        return self._prefix_index.hits
 
     def _prefill(self, req: Request) -> None:
         """Admission-time prefill entry.  Sync: dispatch the whole prompt
@@ -1782,9 +1794,11 @@ class ServingEngine:
             self.temperature, self.quantized,
             self._registry.geometry if self._registry is not None else None,
             self._mesh_key,
-            # the speculative component: K and the draft architecture are
-            # baked into every spec program (draft params are arguments)
+            # the speculative component: K, the draft architecture, and the
+            # draft arena's storage dtype are baked into every spec program
+            # (draft params are arguments)
             (self.spec.K,
+             str(self.draft_pool.kv_dtype),
              tuple(sorted(dataclasses.asdict(self.spec.draft_cfg).items())))
             if self.spec is not None else None,
         )
@@ -2130,5 +2144,46 @@ def serve(model_fn, params, cfg, **kwargs) -> ServingEngine:
     tokens are bit-identical to solo ``speculative_generate()`` — greedy
     or sampled — and re-prefill recovery replays both arenas
     deterministically.  ``speculative=None`` (default) leaves every
-    compiled program byte-identical to a spec-free engine."""
+    compiled program byte-identical to a spec-free engine.
+
+    Data-parallel replication: a mesh with a ``dp`` axis (size > 1) — or
+    an explicit ``replicas=N`` without a mesh — returns a
+    :class:`~thunder_tpu.serving.router.ReplicatedEngine`: the device set
+    splits into ``dp`` submeshes (each engine keeps every other axis, so
+    ``(dp, tp)`` runs TP-sharded replicas), one async engine per replica
+    with its own arena / lanes / program-cache entries, fronted by a
+    single prefix-affinity router that keeps this exact API.  Faults stay
+    replica-scoped; pass ``fault_plans=[...]`` (one entry per replica)
+    instead of the solo ``fault_plan=``.  ``replicas=1`` / no-``dp``-axis
+    returns a plain :class:`ServingEngine` whose compiled programs are
+    byte-identical to today's (the module program cache is shared either
+    way).  See :mod:`thunder_tpu.serving.router` for routing semantics
+    and the multi-host (process-0) caveat."""
+    replicas = kwargs.pop("replicas", None)
+    fault_plans = kwargs.pop("fault_plans", None)
+    mesh = kwargs.get("mesh")
+    dp = 0
+    if mesh is not None and "dp" in mesh.axis_names:
+        dp = int(mesh.shape["dp"])
+        if replicas is not None and replicas != dp:
+            raise ValueError(
+                f"replicas={replicas} conflicts with the mesh dp axis of "
+                f"size {dp} — pass one or the other"
+            )
+    n = replicas if replicas is not None else dp
+    if n is not None and n > 1:
+        from thunder_tpu.serving.router import ReplicatedEngine
+
+        if mesh is not None and dp == 0:
+            raise ValueError(
+                f"replicas={n} with a mesh requires a 'dp' axis to split "
+                f"on (axes: {mesh.axis_names})"
+            )
+        return ReplicatedEngine(params, cfg, model_fn=model_fn, replicas=n,
+                                fault_plans=fault_plans, **kwargs)
+    if fault_plans is not None:
+        raise ValueError(
+            "fault_plans= is the per-replica form; a solo engine takes "
+            "fault_plan="
+        )
     return ServingEngine(params, cfg, model_fn=model_fn, **kwargs)

@@ -52,7 +52,8 @@ import numpy as np
 from thunder_tpu.models.generate import kv_block_shape
 from thunder_tpu.serving.quant import is_quantized_kv, resolve_kv_dtype
 
-__all__ = ["PoolExhaustedError", "ArenaMismatchError", "PagedKVPool", "chunk_tables"]
+__all__ = ["PoolExhaustedError", "ArenaMismatchError", "PagedKVPool",
+           "PrefixIndex", "chunk_tables"]
 
 SINK_BLOCK = 0  # reserved physical block for padding/expired table entries
 
@@ -369,6 +370,97 @@ class PagedKVPool:
             arenas["k_scale"] = k_scale
             arenas["v_scale"] = v_scale
         self.set_arenas(arenas)
+
+
+class PrefixIndex:
+    """Block-aligned prompt-prefix → ``(owner rid, block ids)`` map — the
+    prefix-sharing lookup structure one engine (one pool) owns.
+
+    Liveness is delegated: every query takes an ``alive(hit) -> bool``
+    callback (the engine checks that the owner is still running and every
+    snapshot block id is still the live table entry), so the index itself
+    stays a pure pool-side structure with no scheduler dependency — which
+    is what lets the dp router read it from outside the engine.
+
+    Two lookup flavors with different side-effect contracts:
+
+    - :meth:`find` — the engine's admission-path lookup: counts into
+      ``lookups``/``hits`` and scrubs stale entries as it walks (sharing a
+      stale snapshot would lease dead block ids);
+    - :meth:`probe` — the router's affinity query: **non-mutating** (no
+      counter bumps, no scrubbing), because a routing decision must not
+      perturb the engine's prefix-share hit-rate accounting or race its
+      scrub with an admission happening on the same step.
+    """
+
+    def __init__(self, block_size: int):
+        self.block_size = int(block_size)
+        self._index: dict[tuple, tuple[int, tuple[int, ...]]] = {}
+        self.lookups = 0
+        self.hits = 0
+
+    def __len__(self) -> int:
+        return len(self._index)
+
+    def find(self, prompt: np.ndarray, alive) -> list[int]:
+        """Longest block-aligned prefix of ``prompt`` with a live owner:
+        the shared block ids (the last prompt token always re-prefills, so
+        the share is capped one token short of the full prompt), or ``[]``.
+        Counts the lookup and deletes stale entries encountered."""
+        self.lookups += 1
+        bs = self.block_size
+        max_share = ((int(prompt.shape[0]) - 1) // bs) * bs
+        for k in range(max_share, 0, -bs):
+            key = tuple(prompt[:k].tolist())
+            hit = self._index.get(key)
+            if hit is None:
+                continue
+            if alive(hit):
+                self.hits += 1
+                return list(hit[1])
+            # stale snapshot (the owner's blocks were freed or sunk, e.g. by
+            # sliding-window expiry): sharing it would lease dead block ids
+            del self._index[key]
+        return []
+
+    def probe(self, prompt, alive) -> int:
+        """Longest *alive* shared-prefix length in tokens (0 on miss),
+        without touching counters or scrubbing — the router's read-only
+        affinity question: "how much of this prompt is already resident
+        here?"."""
+        prompt = np.asarray(prompt).reshape(-1)
+        bs = self.block_size
+        max_share = ((int(prompt.shape[0]) - 1) // bs) * bs
+        for k in range(max_share, 0, -bs):
+            hit = self._index.get(tuple(prompt[:k].tolist()))
+            if hit is not None and alive(hit):
+                return k
+        return 0
+
+    def register(self, rid: int, prompt: np.ndarray, block_table,
+                 alive, *, upto: int | None = None) -> None:
+        """Registers every block-aligned prefix of ``prompt`` (owner
+        ``rid``).  ``upto`` bounds registration to tokens already written
+        (a chunked prefill registers after each piece); live entries are
+        never displaced — first writer wins while it stays alive."""
+        bs = self.block_size
+        n = int(prompt.shape[0])
+        limit = n if upto is None else min(upto, n)
+        hi = min((limit // bs) * bs, ((n - 1) // bs) * bs)
+        toks = prompt.tolist()
+        for k in range(bs, hi + 1, bs):
+            key = tuple(toks[:k])
+            cur = self._index.get(key)
+            if cur is None or not alive(cur):
+                self._index[key] = (rid, tuple(block_table[: k // bs]))
+
+    def unregister(self, rid: int) -> None:
+        """Drops every entry owned by ``rid`` (called before its blocks
+        free, so no later request can share just-released ids)."""
+        if self._index:
+            stale = [k for k, (r, _) in self._index.items() if r == rid]
+            for k in stale:
+                del self._index[k]
 
 
 def chunk_tables(block_table, pos: int, n_tokens: int, nbb: int,

@@ -38,6 +38,7 @@ from __future__ import annotations
 import re
 
 import jax
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from thunder_tpu.distributed.sharding import apply_shardings, kv_cache_spec, llama_shardings
@@ -45,6 +46,7 @@ from thunder_tpu.distributed.sharding import apply_shardings, kv_cache_spec, lla
 __all__ = [
     "mesh_fingerprint",
     "arena_sharding",
+    "split_mesh",
     "place_params",
     "program_shardings",
     "collective_counts",
@@ -65,6 +67,45 @@ def mesh_fingerprint(mesh: Mesh | None) -> tuple | None:
         tuple(int(mesh.shape[a]) for a in mesh.axis_names),
         tuple(int(d.id) for d in mesh.devices.flat),
     )
+
+
+def split_mesh(mesh: Mesh, *, axis: str = "dp") -> list[Mesh]:
+    """Splits ``mesh`` along its ``axis`` dimension into one submesh per
+    index — the device-set side of data-parallel serving replication.
+
+    Each returned submesh keeps every *other* axis of the parent (so a
+    ``(dp=2, tp=2)`` mesh yields two 2-device ``("tp",)`` meshes whose
+    engines stay TP-sharded), in the parent's device order.  A mesh whose
+    only axis is ``axis`` degrades each slice to a single-device ``("tp",)``
+    mesh of size 1 — every sharding rule (:func:`kv_cache_spec`,
+    ``llama_shardings``) degrades to replicated on a trivial axis, so the
+    per-replica engine runs effectively unsharded while still carrying a
+    distinct :func:`mesh_fingerprint` (its own device id), which keeps each
+    replica's compiled programs from aliasing another device's placement.
+
+    Works unchanged for a ``dist.multihost.hybrid_mesh`` whose leading
+    (DCN) axis is the replica axis: each slice is then one ICI-connected
+    device block.  Multi-host caveat: the *router* that consumes these
+    submeshes is host-local — run it on process 0 only (single-process
+    serving is the documented fallback; see ``serving.router``)."""
+    if axis not in mesh.axis_names:
+        raise ValueError(
+            f"mesh has no {axis!r} axis to split on (axes: {mesh.axis_names})"
+        )
+    rest = tuple(a for a in mesh.axis_names if a != axis)
+    idx = mesh.axis_names.index(axis)
+    devs = np.moveaxis(mesh.devices, idx, 0)
+    out = []
+    for i in range(devs.shape[0]):
+        sub = devs[i]
+        if rest:
+            out.append(Mesh(sub, rest))
+        else:
+            # a dp-only mesh: each slice is one device (indexing the object
+            # array yields the bare Device), kept as a trivial ("tp",) mesh
+            # so every axis-keyed rule degrades cleanly
+            out.append(Mesh(np.array([sub], dtype=object), ("tp",)))
+    return out
 
 
 def arena_sharding(cfg, mesh: Mesh, *, axis: str = "tp") -> NamedSharding:

@@ -207,6 +207,57 @@ class Scheduler:
         capacity shows up in admission accounting)."""
         return self.blocks_needed(req) * self.pool.block_bytes()
 
+    def check_feasible(self, prompt_len: int, max_new_tokens: int) -> int:
+        """The never-fits validation, callable without constructing a
+        :class:`Request` (the dp router pre-validates against one replica's
+        configuration before a request enters the global queue — every
+        replica is configured identically, so one check covers the fleet).
+        Returns the full block reservation; raises :class:`AdmissionError`
+        for a request that could never be admitted."""
+        blocks = self.pool.blocks_for_tokens(
+            prompt_len + int(max_new_tokens) + self.reserve_extra_tokens)
+        hard_cap = min(self.pool.num_usable, self.block_buckets[-1])
+        if blocks > hard_cap:
+            raise AdmissionError(
+                f"request needs {blocks} blocks; the pool/bucket "
+                f"cap is {hard_cap} — it can never be admitted"
+            )
+        if self.prefill_chunk is None and prompt_len > self.prefill_buckets[-1]:
+            # with chunking enabled the prompt prefills in pieces bounded by
+            # the bucket set, so only the pool/block-bucket capacity (checked
+            # above) caps prompt length
+            raise AdmissionError(
+                f"prompt of {prompt_len} tokens exceeds the largest prefill "
+                f"bucket {self.prefill_buckets[-1]} — it can never be admitted"
+            )
+        return blocks
+
+    def committed_blocks(self) -> int:
+        """Blocks the queued (not-yet-leased) requests will claim at
+        admission — reservations *promised* but not yet taken from the
+        pool's free list.  The router's hand-off test subtracts this from
+        ``pool.num_free`` so stacking several requests onto one replica in
+        a single routing pass can never overcommit its arena."""
+        return sum(self.blocks_needed(r) for r in self.queue)
+
+    def free_slots(self) -> int:
+        """Batch slots not yet spoken for: ``max_batch`` minus running
+        minus queued (queued requests hold a promised slot the same way
+        :meth:`committed_blocks` holds promised blocks)."""
+        return self.max_batch - len(self.running) - len(self.queue)
+
+    def can_accept(self, blocks: int, *, shared_blocks: int = 0) -> bool:
+        """Whether a request reserving ``blocks`` (less any shareable
+        prefix discount) could be handed to this scheduler *now* without
+        queueing behind an infeasible head: a free batch slot AND enough
+        uncommitted free blocks.  This is the dp router's placement test —
+        it keeps replica queues shallow (a handed-off request admits on the
+        replica's next step), which is what lets prefix affinity engage."""
+        if self.free_slots() < 1:
+            return False
+        need = max(blocks - shared_blocks, 0)
+        return self.pool.num_free - self.committed_blocks() >= need
+
     def submit(self, prompt, max_new_tokens: int, *, key, deadline_s: float | None = None,
                stream_cb=None, adapter_id: str | None = None,
                adapter_slot: int = 0) -> Request:
@@ -227,20 +278,7 @@ class Scheduler:
             adapter_id=adapter_id,
             adapter_slot=int(adapter_slot),
         )
-        hard_cap = min(self.pool.num_usable, self.block_buckets[-1])
-        if self.blocks_needed(req) > hard_cap:
-            raise AdmissionError(
-                f"request needs {self.blocks_needed(req)} blocks; the pool/bucket "
-                f"cap is {hard_cap} — it can never be admitted"
-            )
-        if self.prefill_chunk is None and req.prompt_len > self.prefill_buckets[-1]:
-            # with chunking enabled the prompt prefills in pieces bounded by
-            # the bucket set, so only the pool/block-bucket capacity (checked
-            # above) caps prompt length
-            raise AdmissionError(
-                f"prompt of {req.prompt_len} tokens exceeds the largest prefill "
-                f"bucket {self.prefill_buckets[-1]} — it can never be admitted"
-            )
+        self.check_feasible(req.prompt_len, req.max_new_tokens)
         if len(self.queue) >= self.max_queue:
             raise AdmissionError(
                 f"wait queue full ({self.max_queue}); request rejected"

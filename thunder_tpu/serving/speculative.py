@@ -82,11 +82,17 @@ class SpecConfig:
     the target's padded vocab; LoRA and custom forwards stay target-only).
     ``K``: drafted tokens per round — each round costs one K-step draft
     scan plus one (K+1)-position target verify, and emits 1..K+1 tokens.
+    ``draft_kv_dtype``: storage dtype of the DRAFT arena only (``"int8"``,
+    ``"fp8"``, or ``None`` to inherit the engine's ``kv_dtype``) — the
+    draft cache only feeds proposals that the acceptance rule corrects
+    against the target, so it tolerates aggressive quantization even when
+    the target arena stays full-precision (and vice versa).
     """
 
     draft_params: Any
     draft_cfg: Any
     K: int = 4
+    draft_kv_dtype: Any = None
 
 
 def validate_spec(spec: SpecConfig, cfg, *, custom_forward: bool,
@@ -222,7 +228,9 @@ def build_spec_prefill(eng, Tb: int, nbb: int):
     cfg, dcfg = eng.cfg, eng.spec.draft_cfg
     temp, quantized = eng.temperature, eng.quantized
     qkv = eng.pool.quantized_kv
+    dqkv = eng.draft_pool.quantized_kv
     cdtype = jnp.dtype(eng.pool.dtype)
+    ddtype = jnp.dtype(eng.draft_pool.dtype)
     cap = eng.pool.capacity_tokens(nbb)
     cos, sin = build_rope_cache(cfg, cap)
     cos_d, sin_d = build_rope_cache(dcfg, cap)
@@ -237,7 +245,7 @@ def build_spec_prefill(eng, Tb: int, nbb: int):
         )
         # LoRA rides the target only (solo contract): the draft is a cheap
         # base proposal and the acceptance rule corrects any q/p mismatch
-        ddense = _gather(darenas, table[None, :], qkv, cdtype)
+        ddense = _gather(darenas, table[None, :], dqkv, ddtype)
         _dlogits, dcache = forward_with_cache(
             dparams, toks, pos, ddense, cos_d, sin_d, dcfg, quantized=quantized)
         last = jax.lax.dynamic_index_in_dim(logits, n_real - 1, axis=1,
@@ -249,7 +257,7 @@ def build_spec_prefill(eng, Tb: int, nbb: int):
             tok = jax.vmap(jax.random.categorical)(
                 jax.random.split(kf, 1), last / temp).astype(jnp.int32)
         arenas, qerr = _scatter_prefill(arenas, cache, dest, qkv)
-        darenas, _dqerr = _scatter_prefill(darenas, dcache, dest, qkv)
+        darenas, _dqerr = _scatter_prefill(darenas, dcache, dest, dqkv)
         return tok, arenas, darenas, key, qerr
 
     return spec_prefill
@@ -264,7 +272,9 @@ def build_spec_prefill_chunk(eng, Tb: int, nbb: int):
     cfg, dcfg = eng.cfg, eng.spec.draft_cfg
     quantized = eng.quantized
     qkv = eng.pool.quantized_kv
+    dqkv = eng.draft_pool.quantized_kv
     cdtype = jnp.dtype(eng.pool.dtype)
+    ddtype = jnp.dtype(eng.draft_pool.dtype)
     cap = eng.pool.capacity_tokens(nbb)
     cos, sin = build_rope_cache(cfg, cap)
     cos_d, sin_d = build_rope_cache(dcfg, cap)
@@ -277,11 +287,11 @@ def build_spec_prefill_chunk(eng, Tb: int, nbb: int):
             params, toks, pos, dense, cos, sin, cfg,
             **eng._fwd_kwargs(lora, slot),
         )
-        ddense = _gather(darenas, table[None, :], qkv, cdtype)
+        ddense = _gather(darenas, table[None, :], dqkv, ddtype)
         _dlogits, dcache = forward_with_cache(
             dparams, toks, pos, ddense, cos_d, sin_d, dcfg, quantized=quantized)
         arenas, qerr = _scatter_prefill(arenas, cache, dest, qkv)
-        darenas, _dqerr = _scatter_prefill(darenas, dcache, dest, qkv)
+        darenas, _dqerr = _scatter_prefill(darenas, dcache, dest, dqkv)
         return arenas, darenas, qerr
 
     return spec_prefill_chunk

@@ -36,6 +36,7 @@ __all__ = [
     "check_recovery_targets",
     "check_paged_attn_targets",
     "check_serving_spec_targets",
+    "check_serving_dp_targets",
 ]
 
 # generous: CI hosts jitter, and the gate exists to catch the donate=False
@@ -507,6 +508,67 @@ def check_serving_spec_targets(artifact: dict | None = None, *,
     assert compiles <= r["bucket_bound"], (
         f"{compiles} compiled programs exceed the spec-extended bucket "
         f"bound {r['bucket_bound']} — the lane is leaking program shapes"
+    )
+    assert r["cold_compile_prefills_measured"] == 0, (
+        f"{r['cold_compile_prefills_measured']} measured-engine prefills "
+        f"paid an XLA compile — the throughput windows are polluted by "
+        f"cold starts"
+    )
+    return artifact
+
+
+def check_serving_dp_targets(artifact: dict | None = None, *,
+                             min_ratio: float = 1.6) -> dict:
+    """Validates the BENCH_SERVING_DP.json artifact: schema, **exact** token
+    parity between the 2-replica routed fleet and the solo engine at equal
+    total occupancy (a router that reorders or perturbs decode is broken,
+    whatever its throughput), the headline claim (routed throughput at
+    least ``min_ratio``x solo — the shape-segregation win), evidence the
+    router actually segregated (at least one affinity hit, every request
+    routed, both lanes used), and the compile-free measured window.
+    Returns the artifact for chaining."""
+    if artifact is None:
+        artifact = load_artifact("BENCH_SERVING_DP.json")
+    assert "backend" in artifact and "results" in artifact, sorted(artifact)
+    r = artifact["results"]
+    for key in (
+        "solo_tokens_per_sec", "dp_tokens_per_sec", "throughput_ratio",
+        "token_parity_exact", "replicas", "routed", "affinity_hits",
+        "routed_by_replica", "imbalance", "per_replica_decode_steps",
+        "per_replica_mean_occupancy", "per_replica_free_blocks_low_water",
+        "solo_mean_occupancy", "decode_compiles", "bucket_bound",
+        "cold_compile_prefills_measured", "n_long", "n_short",
+    ):
+        assert key in r, (key, sorted(r))
+    assert r["solo_tokens_per_sec"] > 0 and r["dp_tokens_per_sec"] > 0, r
+    assert r["token_parity_exact"] is True, (
+        "routed tokens diverged from the solo engine — the throughput "
+        "comparison is void (routing must be bit-identical to solo decode "
+        "by construction: per-request key chains, greedy or not)"
+    )
+    assert r["replicas"] == 2, r["replicas"]
+    assert r["routed"] == r["n_long"] + r["n_short"], (
+        f"router placed {r['routed']} of {r['n_long'] + r['n_short']} "
+        f"requests — some never left the global queue"
+    )
+    assert r["affinity_hits"] >= 1, (
+        "zero prefix-affinity hits — the long family was not co-located "
+        "by the router, so the segregation this bench claims never "
+        "happened"
+    )
+    assert all(n > 0 for n in r["routed_by_replica"]), (
+        f"routing collapsed onto one lane ({r['routed_by_replica']}) — "
+        f"that measures a half-capacity solo engine, not replication"
+    )
+    assert r["throughput_ratio"] >= min_ratio, (
+        f"2-replica routed serving only {r['throughput_ratio']:.2f}x the "
+        f"solo engine at equal total occupancy (< {min_ratio}x) — lane "
+        f"segregation is not paying for the router"
+    )
+    assert r["decode_compiles"] <= r["bucket_bound"], (
+        f"{r['decode_compiles']} compiled decode programs exceed the "
+        f"bucket bound {r['bucket_bound']} — the fleet is leaking program "
+        f"shapes (replicas must share the module program cache)"
     )
     assert r["cold_compile_prefills_measured"] == 0, (
         f"{r['cold_compile_prefills_measured']} measured-engine prefills "

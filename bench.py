@@ -1025,6 +1025,35 @@ def main():
             "results": out["results"],
         }))
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "multistep":
+        # multi-step decode bench: host visits per served token at
+        # decode_steps N in {1, 4, 8}, occupancy 8, exact token parity
+        # asserted request-by-request and zero cold compiles in the
+        # measured windows.  Host work only, no TPU probe; artifact uses
+        # the BENCH_MICRO schema.
+        from thunder_tpu._platform import force_cpu
+
+        force_cpu()
+        from thunder_tpu.benchmarks.multistep import multistep_bench
+
+        out = multistep_bench(on_tpu=False)
+        artifact = {"backend": jax.default_backend(), **out}
+        with open("BENCH_MULTISTEP.json", "w") as f:
+            json.dump(artifact, f, indent=1)
+        for k, v in out["results"].items():
+            log(f"multistep {k}: {v}")
+        ph = out["results"]["per_horizon"]
+        h1 = ph["1"]["host_visits_per_token"]
+        hN = ph[str(out["results"]["horizons"][-1])]["host_visits_per_token"]
+        print(json.dumps({
+            "metric": "multistep_host_visit_amortization_x",
+            "value": round(h1 / hN, 2),
+            "unit": "x",
+            # the 1-step engine's host-visits-per-token IS the baseline
+            "vs_baseline": round(h1 / hN, 2),
+            "results": out["results"],
+        }))
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "cost":
         # analytic companion to the measured headline (no TPU needed): XLA's
         # own cost model on the compiled loss+grad at headline geometry, and

@@ -949,3 +949,69 @@ class TestServingDpTargets:
         check_serving_dp_targets(art, min_ratio=0.0)
         assert out["results"]["smoke"] is True
         assert out["results"]["token_parity_exact"] is True
+
+
+class TestMultistepTargets:
+    def test_multistep_gate_on_committed_artifact(self):
+        """BENCH_MULTISTEP.json must keep showing multi-step decode's
+        host-visit amortization (visits/token at horizon N within 1.1x of
+        1/N of the 1-step engine's), exact token parity across every
+        horizon, the per-horizon bucket bound, and a compile-free measured
+        window.  A regression recorded into the artifact fails here."""
+        from tools.bench_targets import check_multistep_targets
+
+        art = check_multistep_targets()
+        assert art["backend"] in ("cpu", "tpu")
+        r = art["results"]
+        assert r["horizons"][0] == 1 and len(r["horizons"]) >= 2
+        top = str(max(r["horizons"]))
+        assert (r["per_horizon"][top]["tokens_per_host_visit"]
+                > r["per_horizon"]["1"]["tokens_per_host_visit"])
+
+    def test_multistep_gate_rejects_regressions(self):
+        from tools.bench_targets import check_multistep_targets, load_artifact
+
+        good = load_artifact("BENCH_MULTISTEP.json")
+        top = str(max(good["results"]["horizons"]))
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["token_parity_exact"] = False
+        with pytest.raises(AssertionError, match="diverged"):
+            check_multistep_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["per_horizon"][top]["host_visits_per_token"] = (
+            bad["results"]["per_horizon"]["1"]["host_visits_per_token"])
+        with pytest.raises(AssertionError, match="not amortizing"):
+            check_multistep_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["per_horizon"][top]["decode_compiles"] = (
+            bad["results"]["per_horizon"][top]["bucket_bound"] + 1)
+        with pytest.raises(AssertionError, match="bucket"):
+            check_multistep_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["cold_compile_prefills_measured"] = 2
+        with pytest.raises(AssertionError, match="cold"):
+            check_multistep_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        del bad["results"]["per_horizon"]["1"]
+        with pytest.raises(AssertionError):
+            check_multistep_targets(bad)
+
+    @pytest.mark.slow
+    def test_multistep_bench_live_smoke(self):
+        """The bench harness itself at smoke shapes (horizons (1, 4), 4
+        requests): parity, the visit-count amortization, the bucket bound,
+        and the compile-free window must all hold live — the visit counts
+        are deterministic, so the full gate applies even at smoke shapes."""
+        from thunder_tpu.benchmarks.multistep import multistep_bench
+        from tools.bench_targets import check_multistep_targets
+
+        out = multistep_bench(on_tpu=False, smoke=True)
+        art = {"backend": jax.default_backend(), **out}
+        check_multistep_targets(art)
+        assert out["results"]["smoke"] is True
+        assert out["results"]["token_parity_exact"] is True

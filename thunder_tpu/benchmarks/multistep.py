@@ -1,0 +1,132 @@
+"""Multi-step decode benchmark: host visits per token at N ∈ {1, 4, 8}.
+
+The claim under test is the reason ``decode_steps=N`` exists: host
+dispatch is the last per-token cost in the serving plane (one Python
+round-trip per decode step), so running N decode steps inside one
+compiled ``lax.scan`` program amortizes the per-token launch overhead by
+N — the XLA analog of CUDA-graph multi-token capture and of vLLM's
+``--num-scheduler-steps``.  On CPU the tiny-model decode step is
+dispatch-bound, which is exactly the regime the TPU serving loop lives in
+(host step latency dominating a small-batch decode), so the measured
+host-visit counts exercise the real mechanism: fewer round-trips per
+served token.
+
+Workload: the same ``n_req`` fixed-length greedy requests served at each
+horizon N.  Same-length requests finish together, so every visit of the
+measured window runs at full occupancy and the horizon's visit count is
+deterministic: ``host_visits_per_token`` must land at ~1/N of the 1-step
+engine's (the gate allows 10%: the first generated token comes from
+prefill, and a final partial visit rounds up).  Token parity against the
+N=1 engine is asserted in-bench request-by-request — the throughput
+numbers are only comparable because the streams are bit-identical.
+
+All engines are warmed first (bucket programs land in the module cache),
+so the measured windows pay zero XLA compiles (asserted via
+``prefill_compiled`` and the gate's cold-compile check), and every
+horizon's decode-program count stays inside the engine's bucket bound (N
+joins the static key as one knob, not per-horizon buckets).
+"""
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+HORIZONS = (1, 4, 8)
+SMOKE_HORIZONS = (1, 4)
+
+
+def multistep_bench(on_tpu: bool = False, *, smoke: bool = False) -> dict:
+    """Returns ``{"results": {...}}`` in the BENCH_MICRO artifact shape."""
+    import thunder_tpu as tt
+    from thunder_tpu.models import llama
+
+    horizons = SMOKE_HORIZONS if smoke else HORIZONS
+    if smoke:
+        n_req, prompt_len, max_new, max_batch, block_size = 4, 8, 9, 4, 8
+    else:
+        n_req, prompt_len, max_new, max_batch, block_size = 8, 16, 33, 8, 8
+    # max_new - 1 decode tokens per request: divisible by 4 AND 8, so every
+    # horizon's final visit is full and the visit count is exactly
+    # ceil((max_new - 1) / N) per request-cohort
+    overrides = dict(n_embd=128, intermediate_size=344, n_layer=4)
+    cfg = llama.Config.from_name("tiny-llama-debug", **overrides)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, (prompt_len,)).astype(np.int32)
+               for _ in range(n_req)]
+    reqs = [{"prompt": p, "max_new_tokens": max_new} for p in prompts]
+    per_req = -(-(prompt_len + max_new + max(horizons)) // block_size)
+    num_blocks = n_req * per_req + per_req + 1
+
+    def make_engine(N: int):
+        return tt.serve(
+            None, params, cfg,
+            block_size=block_size, num_blocks=num_blocks,
+            max_batch=max_batch, cache_dtype=jnp.float32,
+            batch_buckets=(max_batch,), decode_steps=N,
+        )
+
+    def drive(N: int):
+        eng = make_engine(N)
+        t0 = time.perf_counter()
+        results = eng.run([dict(r) for r in reqs])
+        dt = time.perf_counter() - t0
+        return eng, results, dt
+
+    # warm every horizon: bucket programs land in the module cache, so the
+    # measured engines pay zero XLA compiles
+    for N in horizons:
+        drive(N)
+
+    measured = {N: drive(N) for N in horizons}
+
+    ref_results = measured[horizons[0]][1]
+    parity = all(
+        np.array_equal(a.tokens, b.tokens)
+        for N in horizons[1:]
+        for a, b in zip(measured[N][1], ref_results)
+    )
+    cold = sum(
+        1 for N in horizons for r in measured[N][1] if r.prefill_compiled
+    )
+
+    per_horizon = {}
+    for N in horizons:
+        eng, results, dt = measured[N]
+        stats = eng.stats()
+        n_tokens = sum(len(r.new_tokens) for r in results)
+        decode_compiles = sum(
+            stats["compile_counts"][k]
+            for k in ("decode", "decode_paged", "decode_multi",
+                      "decode_multi_paged")
+        )
+        per_horizon[str(N)] = {
+            "decode_steps": N,
+            "tokens_per_sec": round(n_tokens / dt, 1),
+            "host_visits": stats["host_visits"],
+            "decode_tokens": eng.decode_lane_tokens,       # prefill excluded
+            "host_visits_per_token": round(stats["host_visits"] / n_tokens, 4),
+            "tokens_per_host_visit": round(stats["tokens_per_host_visit"], 3),
+            "decode_compiles": decode_compiles,
+            "bucket_bound": stats["bucket_bound"],
+        }
+
+    return {
+        "results": {
+            "horizons": list(horizons),
+            "per_horizon": per_horizon,
+            "token_parity_exact": bool(parity),
+            "cold_compile_prefills_measured": cold,
+            "n_requests": n_req,
+            "occupancy": n_req,
+            "prompt_tokens": prompt_len,
+            "max_new_tokens": max_new,
+            "attn": measured[horizons[0]][0].stats()["attn"]["mode"],
+            "config": f"tiny-llama n_embd={cfg.n_embd} n_layer={cfg.n_layer}",
+            "smoke": smoke,
+        }
+    }

@@ -1416,7 +1416,8 @@ def _paged_write_masked_kernel(tab_ref, pos_ref, ne_ref, a_ref, v_ref, o_ref, *,
 
 
 def paged_token_write_masked(arena, vals, tables, pos, n_emit, offset, *, block_size):
-    """Keep-masked arena write for the speculative verify commit.
+    """Keep-masked arena write for the speculative verify commit — and,
+    at ``offset=0``, the per-row liveness write of multi-step decode.
 
     Request ``i`` lands ``vals[i]`` — the K/V (or scale) of chunk offset
     ``offset`` — at arena slot ``pos[i] + offset`` iff ``offset <
@@ -1425,6 +1426,13 @@ def paged_token_write_masked(arena, vals, tables, pos, n_emit, offset, *, block_
     scatter primitive in the program.  ``offset`` is static (one call per
     chunk position); ``n_emit`` rides as a scalar-prefetch operand so the
     routing happens in the BlockSpec index map.
+
+    Multi-step decode liveness contract (``write_fresh_kv_live``): with
+    ``offset=0`` and ``n_emit = live ∈ {0, 1}`` the predicate *is* the
+    per-row liveness mask — a live row commits exactly like the unmasked
+    single-step ``paged_token_write`` (bit-identical stored bytes), a row
+    that finished earlier in the scan sinks every remaining iteration's
+    write, so the N-step program stays static-shape with zero scatters.
     """
     bs = block_size
     B = vals.shape[0]

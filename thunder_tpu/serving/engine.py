@@ -113,6 +113,7 @@ from thunder_tpu.serving.kv_pool import (
     PagedKVPool,
     PrefixIndex,
     chunk_tables,
+    dest_for_pos,
     gather_dense,
     scatter_blocks,
     scatter_token,
@@ -286,6 +287,7 @@ class ServingEngine:
         watchdog_timeout_s: float | None = None,
         speculative=None,
         replica_id: int | None = None,
+        decode_steps: int = 1,
     ):
         if shardings is not None and mesh is None:
             raise ValueError("shardings= requires mesh= (param placement needs a mesh)")
@@ -372,6 +374,23 @@ class ServingEngine:
         # sharding), but block ids are allocated once per request from the
         # target pool and index both arenas (the draft pool's free list is
         # never consulted), so the allocator/prefix machinery stays single
+        # device-resident multi-step decode: N tokens per host visit via an
+        # in-program lax.scan over the decode body.  Stored as
+        # n_decode_steps (self.decode_steps is the dispatch counter); N=1
+        # is byte-identical to the single-step engine (same program kinds,
+        # same static keys, shared module program cache).
+        self.n_decode_steps = int(decode_steps)
+        if self.n_decode_steps < 1:
+            raise ValueError(f"decode_steps= must be >= 1, got {decode_steps}")
+        if speculative is not None and self.n_decode_steps > 1:
+            from thunder_tpu.serving.speculative import multi_step_supported
+
+            ok_ms, why_ms = multi_step_supported(speculative)
+            if not ok_ms:
+                raise ValueError(
+                    f"decode_steps={self.n_decode_steps} with speculative= "
+                    f"is unsupported: {why_ms}"
+                )
         self.spec = speculative
         if speculative is not None:
             from thunder_tpu.serving.speculative import validate_spec
@@ -407,8 +426,12 @@ class ServingEngine:
             sliding_window=cfg.sliding_window,
             prefill_chunk=prefill_chunk,
             # a speculative round's draft scan writes up to K slots past the
-            # last committed token — admission must reserve that overshoot
-            reserve_extra_tokens=speculative.K if speculative is not None else 0,
+            # last committed token — admission must reserve that overshoot;
+            # a multi-step decode visit likewise writes up to N-1 slots past
+            # the first token of the visit before the host sees any of them
+            reserve_extra_tokens=(speculative.K if speculative is not None
+                                  else self.n_decode_steps - 1),
+            decode_horizon=self.n_decode_steps,
         )
         if getattr(cfg, "learned_pos_embedding", False):
             # wpe has block_size rows and dynamic_slice clamps silently past
@@ -471,9 +494,14 @@ class ServingEngine:
         self.tokens_generated = 0
         self._occupancy_sum = 0
         self.compile_counts = {"prefill": 0, "prefill_chunk": 0, "decode": 0,
-                               "decode_paged": 0, "spec_prefill": 0,
+                               "decode_paged": 0, "decode_multi": 0,
+                               "decode_multi_paged": 0, "spec_prefill": 0,
                                "spec_prefill_chunk": 0, "draft_decode": 0,
                                "verify": 0, "verify_paged": 0}
+        # host-visit amortization accounting: one host_visit per decode-lane
+        # harvest (a visit serves up to n_decode_steps tokens per row)
+        self.host_visits = 0
+        self.decode_lane_tokens = 0
         # async lanes: the in-flight futures table — one deferred decode
         # record plus any deferred prefill-piece records, harvested at the
         # top of the next step (the only place the host blocks)
@@ -509,6 +537,7 @@ class ServingEngine:
         self._m_pool_low_water = reg0.gauge("serving.pool.free_blocks_low_water")
         self._m_attn_kernel = reg0.counter("serving.attn.kernel_steps")
         self._m_attn_fallback = reg0.counter("serving.attn.fallback_steps")
+        self._m_host_visits = reg0.counter("serving.decode.host_visits")
         if speculative is not None:
             self._m_spec_rounds = reg0.counter("serving.spec.rounds")
             self._m_spec_accepted = reg0.counter("serving.spec.accepted_tokens")
@@ -873,6 +902,12 @@ class ServingEngine:
             "async_step": self.async_step,
             "prefill_chunk": sch.prefill_chunk,
             "decode_steps": self.decode_steps,
+            "decode_steps_per_visit": self.n_decode_steps,
+            "host_visits": self.host_visits,
+            "tokens_per_host_visit": (
+                self.decode_lane_tokens / self.host_visits
+                if self.host_visits else None
+            ),
             "prefill_runs": self.prefill_runs,
             "chunk_runs": self.chunk_runs,
             "step_calls": self.step_calls,
@@ -941,6 +976,7 @@ class ServingEngine:
                 "async_step": self.async_step,
                 "decode_inflight": (
                     {"step": dec["step"], "bucket": dec["bucket"],
+                     "steps": dec.get("multi", 1),
                      "rids": [r.rid for r in dec["running"]]}
                     if dec is not None else None
                 ),
@@ -1289,15 +1325,17 @@ class ServingEngine:
         nbb = self._nbb(_nbb_raw)
         bs = pool.block_size
         sig = (tuple(r.rid for r in running), Bb, nbb)
+        N = self.n_decode_steps
         st = self._decode_state
         if st is not None and st["sig"] == sig:
             # steady state: the batch composition and tables are unchanged
             # since the last step, so this step's inputs ARE the previous
-            # step's device outputs (toks=nxt, keys=new_keys, pos=pos+1)
+            # step's device outputs (toks=nxt, keys=new_keys, pos=pos+N)
             # plus the cached tables/slots — zero host->device transfers
             toks_d, pos_d = st["toks"], st["pos"]
             tables_d, keys_d, slots_d = st["tables"], st["keys"], st["slots"]
-            host_pos = st["host_pos"] + 1
+            host_pos = st["host_pos"] + N
+            stop_d = st.get("stop")
         else:
             toks = np.zeros(Bb, dtype=np.int32)
             host_pos = np.zeros(Bb, dtype=np.int32)
@@ -1305,6 +1343,10 @@ class ServingEngine:
             keys = np.zeros((Bb, *np.shape(running[0].key)),
                             dtype=np.asarray(running[0].key).dtype)
             slots = np.zeros(Bb, dtype=np.int32)           # padding rows: base slot
+            # multi-step stopping: the last position a row may write before
+            # FINISH_LENGTH (see _build_decode_multi); -1 parks padding rows
+            # dead from step 0
+            stop = np.full(Bb, -1, dtype=np.int32)
             for i, r in enumerate(running):
                 wpos = r.prompt_len + len(r.generated) - 1  # slot this step writes
                 toks[i] = r.generated[-1]
@@ -1312,19 +1354,25 @@ class ServingEngine:
                 tables[i, : len(r.block_table)] = r.block_table
                 keys[i] = r.key
                 slots[i] = r.adapter_slot
+                stop[i] = r.prompt_len + r.max_new_tokens - 2
             # commit once; the chained steps reuse these device buffers
             toks_d, pos_d = jnp.asarray(toks), jnp.asarray(host_pos)
             tables_d, keys_d = jnp.asarray(tables), jnp.asarray(keys)
             slots_d = jnp.asarray(slots)
-        kind = "decode_paged" if self.attn == "paged" else "decode"
+            stop_d = jnp.asarray(stop) if N > 1 else None
+        if N > 1:
+            kind = "decode_multi_paged" if self.attn == "paged" else "decode_multi"
+        else:
+            kind = "decode_paged" if self.attn == "paged" else "decode"
         prog, compiled = self._program(kind, Bb, nbb)
         lora_arenas = self._lora_arenas()
         if self.mesh is not None and self._mesh_collectives is None:
             # census BEFORE the call: the arenas are donated by it
+            ex = (self.params, toks_d, pos_d, tables_d, pool.arenas,
+                  keys_d, lora_arenas, slots_d)
             self._mesh_collectives = self._collective_census(
                 (kind, Bb, nbb), prog,
-                (self.params, toks_d, pos_d, tables_d, pool.arenas,
-                 keys_d, lora_arenas, slots_d),
+                ex + (stop_d,) if N > 1 else ex,
             )
         if self.attn == "paged":
             self.attn_kernel_steps += 1
@@ -1338,22 +1386,33 @@ class ServingEngine:
             for r in running:
                 tr.begin(r.rid, "decode", step=self.decode_steps,
                          compile=compiled, bucket=[Bb, nbb], lane="decode",
-                         attn=self.attn)
-        nxt, new_keys, new_pos, arenas = prog(
-            self.params, toks_d, pos_d, tables_d, pool.arenas,
-            keys_d, lora_arenas, slots_d,
-        )
+                         attn=self.attn,
+                         **({"steps": N} if N > 1 else {}))
+        if N > 1:
+            ys_tok, ys_emit, toks_f, keys_f, pos_f, arenas = prog(
+                self.params, toks_d, pos_d, tables_d, pool.arenas,
+                keys_d, lora_arenas, slots_d, stop_d,
+            )
+            nxt, new_keys, new_pos = toks_f, keys_f, pos_f
+        else:
+            nxt, new_keys, new_pos, arenas = prog(
+                self.params, toks_d, pos_d, tables_d, pool.arenas,
+                keys_d, lora_arenas, slots_d,
+            )
         # past the point of no return: the call consumed the donated arenas
         self._fault_point(FP_SCATTER, tuple(r.rid for r in running))
         pool.set_arenas(arenas)
         self._decode_state = {
             "sig": sig, "toks": nxt, "pos": new_pos, "tables": tables_d,
             "keys": new_keys, "slots": slots_d, "host_pos": host_pos,
+            **({"stop": stop_d} if N > 1 else {}),
         }
         rec = {"kind": "decode", "running": running, "nxt": nxt,
                "new_keys": new_keys, "pos": host_pos, "bucket": [Bb, nbb],
                "compiled": compiled, "step": self.decode_steps,
                "t_disp": time.perf_counter(), "t_clock": sch.clock()}
+        if N > 1:
+            rec.update(multi=N, nxt=ys_tok, emit=ys_emit, new_keys=keys_f)
         self.decode_steps += 1
         self._occupancy_sum += len(running)
         self._m_steps_decode.inc()
@@ -1365,6 +1424,8 @@ class ServingEngine:
             from thunder_tpu.serving.speculative import spec_decode_harvest
 
             return spec_decode_harvest(self, rec)
+        if rec.get("multi"):
+            return self._decode_harvest_multi(rec)
         sch = self.scheduler
         running = rec["running"]
         self._fault_point(FP_HARVEST, tuple(r.rid for r in running))
@@ -1415,11 +1476,91 @@ class ServingEngine:
             if r.state != "running":
                 invalidate = True                          # finished at this token
         self.tokens_generated += emitted
+        self.decode_lane_tokens += emitted
+        self.host_visits += 1
+        self._m_host_visits.inc()
         if emitted:
             self._m_tokens.inc(emitted)
         if invalidate:
             # the chained decode inputs assumed an unchanged batch/tables;
             # the next dispatch rebuilds from host state
+            self._decode_state = None
+
+    def _decode_harvest_multi(self, rec: dict) -> None:
+        """Harvest one multi-step visit: up to N tokens per row.
+
+        ``rec["nxt"]`` is the (N, Bb) token matrix and ``rec["emit"]`` the
+        (N, Bb) liveness mask from the scan's stacked outputs.  The emitted
+        prefix of each column is exactly the tokens the 1-step engine would
+        have served: the in-program ``done`` predicate (pos >= stop, or
+        token == eos) coincides bit-for-bit with ``_emit_token``'s
+        FINISH_LENGTH / FINISH_EOS conditions, so a column with k < N
+        emitted tokens finished at its k-th token and the remaining
+        iterations keep-masked their KV writes to the sink block."""
+        sch = self.scheduler
+        running = rec["running"]
+        N = rec["multi"]
+        self._fault_point(FP_HARVEST, tuple(r.rid for r in running))
+        t0 = time.perf_counter()
+        nxt = np.asarray(rec["nxt"])                       # (N, Bb) host block
+        emit = np.asarray(rec["emit"])                     # (N, Bb) bool
+        new_keys = np.asarray(rec["new_keys"])
+        if self.async_step:
+            stall = time.perf_counter() - t0
+            overlapped = t0 - rec["t_disp"]
+            frac = overlapped / (overlapped + stall) if (overlapped + stall) > 0 else 0.0
+            self._stall_s_sum += stall
+            self._overlap_frac_sum += frac
+            self._overlap_obs += 1
+            self._m_stall.observe(stall)
+            self._m_overlap.set(frac)
+        tr = self._tracer
+        harvested = [int(emit[:, i].sum()) for i in range(len(running))]
+        if tr is not None:                                 # tokens host-visible
+            # one span per request per HOST VISIT (not N phantom per-token
+            # spans): tagged with how many of the N steps actually emitted
+            for i, r in enumerate(running):
+                tr.end(r.rid, "decode", harvested=harvested[i])
+        if self._flight is not None:
+            self._flight.record("decode", step=rec["step"],
+                                batch=len(running), bucket=rec["bucket"],
+                                compiled=rec["compiled"], steps=N,
+                                harvested=harvested,
+                                rids=[r.rid for r in running])
+        pos = rec["pos"]
+        emitted = 0
+        invalidate = False
+        for i, r in enumerate(running):
+            if r.state != "running":
+                invalidate = True                          # finished mid-flight: tokens never promised
+                continue
+            k = harvested[i]
+            r.key = new_keys[i]
+            r.pos = int(pos[i]) + k
+            released = sch.expire_window_blocks(r)
+            if released:
+                invalidate = True
+                self._unregister_prefix(r)
+                if self._flight is not None:
+                    self._flight.record("window_expire", rid=r.rid,
+                                        released=released)
+            for s in range(k):
+                emitted += 1
+                self._emit_token(r, int(nxt[s, i]))
+                if r.state != "running":
+                    invalidate = True                      # finished at this token
+                    break
+            if k < N:
+                # the row went dead in-program; the chained device state no
+                # longer matches this row's host state
+                invalidate = True
+        self.tokens_generated += emitted
+        self.decode_lane_tokens += emitted
+        self.host_visits += 1
+        self._m_host_visits.inc()
+        if emitted:
+            self._m_tokens.inc(emitted)
+        if invalidate:
             self._decode_state = None
 
     #
@@ -1801,6 +1942,10 @@ class ServingEngine:
              str(self.draft_pool.kv_dtype),
              tuple(sorted(dataclasses.asdict(self.spec.draft_cfg).items())))
             if self.spec is not None else None,
+            # the multi-step horizon: ONE knob joining the key, not
+            # per-horizon buckets; N=1 collapses to None so a decode_steps=1
+            # engine shares the module program cache with default engines
+            self.n_decode_steps if self.n_decode_steps > 1 else None,
         )
 
     def _program(self, kind: str, a: int, b: int) -> tuple[Callable, bool]:
@@ -1832,7 +1977,10 @@ class ServingEngine:
                 build = {"prefill": self._build_prefill,
                          "prefill_chunk": self._build_prefill_chunk,
                          "decode": self._build_decode,
-                         "decode_paged": self._build_decode_paged}[kind]
+                         "decode_paged": self._build_decode_paged,
+                         "decode_multi": self._build_decode_multi,
+                         "decode_multi_paged": self._build_decode_multi_paged,
+                         }[kind]
             prog = build(a, b)
             # a genuinely new program for this geometry: count the compile
             self.compile_counts[kind] += 1
@@ -2074,6 +2222,156 @@ class ServingEngine:
 
         return decode_paged
 
+    def _build_decode_multi(self, Bb: int, nbb: int) -> Callable:
+        """N decode steps per host visit: the single-step decode body
+        wrapped in a ``lax.scan`` with in-program stopping.
+
+        Per-row liveness: a row is live while ``pos <= stop`` and no EOS has
+        been sampled (``stop = prompt_len + max_new_tokens - 2`` is the last
+        position a row may write — exactly the position at which the
+        single-step engine's :meth:`_emit_token` fires FINISH_LENGTH on the
+        resulting token).  A dead row keep-masks its KV write to the sink
+        block (:func:`dest_for_pos`), freezes ``pos`` and ``toks``, and
+        stops splitting its PRNG key — so the per-request key chain advances
+        exactly once per *emitted* token, preserving the harvest-time
+        key-advance contract that makes fault-recovery replay bit-identical.
+        Padding rows enter with ``stop = -1`` and are dead from step 0.
+
+        Returns the scan's stacked ``(ys_tok, ys_emit)`` — the (N, Bb)
+        token matrix and liveness mask the harvest reads — plus the final
+        ``(toks, keys, pos)`` carry for the engine's ``_decode_state``
+        device-to-device chain, and the donated arenas."""
+        cfg, fwd, temp = self.cfg, self._forward, self.temperature
+        qkv = self.pool.quantized_kv
+        cdtype = jnp.dtype(self.pool.dtype)
+        bs = self.pool.block_size
+        cap = self.pool.capacity_tokens(nbb)
+        cos_all, sin_all = build_rope_cache(cfg, cap)
+        eos = self.eos_id
+        N = self.n_decode_steps
+
+        @partial(jax.jit, donate_argnums=(4,),
+                 **self._jit_kwargs("decode_multi"))
+        def decode_multi(params, toks, pos, tables, arenas, keys, lora, slots, stop):
+            kw = self._fwd_kwargs(lora, slots)   # LoRA gather once per visit
+            live0 = pos <= stop
+
+            def body(carry, _):
+                toks, pos, keys, live, arenas = carry
+                dest_block, dest_slot = dest_for_pos(
+                    tables, pos, live, block_size=bs)
+                if qkv:
+                    kd, vd = gather_dense_q(
+                        arenas["k"], arenas["v"],
+                        arenas["k_scale"], arenas["v_scale"], tables, cdtype,
+                    )
+                else:
+                    kd, vd = gather_dense(arenas["k"], arenas["v"], tables)
+                logits, cache = fwd(
+                    params, toks[:, None], pos, {"k": kd, "v": vd},
+                    cos_all, sin_all, cfg, **kw,
+                )
+                sp = jax.vmap(jax.random.split)(keys)
+                new_keys = jnp.where(live[:, None], sp[:, 0], keys)
+                nxt = jax.vmap(lambda l, k: sample_token(l[None], temp, k)[0])(
+                    logits[:, 0], sp[:, 1]
+                )
+                kc = cache["k"].transpose(1, 0, 2, 3, 4)
+                vc = cache["v"].transpose(1, 0, 2, 3, 4)
+                pick = jax.vmap(
+                    lambda c, p: jax.lax.dynamic_index_in_dim(
+                        c, p, axis=2, keepdims=False)
+                )
+                if qkv:
+                    k_arena, k_scale = scatter_token_q(
+                        arenas["k"], arenas["k_scale"], pick(kc, pos),
+                        dest_block, dest_slot)
+                    v_arena, v_scale = scatter_token_q(
+                        arenas["v"], arenas["v_scale"], pick(vc, pos),
+                        dest_block, dest_slot)
+                    new_arenas = {"k": k_arena, "v": v_arena,
+                                  "k_scale": k_scale, "v_scale": v_scale}
+                else:
+                    new_arenas = {
+                        "k": scatter_token(arenas["k"], pick(kc, pos),
+                                           dest_block, dest_slot),
+                        "v": scatter_token(arenas["v"], pick(vc, pos),
+                                           dest_block, dest_slot)}
+                done = pos >= stop
+                if eos is not None:
+                    done = done | (nxt == eos)
+                toks_n = jnp.where(live, nxt, toks)
+                pos_n = jnp.where(live, pos + 1, pos)
+                live_n = live & ~done
+                return (toks_n, pos_n, new_keys, live_n, new_arenas), (nxt, live)
+
+            (toks_f, pos_f, keys_f, _live_f, arenas), (ys_tok, ys_emit) = (
+                jax.lax.scan(body, (toks, pos, keys, live0, arenas),
+                             None, length=N))
+            return ys_tok, ys_emit, toks_f, keys_f, pos_f, arenas
+
+        return decode_multi
+
+    def _build_decode_multi_paged(self, Bb: int, nbb: int) -> Callable:
+        """The kernel twin of :meth:`_build_decode_multi`: same scan, same
+        liveness/key-chain math, but each iteration runs the Pallas paged
+        kernel straight off the arenas and folds the fresh token K/V back
+        in via the masked write kernel (live rows commit at ``pos``, dead
+        rows keep-mask to the sink block) — so the compiled N-step program
+        still contains zero arena gather/scatter primitives (the purity
+        census asserts this with the gather program as positive control)."""
+        from thunder_tpu.serving.paged_attention import (
+            forward_paged,
+            write_fresh_kv_live,
+        )
+
+        cfg, temp = self.cfg, self.temperature
+        qkv = self.pool.quantized_kv
+        cdtype = jnp.dtype(self.pool.dtype)
+        kv_dtype = jnp.dtype(self.pool.kv_dtype) if qkv else None
+        bs = self.pool.block_size
+        cap = self.pool.capacity_tokens(nbb)
+        cos_all, sin_all = build_rope_cache(cfg, cap)
+        mesh = self.mesh
+        eos = self.eos_id
+        N = self.n_decode_steps
+
+        @partial(jax.jit, donate_argnums=(4,),
+                 **self._jit_kwargs("decode_multi_paged"))
+        def decode_multi_paged(params, toks, pos, tables, arenas, keys, lora,
+                               slots, stop):
+            kw = self._fwd_kwargs(lora, slots)   # LoRA gather once per visit
+            live0 = pos <= stop
+
+            def body(carry, _):
+                toks, pos, keys, live, arenas = carry
+                logits, fresh = forward_paged(
+                    params, toks[:, None], pos, arenas, tables,
+                    cos_all, sin_all, cfg, cdtype=cdtype, mesh=mesh, **kw,
+                )
+                sp = jax.vmap(jax.random.split)(keys)
+                new_keys = jnp.where(live[:, None], sp[:, 0], keys)
+                nxt = jax.vmap(lambda l, k: sample_token(l[None], temp, k)[0])(
+                    logits[:, 0], sp[:, 1]
+                )
+                new_arenas = write_fresh_kv_live(
+                    arenas, fresh, tables, pos, live,
+                    block_size=bs, kv_dtype=kv_dtype, mesh=mesh)
+                done = pos >= stop
+                if eos is not None:
+                    done = done | (nxt == eos)
+                toks_n = jnp.where(live, nxt, toks)
+                pos_n = jnp.where(live, pos + 1, pos)
+                live_n = live & ~done
+                return (toks_n, pos_n, new_keys, live_n, new_arenas), (nxt, live)
+
+            (toks_f, pos_f, keys_f, _live_f, arenas), (ys_tok, ys_emit) = (
+                jax.lax.scan(body, (toks, pos, keys, live0, arenas),
+                             None, length=N))
+            return ys_tok, ys_emit, toks_f, keys_f, pos_f, arenas
+
+        return decode_multi_paged
+
 
 def serve(model_fn, params, cfg, **kwargs) -> ServingEngine:
     """Builds a :class:`ServingEngine` over ``model_fn`` (``None`` → the
@@ -2108,6 +2406,22 @@ def serve(model_fn, params, cfg, **kwargs) -> ServingEngine:
     on CPU), else falls back to the gather path, counting
     ``serving.attn.fallback_steps``; ``attn="gather"`` pins the dense
     gather/scatter pair.  Served tokens are bit-identical across all three.
+
+    Multi-step decode: ``decode_steps=N`` runs N decode steps per host
+    visit inside one compiled program (a ``lax.scan`` over the decode body
+    with in-program EOS/length stopping and per-request liveness masks —
+    finished rows keep-mask their KV writes to the sink block), serving up
+    to N tokens per dispatch.  Tokens stay bit-identical to the 1-step
+    engine across the whole matrix (greedy/temperature, int8/fp8 KV, LoRA,
+    prefix sharing, chunked prefill, fault recovery); host visits per
+    served token drop to ~1/N.  N joins the program static key as one knob
+    (not per-horizon buckets), and ``decode_steps=1`` (default) is
+    byte-identical to the pre-knob engine, sharing the module program
+    cache.  The trade-off is loop-boundary scheduling: admissions,
+    deadline expiry, window reclamation, and streaming all happen at visit
+    boundaries, so N widens token-delivery granularity by up to N steps.
+    Incompatible with ``speculative=`` (that lane already amortizes host
+    visits over accepted tokens; construction raises with the reason).
 
     Async serving: ``async_step=True`` (default) runs ``step()`` as an
     event loop — decode for batch *k* is dispatched and the host admits,

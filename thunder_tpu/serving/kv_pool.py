@@ -53,7 +53,7 @@ from thunder_tpu.models.generate import kv_block_shape
 from thunder_tpu.serving.quant import is_quantized_kv, resolve_kv_dtype
 
 __all__ = ["PoolExhaustedError", "ArenaMismatchError", "PagedKVPool",
-           "PrefixIndex", "chunk_tables"]
+           "PrefixIndex", "chunk_tables", "dest_for_pos"]
 
 SINK_BLOCK = 0  # reserved physical block for padding/expired table entries
 
@@ -509,6 +509,24 @@ def gather_dense(k_arena, v_arena, tables):
         return g.reshape(L, B, ng, nb * bs, hs)
 
     return one(k_arena), one(v_arena)
+
+
+def dest_for_pos(tables, pos, live, *, block_size):
+    """In-program scatter destination for a token write at ``pos``, with a
+    per-row liveness keep-mask.
+
+    ``tables``: (B, nb) int32 (sink-padded); ``pos``/``live``: (B,).  Live
+    rows advance through their own table as ``pos`` crosses block
+    boundaries (``tables[b, pos // bs]``, the in-program table walk the
+    multi-step decode scan relies on — the full table is leased at
+    admission, so every entry the walk can reach is owned); dead rows route
+    to ``(SINK_BLOCK, 0)`` so a finished request's remaining scan
+    iterations write only garbage the sink absorbs.  Pure jnp; call inside
+    jit.  ``take_along_axis`` clamps an out-of-range block index to the
+    row's last (sink-padded) entry, matching the single-step derivation."""
+    blk = jnp.take_along_axis(tables, (pos // block_size)[:, None], axis=1)[:, 0]
+    return (jnp.where(live, blk, SINK_BLOCK),
+            jnp.where(live, pos % block_size, 0))
 
 
 def scatter_token(arena, new_kv, dest_block, dest_slot):

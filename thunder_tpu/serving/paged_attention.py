@@ -52,8 +52,8 @@ from thunder_tpu.models.generate import (
 )
 from thunder_tpu.serving.quant import quantize_kv
 
-__all__ = ["forward_paged", "write_fresh_kv", "write_fresh_kv_masked",
-           "paged_supported"]
+__all__ = ["forward_paged", "write_fresh_kv", "write_fresh_kv_live",
+           "write_fresh_kv_masked", "paged_supported"]
 
 
 def _smap(fn, mesh, in_specs, out_specs):
@@ -256,6 +256,36 @@ def write_fresh_kv(arenas, fresh, tables, pos, *, block_size, kv_dtype=None,
     scatter primitive, untouched blocks keep their bytes; padding rows land
     in sink block 0, never attended)."""
     w = partial(_write, tables=tables, pos=pos, block_size=block_size, mesh=mesh)
+    if kv_dtype is None:
+        return {"k": w(arenas["k"], fresh["k"]), "v": w(arenas["v"], fresh["v"])}
+    kq, ks = quantize_kv(fresh["k"], kv_dtype)
+    vq, vs = quantize_kv(fresh["v"], kv_dtype)
+    return {
+        "k": w(arenas["k"], kq),
+        "v": w(arenas["v"], vq),
+        "k_scale": w(arenas["k_scale"], ks),
+        "v_scale": w(arenas["v_scale"], vs),
+    }
+
+
+def write_fresh_kv_live(arenas, fresh, tables, pos, live, *, block_size,
+                        kv_dtype=None, mesh=None):
+    """Lands one multi-step scan iteration's fresh K/V, keep-masked by
+    per-row liveness.
+
+    ``fresh``: ``{"k"/"v": (B, L, ng, hs)}`` from a T=1
+    :func:`forward_paged` call; ``live``: (B,) bool.  A live row commits at
+    ``pos`` exactly like :func:`write_fresh_kv`; a dead row (finished
+    earlier in the scan, or batch padding) is sink-routed (block 0, never
+    attended) so the remaining iterations of a finished request leave no
+    trace in its real blocks.  Implemented as an offset-0 masked write —
+    ``n_emit = live`` makes :func:`paged_token_write_masked`'s
+    ``offset < n_emit`` predicate the liveness mask itself — so the stored
+    bytes for live rows are bit-identical to the single-step kernel's and
+    the program still contains zero scatter primitives."""
+    n_emit = live.astype(jnp.int32)
+    w = partial(_write_masked, tables=tables, pos=pos, n_emit=n_emit,
+                offset=0, block_size=block_size, mesh=mesh)
     if kv_dtype is None:
         return {"k": w(arenas["k"], fresh["k"]), "v": w(arenas["v"], fresh["v"])}
     kq, ks = quantize_kv(fresh["k"], kv_dtype)

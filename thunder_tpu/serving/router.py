@@ -426,6 +426,7 @@ class ReplicatedEngine:
                 ],
                 "tokens_generated": sum(p["tokens_generated"] for p in per),
                 "decode_steps": sum(p["decode_steps"] for p in per),
+                "host_visits": sum(p["host_visits"] for p in per),
                 "prefix_hits": sum(p["prefix_hits"] for p in per),
             },
         }

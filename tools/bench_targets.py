@@ -37,6 +37,7 @@ __all__ = [
     "check_paged_attn_targets",
     "check_serving_spec_targets",
     "check_serving_dp_targets",
+    "check_multistep_targets",
 ]
 
 # generous: CI hosts jitter, and the gate exists to catch the donate=False
@@ -512,6 +513,69 @@ def check_serving_spec_targets(artifact: dict | None = None, *,
     assert r["cold_compile_prefills_measured"] == 0, (
         f"{r['cold_compile_prefills_measured']} measured-engine prefills "
         f"paid an XLA compile — the throughput windows are polluted by "
+        f"cold starts"
+    )
+    return artifact
+
+
+def check_multistep_targets(artifact: dict | None = None, *,
+                            tol: float = 1.1) -> dict:
+    """Validates the BENCH_MULTISTEP.json artifact: schema, **exact** token
+    parity between every multi-step engine and the 1-step engine (an
+    in-program scan that perturbs decode is broken, whatever its visit
+    count), the headline claim — at horizon N, host visits per served
+    token at most ``1/N * tol`` of the 1-step engine's (the 10% slack
+    covers the prefill-born first token and a final partial visit) — the
+    per-horizon bucket bound (N joins the static key as one knob, not
+    per-horizon program shapes), and the compile-free measured window.
+    Returns the artifact for chaining."""
+    if artifact is None:
+        artifact = load_artifact("BENCH_MULTISTEP.json")
+    assert "backend" in artifact and "results" in artifact, sorted(artifact)
+    r = artifact["results"]
+    for key in (
+        "horizons", "per_horizon", "token_parity_exact",
+        "cold_compile_prefills_measured", "n_requests", "occupancy",
+        "prompt_tokens", "max_new_tokens", "attn",
+    ):
+        assert key in r, (key, sorted(r))
+    assert r["token_parity_exact"] is True, (
+        "multi-step decode tokens diverged from the 1-step engine — the "
+        "host-visit comparison is void (the in-program scan must be "
+        "bit-identical to per-step dispatch by construction)"
+    )
+    horizons = r["horizons"]
+    assert horizons and horizons[0] == 1, horizons
+    base = None
+    for N in horizons:
+        assert str(N) in r["per_horizon"], (N, sorted(r["per_horizon"]))
+        h = r["per_horizon"][str(N)]
+        for key in (
+            "decode_steps", "tokens_per_sec", "host_visits",
+            "decode_tokens", "host_visits_per_token",
+            "tokens_per_host_visit", "decode_compiles", "bucket_bound",
+        ):
+            assert key in h, (N, key, sorted(h))
+        assert h["host_visits"] > 0 and h["host_visits_per_token"] > 0, h
+        if N == 1:
+            base = h["host_visits_per_token"]
+            continue
+        assert h["host_visits_per_token"] <= base / N * tol, (
+            f"horizon N={N} is not amortizing host visits: "
+            f"{h['host_visits_per_token']} visits/token > "
+            f"{base / N * tol:.4f} (1-step baseline {base} / {N} "
+            f"* {tol} slack) — the scan is leaving the device between "
+            f"decode steps"
+        )
+        assert h["decode_compiles"] <= h["bucket_bound"], (
+            f"horizon N={N}: {h['decode_compiles']} compiled decode "
+            f"programs exceed the bucket bound {h['bucket_bound']} — "
+            f"the horizon is leaking program shapes instead of joining "
+            f"the static key as one knob"
+        )
+    assert r["cold_compile_prefills_measured"] == 0, (
+        f"{r['cold_compile_prefills_measured']} measured-engine prefills "
+        f"paid an XLA compile — the visit-count windows are polluted by "
         f"cold starts"
     )
     return artifact

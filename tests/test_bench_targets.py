@@ -1015,3 +1015,81 @@ class TestMultistepTargets:
         check_multistep_targets(art)
         assert out["results"]["smoke"] is True
         assert out["results"]["token_parity_exact"] is True
+
+
+class TestSessionsTargets:
+    def test_sessions_gate_on_committed_artifact(self):
+        """BENCH_SESSIONS.json must keep showing the stateful-serving
+        claims: resident turn-2 TTFT at least 2x the cold full-history
+        re-prefill with bit-identical tokens, evict-and-resume preemption
+        beating FIFO starvation on high-class p95 with a bit-identical
+        resumed stream, zero programs compiled for new constraint schemas,
+        and a compile-free measured window.  A regression recorded into
+        the artifact fails here."""
+        from tools.bench_targets import check_sessions_targets
+
+        art = check_sessions_targets()
+        assert art["backend"] in ("cpu", "tpu")
+        r = art["results"]
+        assert r["ttft_resident_ms"] < r["ttft_cold_ms"]
+        assert r["preempt_p95_ms"] < r["fifo_p95_ms"]
+
+    def test_sessions_gate_rejects_regressions(self):
+        from tools.bench_targets import check_sessions_targets, load_artifact
+
+        good = load_artifact("BENCH_SESSIONS.json")
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["session_token_parity_exact"] = False
+        with pytest.raises(AssertionError, match="diverged"):
+            check_sessions_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["ttft_speedup_x"] = 1.2
+        with pytest.raises(AssertionError, match="re-attach is not"):
+            check_sessions_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["reattach_hits"] = 0
+        with pytest.raises(AssertionError, match="re-attach"):
+            check_sessions_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["preempt_token_parity_exact"] = False
+        with pytest.raises(AssertionError, match="undisturbed"):
+            check_sessions_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["preemptions"] = 0
+        with pytest.raises(AssertionError, match="preemption"):
+            check_sessions_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["constrained_new_programs"] = 3
+        with pytest.raises(AssertionError, match="mask ARGUMENTS"):
+            check_sessions_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["cold_compile_prefills_measured"] = 2
+        with pytest.raises(AssertionError, match="cold"):
+            check_sessions_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        del bad["results"]["ttft_speedup_x"]
+        with pytest.raises(AssertionError):
+            check_sessions_targets(bad)
+
+    @pytest.mark.slow
+    def test_sessions_bench_live_smoke(self):
+        """The bench harness itself at smoke shapes (48-token history, one
+        rep, 2 high arrivals): parity, re-attach, preemption, and the
+        zero-new-programs contract must all hold live — the speedup gate
+        applies unchanged because the skipped prefill dominates even at
+        smoke shapes."""
+        from thunder_tpu.benchmarks.sessions import sessions_bench
+        from tools.bench_targets import check_sessions_targets
+
+        out = sessions_bench(on_tpu=False, smoke=True)
+        art = {"backend": jax.default_backend(), **out}
+        check_sessions_targets(art)
+        assert out["results"]["smoke"] is True

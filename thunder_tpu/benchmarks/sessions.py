@@ -1,0 +1,186 @@
+"""Stateful-serving benchmark: session re-attach TTFT, preemption latency,
+and zero-compile constrained decoding.
+
+Three claims under test, one per subsystem of the stateful serving PR:
+
+**Sessions.**  A turn-2 request whose session KV is resident re-prefills
+only the block-unaligned tail, so its TTFT must beat a cold engine
+re-prefilling the full history by at least 2x (the gate) — and the
+tokens must be bit-identical to the cold run (re-attach rides the
+shared-prefix path; the speedup is only comparable because the streams
+are exact).
+
+**Priorities.**  Under a pool sized so a high-priority arrival cannot be
+funded while a long low-priority request runs, evict-and-resume
+preemption bounds the high class's TTFT near its solo latency, where the
+FIFO engine makes it wait out the whole low stream — the p95 ratio is
+the headline.  The preempted low stream is asserted bit-identical to an
+undisturbed run (preemption is a checkpoint, not a restart).
+
+**Constraints.**  Schemas are program *arguments*: after one warmup
+request, serving several brand-new constraint automata (different
+classes, different allowed sets) must compile exactly zero programs.
+
+All engines are warmed before measurement (bucket programs land in the
+module cache), so the measured windows pay zero XLA compiles (gated via
+``cold_compile_prefills_measured``).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _p95(xs):
+    return float(np.percentile(np.asarray(xs, dtype=np.float64), 95))
+
+
+def sessions_bench(on_tpu: bool = False, *, smoke: bool = False) -> dict:
+    """Returns ``{"results": {...}}`` in the BENCH_MICRO artifact shape."""
+    import jax
+    import jax.numpy as jnp
+
+    import thunder_tpu as tt
+    from thunder_tpu.models import llama
+    from thunder_tpu.serving import TokenSetConstraint, sequence_constraint
+
+    if smoke:
+        hist_len, tail_len, turn_new, reps = 48, 7, 4, 1
+        low_prompt, low_new, high_prompt, high_new, n_high = 16, 16, 8, 3, 2
+    else:
+        hist_len, tail_len, turn_new, reps = 192, 15, 8, 3
+        low_prompt, low_new, high_prompt, high_new, n_high = 32, 48, 16, 4, 3
+    overrides = dict(n_embd=128, intermediate_size=344, n_layer=4)
+    cfg = llama.Config.from_name("tiny-llama-debug", **overrides)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    V = cfg.padded_vocab_size
+
+    def prompt(n):
+        return rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+
+    def make_engine(**kw):
+        base = dict(block_size=8, num_blocks=96, max_batch=2,
+                    cache_dtype=jnp.float32, batch_buckets=(2,),
+                    prefill_buckets=(32, 256))
+        base.update(kw)
+        return tt.serve(None, params, cfg, **base)
+
+    #
+    # 1. sessions: turn-2 TTFT, resident vs cold full-history re-prefill
+    #
+    warm = make_engine(sessions=True)
+    cold = make_engine()
+
+    def turn_cycle(eng, sid, measured):
+        """Turn 1 (unmeasured) then turn 2; returns the turn-2 result."""
+        p1 = prompt(hist_len)
+        kw = dict(session_id=sid) if sid is not None else {}
+        r1 = eng.submit(p1, max_new_tokens=turn_new, **kw).result()
+        p2 = np.concatenate([p1, np.asarray(r1.new_tokens, np.int32),
+                             prompt(tail_len)])
+        r2 = eng.submit(p2, max_new_tokens=turn_new, **kw).result()
+        if measured is not None:
+            measured.append(r2)
+        return p2, r2
+
+    # warm both engines through a full two-turn cycle: every bucket shape
+    # (full-history prefill, tail re-prefill, decode) lands in the cache
+    turn_cycle(warm, "warmup", None)
+    turn_cycle(cold, None, None)
+
+    resident_ms, cold_ms, parity, measured = [], [], True, []
+    reattach_before = warm.stats()["sessions"]["reattach_hits"]
+    for rep in range(reps):
+        p2, r2 = turn_cycle(warm, f"chat{rep}", measured)
+        resident_ms.append(r2.ttft_s * 1e3)
+        rc = cold.submit(p2, max_new_tokens=turn_new).result()
+        measured.append(rc)
+        cold_ms.append(rc.ttft_s * 1e3)
+        parity = parity and (r2.new_tokens == rc.new_tokens)
+        assert r2.shared_prefix_blocks > 0, "turn 2 never re-attached"
+    reattach_hits = warm.stats()["sessions"]["reattach_hits"] - reattach_before
+    warm.shutdown()
+    cold.shutdown()
+
+    #
+    # 2. priorities: high-class TTFT, evict-and-resume vs FIFO starvation
+    #
+    def priority_run(priorities):
+        # one batch slot: while the low request runs, a high arrival can
+        # only get in by evicting it (or, FIFO, by waiting it out)
+        kw = dict(num_blocks=13, max_batch=1, batch_buckets=(1,))
+        if priorities:
+            kw["priorities"] = True
+        eng = make_engine(**kw)
+        # warm every shape: a solo low-style and high-style request each
+        eng.submit(prompt(low_prompt), max_new_tokens=2).result()
+        eng.submit(prompt(high_prompt), max_new_tokens=2).result()
+        p_low = prompt(low_prompt)
+        lkw = dict(priority="low") if priorities else {}
+        hkw = dict(priority="high") if priorities else {}
+        h_low = eng.submit(p_low, max_new_tokens=low_new, **lkw)
+        for _ in range(4):
+            eng.step()                    # low is mid-decode, pool committed
+        ttfts = []
+        for _ in range(n_high):
+            r = eng.submit(prompt(high_prompt), max_new_tokens=high_new,
+                           **hkw).result()
+            ttfts.append(r.ttft_s * 1e3)
+        r_low = h_low.result()
+        preempted = eng.preempted if priorities else 0
+        eng.shutdown()
+        return ttfts, r_low, p_low, preempted
+
+    pre_ttfts, pre_low, p_low, preemptions = priority_run(True)
+    fifo_ttfts, fifo_low, _, _ = priority_run(False)
+    # the preempted-then-resumed low stream must match an undisturbed run
+    ref = make_engine(num_blocks=13, max_batch=1, batch_buckets=(1,))
+    low_parity = (pre_low.new_tokens
+                  == ref.submit(p_low, max_new_tokens=low_new)
+                  .result().new_tokens)
+    ref.shutdown()
+
+    #
+    # 3. constraints: new schemas compile nothing after warmup
+    #
+    ceng = make_engine(constraints=True)
+    ceng.submit(prompt(high_prompt), max_new_tokens=3,
+                constraint=TokenSetConstraint(V, [1, 2])).result()
+    warm_counts = dict(ceng.compile_counts)
+    schemas = [
+        TokenSetConstraint(V, [5, 6, 7]),
+        sequence_constraint(V, [[3], [4, 5]]),
+        sequence_constraint(V, [[9], [10]], cycle=True),
+    ]
+    for c in schemas:
+        r = ceng.submit(prompt(high_prompt), max_new_tokens=3,
+                        constraint=c).result()
+        measured.append(r)
+    new_programs = (sum(ceng.compile_counts.values())
+                    - sum(warm_counts.values()))
+    ceng.shutdown()
+
+    cold_compiles = sum(1 for r in measured if r.prefill_compiled)
+
+    return {
+        "results": {
+            "ttft_resident_ms": round(float(np.median(resident_ms)), 3),
+            "ttft_cold_ms": round(float(np.median(cold_ms)), 3),
+            "ttft_speedup_x": round(
+                float(np.median(cold_ms)) / float(np.median(resident_ms)), 2),
+            "session_token_parity_exact": bool(parity),
+            "reattach_hits": int(reattach_hits),
+            "history_tokens": hist_len + turn_new,
+            "tail_tokens": tail_len,
+            "preempt_p95_ms": round(_p95(pre_ttfts), 3),
+            "fifo_p95_ms": round(_p95(fifo_ttfts), 3),
+            "preempt_p95_ratio": round(_p95(fifo_ttfts) / _p95(pre_ttfts), 2),
+            "preemptions": int(preemptions),
+            "preempt_token_parity_exact": bool(low_parity),
+            "constrained_new_programs": int(new_programs),
+            "constrained_schemas_tried": len(schemas),
+            "cold_compile_prefills_measured": int(cold_compiles),
+            "config": f"tiny-llama n_embd={cfg.n_embd} n_layer={cfg.n_layer}",
+            "smoke": smoke,
+        }
+    }

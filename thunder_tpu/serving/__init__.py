@@ -52,6 +52,18 @@ request families stay co-located (prefix sharing — and the narrow decode
 buckets it buys — keep working at fleet scale); token streams stay
 bit-identical to a solo engine serving the same request.
 
+Stateful serving (:mod:`serving.sessions` / :mod:`serving.priority` /
+:mod:`serving.constrain`): ``sessions=True`` + ``submit(...,
+session_id=)`` keeps a finished turn's prefix blocks resident in a
+budgeted LRU table, so the next turn re-attaches through the existing
+shared-prefix path and re-prefills only the unaligned tail;
+``priorities=True`` + ``submit(..., priority=)`` adds class-ordered
+queueing, SLO-burn-fed admission, and evict-and-resume preemption
+(checkpoint = release blocks + re-queue; resume = sampling-free chunk
+replay, streams bit-identical); ``constraints=True`` + ``submit(...,
+constraint=)`` masks logits per request through ONE extra program
+argument — schemas are data, never program identity.
+
 Speculative continuous batching (:mod:`serving.speculative`):
 ``speculative=SpecConfig(draft_params, draft_cfg, K=...)`` adds a draft KV
 block arena beside the target arena (same block tables) and swaps each
@@ -104,6 +116,26 @@ from thunder_tpu.serving.scheduler import (  # noqa: F401
     pick_bucket,
     pow2_buckets,
 )
+from thunder_tpu.serving.constrain import (  # noqa: F401
+    Constraint,
+    ConstraintLookaheadError,
+    DFAConstraint,
+    TokenSetConstraint,
+    sequence_constraint,
+)
+from thunder_tpu.serving.priority import (  # noqa: F401
+    PRIORITY_HIGH,
+    PRIORITY_LEVELS,
+    PRIORITY_LOW,
+    PRIORITY_NORMAL,
+    PriorityConfig,
+    PriorityGate,
+)
+from thunder_tpu.serving.sessions import (  # noqa: F401
+    SessionConfig,
+    SessionEntry,
+    SessionTable,
+)
 from thunder_tpu.serving.speculative import SpecConfig  # noqa: F401
 
 __all__ = [
@@ -138,4 +170,18 @@ __all__ = [
     "HarvestHangFault",
     "WatchdogTimeout",
     "RecoveryError",
+    "SessionConfig",
+    "SessionEntry",
+    "SessionTable",
+    "PriorityConfig",
+    "PriorityGate",
+    "PRIORITY_HIGH",
+    "PRIORITY_NORMAL",
+    "PRIORITY_LOW",
+    "PRIORITY_LEVELS",
+    "Constraint",
+    "ConstraintLookaheadError",
+    "TokenSetConstraint",
+    "DFAConstraint",
+    "sequence_constraint",
 ]

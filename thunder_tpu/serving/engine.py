@@ -288,6 +288,9 @@ class ServingEngine:
         speculative=None,
         replica_id: int | None = None,
         decode_steps: int = 1,
+        sessions=None,
+        priorities=None,
+        constraints=None,
     ):
         if shardings is not None and mesh is None:
             raise ValueError("shardings= requires mesh= (param placement needs a mesh)")
@@ -484,6 +487,32 @@ class ServingEngine:
         # replicated fleet names its lane
         self.replica_id = replica_id
         self._prefix_index = PrefixIndex(self.pool.block_size)
+        # stateful serving: resident-session table (parked prefix blocks),
+        # priority gate (admission policy + preemption), and the
+        # constrained-decoding knob.  All three are host policy/data —
+        # only `constraints` touches program identity (one extra mask
+        # argument), and it collapses to None on the off-path so default
+        # engines share cached programs byte-identically.
+        from thunder_tpu.serving.priority import resolve_priorities
+        from thunder_tpu.serving.sessions import resolve_sessions
+
+        self._sessions = resolve_sessions(sessions, self.pool, self._prefix_index)
+        if self._sessions is not None and not self.prefix_sharing:
+            raise ValueError(
+                "sessions= requires prefix_sharing: session re-attach rides "
+                "the shared-prefix admission path")
+        self._priorities = resolve_priorities(priorities)
+        self._constraints = bool(constraints)
+        if self._constraints and speculative is not None:
+            raise ValueError(
+                "constraints= with speculative= is unsupported: the verify "
+                "lane has no mask argument (use the plain decode lane)")
+        # logit width every constraint mask must match (lm_head output)
+        self._vocab = int(getattr(cfg, "padded_vocab_size", None)
+                          or getattr(cfg, "vocab_size"))
+        self._mask_ones: dict[tuple, np.ndarray] = {}
+        self._hit_owner: int | None = None  # owner rid of the last live prefix hit
+        self.preempted = 0
         self._programs: dict[tuple, Callable] = {}
         self._closed = False
         # drive-loop accounting (mirrored into the registry as it changes)
@@ -584,6 +613,9 @@ class ServingEngine:
         key=None,
         stream_cb: Callable[[int], Any] | None = None,
         adapter_id: str | None = None,
+        session_id: str | None = None,
+        priority: str | None = None,
+        constraint=None,
     ) -> RequestHandle:
         """Enqueues one request; returns immediately with a handle.
 
@@ -596,7 +628,15 @@ class ServingEngine:
         its slot here, at admission time — an unknown id raises KeyError
         immediately, never a silent base fallback).  Raises
         :class:`AdmissionError` when the wait queue is full or the request
-        can never fit the pool."""
+        can never fit the pool.
+
+        ``session_id`` (needs ``sessions=``) parks the finished turn's
+        prefix blocks so the next turn re-attaches them; ``priority``
+        (``"high"``/``"normal"``/``"low"``, needs ``priorities=``) orders
+        the queue, feeds the SLO admission gate, and marks preemption
+        victims; ``constraint`` (a :class:`serving.constrain.Constraint`,
+        needs ``constraints=True``) masks every sampled token through the
+        request's host-side automaton."""
         if self._closed:
             raise RuntimeError("engine is shut down")
         if key is None:
@@ -609,11 +649,36 @@ class ServingEngine:
                     f"lora=AdapterRegistry(...)"
                 )
             adapter_slot = self._registry.slot(adapter_id)
+        if session_id is not None and self._sessions is None:
+            raise ValueError(
+                f"session_id={session_id!r} requires an engine built with "
+                f"sessions= (e.g. sessions=True)")
+        from thunder_tpu.serving.priority import priority_level
+
+        if priority is not None and self._priorities is None:
+            raise ValueError(
+                f"priority={priority!r} requires an engine built with "
+                f"priorities= (e.g. priorities=True)")
+        priority_cls, level = priority_level(priority)
+        if constraint is not None:
+            if not self._constraints:
+                raise ValueError(
+                    "constraint= requires an engine built with constraints=True")
+            if int(constraint.vocab_size) != self._vocab:
+                raise ValueError(
+                    f"constraint.vocab_size={constraint.vocab_size} != model "
+                    f"logit width {self._vocab}")
+            # multi-step decode needs exact masks N draws ahead; fail at
+            # submit, not mid-scan (ConstraintLookaheadError propagates)
+            if self.n_decode_steps > 1:
+                constraint.masks(self.n_decode_steps)
         reg = registry()
         try:
             req = self.scheduler.submit(
                 prompt, max_new_tokens, key=key, deadline_s=deadline, stream_cb=stream_cb,
                 adapter_id=adapter_id, adapter_slot=adapter_slot,
+                session_id=session_id, priority=level,
+                priority_class=priority_cls, constraint=constraint,
             )
         except AdmissionError:
             reg.counter("serving.requests.rejected").inc()
@@ -846,6 +911,8 @@ class ServingEngine:
         self._discard_inflight()
         for req in (*self.scheduler.running, *self.scheduler.queue):
             self._finish(req, FINISH_EVICTED)
+        if self._sessions is not None:
+            self._sessions.clear()
         self._closed = True
         if self._owns_telemetry and self.telemetry is not None:
             self.telemetry.close()
@@ -929,6 +996,12 @@ class ServingEngine:
             "recoveries": self.recoveries,
             "faults": self._faults.snapshot() if self._faults is not None else None,
             **({"spec": self._spec_stats()} if self.spec is not None else {}),
+            **({"sessions": self._sessions.snapshot()}
+               if self._sessions is not None else {}),
+            **({"priority": {**self._priorities.snapshot(),
+                             "preempted": self.preempted}}
+               if self._priorities is not None else {}),
+            **({"constrained": True} if self._constraints else {}),
         }
 
     def _spec_stats(self) -> dict:
@@ -1062,22 +1135,87 @@ class ServingEngine:
         if not sch.queue:
             return False
         head = sch.queue[0]
-        shared = self._find_shared_prefix(head)
+        gate = self._priorities
+        if gate is not None and not gate.admit_ok(head.priority_class, self._slo):
+            # SLO burn defers this class; more urgent arrivals jump the
+            # queue (priority insertion), so holding the head is safe
+            return False
+        # a preempted victim re-admitting skips prefix sharing: its replay
+        # rewrites from position 0, so leased shared blocks would be
+        # co-owned write targets
+        resume = bool(head.generated)
+        shared = [] if resume else self._find_shared_prefix(head)
         req = sch.next_admittable(shared_blocks=len(shared))
         if req is None:
-            return False
+            return (gate is not None and self._maybe_preempt(head))
+        if (shared and self._sessions is not None
+                and self._hit_owner is not None and self._hit_owner < 0):
+            self._sessions.note_reattach(self._hit_owner)
         n_needed = sch.blocks_needed(req)
         table = self.pool.share(shared) + self.pool.alloc(n_needed - len(shared))
         sch.admit(req, table, len(shared))
+        if gate is not None:
+            registry().counter(
+                f"serving.priority.{req.priority_class}.admitted").inc()
         if self._tracer is not None:
             self._tracer.end(req.rid, "queued",
                              queue_s=req.admit_t - req.submit_t)
         if self._flight is not None:
             self._flight.record("admit", rid=req.rid, blocks=n_needed,
                                 shared_blocks=len(shared),
-                                pool_free=self.pool.num_free)
-        self._prefill(req)
+                                pool_free=self.pool.num_free,
+                                resume=resume)
+        if resume:
+            self._resume_replay(req)
+        else:
+            self._prefill(req)
         return True
+
+    def _maybe_preempt(self, head: Request) -> bool:
+        """Evict-and-resume: checkpoint the least-urgent running request so
+        a strictly more urgent head can be funded.  The checkpoint is free
+        — prompt, generated tokens and the PRNG key chain are host state
+        that only advances at harvest — so preemption is unregister +
+        release + re-queue; re-admission replays through the sampling-free
+        ``prefill_chunk`` pieces (:meth:`_resume_replay`), bit-identical
+        to an undisturbed run.  Unsupported beside the speculative lane
+        (its harvest has no preemption epoch guard)."""
+        if self.spec is not None:
+            return False
+        victim = self._priorities.pick_victim(self.scheduler.running, head.priority)
+        if victim is None:
+            return False
+        self._unregister_prefix(victim)
+        self.scheduler.preempt(victim)     # frees blocks, bumps preemptions
+        self._decode_state = None
+        self.preempted += 1
+        registry().counter(
+            f"serving.priority.{victim.priority_class}.preempted").inc()
+        if self._tracer is not None:
+            self._tracer.instant(victim.rid, "preempted",
+                                 for_rid=head.rid,
+                                 generated=len(victim.generated))
+            self._tracer.begin(victim.rid, "queued",
+                               preemptions=victim.preemptions)
+        if self._flight is not None:
+            self._flight.record("preempt", rid=victim.rid, for_rid=head.rid,
+                                generated=len(victim.generated),
+                                pool_free=self.pool.num_free)
+        return True
+
+    def _resume_replay(self, req: Request) -> None:
+        """Re-admission path for a preempted request that already holds
+        generated tokens: rebuild its KV through the ``prefill_chunk``
+        replay (bucket-wide pieces, no sampling, no key split) and rejoin
+        the decode lane at the identical position/key chain."""
+        tr = self._tracer
+        if tr is not None:
+            tr.begin(req.rid, "resume", lane="prefill",
+                     generated=len(req.generated))
+        self._replay_request(req)
+        self._register_prefix(req, upto=req.pos)
+        if tr is not None:
+            tr.end(req.rid, "resume", pos=req.pos)
 
     def _find_shared_prefix(self, req: Request) -> list[int]:
         """Longest block-aligned prompt prefix already resident in a live
@@ -1088,17 +1226,28 @@ class ServingEngine:
         engine internals."""
         if not self.prefix_sharing:
             return []
+        self._hit_owner = None
         return self._prefix_index.find(req.prompt, self._prefix_alive)
 
     def _prefix_alive(self, hit: tuple[int, tuple[int, ...]]) -> bool:
         """A registered prefix is shareable only while its owner is still
         running AND every snapshot block id is still the live table entry
-        (window expiry sinks leading entries without finishing the owner)."""
+        (window expiry sinks leading entries without finishing the owner).
+        Negative owner rids are parked sessions — their liveness is the
+        session table's (the entry exists and still owns those blocks)."""
         rid, blocks = hit
+        if rid < 0:
+            ok = self._sessions is not None and self._sessions.alive(rid, blocks)
+            if ok:
+                self._hit_owner = rid
+            return ok
         owner = next((r for r in self.scheduler.running if r.rid == rid), None)
         if owner is None or len(owner.block_table) < len(blocks):
             return False
-        return all(t == b != SINK_BLOCK for t, b in zip(owner.block_table, blocks))
+        ok = all(t == b != SINK_BLOCK for t, b in zip(owner.block_table, blocks))
+        if ok:
+            self._hit_owner = rid
+        return ok
 
     def _register_prefix(self, req: Request, upto: int | None = None) -> None:
         """Registers ``req``'s block-aligned prompt prefixes.  ``upto``
@@ -1194,17 +1343,24 @@ class ServingEngine:
             )
             rec = {"kind": "prefill", "req": req, "tok": tok, "key": key,
                    "qerr": qerr, "compiled": compiled, "span": name,
-                   "t_clock": sch.clock()}
+                   "epoch": req.preemptions, "t_clock": sch.clock()}
         elif final:
-            tok, arenas, key, qerr = prog(
+            args = (
                 self.params, jnp.asarray(toks)[None], jnp.int32(pos), jnp.int32(n_real),
                 pool.arenas, jnp.asarray(table), jnp.asarray(dest),
                 jnp.asarray(req.key),
                 self._lora_arenas(), jnp.asarray([req.adapter_slot], dtype=jnp.int32),
             )
+            if self._constraints:
+                # the final piece samples token 0: it must respect the
+                # request's automaton exactly like every decode draw
+                args += (jnp.asarray(req.constraint.mask()[None])
+                         if req.constraint is not None
+                         else self._ones_mask((1, self._vocab)),)
+            tok, arenas, key, qerr = prog(*args)
             rec = {"kind": "prefill", "req": req, "tok": tok, "key": key,
                    "qerr": qerr, "compiled": compiled, "span": name,
-                   "t_clock": sch.clock()}
+                   "epoch": req.preemptions, "t_clock": sch.clock()}
         elif self.spec is not None:
             arenas, darenas, qerr = prog(
                 self.params, self.spec.draft_params,
@@ -1274,9 +1430,12 @@ class ServingEngine:
         if tr is not None:
             tr.end(req.rid, rec["span"])
             tr.begin(req.rid, "prefill.host")
-        if req.state != "running":
-            # finished (deadline/evict) while the piece was in flight: the
-            # sampled token was never promised — drop it, close the span
+        if req.state != "running" or req.preemptions != rec.get(
+                "epoch", req.preemptions):
+            # finished (deadline/evict) or preempted-and-resumed while the
+            # piece was in flight: the sampled token was never promised (a
+            # resumed request re-draws it against its rebuilt KV) — drop
+            # it, close the span
             if tr is not None:
                 tr.end(req.rid, "prefill.host")
                 tr.end(req.rid, "prefill", aborted=True)
@@ -1360,6 +1519,23 @@ class ServingEngine:
             tables_d, keys_d = jnp.asarray(tables), jnp.asarray(keys)
             slots_d = jnp.asarray(slots)
             stop_d = jnp.asarray(stop) if N > 1 else None
+        # constrained decoding: the per-row token masks are fresh host data
+        # every dispatch (the automata advanced at the last harvest) — an
+        # argument beside the chained device state, never part of it
+        cmask_d = None
+        if self._constraints:
+            shape = ((N, Bb, self._vocab) if N > 1 else (Bb, self._vocab))
+            if any(r.constraint is not None for r in running):
+                m = np.ones(shape, dtype=bool)
+                for i, r in enumerate(running):
+                    if r.constraint is not None:
+                        if N > 1:
+                            m[:, i, :] = r.constraint.masks(N)
+                        else:
+                            m[i] = r.constraint.mask()
+                cmask_d = jnp.asarray(m)
+            else:
+                cmask_d = self._ones_mask(shape)
         if N > 1:
             kind = "decode_multi_paged" if self.attn == "paged" else "decode_multi"
         else:
@@ -1370,9 +1546,12 @@ class ServingEngine:
             # census BEFORE the call: the arenas are donated by it
             ex = (self.params, toks_d, pos_d, tables_d, pool.arenas,
                   keys_d, lora_arenas, slots_d)
+            if N > 1:
+                ex = ex + (stop_d,)
+            if cmask_d is not None:
+                ex = ex + (cmask_d,)
             self._mesh_collectives = self._collective_census(
-                (kind, Bb, nbb), prog,
-                ex + (stop_d,) if N > 1 else ex,
+                (kind, Bb, nbb), prog, ex,
             )
         if self.attn == "paged":
             self.attn_kernel_steps += 1
@@ -1388,17 +1567,17 @@ class ServingEngine:
                          compile=compiled, bucket=[Bb, nbb], lane="decode",
                          attn=self.attn,
                          **({"steps": N} if N > 1 else {}))
+        call_args = (self.params, toks_d, pos_d, tables_d, pool.arenas,
+                     keys_d, lora_arenas, slots_d)
         if N > 1:
-            ys_tok, ys_emit, toks_f, keys_f, pos_f, arenas = prog(
-                self.params, toks_d, pos_d, tables_d, pool.arenas,
-                keys_d, lora_arenas, slots_d, stop_d,
-            )
+            call_args = call_args + (stop_d,)
+        if cmask_d is not None:
+            call_args = call_args + (cmask_d,)
+        if N > 1:
+            ys_tok, ys_emit, toks_f, keys_f, pos_f, arenas = prog(*call_args)
             nxt, new_keys, new_pos = toks_f, keys_f, pos_f
         else:
-            nxt, new_keys, new_pos, arenas = prog(
-                self.params, toks_d, pos_d, tables_d, pool.arenas,
-                keys_d, lora_arenas, slots_d,
-            )
+            nxt, new_keys, new_pos, arenas = prog(*call_args)
         # past the point of no return: the call consumed the donated arenas
         self._fault_point(FP_SCATTER, tuple(r.rid for r in running))
         pool.set_arenas(arenas)
@@ -1410,6 +1589,7 @@ class ServingEngine:
         rec = {"kind": "decode", "running": running, "nxt": nxt,
                "new_keys": new_keys, "pos": host_pos, "bucket": [Bb, nbb],
                "compiled": compiled, "step": self.decode_steps,
+               "epochs": [r.preemptions for r in running],
                "t_disp": time.perf_counter(), "t_clock": sch.clock()}
         if N > 1:
             rec.update(multi=N, nxt=ys_tok, emit=ys_emit, new_keys=keys_f)
@@ -1455,9 +1635,15 @@ class ServingEngine:
         pos = rec["pos"]
         emitted = 0
         invalidate = False
+        epochs = rec.get("epochs")
         for i, r in enumerate(running):
-            if r.state != "running":
-                invalidate = True                          # finished mid-flight: token never promised
+            if r.state != "running" or (
+                    epochs is not None and r.preemptions != epochs[i]):
+                # finished mid-flight (token never promised), or preempted
+                # and already resumed: the resumed chain re-derives this
+                # token against its rebuilt KV — applying the stale record
+                # would advance the key twice
+                invalidate = True
                 continue
             r.key = new_keys[i]
             r.pos = int(pos[i]) + 1
@@ -1530,9 +1716,13 @@ class ServingEngine:
         pos = rec["pos"]
         emitted = 0
         invalidate = False
+        epochs = rec.get("epochs")
         for i, r in enumerate(running):
-            if r.state != "running":
-                invalidate = True                          # finished mid-flight: tokens never promised
+            if r.state != "running" or (
+                    epochs is not None and r.preemptions != epochs[i]):
+                # finished mid-flight (tokens never promised) or preempted
+                # and resumed (the resumed chain re-derives these tokens)
+                invalidate = True
                 continue
             k = harvested[i]
             r.key = new_keys[i]
@@ -1569,6 +1759,10 @@ class ServingEngine:
 
     def _emit_token(self, req: Request, tok: int) -> None:
         req.generated.append(tok)
+        if req.constraint is not None:
+            # the automaton advances exactly where the key chain does (at
+            # harvest), so replay/resume never re-advances it
+            req.constraint.advance(tok)
         if req.stream_cb is not None:
             req.stream_cb(tok)
         if self.eos_id is not None and tok == self.eos_id:
@@ -1578,6 +1772,16 @@ class ServingEngine:
 
     def _finish(self, req: Request, reason: str) -> None:
         never_admitted = req.admit_t is None
+        if self._sessions is not None and req.session_id is not None:
+            if reason in (FINISH_LENGTH, FINISH_EOS) and req.state == "running":
+                # park the turn's block-aligned written prefix BEFORE the
+                # scheduler frees the request's own references; the table
+                # takes share() refs of its own, so the blocks stay leased
+                self._park_session(req)
+            else:
+                # an abnormal turn (deadline/evicted/error) breaks the
+                # deterministic continuation contract: release the session
+                self._sessions.close(req.session_id)
         self._unregister_prefix(req)                       # before blocks free
         self.scheduler.finish(req, reason)
         reg = registry()
@@ -1590,6 +1794,10 @@ class ServingEngine:
             self._tracer.instant(
                 req.rid, "finish", reason=reason,
                 new_tokens=len(req.generated),
+                **({"session_id": req.session_id} if req.session_id else {}),
+                **({"priority": req.priority_class}
+                   if self._priorities is not None else {}),
+                **({"constrained": True} if req.constraint is not None else {}),
                 **({"error": req.error_cause.get("type")}
                    if req.error_cause else {}),
             )
@@ -1626,8 +1834,61 @@ class ServingEngine:
                 e2e_s=res.e2e_s,
                 prefill_compiled=req.prefill_compiled,
                 shared_prefix_blocks=req.n_shared_blocks,
+                session_id=req.session_id,
+                priority=(req.priority_class
+                          if self._priorities is not None else None),
+                constrained=(True if req.constraint is not None else None),
+                preemptions=(req.preemptions or None),
                 error=req.error_cause,
             )
+
+    def _park_session(self, req: Request) -> None:
+        """Park the finished turn's written block-aligned prefix.
+
+        The resident KV covers positions ``[0, req.pos)`` of the full
+        served sequence (prompt + generated; the last emitted token's KV
+        is never written — it was sampled, not forwarded).  Sliding-window
+        expiry may have sunk leading blocks, which truncates the parkable
+        prefix to nothing (the park helper stops at the first sink)."""
+        full = np.concatenate(
+            [np.asarray(req.prompt, dtype=np.int64),
+             np.asarray(req.generated, dtype=np.int64)])
+        bs = self.pool.block_size
+        nblk = min(req.pos // bs, len(req.block_table))
+        entry = self._sessions.park(
+            req.session_id, full[:nblk * bs], req.block_table[:nblk],
+            adapter_slot=req.adapter_slot)
+        if self._flight is not None:
+            self._flight.record(
+                "session_park", rid=req.rid, session_id=req.session_id,
+                blocks=(len(entry.blocks) if entry is not None else 0),
+                resident_blocks=self._sessions.resident_blocks)
+
+    def close_session(self, session_id: str) -> int:
+        """Release a session's parked blocks; returns how many were freed
+        (0 when the session is unknown — closing twice is a no-op)."""
+        if self._sessions is None:
+            return 0
+        freed = self._sessions.close(session_id)
+        if freed and self._flight is not None:
+            self._flight.record("session_close", session_id=session_id,
+                                blocks=freed)
+        return freed
+
+    def session_resident(self, session_id: str) -> bool:
+        """Does this engine's table hold the session's blocks?  (The dp
+        router's session-affinity probe.)"""
+        return self._sessions is not None and self._sessions.resident(session_id)
+
+    def _ones_mask(self, shape: tuple) -> jnp.ndarray:
+        """Cached device-resident all-``True`` constraint mask — the
+        no-op mask unconstrained rows ride through a constrained program
+        (``where(True, logits, -inf)`` is the identity, bit-exactly)."""
+        m = self._mask_ones.get(shape)
+        if m is None:
+            m = jnp.ones(shape, dtype=bool)
+            self._mask_ones[shape] = m
+        return m
 
     def _result(self, req: Request) -> RequestResult:
         n = len(req.generated)
@@ -1813,6 +2074,16 @@ class ServingEngine:
             # it bit-identically (every attended slot holds the draft K/V
             # of the emitted token at that position)
             self.draft_pool.rebuild_arenas()
+        if self._sessions is not None:
+            # parked session KV is soft state like everything else in the
+            # arenas: each entry records the exact tokens its blocks hold,
+            # so the chunk replay rebuilds them bit-identically and turn
+            # k+1 re-attaches as if the fault never happened.  Sessions
+            # replay first: running sharers then overwrite any co-owned
+            # block with identical content (deterministic forward).
+            for entry in self._sessions.entries():
+                self._replay_seq(entry.tokens, list(entry.blocks),
+                                 entry.adapter_slot, len(entry.tokens))
         for req in list(self.scheduler.running):
             req.pos = 0
             if req.generated:
@@ -1835,13 +2106,22 @@ class ServingEngine:
         untouched, so the next draw is bit-identical.  Window-expired
         (sunk) table entries route their writes to the sink exactly like
         live padding; the keep-mask already excludes those positions."""
-        sch, pool = self.scheduler, self.pool
-        bs = pool.block_size
         n = len(req.generated)
         seq = np.concatenate([
             req.prompt, np.asarray(req.generated[:n - 1], dtype=np.int32),
         ])
-        target = req.prompt_len + n - 1
+        self._replay_seq(seq, req.block_table, req.adapter_slot,
+                         req.prompt_len + n - 1, req=req)
+
+    def _replay_seq(self, seq, block_table, adapter_slot: int,
+                    target: int, *, req: Request | None = None) -> None:
+        """The chunk-replay engine under :meth:`_replay_request` and the
+        resident-session recovery replay: writes KV for ``seq[:target]``
+        into ``block_table`` through the sampling-free ``prefill_chunk``
+        programs, one fenced bucket-wide piece at a time."""
+        sch, pool = self.scheduler, self.pool
+        bs = pool.block_size
+        seq = np.asarray(seq, dtype=np.int32)
         aligned = [t for t in sch.prefill_buckets if t % bs == 0]
         piece = max(aligned) if aligned else sch.prefill_buckets[-1]
         if getattr(self.cfg, "learned_pos_embedding", False):
@@ -1853,10 +2133,10 @@ class ServingEngine:
         while pos < target:
             n_real = min(target - pos, piece)
             Tb = sch.prefill_bucket(n_real)
-            nbb = self._nbb(max(len(req.block_table), -(-(pos + Tb) // bs)))
+            nbb = self._nbb(max(len(block_table), -(-(pos + Tb) // bs)))
             toks = np.zeros(Tb, dtype=np.int32)
             toks[:n_real] = seq[pos:pos + n_real]
-            table, dest = chunk_tables(req.block_table, pos, Tb, nbb, bs)
+            table, dest = chunk_tables(block_table, pos, Tb, nbb, bs)
             if self.spec is not None:
                 # the draft forward is deterministic, so the replay rebuilds
                 # the draft arena bit-identically alongside the target's
@@ -1867,7 +2147,7 @@ class ServingEngine:
                     pool.arenas, self.draft_pool.arenas,
                     jnp.asarray(table), jnp.asarray(dest),
                     self._lora_arenas(),
-                    jnp.asarray([req.adapter_slot], dtype=jnp.int32),
+                    jnp.asarray([adapter_slot], dtype=jnp.int32),
                 )
                 self.draft_pool.set_arenas(darenas)
             else:
@@ -1876,10 +2156,12 @@ class ServingEngine:
                     self.params, jnp.asarray(toks)[None], jnp.int32(pos),
                     pool.arenas, jnp.asarray(table), jnp.asarray(dest),
                     self._lora_arenas(),
-                    jnp.asarray([req.adapter_slot], dtype=jnp.int32),
+                    jnp.asarray([adapter_slot], dtype=jnp.int32),
                 )
             pool.set_arenas(arenas)
-            req.pos = pos = pos + n_real
+            pos = pos + n_real
+            if req is not None:
+                req.pos = pos
             float(np.asarray(qerr))        # fence this piece before the next
             self._release_retired()
             self.chunk_runs += 1
@@ -1946,6 +2228,10 @@ class ServingEngine:
             # per-horizon buckets; N=1 collapses to None so a decode_steps=1
             # engine shares the module program cache with default engines
             self.n_decode_steps if self.n_decode_steps > 1 else None,
+            # constrained decoding: one boolean knob — schemas/automata are
+            # mask ARGUMENTS (the LoRA idiom), so program identity never
+            # sees a grammar; off collapses to None for cache sharing
+            "constrained" if self._constraints else None,
         )
 
     def _program(self, kind: str, a: int, b: int) -> tuple[Callable, bool]:
@@ -2014,7 +2300,17 @@ class ServingEngine:
                 draft_params=self.spec.draft_params,
                 draft_arena_sh=self.draft_pool.arena_sharding,
             )
-        return program_shardings(kind, self.params, self.mesh, self.pool.arena_sharding)
+        kw = program_shardings(kind, self.params, self.mesh, self.pool.arena_sharding)
+        if self._constraints and kind in (
+                "prefill", "decode", "decode_paged",
+                "decode_multi", "decode_multi_paged"):
+            # the trailing constraint-mask argument is replicated like every
+            # other small host-built per-step array
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            repl = NamedSharding(self.mesh, PartitionSpec())
+            kw["in_shardings"] = (*kw["in_shardings"], repl)
+        return kw
 
     def _collective_census(self, bucket_key: tuple, prog, example_args) -> dict:
         """Collective count of one compiled decode program (mesh mode):
@@ -2052,8 +2348,12 @@ class ServingEngine:
         cap = self.pool.capacity_tokens(nbb)
         cos_all, sin_all = build_rope_cache(cfg, cap)
 
+        # Constrained engines pass one trailing ``(1, V)`` bool mask; plain
+        # engines pass nothing, so the traced program (and its module-cache
+        # entry) is byte-identical to a pre-constraints engine.
         @partial(jax.jit, donate_argnums=(4,), **self._jit_kwargs("prefill"))
-        def prefill(params, toks, pos, n_real, arenas, table, dest, key, lora, slot):
+        def prefill(params, toks, pos, n_real, arenas, table, dest, key, lora, slot,
+                    *cmask):
             if qkv:
                 kd, vd = gather_dense_q(
                     arenas["k"], arenas["v"], arenas["k_scale"], arenas["v_scale"],
@@ -2066,6 +2366,8 @@ class ServingEngine:
                 **self._fwd_kwargs(lora, slot),
             )
             last = jax.lax.dynamic_index_in_dim(logits, n_real - 1, axis=1, keepdims=False)
+            if cmask:
+                last = jnp.where(cmask[0], last, -jnp.inf)
             key, sub = jax.random.split(key)
             tok = sample_token(last, temp, sub)            # (1,) — solo-prefill parity
             if qkv:
@@ -2142,8 +2444,10 @@ class ServingEngine:
         # engine's _decode_state chain).  Padding rows carry all-sink
         # tables, and out-of-range block indices clamp to the row's last
         # (sink) entry, so derived destinations stay sink-routed.
+        # Constrained engines pass one trailing ``(Bb, V)`` bool mask
+        # (all-True rows are a bit-exact no-op); plain engines pass nothing.
         @partial(jax.jit, donate_argnums=(4,), **self._jit_kwargs("decode"))
-        def decode(params, toks, pos, tables, arenas, keys, lora, slots):
+        def decode(params, toks, pos, tables, arenas, keys, lora, slots, *cmask):
             dest_block = jnp.take_along_axis(
                 tables, (pos // bs)[:, None], axis=1)[:, 0]
             dest_slot = pos % bs
@@ -2160,9 +2464,12 @@ class ServingEngine:
             )
             sp = jax.vmap(jax.random.split)(keys)          # per-request key chains
             new_keys, subs = sp[:, 0], sp[:, 1]
+            lg = logits[:, 0]
+            if cmask:
+                lg = jnp.where(cmask[0], lg, -jnp.inf)
             # (1, V) per row under vmap == the unbatched B=1 generate() draw
             nxt = jax.vmap(lambda l, k: sample_token(l[None], temp, k)[0])(
-                logits[:, 0], subs
+                lg, subs
             )
             kc = cache["k"].transpose(1, 0, 2, 3, 4)       # (B, L, ng, cap, hs)
             vc = cache["v"].transpose(1, 0, 2, 3, 4)
@@ -2206,15 +2513,19 @@ class ServingEngine:
         mesh = self.mesh
 
         @partial(jax.jit, donate_argnums=(4,), **self._jit_kwargs("decode_paged"))
-        def decode_paged(params, toks, pos, tables, arenas, keys, lora, slots):
+        def decode_paged(params, toks, pos, tables, arenas, keys, lora, slots,
+                         *cmask):
             logits, fresh = forward_paged(
                 params, toks[:, None], pos, arenas, tables, cos_all, sin_all,
                 cfg, cdtype=cdtype, mesh=mesh, **self._fwd_kwargs(lora, slots),
             )
             sp = jax.vmap(jax.random.split)(keys)          # per-request key chains
             new_keys, subs = sp[:, 0], sp[:, 1]
+            lg = logits[:, 0]
+            if cmask:
+                lg = jnp.where(cmask[0], lg, -jnp.inf)
             nxt = jax.vmap(lambda l, k: sample_token(l[None], temp, k)[0])(
-                logits[:, 0], subs
+                lg, subs
             )
             arenas = write_fresh_kv(arenas, fresh, tables, pos, block_size=bs,
                                     kv_dtype=kv_dtype, mesh=mesh)
@@ -2252,11 +2563,12 @@ class ServingEngine:
 
         @partial(jax.jit, donate_argnums=(4,),
                  **self._jit_kwargs("decode_multi"))
-        def decode_multi(params, toks, pos, tables, arenas, keys, lora, slots, stop):
+        def decode_multi(params, toks, pos, tables, arenas, keys, lora, slots, stop,
+                         *cmask):
             kw = self._fwd_kwargs(lora, slots)   # LoRA gather once per visit
             live0 = pos <= stop
 
-            def body(carry, _):
+            def body(carry, step_mask):
                 toks, pos, keys, live, arenas = carry
                 dest_block, dest_slot = dest_for_pos(
                     tables, pos, live, block_size=bs)
@@ -2273,8 +2585,11 @@ class ServingEngine:
                 )
                 sp = jax.vmap(jax.random.split)(keys)
                 new_keys = jnp.where(live[:, None], sp[:, 0], keys)
+                lg = logits[:, 0]
+                if cmask:
+                    lg = jnp.where(step_mask, lg, -jnp.inf)
                 nxt = jax.vmap(lambda l, k: sample_token(l[None], temp, k)[0])(
-                    logits[:, 0], sp[:, 1]
+                    lg, sp[:, 1]
                 )
                 kc = cache["k"].transpose(1, 0, 2, 3, 4)
                 vc = cache["v"].transpose(1, 0, 2, 3, 4)
@@ -2305,9 +2620,11 @@ class ServingEngine:
                 live_n = live & ~done
                 return (toks_n, pos_n, new_keys, live_n, new_arenas), (nxt, live)
 
+            # the constraint masks are scan xs: one (Bb, V) slice per step,
+            # computed host-side from the exact masks(N) lookahead
             (toks_f, pos_f, keys_f, _live_f, arenas), (ys_tok, ys_emit) = (
                 jax.lax.scan(body, (toks, pos, keys, live0, arenas),
-                             None, length=N))
+                             cmask[0] if cmask else None, length=N))
             return ys_tok, ys_emit, toks_f, keys_f, pos_f, arenas
 
         return decode_multi
@@ -2339,11 +2656,11 @@ class ServingEngine:
         @partial(jax.jit, donate_argnums=(4,),
                  **self._jit_kwargs("decode_multi_paged"))
         def decode_multi_paged(params, toks, pos, tables, arenas, keys, lora,
-                               slots, stop):
+                               slots, stop, *cmask):
             kw = self._fwd_kwargs(lora, slots)   # LoRA gather once per visit
             live0 = pos <= stop
 
-            def body(carry, _):
+            def body(carry, step_mask):
                 toks, pos, keys, live, arenas = carry
                 logits, fresh = forward_paged(
                     params, toks[:, None], pos, arenas, tables,
@@ -2351,8 +2668,11 @@ class ServingEngine:
                 )
                 sp = jax.vmap(jax.random.split)(keys)
                 new_keys = jnp.where(live[:, None], sp[:, 0], keys)
+                lg = logits[:, 0]
+                if cmask:
+                    lg = jnp.where(step_mask, lg, -jnp.inf)
                 nxt = jax.vmap(lambda l, k: sample_token(l[None], temp, k)[0])(
-                    logits[:, 0], sp[:, 1]
+                    lg, sp[:, 1]
                 )
                 new_arenas = write_fresh_kv_live(
                     arenas, fresh, tables, pos, live,
@@ -2367,7 +2687,7 @@ class ServingEngine:
 
             (toks_f, pos_f, keys_f, _live_f, arenas), (ys_tok, ys_emit) = (
                 jax.lax.scan(body, (toks, pos, keys, live0, arenas),
-                             None, length=N))
+                             cmask[0] if cmask else None, length=N))
             return ys_tok, ys_emit, toks_f, keys_f, pos_f, arenas
 
         return decode_multi_paged

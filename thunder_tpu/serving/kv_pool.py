@@ -438,15 +438,22 @@ class PrefixIndex:
         return 0
 
     def register(self, rid: int, prompt: np.ndarray, block_table,
-                 alive, *, upto: int | None = None) -> None:
+                 alive, *, upto: int | None = None, full: bool = False) -> None:
         """Registers every block-aligned prefix of ``prompt`` (owner
         ``rid``).  ``upto`` bounds registration to tokens already written
         (a chunked prefill registers after each piece); live entries are
-        never displaced — first writer wins while it stays alive."""
+        never displaced — first writer wins while it stays alive.
+
+        ``full=True`` lifts the one-token-short cap: a *running* request
+        must keep its last prompt token for its own re-prefill, but a
+        parked session sequence is complete and fully written, so every
+        covered block is shareable (turn k+1's prompt is strictly longer,
+        which is what the ``find`` cap already guarantees per-query)."""
         bs = self.block_size
         n = int(prompt.shape[0])
         limit = n if upto is None else min(upto, n)
-        hi = min((limit // bs) * bs, ((n - 1) // bs) * bs)
+        hi = (limit // bs) * bs if full else min(
+            (limit // bs) * bs, ((n - 1) // bs) * bs)
         toks = prompt.tolist()
         for k in range(bs, hi + 1, bs):
             key = tuple(toks[:k])

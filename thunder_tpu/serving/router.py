@@ -111,6 +111,7 @@ class RoutedHandle:
         self._deadline_t = deadline_t
         self._submit_t = submit_t
         self._blocks = 0                 # full reservation, set at submit
+        self._level = 1                  # priority level (normal) for queue order
         self._inner = None               # replica-local RequestHandle
         self.replica: int | None = None
         self._synthetic: RequestResult | None = None   # expired/evicted pre-route
@@ -262,6 +263,9 @@ class ReplicatedEngine:
         key=None,
         stream_cb: Callable[[int], Any] | None = None,
         adapter_id: str | None = None,
+        session_id: str | None = None,
+        priority: str | None = None,
+        constraint=None,
     ) -> RoutedHandle:
         """Enqueues one request on the router's global queue; returns
         immediately.  Admission is aggregate: the request is validated
@@ -269,7 +273,12 @@ class ReplicatedEngine:
         identically, so feasible-on-one means feasible-anywhere) and the
         global queue bound is ``max_queue × replicas``.  Raises
         :class:`AdmissionError` when the request can never fit or the
-        global queue is full."""
+        global queue is full.
+
+        ``session_id`` / ``priority`` / ``constraint`` pass through to the
+        replica (engines must be built with the matching knob); the router
+        adds session affinity (a session's next turn routes to the lane
+        holding its parked KV) and class-ordered global queueing."""
         if self._closed:
             raise RuntimeError("engine is shut down")
         if not self._process0:
@@ -298,17 +307,34 @@ class ReplicatedEngine:
         handle = RoutedHandle(
             self, self._next_rid, prompt,
             dict(max_new_tokens=int(max_new_tokens), key=key,
-                 stream_cb=stream_cb, adapter_id=adapter_id),
+                 stream_cb=stream_cb, adapter_id=adapter_id,
+                 session_id=session_id, priority=priority,
+                 constraint=constraint),
             (now + deadline) if deadline is not None else None,
             now,
         )
         handle._blocks = blocks
+        if priority is not None:
+            from thunder_tpu.serving.priority import priority_level
+
+            handle._level = priority_level(priority)[1]
         self._next_rid += 1
         self.submitted += 1
-        self._pending.append(handle)
+        self._enqueue(handle)
         self._handles[handle.rid] = handle
         self._m_queue_depth.set(len(self._pending))
         return handle
+
+    def _enqueue(self, handle: RoutedHandle) -> None:
+        """Class-ordered global queueing: insert before the first pending
+        request of a strictly less urgent class (FIFO within a class).
+        All-default submissions carry the same level, so this degrades to
+        append — the off-path queue order is untouched."""
+        for i, h in enumerate(self._pending):
+            if h._level > handle._level:
+                self._pending.insert(i, handle)
+                return
+        self._pending.append(handle)
 
     def step(self) -> bool:
         """One router iteration: route whatever is placeable, drive every
@@ -364,18 +390,33 @@ class ReplicatedEngine:
         """Administratively removes a request wherever it is: routed →
         the owning replica frees its blocks (that replica's pool only);
         still pending → dropped from the global queue with a synthetic
-        ``"evicted"`` result."""
+        ``"evicted"`` result.  Either way the request's session (if any)
+        is closed fleet-wide — an evicted turn must not leave parked
+        blocks resident on any lane."""
         if handle.done():
             return
+        sid = handle._kwargs.get("session_id")
         if handle._inner is not None:
             self._engines[handle.replica].evict(handle._inner)
+            if sid is not None:
+                self.close_session(sid)
             return
         self._finish_pending(handle, FINISH_EVICTED)
         try:
             self._pending.remove(handle)
         except ValueError:
             pass
+        if sid is not None:
+            self.close_session(sid)
         self._m_queue_depth.set(len(self._pending))
+
+    def close_session(self, session_id: str) -> int:
+        """Releases a session's parked blocks on EVERY lane; returns the
+        total blocks freed.  (A session normally lives on one lane thanks
+        to affinity, but the fleet-wide sweep is what guarantees a dead
+        session's blocks return to the free list no matter how routing
+        history scattered its turns.)"""
+        return sum(eng.close_session(session_id) for eng in self._engines)
 
     def shutdown(self, *, drain: bool = True) -> None:
         """Graceful stop: optionally drains the fleet, evicts whatever
@@ -428,6 +469,26 @@ class ReplicatedEngine:
                 "decode_steps": sum(p["decode_steps"] for p in per),
                 "host_visits": sum(p["host_visits"] for p in per),
                 "prefix_hits": sum(p["prefix_hits"] for p in per),
+                "prefix_lookups": sum(p["prefix_lookups"] for p in per),
+                "prefix_hit_rate": (
+                    sum(p["prefix_hits"] for p in per)
+                    / sum(p["prefix_lookups"] for p in per)
+                    if sum(p["prefix_lookups"] for p in per) else None
+                ),
+                **({
+                    "session_resident_blocks": sum(
+                        p["sessions"]["resident_blocks"]
+                        for p in per if "sessions" in p),
+                    "session_reattach_hits": sum(
+                        p["sessions"]["reattach_hits"]
+                        for p in per if "sessions" in p),
+                    "session_evictions": sum(
+                        p["sessions"]["evictions"]
+                        for p in per if "sessions" in p),
+                } if any("sessions" in p for p in per) else {}),
+                **({"preempted": sum(p["priority"]["preempted"]
+                                     for p in per if "priority" in p)}
+                   if any("priority" in p for p in per) else {}),
             },
         }
 
@@ -446,6 +507,11 @@ class ReplicatedEngine:
             if head._deadline_t is not None and now >= head._deadline_t:
                 self._finish_pending(head, FINISH_DEADLINE)
                 self._pending.popleft()
+                sid = head._kwargs.get("session_id")
+                if sid is not None:
+                    # expiry kills the session: release parked blocks on
+                    # every lane, not just wherever affinity last sent it
+                    self.close_session(sid)
                 worked = True
                 continue
             placed = self._place(head)
@@ -494,8 +560,13 @@ class ReplicatedEngine:
         return idx
 
     def _choose(self, head: RoutedHandle) -> tuple[int | None, str | None]:
-        """Pick the target replica: resident prefix > routing history >
-        least-loaded-that-can-accept."""
+        """Pick the target replica: resident session > resident prefix >
+        routing history > least-loaded-that-can-accept."""
+        sid = head._kwargs.get("session_id")
+        if sid is not None:
+            for i, eng in enumerate(self._engines):
+                if eng.session_resident(sid):
+                    return i, "session"
         best_i, best_k = None, 0
         for i, eng in enumerate(self._engines):
             k = eng.probe_prefix(head._prompt)

@@ -107,6 +107,14 @@ class Request:
     # decodes through; slot 0 is the reserved base (no-adapter) slot
     adapter_id: str | None = None
     adapter_slot: int = 0
+    # stateful serving: session identity (prefix blocks parked on finish),
+    # priority class (lower level = more urgent; 1 = "normal" everywhere
+    # when priorities are off), and the host-side decoding automaton
+    session_id: str | None = None
+    priority: int = 1
+    priority_class: str = "normal"
+    constraint: object | None = None
+    preemptions: int = 0
     # cache state
     block_table: list[int] = field(default_factory=list)
     n_shared_blocks: int = 0                # leading table entries leased via share()
@@ -268,7 +276,9 @@ class Scheduler:
 
     def submit(self, prompt, max_new_tokens: int, *, key, deadline_s: float | None = None,
                stream_cb=None, adapter_id: str | None = None,
-               adapter_slot: int = 0) -> Request:
+               adapter_slot: int = 0, session_id: str | None = None,
+               priority: int = 1, priority_class: str = "normal",
+               constraint=None) -> Request:
         prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -285,14 +295,31 @@ class Scheduler:
             submit_t=now,
             adapter_id=adapter_id,
             adapter_slot=int(adapter_slot),
+            session_id=session_id,
+            priority=int(priority),
+            priority_class=str(priority_class),
+            constraint=constraint,
         )
         self.check_feasible(req.prompt_len, req.max_new_tokens)
         if len(self.queue) >= self.max_queue:
             raise AdmissionError(
                 f"wait queue full ({self.max_queue}); request rejected"
             )
-        self.queue.append(req)
+        self._enqueue(req)
         return req
+
+    def _enqueue(self, req: Request) -> None:
+        """Queue insertion: priority order, FIFO within a class.
+
+        Inserts before the first entry of a strictly less urgent class
+        (larger level) — with uniform levels (priorities off) this is a
+        plain append, so default scheduling is unchanged."""
+        at = next((i for i, q in enumerate(self.queue)
+                   if q.priority > req.priority), None)
+        if at is None:
+            self.queue.append(req)
+        else:
+            self.queue.insert(at, req)
 
     def next_admittable(self, *, shared_blocks: int = 0) -> Request | None:
         """FIFO head if a batch slot and enough blocks are free, else None
@@ -337,6 +364,35 @@ class Scheduler:
         if req.block_table:
             self.pool.free([b for b in req.block_table if b != SINK_BLOCK])
             req.block_table = []
+
+    def preempt(self, req: Request) -> None:
+        """Evict-and-resume checkpoint: running → queued, blocks released.
+
+        The checkpoint is purely host-side — prompt, generated tokens and
+        the PRNG key chain are already exact (keys only advance at
+        harvest) — so releasing the blocks loses nothing that the
+        ``prefill_chunk`` replay cannot rebuild bit-identically at
+        re-admission.  The caller (the engine) must scrub its prefix
+        index for this request *before* calling, exactly as for finish.
+        Re-queued at the front of its own class (seniority by submit
+        time), behind every strictly more urgent entry."""
+        assert req.state == "running", f"cannot preempt {req.state} request"
+        self.running.remove(req)
+        if req.block_table:
+            self.pool.free([b for b in req.block_table if b != SINK_BLOCK])
+            req.block_table = []
+        req.n_shared_blocks = 0
+        req.pos = 0
+        req.state = "queued"
+        req.preemptions += 1
+        at = next((i for i, q in enumerate(self.queue)
+                   if q.priority > req.priority
+                   or (q.priority == req.priority
+                       and q.submit_t > req.submit_t)), None)
+        if at is None:
+            self.queue.append(req)
+        else:
+            self.queue.insert(at, req)
 
     def deadline_expired(self) -> list[Request]:
         """Queued/running requests past their deadline.  The engine finishes
@@ -386,6 +442,10 @@ class Scheduler:
                 "adapter_id": r.adapter_id,
                 "prefill_compiled": r.prefill_compiled,
                 "deadline_t": r.deadline_t,
+                "session_id": r.session_id,
+                "priority": r.priority_class,
+                "constrained": r.constraint is not None,
+                "preemptions": r.preemptions,
             }
 
         return {

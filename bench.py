@@ -1080,6 +1080,32 @@ def main():
             "results": out["results"],
         }))
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "goodput":
+        # goodput-ledger bench: observation overhead vs an identical
+        # goodput=False engine, the exact conservation identity in-bench,
+        # ledger/engine speculative-acceptance integer agreement, and zero
+        # programs compiled for observation.  Host work only, no TPU probe;
+        # artifact uses the BENCH_MICRO schema.
+        from thunder_tpu._platform import force_cpu
+
+        force_cpu()
+        from thunder_tpu.benchmarks.goodput import goodput_bench
+
+        out = goodput_bench(on_tpu=False)
+        artifact = {"backend": jax.default_backend(), **out}
+        with open("BENCH_GOODPUT.json", "w") as f:
+            json.dump(artifact, f, indent=1)
+        for k, v in out["results"].items():
+            log(f"goodput {k}: {v}")
+        print(json.dumps({
+            "metric": "goodput_observation_overhead_x",
+            "value": out["results"]["overhead_ratio_x"],
+            "unit": "x",
+            # the goodput=False engine IS the baseline
+            "vs_baseline": out["results"]["overhead_ratio_x"],
+            "results": out["results"],
+        }))
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "cost":
         # analytic companion to the measured headline (no TPU needed): XLA's
         # own cost model on the compiled loss+grad at headline geometry, and

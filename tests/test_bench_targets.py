@@ -1093,3 +1093,66 @@ class TestSessionsTargets:
         art = {"backend": jax.default_backend(), **out}
         check_sessions_targets(art)
         assert out["results"]["smoke"] is True
+
+
+class TestGoodputTargets:
+    def test_goodput_gate_on_committed_artifact(self):
+        """BENCH_GOODPUT.json must keep showing the goodput-ledger claims:
+        exact conservation on the measured engines, observation overhead
+        within 1.05x of the identical goodput=False engine, the ledger's
+        draft-kind integers equal to the speculative engine's acceptance
+        counters, and zero programs compiled for observation.  A
+        regression recorded into the artifact fails here."""
+        from tools.bench_targets import check_goodput_targets
+
+        art = check_goodput_targets()
+        assert art["backend"] in ("cpu", "tpu")
+        r = art["results"]
+        assert r["spec_draft_tokens"] >= r["spec_accepted_tokens"] > 0
+        assert r["off_ms"] > 0 and r["on_ms"] > 0
+
+    def test_goodput_gate_rejects_regressions(self):
+        from tools.bench_targets import check_goodput_targets, load_artifact
+
+        good = load_artifact("BENCH_GOODPUT.json")
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["conservation_exact"] = False
+        with pytest.raises(AssertionError, match="conservation"):
+            check_goodput_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["overhead_ratio_x"] = 1.5
+        with pytest.raises(AssertionError, match="overhead"):
+            check_goodput_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["spec_acceptance_exact"] = False
+        with pytest.raises(AssertionError, match="acceptance"):
+            check_goodput_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        bad["results"]["new_programs_with_goodput"] = 2
+        with pytest.raises(AssertionError, match="programs"):
+            check_goodput_targets(bad)
+
+        bad = json.loads(json.dumps(good))
+        del bad["results"]["overhead_ratio_x"]
+        with pytest.raises(AssertionError):
+            check_goodput_targets(bad)
+
+    @pytest.mark.slow
+    def test_goodput_bench_live_smoke(self):
+        """The bench harness itself at smoke shapes (2 reps, 3 requests,
+        8 new tokens): conservation, acceptance agreement, and the
+        zero-new-programs contract are deterministic and must hold live;
+        the overhead ratio is not gated at smoke shapes (too few reps to
+        reject host jitter — the committed artifact carries that gate)."""
+        from thunder_tpu.benchmarks.goodput import goodput_bench
+        from tools.bench_targets import check_goodput_targets
+
+        out = goodput_bench(on_tpu=False, smoke=True)
+        art = {"backend": jax.default_backend(), **out}
+        check_goodput_targets(art, max_overhead=math.inf)
+        assert out["results"]["smoke"] is True
+        assert out["results"]["conservation_exact"] is True

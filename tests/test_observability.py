@@ -183,6 +183,7 @@ class TestMetricsRegistry:
         assert snap["h"] == {
             "count": 2, "sum": 6.0, "mean": 3.0, "min": 2.0, "max": 4.0,
             "p50": 2.0, "p95": 4.0, "p99": 4.0,
+            "window": obs.Histogram.WINDOW,
         }
 
         # get-or-create returns the same object; a type collision raises

@@ -92,6 +92,7 @@ __all__ = [
     "profile_stats",
     "donation_stats",
     "metrics_snapshot",
+    "metrics_export_text",
     "serve",
     "export_chrome_trace",
     "flight_record",
@@ -901,6 +902,16 @@ def metrics_snapshot() -> dict:
     return observability.snapshot()
 
 
+def metrics_export_text() -> str:
+    """The registry rendered in Prometheus text exposition format (0.0.4):
+    counters and gauges as-is, histograms as a ``summary`` family whose
+    quantiles are computed over the histogram's bounded sample window (the
+    HELP line carries that caveat).  Serve it from any HTTP handler to
+    scrape thunder_tpu like vLLM's ``/metrics`` (alias of
+    ``thunder_tpu.observability.export_text()``; see MIGRATION.md)."""
+    return observability.export_text()
+
+
 def export_chrome_trace(path: str) -> str:
     """Writes the buffered events — compile pipeline (interpret / transforms
     / lower / codegen / compile) AND any per-request serving lifecycle spans
@@ -947,8 +958,13 @@ def serve(model_fn, params, cfg, **kwargs):
     ``generate(..., mesh=mesh)``; see GUIDE.md "Sharded serving".
     Serving-plane observability (each off by default): ``trace=True`` for
     per-request lifecycle spans in ``tt.export_chrome_trace``, ``slo={...}``
-    for burn-rate monitoring via ``engine.slo_report()``, and
-    ``flight_recorder=True`` for crash dumps (``tt.flight_record``).
+    for burn-rate monitoring via ``engine.slo_report()``,
+    ``flight_recorder=True`` for crash dumps (``tt.flight_record``), and
+    ``goodput=True`` for the exact device-work ledger — every dispatched
+    token-position classified committed-or-waste with per-dispatch
+    conservation (``stats()["goodput"]`` / ``engine.goodput_report()``;
+    GUIDE.md "Goodput & waste attribution").  All compile zero extra
+    programs and leave the default off-path byte-identical.
     Speculative serving: ``speculative=serving.SpecConfig(draft_params,
     draft_cfg, K=...)`` runs a draft/verify lane over the paged arena —
     each decode turn drafts K tokens with the cheap model and verifies

@@ -1,0 +1,160 @@
+"""Goodput-ledger benchmark: observation overhead, conservation, and the
+zero-new-programs contract (ISSUE 18).
+
+Four claims under test, one per acceptance bar of the goodput PR:
+
+**Overhead.**  The ledger is pure host arithmetic over shapes the engine
+already holds, so an engine serving with ``goodput=True`` must stay
+within 1.05x of the identical ``goodput=False`` engine's wall time over
+the same request load (min-of-reps on both sides, reps interleaved so
+machine drift hits both engines equally).
+
+**Conservation.**  On the measured engine itself, the ledger's aggregate
+identity must hold exactly: ``committed + sum(waste) == positions`` as
+integers, zero violations (the ledger runs strict, so any per-dispatch
+violation would have raised mid-bench), and ``committed_tokens`` equal to
+the tokens the requests actually streamed.
+
+**Acceptance.**  On a speculative engine pair, the ledger's draft-kind
+committed count must equal the engine's own ``spec_accepted_tokens``
+integer exactly — the waste taxonomy reproduces the acceptance
+accounting, it does not approximate it.
+
+**Programs.**  After the ``goodput=False`` engine warms the module
+program cache, building and driving the ``goodput=True`` engines must
+add zero cache entries and compile nothing: observation never enters
+program identity.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+def _drive(eng, prompts, n):
+    hs = [eng.submit(p, max_new_tokens=n) for p in prompts]
+    return [h.result() for h in hs]
+
+
+def goodput_bench(on_tpu: bool = False, *, smoke: bool = False) -> dict:
+    """Returns ``{"results": {...}}`` in the BENCH_MICRO artifact shape."""
+    import jax
+    import jax.numpy as jnp
+
+    import thunder_tpu as tt
+    from thunder_tpu.models import llama
+    from thunder_tpu.serving import SpecConfig
+    from thunder_tpu.serving.engine import _program_cache
+
+    if smoke:
+        reps, n_req, prompt_len, new_tokens = 2, 3, 12, 8
+    else:
+        reps, n_req, prompt_len, new_tokens = 8, 4, 24, 32
+    overrides = dict(n_embd=128, intermediate_size=344, n_layer=4)
+    cfg = llama.Config.from_name("tiny-llama-debug", **overrides)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    dcfg = llama.Config.from_name("tiny-llama-debug",
+                                  **{**overrides, "n_layer": 1})
+    dparams = llama.init_params(dcfg, jax.random.PRNGKey(9), dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+
+    def prompts():
+        return [rng.integers(0, cfg.vocab_size, (prompt_len,)).astype(np.int32)
+                for _ in range(n_req)]
+
+    def make_engine(**kw):
+        base = dict(block_size=8, num_blocks=96, max_batch=4,
+                    cache_dtype=jnp.float32, batch_buckets=(4,),
+                    prefill_buckets=(32,))
+        base.update(kw)
+        return tt.serve(None, params, cfg, **base)
+
+    #
+    # 1+2+4. paired decode engines: overhead, conservation, program count
+    #
+    off = make_engine()
+    _drive(off, prompts(), new_tokens)               # warm the module cache
+    progs_before = len(_program_cache)
+    on = make_engine(goodput=True)
+    _drive(on, prompts(), new_tokens)                # warm (cache is shared)
+    new_programs = len(_program_cache) - progs_before
+    new_programs += sum(on.compile_counts.values())  # and none engine-local
+
+    off_s, on_s = [], []
+    streamed = 0
+    tokens_before = on.stats()["goodput"]["committed_tokens"]
+    for rep in range(reps):          # interleave, alternate order: drift-fair
+        load = prompts()
+        for eng in ((off, on) if rep % 2 == 0 else (on, off)):
+            t0 = time.perf_counter()
+            res = _drive(eng, load, new_tokens)
+            (off_s if eng is off else on_s).append(time.perf_counter() - t0)
+            if eng is on:
+                streamed += sum(len(r.new_tokens) for r in res)
+    snap = on.stats()["goodput"]
+    conserved = (
+        snap["violations"] == 0
+        and snap["committed"] + sum(snap["waste"].values()) == snap["positions"]
+        and snap["committed_tokens"] - tokens_before == streamed)
+    off.shutdown()
+    on.shutdown()
+
+    #
+    # 3. speculative pairs: the ledger's acceptance integers are the
+    # engine's — a real draft/target pair exercises the rejection path
+    # (near-zero acceptance at this vocab), a self-draft pair the
+    # acceptance path (greedy: every drafted token accepted)
+    #
+    def spec_pair(dp_, dcfg_):
+        nonlocal new_programs, conserved
+        off_e = make_engine(num_blocks=128,
+                            speculative=SpecConfig(dp_, dcfg_, K=2))
+        _drive(off_e, prompts(), new_tokens)
+        before = len(_program_cache)
+        on_e = make_engine(num_blocks=128,
+                           speculative=SpecConfig(dp_, dcfg_, K=2),
+                           goodput=True)
+        _drive(on_e, prompts(), new_tokens)
+        new_programs += len(_program_cache) - before
+        new_programs += sum(on_e.compile_counts.values())
+        per = on_e.goodput_report()["per_kind"]
+        acc = per["draft_decode"]["committed"]
+        drafted = (per["draft_decode"]["positions"]
+                   - per["draft_decode"]["waste"].get("pad_row", 0)
+                   - per["draft_decode"]["waste"].get("dead_scan_row", 0))
+        exact = (acc == on_e.spec_accepted_tokens
+                 and drafted == on_e.spec_draft_tokens)
+        s = on_e.stats()["goodput"]
+        conserved = conserved and s["violations"] == 0 and (
+            s["committed"] + sum(s["waste"].values()) == s["positions"])
+        off_e.shutdown()
+        on_e.shutdown()
+        return acc, drafted, exact
+
+    acc_r, drafted_r, exact_r = spec_pair(dparams, dcfg)
+    acc_s, drafted_s, exact_s = spec_pair(params, cfg)
+    ledger_accepted = acc_r + acc_s
+    ledger_drafted = drafted_r + drafted_s
+    spec_exact = exact_r and exact_s and acc_s > 0
+
+    return {
+        "results": {
+            "off_ms": round(min(off_s) * 1e3, 3),
+            "on_ms": round(min(on_s) * 1e3, 3),
+            "overhead_ratio_x": round(min(on_s) / min(off_s), 4),
+            "conservation_exact": bool(conserved),
+            "goodput_frac": round(snap["goodput_frac"], 4),
+            "token_goodput_frac": round(snap["token_goodput_frac"], 4),
+            "waste": dict(snap["waste"]),
+            "spec_acceptance_exact": bool(spec_exact),
+            "spec_accepted_tokens": int(ledger_accepted),
+            "spec_draft_tokens": int(ledger_drafted),
+            "new_programs_with_goodput": int(new_programs),
+            "reps": reps,
+            "requests_per_rep": n_req,
+            "new_tokens": new_tokens,
+            "config": f"tiny-llama n_embd={cfg.n_embd} n_layer={cfg.n_layer}",
+            "smoke": smoke,
+        }
+    }

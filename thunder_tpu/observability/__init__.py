@@ -89,10 +89,17 @@ from thunder_tpu.observability.metrics import (  # noqa: F401
     MetricsRegistry,
     clear_hooks,
     emit,
+    export_text,
     has_hooks,
     register_hook,
     registry,
     unregister_hook,
+)
+from thunder_tpu.observability.goodput import (  # noqa: F401
+    WASTE_CAUSES,
+    GoodputConfig,
+    GoodputLedger,
+    fleet_goodput,
 )
 
 __all__ = [
@@ -120,12 +127,18 @@ __all__ = [
     "active_recorder",
     "serving_trace_env_enabled",
     "flight_recorder_env_enabled",
+    # goodput ledger (ISSUE 18)
+    "WASTE_CAUSES",
+    "GoodputConfig",
+    "GoodputLedger",
+    "fleet_goodput",
     # metrics + hooks
     "Counter",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
     "registry",
+    "export_text",
     "HOOK_EVENTS",
     "register_hook",
     "unregister_hook",

@@ -12,7 +12,11 @@ prefix-share hit rate, which bucket geometries compiled when (the
 per-bucket compile causes), and — on the async engine — the per-lane
 state: the in-flight decode/prefill futures and every partially-prefilled
 request (``state["lanes"]``), so a crash mid-overlap shows what was still
-on the device.
+on the device.  Engines running with ``goodput=True`` tag each recorded
+decode event with the dispatch's goodput breakdown (committed + non-zero
+waste causes) and put the ledger's running brief in ``state["lanes"]
+["goodput"]``, so the postmortem also answers "was the engine doing
+*useful* work when it died".
 
 Dump paths:
 

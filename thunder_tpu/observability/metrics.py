@@ -17,6 +17,8 @@ registry counter so silent hook failures stay measurable.
 """
 from __future__ import annotations
 
+import math
+import re
 import threading
 import warnings
 from typing import Any, Callable
@@ -27,6 +29,7 @@ __all__ = [
     "Histogram",
     "MetricsRegistry",
     "registry",
+    "export_text",
     "HOOK_EVENTS",
     "register_hook",
     "unregister_hook",
@@ -110,6 +113,11 @@ class Histogram:
         return vals[min(rank, len(vals)) - 1]
 
     def snapshot(self) -> dict:
+        """Summary dict.  Note the mixed horizons: ``count``/``sum``/
+        ``mean``/``min``/``max`` are exact over the *entire* stream, while
+        ``p50``/``p95``/``p99`` are nearest-rank over only the most recent
+        ``WINDOW`` (= 512) observations.  The ``window`` field states the
+        percentile horizon so consumers can tell which is which."""
         return {
             "count": self.count,
             "sum": self.sum,
@@ -119,6 +127,7 @@ class Histogram:
             "p50": self.percentile(50),
             "p95": self.percentile(95),
             "p99": self.percentile(99),
+            "window": self.WINDOW,
         }
 
     def reset(self) -> None:
@@ -181,6 +190,85 @@ _REGISTRY = MetricsRegistry()
 def registry() -> MetricsRegistry:
     """The process-wide registry every subsystem publishes into."""
     return _REGISTRY
+
+
+#
+# Prometheus text exposition
+#
+
+_NAME_OK = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+
+def _prom_name(name: str) -> str:
+    """Registry names (dotted) to the Prometheus charset
+    ``[a-zA-Z_:][a-zA-Z0-9_:]*`` — every illegal char becomes ``_``."""
+    out = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+    if not out or not re.match(r"[a-zA-Z_:]", out[0]):
+        out = "_" + out
+    return out
+
+
+def _prom_value(v) -> str:
+    if isinstance(v, bool):
+        return str(int(v))
+    v = float(v)
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+def _numeric(v) -> bool:
+    return isinstance(v, (int, float))  # bool is an int: rendered as 0/1
+
+
+def export_text(reg: MetricsRegistry | None = None) -> str:
+    """Render the registry in the Prometheus text exposition format
+    (version 0.0.4), ready to serve from any HTTP handler.
+
+    Counters and gauges are emitted as-is (one sample each; unset or
+    non-numeric gauges are skipped).  Each :class:`Histogram` becomes a
+    ``summary``: ``<name>_count``/``<name>_sum`` are exact over the whole
+    stream, and the ``quantile``-labelled samples (0.5/0.95/0.99) are
+    nearest-rank percentiles over only the most recent
+    ``Histogram.WINDOW`` (= 512) observations — NOT all-time quantiles;
+    the caveat is restated in each summary's ``# HELP`` line.  Dotted
+    registry names are sanitized to the Prometheus charset
+    (``serving.goodput.frac`` -> ``serving_goodput_frac``).
+    """
+    reg = reg or _REGISTRY
+    lines: list[str] = []
+    for name, m in sorted(reg._metrics.items()):
+        pname = _prom_name(name)
+        if isinstance(m, Counter):
+            lines.append(f"# HELP {pname} Counter {name!r} "
+                         f"(monotonic within the process; reset() rewinds).")
+            lines.append(f"# TYPE {pname} counter")
+            lines.append(f"{pname} {_prom_value(m.value)}")
+        elif isinstance(m, Gauge):
+            if m.value is None or not _numeric(m.value):
+                continue
+            lines.append(f"# HELP {pname} Gauge {name!r} (last written value).")
+            lines.append(f"# TYPE {pname} gauge")
+            lines.append(f"{pname} {_prom_value(m.value)}")
+        elif isinstance(m, Histogram):
+            lines.append(
+                f"# HELP {pname} Summary of {name!r}: _count/_sum are exact "
+                f"over the whole stream; quantiles are nearest-rank over "
+                f"only the last {m.WINDOW} observations (windowed, not "
+                f"all-time); min/max in snapshot() are all-time.")
+            lines.append(f"# TYPE {pname} summary")
+            for q, label in ((50, "0.5"), (95, "0.95"), (99, "0.99")):
+                pv = m.percentile(q)
+                if pv is not None:
+                    lines.append(
+                        f'{pname}{{quantile="{label}"}} {_prom_value(pv)}')
+            lines.append(f"{pname}_sum {_prom_value(m.sum)}")
+            lines.append(f"{pname}_count {_prom_value(m.count)}")
+    return "\n".join(lines) + "\n" if lines else ""
 
 
 #

@@ -24,7 +24,26 @@ from typing import Any, IO
 
 from thunder_tpu.observability.metrics import registry
 
-__all__ = ["StepLogger", "trace_peak_bytes"]
+__all__ = ["StepLogger", "trace_peak_bytes",
+           "REQUEST_SCHEMA_V", "REQUEST_FIELDS_V2"]
+
+#: Version stamp every ``{"event": "request"}`` record carries.  Bumped
+#: when the field set changes so offline readers can dispatch on it.
+#: v2 (ISSUE 18) added ``tokens_recomputed``/``recompute_causes`` from the
+#: goodput ledger (and the ``v`` stamp itself; v1 records have no ``v``).
+REQUEST_SCHEMA_V = 2
+
+#: The complete closed field set a v2 request record may carry (optional
+#: fields are omitted when None).  A reader-side test pins this tuple so
+#: future additions are a deliberate schema bump, not drift.
+REQUEST_FIELDS_V2 = (
+    "event", "v", "rid", "time",
+    "prompt_tokens", "new_tokens", "finish_reason",
+    "ttft_s", "tpot_s", "tokens_per_sec", "queue_s", "e2e_s",
+    "prefill_compiled", "shared_prefix_blocks",
+    "session_id", "priority", "constrained", "preemptions", "error",
+    "tokens_recomputed", "recompute_causes",
+)
 
 
 class StepLogger:
@@ -47,7 +66,10 @@ class StepLogger:
         self._mirror = mirror
         self.steps_logged = 0
         if meta is not None:
-            self._write({"event": "run_start", "time": time.time(), **meta})
+            self._write({"event": "run_start", "time": time.time(),
+                         "request_schema_v": REQUEST_SCHEMA_V,
+                         "request_fields": list(REQUEST_FIELDS_V2),
+                         **meta})
 
     def _write(self, rec: dict) -> None:
         self._f.write(json.dumps(rec) + "\n")
@@ -111,9 +133,14 @@ class StepLogger:
         ``tokens_per_sec``, ``queue_s``, ``e2e_s`` — submit→finish wall
         time) and the ``prefill_compiled`` cold-compile tag passed through
         ``extra``.  ``None`` values are omitted, mirroring
-        :meth:`log_step`."""
+        :meth:`log_step`.
+
+        Records are schema v2 (``"v": 2``, see :data:`REQUEST_FIELDS_V2`):
+        v2 added the goodput-ledger recompute fields ``tokens_recomputed``
+        and ``recompute_causes``."""
         rec: dict[str, Any] = {
             "event": "request",
+            "v": REQUEST_SCHEMA_V,
             "rid": int(rid),
             "time": time.time(),
             "prompt_tokens": int(prompt_tokens),

@@ -14,7 +14,11 @@ spans (``ph: "b"/"e"`` keyed by ``id=rid``) for every request phase:
   ``prefill.chunk`` child span per intermediate piece;
 - ``decode``     — one span per request per decode step (batched requests
   share wall time; each still gets its own span so a request's row reads
-  start-to-finish), annotated with the step index;
+  start-to-finish), annotated with the step index; when the engine runs
+  with ``goodput=True`` each decode-span end also carries the dispatch's
+  goodput tag (committed slots + non-zero waste causes from
+  :mod:`thunder_tpu.observability.goodput`), so the timeline shows *which*
+  steps burned device work on padding, dead rows, or rejected drafts;
 - an instant ``finish``/``deadline``/``evicted``/``eos`` marker.
 
 Spans from the async engine carry a ``lane`` arg (:data:`LANE_DECODE` /

@@ -91,6 +91,7 @@ from thunder_tpu.observability.config import (
     serving_trace_env_enabled,
 )
 from thunder_tpu.observability.flight import FlightRecorder
+from thunder_tpu.observability.goodput import resolve_goodput
 from thunder_tpu.observability.metrics import registry
 from thunder_tpu.observability.slo import resolve_slo
 from thunder_tpu.observability.tracing import RequestTracer
@@ -197,6 +198,8 @@ class RequestResult:
     shared_prefix_blocks: int
     prefill_compiled: bool = False          # the prefill run paid an XLA compile
     error: dict | None = None               # structured cause when quarantined
+    tokens_recomputed: int = 0              # prompt positions re-dispatched by replay
+    recompute_causes: tuple = ()            # why (goodput waste-cause names)
 
     @property
     def tokens(self) -> np.ndarray:
@@ -291,6 +294,7 @@ class ServingEngine:
         sessions=None,
         priorities=None,
         constraints=None,
+        goodput=None,
     ):
         if shardings is not None and mesh is None:
             raise ValueError("shardings= requires mesh= (param placement needs a mesh)")
@@ -567,6 +571,7 @@ class ServingEngine:
         self._m_attn_kernel = reg0.counter("serving.attn.kernel_steps")
         self._m_attn_fallback = reg0.counter("serving.attn.fallback_steps")
         self._m_host_visits = reg0.counter("serving.decode.host_visits")
+        self._m_pool_occ = reg0.gauge("serving.pool.occupancy_frac")
         if speculative is not None:
             self._m_spec_rounds = reg0.counter("serving.spec.rounds")
             self._m_spec_accepted = reg0.counter("serving.spec.accepted_tokens")
@@ -581,6 +586,10 @@ class ServingEngine:
             trace = serving_trace_env_enabled()
         self._tracer = RequestTracer() if trace else None
         self._slo = resolve_slo(slo)
+        # goodput ledger (ISSUE 18): host-side classification of every
+        # dispatched device token-position; never enters _static_key, so
+        # goodput=True compiles zero additional programs
+        self._goodput = resolve_goodput(goodput)
         if flight_recorder is None:
             flight_recorder = flight_recorder_env_enabled()
         if isinstance(flight_recorder, FlightRecorder):
@@ -760,6 +769,7 @@ class ServingEngine:
                 # before the decode batch consumes generated[-1]
                 self._prefill_harvest(self._prefill_dispatch(r))
                 self._release_retired()
+                self._sample_occupancy()
                 worked = True
         if self.scheduler.running:
             self._decode_once()
@@ -829,6 +839,7 @@ class ServingEngine:
             # completed — dropping the parked handles is free now (doing it
             # at dispatch would block the host for the whole device step)
             self._release_retired()
+            self._sample_occupancy()
         return worked
 
     def _release_retired(self) -> None:
@@ -1002,6 +1013,9 @@ class ServingEngine:
                              "preempted": self.preempted}}
                if self._priorities is not None else {}),
             **({"constrained": True} if self._constraints else {}),
+            **({"goodput": self._goodput.snapshot()}
+               if self._goodput is not None else {}),
+            "pool_occupancy": self.pool.occupancy_snapshot(),
         }
 
     def _spec_stats(self) -> dict:
@@ -1032,6 +1046,18 @@ class ServingEngine:
         if self._slo is None:
             return {"enabled": False}
         return self._slo.report()
+
+    def goodput_report(self) -> dict:
+        """Full goodput-ledger report (``goodput=`` at construction; see
+        :mod:`thunder_tpu.observability.goodput`): token-goodput fraction
+        plus per-cause and per-program-kind breakdowns with device-time
+        attribution.  ``{"enabled": False}`` when the ledger is off."""
+        if self._goodput is None:
+            return {"enabled": False}
+        rep = self._goodput.report()
+        if self.replica_id is not None:
+            rep["replica"] = self.replica_id
+        return rep
 
     def _flight_state(self) -> dict:
         """State snapshot the flight recorder embeds in every dump."""
@@ -1068,6 +1094,8 @@ class ServingEngine:
                      "acceptance_rate": self._spec_stats()["acceptance_rate"]}
                     if self.spec is not None else None
                 ),
+                "goodput": (self._goodput.brief()
+                            if self._goodput is not None else None),
             },
             "prefix_share_hit_rate": (self._prefix_hits / lookups) if lookups else None,
             "compiles": list(self._compile_log),         # per-bucket compile causes
@@ -1151,6 +1179,14 @@ class ServingEngine:
         if (shared and self._sessions is not None
                 and self._hit_owner is not None and self._hit_owner < 0):
             self._sessions.note_reattach(self._hit_owner)
+            entry = self._sessions.owner_entry(self._hit_owner)
+            if entry is not None and entry.full_pos:
+                # the parked turn had written full_pos cache slots; the
+                # prompt positions below that watermark and past the shared
+                # blocks are recomputation of the truncated tail
+                req.replay_until = max(
+                    req.replay_until, min(entry.full_pos, req.prompt_len))
+                req.replay_cause = "replay_session_tail"
         n_needed = sch.blocks_needed(req)
         table = self.pool.share(shared) + self.pool.alloc(n_needed - len(shared))
         sch.admit(req, table, len(shared))
@@ -1212,7 +1248,7 @@ class ServingEngine:
         if tr is not None:
             tr.begin(req.rid, "resume", lane="prefill",
                      generated=len(req.generated))
-        self._replay_request(req)
+        self._replay_request(req, cause="replay_preemption")
         self._register_prefix(req, upto=req.pos)
         if tr is not None:
             tr.end(req.rid, "resume", pos=req.pos)
@@ -1290,6 +1326,7 @@ class ServingEngine:
         else:
             self._prefill_harvest(rec)
             self._release_retired()         # token materialized: consumer done
+            self._sample_occupancy()
 
     def _prefill_dispatch(self, req: Request) -> dict:
         """Dispatches the next prefill piece for ``req`` and returns its
@@ -1389,6 +1426,18 @@ class ServingEngine:
             self.draft_pool.set_arenas(darenas)
         req.pos = pos + n_real                             # written (device-ordered)
         self._register_prefix(req, upto=req.pos)
+        if req.replay_until > pos:
+            # recompute bookkeeping (host ints, replay paths only): these
+            # positions were already dispatched once before the replay
+            rn = min(req.replay_until, pos + n_real) - pos
+            req.tokens_recomputed += rn
+            if (req.replay_cause
+                    and req.replay_cause not in req.recompute_causes):
+                req.recompute_causes.append(req.replay_cause)
+        if self._goodput is not None:
+            rec["pkind"] = kind
+            rec["t_disp"] = time.perf_counter()
+            rec["goodput"] = self._account_prefill(req, kind, pos, n_real, Tb)
         reg = registry()
         if final:
             self.prefill_runs += 1
@@ -1416,6 +1465,9 @@ class ServingEngine:
         (TTFT stamps here — token availability, not dispatch)."""
         req, pool = rec["req"], self.pool
         self._fault_point(FP_HARVEST, (req.rid,))
+        gp = self._goodput
+        if gp is not None and "t_disp" in rec:
+            gp.note_device_s(rec["pkind"], time.perf_counter() - rec["t_disp"])
         tr = self._tracer
         if rec["kind"] == "chunk":
             # the scalar fetch doubles as the fence on the chunk execution
@@ -1447,6 +1499,8 @@ class ServingEngine:
             tr.end(req.rid, "prefill.host")
             tr.end(req.rid, "prefill", compile=req.prefill_compiled)
         self.tokens_generated += 1                         # prefill samples token 0
+        if gp is not None:
+            gp.commit_tokens(1)                            # token 0 streams below
         reg = registry()
         reg.counter("serving.tokens").inc()
         if pool.quantized_kv:
@@ -1454,6 +1508,54 @@ class ServingEngine:
             # (sum|dq-x|/sum|x| over non-sink destinations)
             reg.gauge("serving.kv_quant.rel_err").set(float(np.asarray(rec["qerr"])))
         self._emit_token(req, tok0)
+
+    #
+    # goodput / occupancy accounting helpers
+    #
+
+    def _sample_occupancy(self) -> None:
+        """One ``(free, shared, leased)`` sample into the pool's bounded
+        occupancy ring per harvest, mirrored into the
+        ``serving.pool.occupancy_frac`` gauge."""
+        self.pool.sample_occupancy()
+        self._m_pool_occ.set(self.pool.utilization())
+
+    @staticmethod
+    def _sunk_positions(block_table, pos: int, n: int, bs: int) -> int:
+        """How many of the real positions ``[pos, pos + n)`` route their
+        KV write to the sink block (window-expired table entries — the
+        replayed work is recomputed but never attended)."""
+        if n <= 0:
+            return 0
+        sunk = 0
+        for bi in range(pos // bs, -(-(pos + n) // bs)):
+            b = block_table[bi] if bi < len(block_table) else SINK_BLOCK
+            if b == SINK_BLOCK:
+                sunk += min(pos + n, (bi + 1) * bs) - max(pos, bi * bs)
+        return sunk
+
+    def _account_prefill(self, req: Request, kind: str, pos: int,
+                         n_real: int, Tb: int) -> dict:
+        """Classify one prefill-family dispatch (1 row x Tb positions):
+        bucket padding, sink-routed (window-expired) slots, recompute
+        below the request's replay watermark, and fresh committed KV
+        work.  Returns the ledger's compact tag dict."""
+        bs = self.pool.block_size
+        sunk = self._sunk_positions(req.block_table, pos, n_real, bs)
+        replay_n = min(max(req.replay_until - pos, 0), n_real)
+        win = min(sunk, replay_n)          # sunk slots inside the watermark
+        extra_sunk = sunk - win            # defensive: sunk fresh writes
+        cause_n = replay_n - win
+        waste = {}
+        if Tb > n_real:
+            waste["pad_prefill"] = Tb - n_real
+        if sunk:
+            waste["replay_window"] = sunk
+        if cause_n:
+            cause = req.replay_cause or "replay_recovery"
+            waste[cause] = waste.get(cause, 0) + cause_n
+        return self._goodput.account(
+            kind, 1, Tb, committed=n_real - replay_n - extra_sunk, **waste)
 
     #
     # decode
@@ -1474,6 +1576,7 @@ class ServingEngine:
         else:
             self._decode_harvest(rec)
             self._release_retired()         # tokens materialized: consumer done
+            self._sample_occupancy()
 
     def _decode_dispatch(self) -> dict:
         sch, pool = self.scheduler, self.pool
@@ -1588,7 +1691,7 @@ class ServingEngine:
         }
         rec = {"kind": "decode", "running": running, "nxt": nxt,
                "new_keys": new_keys, "pos": host_pos, "bucket": [Bb, nbb],
-               "compiled": compiled, "step": self.decode_steps,
+               "pkind": kind, "compiled": compiled, "step": self.decode_steps,
                "epochs": [r.preemptions for r in running],
                "t_disp": time.perf_counter(), "t_clock": sch.clock()}
         if N > 1:
@@ -1623,19 +1726,45 @@ class ServingEngine:
             self._overlap_obs += 1
             self._m_stall.observe(stall)
             self._m_overlap.set(frac)
+        epochs = rec.get("epochs")
+        gp, gtag = self._goodput, None
+        if gp is not None:
+            # exact pre-emit classification of this visit's Bb x 1 slots:
+            # every non-skipped row streams exactly one token
+            Bb = rec["bucket"][0]
+            n_stale = n_dead = live = 0
+            for i, r in enumerate(running):
+                if epochs is not None and r.preemptions != epochs[i]:
+                    n_stale += 1                           # preempted: chain re-derives it
+                elif r.state != "running":
+                    n_dead += 1                            # finished while in flight
+                else:
+                    live += 1
+            waste = {}
+            if Bb > len(running):
+                waste["pad_row"] = Bb - len(running)
+            if n_stale:
+                waste["replay_preemption"] = n_stale
+            if n_dead:
+                waste["dead_scan_row"] = n_dead
+            gtag = gp.account(rec["pkind"], Bb, 1, committed=live, **waste)
+            gp.note_device_s(rec["pkind"],
+                             time.perf_counter() - rec["t_disp"])
         tr = self._tracer
         if tr is not None:                                 # tokens host-visible
             for r in running:
-                tr.end(r.rid, "decode")
+                tr.end(r.rid, "decode",
+                       **({"goodput": gtag} if gtag is not None else {}))
         if self._flight is not None:
             self._flight.record("decode", step=rec["step"],
                                 batch=len(running), bucket=rec["bucket"],
                                 compiled=rec["compiled"],
-                                rids=[r.rid for r in running])
+                                rids=[r.rid for r in running],
+                                **({"goodput": gtag}
+                                   if gtag is not None else {}))
         pos = rec["pos"]
         emitted = 0
         invalidate = False
-        epochs = rec.get("epochs")
         for i, r in enumerate(running):
             if r.state != "running" or (
                     epochs is not None and r.preemptions != epochs[i]):
@@ -1667,6 +1796,8 @@ class ServingEngine:
         self._m_host_visits.inc()
         if emitted:
             self._m_tokens.inc(emitted)
+        if gp is not None:
+            gp.commit_tokens(emitted)
         if invalidate:
             # the chained decode inputs assumed an unchanged batch/tables;
             # the next dispatch rebuilds from host state
@@ -1702,21 +1833,58 @@ class ServingEngine:
             self._m_overlap.set(frac)
         tr = self._tracer
         harvested = [int(emit[:, i].sum()) for i in range(len(running))]
+        epochs = rec.get("epochs")
+        gp, gtag = self._goodput, None
+        if gp is not None:
+            # exact pre-emit classification of the Bb x N scan slots: the
+            # in-program done predicate coincides with _emit_token's finish
+            # conditions, so a live row streams min(k, budget, eos-cut)
+            # tokens and its remaining iterations were dead scan rows
+            Bb = rec["bucket"][0]
+            committed = n_stale = n_dead = 0
+            for i, r in enumerate(running):
+                if epochs is not None and r.preemptions != epochs[i]:
+                    n_stale += N
+                elif r.state != "running":
+                    n_dead += N
+                else:
+                    streamed = min(harvested[i],
+                                   r.max_new_tokens - len(r.generated))
+                    if self.eos_id is not None:
+                        for s in range(streamed):
+                            if int(nxt[s, i]) == self.eos_id:
+                                streamed = s + 1
+                                break
+                    committed += streamed
+                    n_dead += N - streamed
+            waste = {}
+            if Bb > len(running):
+                waste["pad_row"] = (Bb - len(running)) * N
+            if n_stale:
+                waste["replay_preemption"] = n_stale
+            if n_dead:
+                waste["dead_scan_row"] = n_dead
+            gtag = gp.account(rec["pkind"], Bb, N, committed=committed,
+                              **waste)
+            gp.note_device_s(rec["pkind"],
+                             time.perf_counter() - rec["t_disp"])
         if tr is not None:                                 # tokens host-visible
             # one span per request per HOST VISIT (not N phantom per-token
             # spans): tagged with how many of the N steps actually emitted
             for i, r in enumerate(running):
-                tr.end(r.rid, "decode", harvested=harvested[i])
+                tr.end(r.rid, "decode", harvested=harvested[i],
+                       **({"goodput": gtag} if gtag is not None else {}))
         if self._flight is not None:
             self._flight.record("decode", step=rec["step"],
                                 batch=len(running), bucket=rec["bucket"],
                                 compiled=rec["compiled"], steps=N,
                                 harvested=harvested,
-                                rids=[r.rid for r in running])
+                                rids=[r.rid for r in running],
+                                **({"goodput": gtag}
+                                   if gtag is not None else {}))
         pos = rec["pos"]
         emitted = 0
         invalidate = False
-        epochs = rec.get("epochs")
         for i, r in enumerate(running):
             if r.state != "running" or (
                     epochs is not None and r.preemptions != epochs[i]):
@@ -1750,6 +1918,8 @@ class ServingEngine:
         self._m_host_visits.inc()
         if emitted:
             self._m_tokens.inc(emitted)
+        if gp is not None:
+            gp.commit_tokens(emitted)
         if invalidate:
             self._decode_state = None
 
@@ -1840,6 +2010,9 @@ class ServingEngine:
                 constrained=(True if req.constraint is not None else None),
                 preemptions=(req.preemptions or None),
                 error=req.error_cause,
+                tokens_recomputed=(req.tokens_recomputed or None),
+                recompute_causes=(list(req.recompute_causes)
+                                  if req.recompute_causes else None),
             )
 
     def _park_session(self, req: Request) -> None:
@@ -1857,7 +2030,7 @@ class ServingEngine:
         nblk = min(req.pos // bs, len(req.block_table))
         entry = self._sessions.park(
             req.session_id, full[:nblk * bs], req.block_table[:nblk],
-            adapter_slot=req.adapter_slot)
+            adapter_slot=req.adapter_slot, full_pos=req.pos)
         if self._flight is not None:
             self._flight.record(
                 "session_park", rid=req.rid, session_id=req.session_id,
@@ -1913,6 +2086,8 @@ class ServingEngine:
             shared_prefix_blocks=req.n_shared_blocks,
             prefill_compiled=req.prefill_compiled,
             error=req.error_cause,
+            tokens_recomputed=req.tokens_recomputed,
+            recompute_causes=tuple(req.recompute_causes),
         )
 
     def _update_gauges(self) -> None:
@@ -2067,7 +2242,7 @@ class ServingEngine:
         path (their key was never split, so token 0 is unchanged); shared-
         prefix blocks are rewritten by every co-owner with bit-identical
         content (the forward pass is deterministic)."""
-        self._discard_inflight()
+        self._discard_inflight(cause="replay_recovery")
         self.pool.rebuild_arenas()
         if self.draft_pool is not None:
             # the draft arena is soft state too: the replay below rebuilds
@@ -2083,11 +2258,18 @@ class ServingEngine:
             # block with identical content (deterministic forward).
             for entry in self._sessions.entries():
                 self._replay_seq(entry.tokens, list(entry.blocks),
-                                 entry.adapter_slot, len(entry.tokens))
+                                 entry.adapter_slot, len(entry.tokens),
+                                 cause="replay_recovery")
         for req in list(self.scheduler.running):
+            if req.pos and not req.generated:
+                # token-0 requests re-run the normal prefill path from 0:
+                # the positions their admission prefill already wrote are
+                # recomputation chargeable to the recovery
+                req.replay_until = max(req.replay_until, req.pos)
+                req.replay_cause = "replay_recovery"
             req.pos = 0
             if req.generated:
-                self._replay_request(req)
+                self._replay_request(req, cause="replay_recovery")
         if not self.async_step:
             # the sync loop has no prefill lane; re-prefill token-0
             # requests inline so the next decode batch has a history row
@@ -2097,7 +2279,8 @@ class ServingEngine:
                     self._prefill_harvest(self._prefill_dispatch(req))
                     self._release_retired()
 
-    def _replay_request(self, req: Request) -> None:
+    def _replay_request(self, req: Request, *,
+                        cause: str = "replay_recovery") -> None:
         """Replays ``req``'s known sequence (prompt + all but the last
         emitted token) into its blocks through the sampling-free
         ``prefill_chunk`` program.  After the replay the written KV covers
@@ -2111,10 +2294,11 @@ class ServingEngine:
             req.prompt, np.asarray(req.generated[:n - 1], dtype=np.int32),
         ])
         self._replay_seq(seq, req.block_table, req.adapter_slot,
-                         req.prompt_len + n - 1, req=req)
+                         req.prompt_len + n - 1, req=req, cause=cause)
 
     def _replay_seq(self, seq, block_table, adapter_slot: int,
-                    target: int, *, req: Request | None = None) -> None:
+                    target: int, *, req: Request | None = None,
+                    cause: str = "replay_recovery") -> None:
         """The chunk-replay engine under :meth:`_replay_request` and the
         resident-session recovery replay: writes KV for ``seq[:target]``
         into ``block_table`` through the sampling-free ``prefill_chunk``
@@ -2131,6 +2315,7 @@ class ServingEngine:
             piece = max(piece, target)
         pos = 0
         while pos < target:
+            t_disp = time.perf_counter() if self._goodput is not None else 0.0
             n_real = min(target - pos, piece)
             Tb = sch.prefill_bucket(n_real)
             nbb = self._nbb(max(len(block_table), -(-(pos + Tb) // bs)))
@@ -2159,26 +2344,65 @@ class ServingEngine:
                     jnp.asarray([adapter_slot], dtype=jnp.int32),
                 )
             pool.set_arenas(arenas)
+            if req is not None:
+                # every real position of a replay piece is recomputation
+                req.tokens_recomputed += n_real
+                if cause not in req.recompute_causes:
+                    req.recompute_causes.append(cause)
+            gp = self._goodput
+            if gp is not None:
+                # replay pieces never stream: real positions are the given
+                # replay cause, except sink-routed (window-expired) slots
+                kind = ("spec_prefill_chunk" if self.spec is not None
+                        else "prefill_chunk")
+                sunk = self._sunk_positions(block_table, pos, n_real, bs)
+                waste = {}
+                if Tb > n_real:
+                    waste["pad_prefill"] = Tb - n_real
+                if sunk:
+                    waste["replay_window"] = sunk
+                if n_real > sunk:
+                    waste[cause] = waste.get(cause, 0) + (n_real - sunk)
+                gp.account(kind, 1, Tb, committed=0, **waste)
             pos = pos + n_real
             if req is not None:
                 req.pos = pos
             float(np.asarray(qerr))        # fence this piece before the next
+            if gp is not None:
+                gp.note_device_s(
+                    "spec_prefill_chunk" if self.spec is not None
+                    else "prefill_chunk", time.perf_counter() - t_disp)
             self._release_retired()
             self.chunk_runs += 1
             registry().counter("serving.steps.prefill_chunk").inc()
 
-    def _discard_inflight(self) -> None:
+    def _discard_inflight(self, cause: str = "dead_scan_row") -> None:
         """Drops every in-flight future record (their tokens were never
         promised) plus the parked donated-arena handles: recovery and
         ``shutdown()`` must not leak futures or retired handles past the
         engine's life.  The derefs may block briefly until the consuming
         executions finish — this is the slow path, correctness over
-        overlap."""
+        overlap.  ``cause`` classifies the discarded decode dispatch's
+        device slots in the goodput ledger (``replay_recovery`` from
+        recovery; the ``dead_scan_row`` default from shutdown)."""
         rec, self._inflight_decode = self._inflight_decode, None
         tr = self._tracer
         if rec is not None and tr is not None:
             for r in rec["running"]:
                 tr.end(r.rid, "decode", aborted=True)
+        gp = self._goodput
+        if gp is not None and rec is not None:
+            # the dispatch ran on device but will never be harvested: every
+            # slot is waste (prefill pieces were accounted at dispatch)
+            Bb = rec["bucket"][0]
+            if rec.get("spec"):
+                K = self.spec.K
+                gp.account("draft_decode", Bb, K, **{cause: Bb * K})
+                vkind = rec.get("vkind", "verify")
+                gp.account(vkind, Bb, K + 1, **{cause: Bb * (K + 1)})
+            else:
+                n = rec.get("multi", 1)
+                gp.account(rec["pkind"], Bb, n, **{cause: Bb * n})
         pending, self._inflight_prefill = self._inflight_prefill, []
         if tr is not None:
             for prec in pending:

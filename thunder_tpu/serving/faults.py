@@ -34,6 +34,14 @@ layer (:mod:`serving.engine`) speaks:
 ``tt.serve(..., fault_plan=...)`` accepts a plan/spec/dict/list, and
 ``THUNDER_TPU_FAULT_PLAN`` (JSON) arms engines from the environment —
 chaos-test a deployment without touching its code.
+
+Recovery's re-prefill replay (and the device work a fault strands in
+flight) is attributed, not hidden: with ``goodput=True`` the engine
+charges discarded in-flight dispatches and every replayed position to
+the ``replay_recovery`` waste cause in the goodput ledger and bills the
+affected :class:`RequestResult` (``tokens_recomputed`` /
+``recompute_causes``) — the chaos soak's recovery cost is a number, not
+a vibe.
 """
 from __future__ import annotations
 

@@ -43,6 +43,7 @@ Design points:
 """
 from __future__ import annotations
 
+from collections import deque
 from typing import Sequence
 
 import jax
@@ -53,9 +54,12 @@ from thunder_tpu.models.generate import kv_block_shape
 from thunder_tpu.serving.quant import is_quantized_kv, resolve_kv_dtype
 
 __all__ = ["PoolExhaustedError", "ArenaMismatchError", "PagedKVPool",
-           "PrefixIndex", "chunk_tables", "dest_for_pos"]
+           "PrefixIndex", "chunk_tables", "dest_for_pos",
+           "OCCUPANCY_WINDOW"]
 
 SINK_BLOCK = 0  # reserved physical block for padding/expired table entries
+
+OCCUPANCY_WINDOW = 128  # samples retained in the occupancy timeline ring
 
 
 class PoolExhaustedError(RuntimeError):
@@ -147,6 +151,9 @@ class PagedKVPool:
         # capacity-exhaustion post-mortems need the floor, not the current
         # value: the low-water mark survives into the flight-recorder dump
         self._free_low_water = len(self._free)
+        # occupancy timeline: bounded ring of (free, shared, leased) triples
+        # sampled at each harvest — the low-water mark alone hides spikes
+        self._occ_ring: deque = deque(maxlen=OCCUPANCY_WINDOW)
 
     #
     # allocator
@@ -220,6 +227,34 @@ class PagedKVPool:
     def refcount(self, block: int) -> int:
         return int(self._refcount[block])
 
+    def sample_occupancy(self) -> tuple[int, int, int]:
+        """Append one ``(free, shared, leased)`` sample to the bounded
+        occupancy ring (the engine calls this once per harvest) and
+        return it.  O(num_blocks) numpy scan; ring stays O(1) memory."""
+        counts = self._refcount[SINK_BLOCK + 1:]
+        sample = (self.num_free, int((counts > 1).sum()),
+                  int((counts > 0).sum()))
+        self._occ_ring.append(sample)
+        return sample
+
+    def occupancy_timeline(self) -> list[tuple[int, int, int]]:
+        """The retained ``(free, shared, leased)`` samples, oldest first
+        (at most :data:`OCCUPANCY_WINDOW` — spikes between crashes stay
+        visible, unlike the low-water scalar alone)."""
+        return list(self._occ_ring)
+
+    def occupancy_snapshot(self) -> dict:
+        """Summary of the timeline for ``stats()``: sample count, window,
+        the latest triple, and the peak leased-block count observed."""
+        tl = self._occ_ring
+        return {
+            "window": OCCUPANCY_WINDOW,
+            "samples": len(tl),
+            "last": tl[-1] if tl else None,
+            "peak_leased": max((s[2] for s in tl), default=0),
+            "occupancy_frac": self.utilization(),
+        }
+
     def state_snapshot(self) -> dict:
         """Allocator state for the flight recorder: occupancy plus the
         free-list/sharing breakdown (the paged-pool notion of
@@ -236,6 +271,7 @@ class PagedKVPool:
             "lease_refs": int(counts.sum()),
             "kv_dtype": str(self.kv_dtype),
             "arena_bytes": self.arena_bytes(),
+            "occupancy_timeline": [list(s) for s in self._occ_ring],
         }
         if self.arena_sharding is not None:
             snap["arena_spec"] = str(self.arena_sharding.spec)

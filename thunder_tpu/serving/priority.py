@@ -24,6 +24,12 @@ re-queue".  On re-admission the victim's sequence is rebuilt through the
 sampling-free ``prefill_chunk`` replay — bucket-wide pieces, never
 token-by-token — and decode continues from the identical key chain, so a
 preempted-then-resumed stream is bit-identical to an undisturbed run.
+The replay is not free, and with ``goodput=True`` it is not invisible
+either: the engine charges every replayed position to the
+``replay_preemption`` waste cause in the goodput ledger and bills the
+victim's :class:`RequestResult` (``tokens_recomputed`` /
+``recompute_causes``), so preemption pressure shows up as attributed
+device work, not silent throughput loss.
 
 With ``priorities=None`` (default) nothing changes: every request takes
 the same level, insertion degrades to append, the gate never runs, and

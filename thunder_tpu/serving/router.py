@@ -73,6 +73,7 @@ from typing import Any, Callable, Sequence
 import jax
 import numpy as np
 
+from thunder_tpu.observability.goodput import fleet_goodput
 from thunder_tpu.observability.metrics import registry
 from thunder_tpu.serving.engine import (
     EngineStalledError,
@@ -489,7 +490,23 @@ class ReplicatedEngine:
                 **({"preempted": sum(p["priority"]["preempted"]
                                      for p in per if "priority" in p)}
                    if any("priority" in p for p in per) else {}),
+                **({"goodput": fleet_goodput(
+                        [p["goodput"] for p in per if "goodput" in p])}
+                   if any("goodput" in p for p in per) else {}),
             },
+        }
+
+    def goodput_report(self) -> dict:
+        """Fleet goodput: the summed waste taxonomy plus per-lane reports
+        and the committed-work imbalance figure (see
+        :func:`thunder_tpu.observability.goodput.fleet_goodput`).  Lanes
+        with the ledger disabled report ``{"enabled": False}``."""
+        per = [eng.goodput_report() for eng in self._engines]
+        snaps = [p for p in per if p.get("enabled", True)]
+        return {
+            "replicas": len(self._engines),
+            "per_replica": per,
+            **(fleet_goodput(snaps) if snaps else {"enabled": False}),
         }
 
     #

@@ -128,6 +128,14 @@ class Request:
     finish_t: float | None = None
     prefill_compiled: bool = False          # this request's prefill paid an XLA compile
     error_cause: dict | None = None         # structured cause when quarantined
+    # recompute accounting (goodput ledger, ISSUE 18): prompt positions
+    # re-dispatched by replay (recovery/preemption/session re-attach) and
+    # why; replay_until marks the watermark below which prefill positions
+    # are recomputation rather than fresh work
+    tokens_recomputed: int = 0
+    recompute_causes: list = field(default_factory=list)
+    replay_until: int = 0
+    replay_cause: str | None = None
 
     @property
     def prompt_len(self) -> int:

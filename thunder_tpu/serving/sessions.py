@@ -92,6 +92,10 @@ class SessionEntry:
     blocks: tuple[int, ...]   # table-held pool.share() references
     adapter_slot: int         # LoRA slot the KV was computed under
     nbytes: int
+    # cache positions the parked turn had written in total: positions past
+    # len(blocks)*block_size were truncated at park and must be recomputed
+    # on re-attach (goodput cause "replay_session_tail")
+    full_pos: int = 0
 
 
 class SessionTable:
@@ -146,7 +150,7 @@ class SessionTable:
 
     # -- mutation -----------------------------------------------------------
     def park(self, session_id: str, tokens, blocks, *,
-             adapter_slot: int = 0) -> SessionEntry | None:
+             adapter_slot: int = 0, full_pos: int = 0) -> SessionEntry | None:
         """Retain ``blocks`` (holding exactly ``tokens``) for the session.
 
         Shares the blocks *before* releasing any prior entry for the same
@@ -175,7 +179,8 @@ class SessionTable:
         entry = SessionEntry(session_id=session_id,
                              owner_rid=next(self._owner_ids),
                              tokens=tokens, blocks=blocks,
-                             adapter_slot=int(adapter_slot), nbytes=nbytes)
+                             adapter_slot=int(adapter_slot), nbytes=nbytes,
+                             full_pos=int(full_pos))
         self._entries[session_id] = entry
         self._by_owner[entry.owner_rid] = entry
         self.index.register(entry.owner_rid, tokens, list(blocks),

@@ -554,7 +554,7 @@ def spec_decode_dispatch(eng) -> dict:
     }
     rec = {"kind": "decode", "spec": True, "running": running,
            "emitted": emitted, "n_emit": n_emit, "new_keys": new_keys,
-           "pos": host_pos, "bucket": [Bb, nbb],
+           "pos": host_pos, "bucket": [Bb, nbb], "vkind": vkind,
            "compiled": dcompiled or vcompiled, "step": eng.decode_steps,
            "t_disp": time.perf_counter(), "t_clock": sch.clock()}
     eng.decode_steps += 1
@@ -591,17 +591,63 @@ def spec_decode_harvest(eng, rec: dict) -> None:
         eng._overlap_obs += 1
         eng._m_stall.observe(stall)
         eng._m_overlap.set(frac)
+    K = eng.spec.K
+    gp, gtag = eng._goodput, None
+    if gp is not None:
+        # exact pre-emit classification of the round's device slots, two
+        # dispatches per round.  Draft (Bb x K): accepted positions are
+        # committed from the verifier's ne-1 (trim-independent, so the
+        # ledger's acceptance integers reproduce spec_accepted_tokens /
+        # spec_draft_tokens exactly); the rest were rejected.  Verify
+        # (Bb x (K+1)): committed slots are the tokens that actually
+        # stream; unused verify positions are draft_rejected; accepted-
+        # but-trimmed (EOS/length mid-round) slots are dead scan rows.
+        Bb = rec["bucket"][0]
+        d_comm = d_rej = d_dead = 0
+        v_comm = v_rej = v_dead = 0
+        for i, r in enumerate(running):
+            if r.state != "running":
+                d_dead += K
+                v_dead += K + 1
+                continue
+            ne = int(n_emit[i])
+            d_comm += ne - 1
+            d_rej += K - (ne - 1)
+            streamed = min(ne, r.max_new_tokens - len(r.generated))
+            if eng.eos_id is not None:
+                for s in range(streamed):
+                    if int(emitted[i, s]) == eng.eos_id:
+                        streamed = s + 1
+                        break
+            v_comm += streamed
+            v_rej += (K + 1) - ne
+            v_dead += ne - streamed
+        npad = Bb - len(running)
+        gp.account("draft_decode", Bb, K, committed=d_comm,
+                   **{k: v for k, v in (("pad_row", npad * K),
+                                        ("draft_rejected", d_rej),
+                                        ("dead_scan_row", d_dead)) if v})
+        gtag = gp.account(rec["vkind"], Bb, K + 1, committed=v_comm,
+                          **{k: v for k, v in (("pad_row", npad * (K + 1)),
+                                               ("draft_rejected", v_rej),
+                                               ("dead_scan_row", v_dead))
+                             if v})
+        # one wall interval covers both programs: split by their slot share
+        dt = time.perf_counter() - rec["t_disp"]
+        gp.note_device_s("draft_decode", dt * K / (2 * K + 1))
+        gp.note_device_s(rec["vkind"], dt * (K + 1) / (2 * K + 1))
     tr = eng._tracer
     if tr is not None:                                 # tokens host-visible
         for r in running:
-            tr.end(r.rid, "decode")
+            tr.end(r.rid, "decode",
+                   **({"goodput": gtag} if gtag is not None else {}))
     if eng._flight is not None:
         eng._flight.record("decode", step=rec["step"], batch=len(running),
                            bucket=rec["bucket"], compiled=rec["compiled"],
                            rids=[r.rid for r in running], spec=True,
-                           accept_len=[int(n_emit[i]) for i in range(len(running))])
+                           accept_len=[int(n_emit[i]) for i in range(len(running))],
+                           **({"goodput": gtag} if gtag is not None else {}))
     pos = rec["pos"]
-    K = eng.spec.K
     count = 0
     invalidate = False
     for i, r in enumerate(running):
@@ -626,6 +672,8 @@ def spec_decode_harvest(eng, rec: dict) -> None:
                 # same overshoot off its fixed buffer)
                 invalidate = True
                 break
+    if gp is not None:
+        gp.commit_tokens(count)
     eng.tokens_generated += count
     eng.decode_lane_tokens += count
     eng.host_visits += 1

@@ -38,6 +38,8 @@ __all__ = [
     "check_serving_spec_targets",
     "check_serving_dp_targets",
     "check_multistep_targets",
+    "check_sessions_targets",
+    "check_goodput_targets",
 ]
 
 # generous: CI hosts jitter, and the gate exists to catch the donate=False
@@ -711,4 +713,51 @@ def check_sessions_targets(artifact: dict | None = None, *,
         f"paid an XLA compile — the TTFT windows are polluted by cold "
         f"starts"
     )
+    return artifact
+
+
+def check_goodput_targets(artifact: dict | None = None, *,
+                          max_overhead: float = 1.05) -> dict:
+    """Validates the BENCH_GOODPUT.json artifact: schema, the **exact**
+    conservation identity on the measured engines (committed + waste ==
+    positions as integers, committed_tokens == streamed), the ledger's
+    observation overhead against the identical ``goodput=False`` engine
+    (min-of-reps, default bar 1.05x), exact integer agreement between the
+    ledger's draft-kind accounting and the speculative engine's own
+    acceptance counters, and zero programs compiled for observation.
+    Returns the artifact for chaining."""
+    if artifact is None:
+        artifact = load_artifact("BENCH_GOODPUT.json")
+    assert "backend" in artifact and "results" in artifact, sorted(artifact)
+    r = artifact["results"]
+    for key in (
+        "off_ms", "on_ms", "overhead_ratio_x", "conservation_exact",
+        "goodput_frac", "token_goodput_frac", "waste",
+        "spec_acceptance_exact", "spec_accepted_tokens", "spec_draft_tokens",
+        "new_programs_with_goodput", "reps",
+    ):
+        assert key in r, (key, sorted(r))
+    assert r["conservation_exact"] is True, (
+        "goodput conservation violated in-bench: the ledger's committed + "
+        "waste buckets did not reproduce rows x positions (or "
+        "committed_tokens diverged from the streamed count) — the report "
+        "is supposed to be an identity, not a sample"
+    )
+    assert r["overhead_ratio_x"] <= max_overhead, (
+        f"goodput=True engine ran {r['overhead_ratio_x']:.3f}x the "
+        f"goodput=False engine (> {max_overhead}x) — the ledger's "
+        f"observation overhead is leaking onto the serving path"
+    )
+    assert r["spec_acceptance_exact"] is True, (
+        "the ledger's draft-kind integers diverged from the speculative "
+        "engine's own acceptance counters — the waste taxonomy must "
+        "reproduce spec_accepted_tokens / spec_draft_tokens exactly, "
+        "not approximate them"
+    )
+    assert r["new_programs_with_goodput"] == 0, (
+        f"{r['new_programs_with_goodput']} programs compiled for "
+        f"observation — the ledger must never enter program identity "
+        f"(goodput is host arithmetic, not device code)"
+    )
+    assert 0.0 <= r["token_goodput_frac"] <= r["goodput_frac"] <= 1.0, r
     return artifact

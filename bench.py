@@ -999,6 +999,33 @@ def main():
             "results": out["results"],
         }))
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "ragged":
+        # ragged paged decode + paged chunk-prefill bench: blocks walked vs
+        # real on a mixed 64/1024-token occupancy-8 cohort (the bucket tax
+        # the ragged clamp stops paying, gated >= 2x), exact token parity
+        # vs the gather twins, analytic chunk arena-traffic ratio, and the
+        # zero-new-programs warm-engine contract.
+        from thunder_tpu._platform import force_cpu
+
+        force_cpu()
+        from thunder_tpu.benchmarks.ragged import ragged_bench
+
+        out = ragged_bench(on_tpu=False)
+        artifact = {"backend": jax.default_backend(), **out}
+        with open("BENCH_RAGGED.json", "w") as f:
+            json.dump(artifact, f, indent=1)
+        for k, v in out["results"].items():
+            log(f"ragged {k}: {v}")
+        print(json.dumps({
+            "metric": "ragged_blocks_walked_over_real_x",
+            "value": out["results"]["blocks_ratio_x"],
+            "unit": "x",
+            # the bucketed walk (what every step paid pre-ragged) IS the
+            # baseline of this ratio
+            "vs_baseline": out["results"]["blocks_ratio_x"],
+            "results": out["results"],
+        }))
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "serving_spec":
         # speculative-serving bench: draft/verify lane vs the plain decode
         # engine at occupancy 8 with a high-acceptance draft (the 1-layer

@@ -1,0 +1,154 @@
+"""Ragged paged decode + paged chunk-prefill bench (`bench.py ragged`).
+
+Four claims, one artifact (BENCH_RAGGED.json):
+
+1. **Blocks walked vs real** — the headline: on a mixed short/long cohort
+   sharing one decode bucket, the compiled grid "walks" ``Bb x nbb`` blocks
+   per step but the ragged clamp streams only each request's actual block
+   count.  The goodput ledger records both integers per dispatch
+   (position math, fully deterministic), and the walked/real ratio is
+   gated ≥ 2x at the committed cohort — the bucket tax the ragged kernel
+   stops paying.
+2. **Token parity** — gated on every backend: the ragged paged engine and
+   the chunked paged-prefill engine serve tokens bit-identical to their
+   gather twins over the same workloads.
+3. **Chunk arena traffic** — the *why* of ``prefill_chunk_paged``: the
+   gather chunk round-trips the whole bucketed dense cache per piece
+   (arena→dense gather, dense re-write, full-arena scatter copy under
+   donation) where the paged chunk reads table blocks once and writes only
+   the chunk's blocks.  Byte counts are analytic (static shapes), the
+   ratio is gated > 1.
+4. **Program identity** — raggedness is data and the chunk kind swaps 1:1
+   for the gather chunk kind, so a warm engine compiles ZERO new programs
+   and the compile count stays inside the engine's own bucket bound.
+
+Wall-clock is recorded but informational: on CPU the kernels run in Pallas
+interpret mode, so throughput claims wait for a real TPU window.
+"""
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def ragged_bench(on_tpu: bool = False, *, smoke: bool = False) -> dict:
+    """Returns ``{"shapes": ..., "results": ...}`` in the BENCH_MICRO
+    artifact shape.  ``smoke=True`` shrinks the cohort (3x16 + 1x64-token,
+    block_size 4) for CI; the committed artifact uses the full
+    6x64 + 2x1024-token occupancy-8 cohort at block_size 16."""
+    import thunder_tpu as tt
+    from thunder_tpu.models import llama
+
+    if smoke:
+        bs, nbb, Bb = 4, 18, 4
+        short_len, long_len, n_short, n_long = 16, 64, 3, 1
+        prefill_buckets, chunk = (16, 64), 16
+        chunk_long = 32
+        num_blocks, max_new, seq_cap = 64, 6, 128
+    else:
+        bs, nbb, Bb = 16, 66, 8
+        short_len, long_len, n_short, n_long = 64, 1024, 6, 2
+        prefill_buckets, chunk = (64, 1024), 64
+        chunk_long = 256
+        num_blocks, max_new, seq_cap = 192, 8, 1152
+
+    cfg = llama.Config.from_name(
+        "tiny-llama-debug",
+        n_layer=2, n_head=4, n_query_groups=2, n_embd=32,
+        intermediate_size=64, vocab_size=64, block_size=seq_cap,
+    )
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    prompts = (
+        [rng.integers(0, cfg.vocab_size, (short_len,)).astype(np.int32)
+         for _ in range(n_short)]
+        + [rng.integers(0, cfg.vocab_size, (long_len,)).astype(np.int32)
+           for _ in range(n_long)]
+    )
+    base_kw = dict(block_size=bs, num_blocks=num_blocks, max_batch=Bb,
+                   cache_dtype=jnp.float32, batch_buckets=(Bb,),
+                   block_buckets=(nbb,), prefill_buckets=prefill_buckets)
+
+    def drive(attn, reqs, **extra_kw):
+        eng = tt.serve(None, params, cfg, attn=attn, **base_kw, **extra_kw)
+        hs = [eng.submit(p, max_new_tokens=max_new) for p in reqs]
+        t0 = time.perf_counter()
+        eng.drain()
+        dt = time.perf_counter() - t0
+        return [tuple(h.result(drive=False).tokens) for h in hs], dt, eng
+
+    # 1+2: mixed cohort, ragged ledger off the paged engine, parity vs gather
+    toks_g, gather_s, _ = drive("gather", prompts)
+    toks_p, paged_s, eng_p = drive("paged", prompts, goodput=True)
+    parity_ok = toks_g == toks_p
+    tokens_checked = sum(len(t) for t in toks_g)
+    blk = eng_p.stats()["goodput"]["blocks"]
+    walked, real = blk["walked"], blk["real"]
+    per_kind = eng_p.goodput_report().get("blocks_per_kind", {})
+    decode_dispatches = sum(
+        row["dispatches"] for k, row in per_kind.items() if k.startswith("decode"))
+
+    # 2 again: chunked prefill, paged chunk vs gather chunk
+    chunk_kw = dict(prefill_chunk=chunk)
+    chunk_prompts = [prompts[0],
+                     rng.integers(0, cfg.vocab_size,
+                                  (chunk_long,)).astype(np.int32)]
+    ctoks_g, _, _ = drive("gather", chunk_prompts, **chunk_kw)
+    ctoks_p, _, eng_c = drive("paged", chunk_prompts, **chunk_kw)
+    chunk_parity_ok = ctoks_g == ctoks_p
+    chunk_st = eng_c.stats()["attn"]["kinds"]["prefill_chunk"]
+
+    # 4: a warm engine (identical config, module program cache already
+    # carries every program) must compile nothing
+    toks_w, _, eng_w = drive("paged", prompts, goodput=True)
+    warm_new_programs = sum(eng_w.stats()["compile_counts"].values())
+    warm_parity_ok = toks_w == toks_p
+    bucket_bound = eng_p.stats()["bucket_bound"]
+    compiles_total = sum(eng_p.stats()["compile_counts"].values())
+
+    # 3: analytic per-chunk-piece arena traffic (static shapes, f32).
+    # gather chunk: arena->dense gather (K+V), the dense re-write inside
+    # attention, and the scatter's full-arena copy under donation; paged
+    # chunk: the kernel reads each table block once (bounded by the dense
+    # cache) and writes only the chunk's own blocks.
+    L, ng, hd = cfg.n_layer, cfg.n_query_groups, cfg.head_size
+    itm = 4
+    dense_elems = nbb * bs * L * ng * hd          # one K or V dense cache
+    arena_elems = num_blocks * bs * L * ng * hd   # one whole arena
+    chunk_elems = chunk * L * ng * hd             # the piece's own tokens
+    gather_chunk_bytes = 2 * itm * (3 * dense_elems + arena_elems)
+    paged_chunk_bytes = 2 * itm * (dense_elems + chunk_elems)
+    chunk_ratio = gather_chunk_bytes / paged_chunk_bytes
+
+    return {
+        "shapes": {
+            "cfg": "tiny-llama-debug(2L,4h,2g)",
+            "cohort": f"{n_short}x{short_len} + {n_long}x{long_len} tokens",
+            "max_new_tokens": max_new, "bucket": [Bb, nbb], "block_size": bs,
+            "prefill_chunk": chunk, "chunk_prompt": chunk_long,
+        },
+        "results": {
+            **({"smoke": True} if smoke else {}),
+            "parity_ok": bool(parity_ok),
+            "tokens_checked": int(tokens_checked),
+            "blocks_walked": int(walked),
+            "blocks_real": int(real),
+            "blocks_ratio_x": round(walked / max(real, 1), 3),
+            "decode_dispatches": int(decode_dispatches),
+            "chunk_parity_ok": bool(chunk_parity_ok),
+            "chunk_attn_mode": chunk_st["mode"],
+            "chunk_kernel_steps": int(chunk_st["kernel_steps"]),
+            "gather_chunk_bytes_per_piece": int(gather_chunk_bytes),
+            "paged_chunk_bytes_per_piece": int(paged_chunk_bytes),
+            "chunk_traffic_ratio_x": round(chunk_ratio, 3),
+            "warm_engine_new_programs": int(warm_new_programs),
+            "warm_parity_ok": bool(warm_parity_ok),
+            "bucket_bound": int(bucket_bound),
+            "compiles_total": int(compiles_total),
+            "drive_gather_ms": round(gather_s * 1e3, 3),
+            "drive_paged_ms": round(paged_s * 1e3, 3),
+        },
+    }

@@ -1071,8 +1071,9 @@ def paged_available() -> bool:
     return _pallas_available() and pltpu is not None
 
 
-def _paged_kernel(tab_ref, pos_ref, q_ref, k_ref, v_ref, *rest, bs, window,
-                  quantized, cdtype, sm):
+def _paged_kernel(tab_ref, pos_ref, nb_ref, q_ref, k_ref, v_ref, *rest, bs,
+                  window, quantized, cdtype, sm):
+    del nb_ref  # raggedness lives in the BlockSpec index maps
     if quantized:
         ks_ref, vs_ref, fk_ref, fv_ref, o_ref, m_ref, l_ref, acc_ref = rest
     else:
@@ -1142,8 +1143,26 @@ def _paged_kernel(tab_ref, pos_ref, q_ref, k_ref, v_ref, *rest, bs, window,
         o_ref[0, 0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
 
 
+def _ragged_step(i, j, p, nb, *, bs, window):
+    """Ragged block walk: clamp grid step ``j`` into request ``i``'s live
+    block range.  Out-of-range steps (bucket padding past the request's last
+    real block, or — under a sliding window — blocks that slid out) re-map to
+    the nearest live block, so consecutive grid steps hand the pipeline the
+    *same* arena indices and it skips re-issuing the DMA: a short request
+    stops paying its bucket without the grid (program identity) changing.
+    The compute for those steps was already ``pl.when``-skipped; this clamps
+    the *fetch*."""
+    hi = jnp.maximum(nb[i], 1) - 1
+    jj = jnp.minimum(j, hi)
+    if window is not None:
+        lo = jnp.minimum(jnp.maximum(p[i] - (window - 1), 0) // bs, hi)
+        jj = jnp.maximum(jj, lo)
+    return jj
+
+
 def paged_attn_decode(q, k_arena, v_arena, fresh_k, fresh_v, tables, pos, *,
-                      layer, k_scale=None, v_scale=None, window=None):
+                      layer, k_scale=None, v_scale=None, window=None,
+                      n_blocks=None):
     """Single-token attention straight off the KV block arena, one layer.
 
     ``q``: (B, nh, hs) queries at the compute dtype; ``k_arena``/``v_arena``:
@@ -1155,8 +1174,10 @@ def paged_attn_decode(q, k_arena, v_arena, fresh_k, fresh_v, tables, pos, *,
     them with :func:`paged_token_write` afterwards); ``tables``: (B, nbb)
     int32 sink-padded block tables; ``pos``: (B,) int32 global positions;
     ``k_scale``/``v_scale``: (num_blocks, L, ng, bs) float32 dequant scales
-    (both or neither); ``window``: ``cfg.sliding_window``.  Returns
-    (B, nh, hs) attention outputs at ``q.dtype``.
+    (both or neither); ``window``: ``cfg.sliding_window``; ``n_blocks``:
+    (B,) int32 per-request live block counts (derived from ``pos`` when
+    omitted) — the ragged-walk prefetch vector (see :func:`_ragged_step`).
+    Returns (B, nh, hs) attention outputs at ``q.dtype``.
     """
     B, nh, hs = q.shape
     num_blocks, _L, ng, bs, _ = k_arena.shape
@@ -1165,13 +1186,19 @@ def paged_attn_decode(q, k_arena, v_arena, fresh_k, fresh_v, tables, pos, *,
     assert rep * ng == nh, (nh, ng)
     quantized = k_scale is not None
     q4 = q.reshape(B, ng, rep, hs)
+    if n_blocks is None:
+        n_blocks = (pos + (bs - 1)) // bs
+    n_blocks = n_blocks.astype(jnp.int32)
+    step = functools.partial(_ragged_step, bs=bs, window=window)
 
     arena_spec = pl.BlockSpec(
-        (1, 1, 1, bs, hs), lambda i, g, j, tab, p: (tab[i, j], layer, g, 0, 0))
+        (1, 1, 1, bs, hs),
+        lambda i, g, j, tab, p, nb: (tab[i, step(i, j, p, nb)], layer, g, 0, 0))
     scale_spec = pl.BlockSpec(
-        (1, 1, 1, bs), lambda i, g, j, tab, p: (tab[i, j], layer, g, 0))
-    fresh_spec = pl.BlockSpec((1, 1, hs), lambda i, g, j, tab, p: (i, g, 0))
-    q_spec = pl.BlockSpec((1, 1, rep, hs), lambda i, g, j, tab, p: (i, g, 0, 0))
+        (1, 1, 1, bs),
+        lambda i, g, j, tab, p, nb: (tab[i, step(i, j, p, nb)], layer, g, 0))
+    fresh_spec = pl.BlockSpec((1, 1, hs), lambda i, g, j, tab, p, nb: (i, g, 0))
+    q_spec = pl.BlockSpec((1, 1, rep, hs), lambda i, g, j, tab, p, nb: (i, g, 0, 0))
 
     in_specs = [q_spec, arena_spec, arena_spec]
     args = [q4, k_arena, v_arena]
@@ -1182,7 +1209,7 @@ def paged_attn_decode(q, k_arena, v_arena, fresh_k, fresh_v, tables, pos, *,
     args += [fresh_k, fresh_v]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(B, ng, nbb),
         in_specs=in_specs,
         out_specs=q_spec,
@@ -1205,7 +1232,7 @@ def paged_attn_decode(q, k_arena, v_arena, fresh_k, fresh_v, tables, pos, *,
         out_shape=jax.ShapeDtypeStruct((B, ng, rep, hs), q.dtype),
         interpret=_interpret(),
         **kwargs,
-    )(tables, pos, *args)
+    )(tables, pos, n_blocks, *args)
     return out.reshape(B, nh, hs)
 
 
@@ -1264,13 +1291,16 @@ def paged_token_write(arena, vals, tables, pos, *, block_size):
     )(tables, pos, arena, vals)
 
 
-def _paged_verify_kernel(tab_ref, pos_ref, q_ref, k_ref, v_ref, *rest, bs, T,
-                         quantized, cdtype, sm):
+def _paged_verify_kernel(tab_ref, pos_ref, nb_ref, q_ref, k_ref, v_ref, *rest,
+                         bs, T, quantized, cdtype, sm):
     """Multi-token-query variant of ``_paged_kernel`` for the speculative
-    verify step: T = K+1 chunk queries per request share one pass over the
-    arena blocks, with the causal intra-chunk mask folded into the final
-    online-softmax term.  Queries ride flattened as (rep*T, hs) rows so the
-    arena phase is the single-token kernel's math at a wider row count."""
+    verify step — and, at T = chunk width, the chunked-prefill attention
+    kernel (:func:`paged_attn_verify` docstring): T chunk queries per request
+    share one pass over the arena blocks, with the causal intra-chunk mask
+    folded into the final online-softmax term.  Queries ride flattened as
+    (rep*T, hs) rows so the arena phase is the single-token kernel's math at
+    a wider row count."""
+    del nb_ref  # raggedness lives in the BlockSpec index maps
     if quantized:
         ks_ref, vs_ref, fk_ref, fv_ref, o_ref, m_ref, l_ref, acc_ref = rest
     else:
@@ -1342,17 +1372,23 @@ def _paged_verify_kernel(tab_ref, pos_ref, q_ref, k_ref, v_ref, *rest, bs, T,
 
 
 def paged_attn_verify(q, k_arena, v_arena, fresh_k, fresh_v, tables, pos, *,
-                      layer, k_scale=None, v_scale=None):
+                      layer, k_scale=None, v_scale=None, n_blocks=None):
     """Multi-token-query attention off the KV block arena, one layer — the
-    speculative verify step's kernel (ROADMAP item 3's reserved variant).
+    speculative verify step's kernel (T = K+1) and, generalized to T = the
+    chunk width, the chunked-prefill attention kernel (the arena keep-mask
+    is query-independent either way: the arena holds only the committed
+    strictly-older prefix, and the chunk's own keys fold in causally as the
+    final online-softmax term).
 
     ``q``: (B, nh, T, hs) chunk queries at global positions
     ``[pos, pos+T)``; ``fresh_k``/``fresh_v``: (B, ng, T, hs) the chunk's own
     projected K/V at the cache compute dtype (not yet in the arena — the
-    caller commits the accepted prefix with :func:`paged_token_write_masked`
-    afterwards).  Arena/scale/table/pos arguments as
+    caller commits the accepted prefix with :func:`paged_token_write_masked`,
+    or the whole chunk with :func:`paged_chunk_write`, afterwards).
+    Arena/scale/table/pos/``n_blocks`` arguments as
     :func:`paged_attn_decode`.  Sliding-window models are rejected upstream
-    (speculation needs full caches).  Returns (B, nh, T, hs) at ``q.dtype``.
+    (speculation needs full caches; the chunked-prefill resolution falls
+    back to gather).  Returns (B, nh, T, hs) at ``q.dtype``.
     """
     B, nh, T, hs = q.shape
     num_blocks, _L, ng, bs, _ = k_arena.shape
@@ -1363,13 +1399,19 @@ def paged_attn_verify(q, k_arena, v_arena, fresh_k, fresh_v, tables, pos, *,
     # (B, nh, T, hs) -> (B, ng, rep*T, hs): nh splits as (ng, rep), then the
     # adjacent (rep, T) dims fold — row r = rep_idx*T + t
     qf = q.reshape(B, ng, rep * T, hs)
+    if n_blocks is None:
+        n_blocks = (pos + (bs - 1)) // bs
+    n_blocks = n_blocks.astype(jnp.int32)
+    step = functools.partial(_ragged_step, bs=bs, window=None)
 
     arena_spec = pl.BlockSpec(
-        (1, 1, 1, bs, hs), lambda i, g, j, tab, p: (tab[i, j], layer, g, 0, 0))
+        (1, 1, 1, bs, hs),
+        lambda i, g, j, tab, p, nb: (tab[i, step(i, j, p, nb)], layer, g, 0, 0))
     scale_spec = pl.BlockSpec(
-        (1, 1, 1, bs), lambda i, g, j, tab, p: (tab[i, j], layer, g, 0))
-    fresh_spec = pl.BlockSpec((1, 1, T, hs), lambda i, g, j, tab, p: (i, g, 0, 0))
-    q_spec = pl.BlockSpec((1, 1, rep * T, hs), lambda i, g, j, tab, p: (i, g, 0, 0))
+        (1, 1, 1, bs),
+        lambda i, g, j, tab, p, nb: (tab[i, step(i, j, p, nb)], layer, g, 0))
+    fresh_spec = pl.BlockSpec((1, 1, T, hs), lambda i, g, j, tab, p, nb: (i, g, 0, 0))
+    q_spec = pl.BlockSpec((1, 1, rep * T, hs), lambda i, g, j, tab, p, nb: (i, g, 0, 0))
 
     in_specs = [q_spec, arena_spec, arena_spec]
     args = [qf, k_arena, v_arena]
@@ -1380,7 +1422,7 @@ def paged_attn_verify(q, k_arena, v_arena, fresh_k, fresh_v, tables, pos, *,
     args += [fresh_k, fresh_v]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(B, ng, nbb),
         in_specs=in_specs,
         out_specs=q_spec,
@@ -1403,7 +1445,7 @@ def paged_attn_verify(q, k_arena, v_arena, fresh_k, fresh_v, tables, pos, *,
         out_shape=jax.ShapeDtypeStruct((B, ng, rep * T, hs), q.dtype),
         interpret=_interpret(),
         **kwargs,
-    )(tables, pos, *args)
+    )(tables, pos, n_blocks, *args)
     return out.reshape(B, nh, T, hs)
 
 
@@ -1471,6 +1513,257 @@ def paged_token_write_masked(arena, vals, tables, pos, n_emit, offset, *, block_
         interpret=_interpret(),
         **kwargs,
     )(tables, pos, n_emit.astype(jnp.int32), arena, vals)
+
+
+def _chunk_dest(c, dest_ref, pos_ref, *, bs):
+    """Chunk-writer routing: grid step ``c`` writes the chunk's ``c``-th
+    block, i.e. dest entry ``pos // bs + c``.  Entries past the table width
+    (bucket padding spilling beyond the leased table) route to physical
+    block 0 — the sink, whose bytes are never attended."""
+    nbb = dest_ref.shape[0]
+    idx = pos_ref[0] // bs + c
+    return jnp.where(idx < nbb, dest_ref[jnp.minimum(idx, nbb - 1)], 0)
+
+
+def _paged_chunk_write_kernel(dest_ref, pos_ref, a_ref, v_ref, o_ref):
+    del dest_ref, pos_ref, a_ref  # routing happens in the BlockSpec index maps
+    o_ref[0] = v_ref[0]
+
+
+def paged_chunk_write(arena, vals, dest, pos, *, block_size):
+    """In-place block-granule chunk write — the chunked-prefill
+    ``scatter_blocks`` replacement.
+
+    ``arena``: (num_blocks, L, ng, bs, hs) K/V arena; ``vals``: (nc, L, ng,
+    bs, hs) the chunk's fresh K (or V) at the arena dtype, pre-folded to
+    block granules (a pure reshape/transpose of the (1, L, ng, T, hs)
+    forward output — no gather); ``dest``: (nbb,) int32 scatter table from
+    :func:`serving.kv_pool.chunk_tables` (sink entries absorb everything
+    outside the chunk's own block range); ``pos``: (1,) int32 chunk start
+    (block-aligned — the paged chunk resolution guarantees it).  One grid
+    step per chunk block lands a whole (L, ng, bs, hs) slab at
+    ``dest[pos // bs + c]`` via the aliased output, so untouched blocks keep
+    their bytes and no scatter primitive appears in the program.  Trailing
+    bucket-padding slots write garbage exactly like the gather path's
+    ``scatter_blocks`` — sunk, never attended, or overwritten before use.
+    """
+    bs = block_size
+    nc, L, ng, _bs, hs = vals.shape
+    assert _bs == arena.shape[3] == bs, (vals.shape, arena.shape, bs)
+    route = functools.partial(_chunk_dest, bs=bs)
+    a_spec = pl.BlockSpec(
+        (1, L, ng, bs, hs), lambda c, dest, p: (route(c, dest, p), 0, 0, 0, 0))
+    v_spec = pl.BlockSpec((1, L, ng, bs, hs), lambda c, dest, p: (c, 0, 0, 0, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(nc,),
+        in_specs=[a_spec, v_spec],
+        out_specs=a_spec,
+    )
+    kwargs = {}
+    if not _interpret():
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",))
+    return pl.pallas_call(
+        _paged_chunk_write_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(arena.shape, arena.dtype),
+        input_output_aliases={2: 0},   # arena in == arena out (in-place)
+        interpret=_interpret(),
+        **kwargs,
+    )(dest, pos, arena, vals)
+
+
+def _absmax_quant(x, qmax, storage):
+    """The exact :func:`serving.quant.quantize_kv` math, in-kernel: float32
+    absmax over the last (hs) dim, scale 1.0 for all-zero rows, int8
+    round-and-clip / fp8 cast.  Same ops in the same order, so the stored
+    bytes are bit-identical to the unfused quantize-then-write path."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.where(amax == 0.0, 1.0, amax / qmax)
+    y = xf / scale[..., None]
+    if jnp.dtype(storage) == jnp.dtype(jnp.int8):
+        q = jnp.clip(jnp.round(y), -qmax, qmax).astype(storage)
+    else:
+        q = y.astype(storage)
+    return q, scale, xf
+
+
+def _paged_chunk_write_fused_kernel(dest_ref, pos_ref, a_ref, s_ref, v_ref,
+                                    oa_ref, os_ref, oe_ref, *, bs, qmax):
+    del a_ref, s_ref  # aliased outputs; routing happens in the index maps
+    c = pl.program_id(0)
+    q, scale, xf = _absmax_quant(v_ref[0], qmax, oa_ref.dtype)
+    oa_ref[0] = q
+    os_ref[0] = scale
+    # masked quantization-error sums behind the serving.kv_quant.rel_err
+    # gauge: only blocks actually written (non-sink dest) count, matching
+    # scatter_blocks_q's mask
+    nbb = dest_ref.shape[0]
+    idx = pos_ref[0] // bs + c
+    live = jnp.logical_and(idx < nbb, dest_ref[jnp.minimum(idx, nbb - 1)] != 0)
+    m = live.astype(jnp.float32)
+    dq = q.astype(jnp.float32) * scale[..., None]
+    err = jnp.zeros((8, 128), jnp.float32)
+    err = err.at[0, 0].set(jnp.sum(jnp.abs(dq - xf)) * m)
+    err = err.at[0, 1].set(jnp.sum(jnp.abs(xf)) * m)
+    oe_ref[0] = err
+
+
+def paged_chunk_write_fused(arena, scale_arena, vals, dest, pos, *, block_size):
+    """Quantizing twin of :func:`paged_chunk_write` with the absmax
+    quantize-on-write folded in (the Liger-style fused epilogue): ``vals``
+    arrive at the *compute* dtype, the kernel computes the per-slot-head
+    absmax scale and stores value + scale through two aliased outputs in ONE
+    pallas_call — no standalone quantize op in the program.
+
+    Returns ``(arena, scale_arena, err)`` where ``err`` is (nc, 8, 128)
+    float32 with per-block masked error sums at ``[c, 0, 0]`` (|dq - x|) and
+    ``[c, 0, 1]`` (|x|) — combine as ``sum / (sum + 1e-30)`` for the same
+    rel_err figure ``scatter_blocks_q`` reports."""
+    bs = block_size
+    nc, L, ng, _bs, hs = vals.shape
+    qmax = 127.0 if arena.dtype == jnp.dtype(jnp.int8) else float(jnp.finfo(arena.dtype).max)
+    route = functools.partial(_chunk_dest, bs=bs)
+    a_spec = pl.BlockSpec(
+        (1, L, ng, bs, hs), lambda c, dest, p: (route(c, dest, p), 0, 0, 0, 0))
+    s_spec = pl.BlockSpec(
+        (1, L, ng, bs), lambda c, dest, p: (route(c, dest, p), 0, 0, 0))
+    v_spec = pl.BlockSpec((1, L, ng, bs, hs), lambda c, dest, p: (c, 0, 0, 0, 0))
+    e_spec = pl.BlockSpec((1, 8, 128), lambda c, dest, p: (c, 0, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(nc,),
+        in_specs=[a_spec, s_spec, v_spec],
+        out_specs=[a_spec, s_spec, e_spec],
+    )
+    kwargs = {}
+    if not _interpret():
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",))
+    return pl.pallas_call(
+        functools.partial(_paged_chunk_write_fused_kernel, bs=bs, qmax=qmax),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(arena.shape, arena.dtype),
+            jax.ShapeDtypeStruct(scale_arena.shape, scale_arena.dtype),
+            jax.ShapeDtypeStruct((nc, 8, 128), jnp.float32),
+        ],
+        input_output_aliases={2: 0, 3: 1},   # value + scale arenas in-place
+        interpret=_interpret(),
+        **kwargs,
+    )(dest, pos, arena, scale_arena, vals)
+
+
+def _paged_token_write_fused_kernel(tab_ref, pos_ref, *rest, qmax):
+    # rest = (ne_ref?, a_ref, s_ref, v_ref, oa_ref, os_ref) — the masked
+    # variant prepends its n_emit prefetch ref; all routing (including the
+    # emit predicate) happens in the BlockSpec index maps
+    del tab_ref, pos_ref
+    v_ref, oa_ref, os_ref = rest[-3:]
+    q, scale, _ = _absmax_quant(v_ref[0], qmax, oa_ref.dtype)
+    oa_ref[0, :, :, 0, :] = q
+    os_ref[0, :, :, 0] = scale
+
+
+def paged_token_write_fused(arena, scale_arena, vals, tables, pos, *,
+                            block_size, n_emit=None, offset=0):
+    """Quantizing twin of :func:`paged_token_write` (and, with ``n_emit``,
+    of :func:`paged_token_write_masked`): ``vals`` (B, L, ng, hs) arrive at
+    the compute dtype; the kernel runs the exact ``quantize_kv`` absmax math
+    and lands value + scale through two aliased outputs in one pallas_call —
+    the decode program's quantize-on-write with no standalone quantize op.
+    Returns ``(arena, scale_arena)``."""
+    bs = block_size
+    B = vals.shape[0]
+    _, L, ng, _, hs = arena.shape
+    qmax = 127.0 if arena.dtype == jnp.dtype(jnp.int8) else float(jnp.finfo(arena.dtype).max)
+    k = offset
+    if n_emit is None:
+        a_spec = pl.BlockSpec(
+            (1, L, ng, 1, hs),
+            lambda i, tab, p: (tab[i, p[i] // bs], 0, 0, p[i] % bs, 0))
+        s_spec = pl.BlockSpec(
+            (1, L, ng, 1),
+            lambda i, tab, p: (tab[i, p[i] // bs], 0, 0, p[i] % bs))
+        v_spec = pl.BlockSpec((1, L, ng, hs), lambda i, tab, p: (i, 0, 0, 0))
+        num_prefetch, prefetch = 2, (tables, pos)
+    else:
+        a_spec = pl.BlockSpec(
+            (1, L, ng, 1, hs),
+            lambda i, tab, p, ne: (
+                jnp.where(k < ne[i], tab[i, (p[i] + k) // bs], 0), 0, 0,
+                jnp.where(k < ne[i], (p[i] + k) % bs, 0), 0))
+        s_spec = pl.BlockSpec(
+            (1, L, ng, 1),
+            lambda i, tab, p, ne: (
+                jnp.where(k < ne[i], tab[i, (p[i] + k) // bs], 0), 0, 0,
+                jnp.where(k < ne[i], (p[i] + k) % bs, 0)))
+        v_spec = pl.BlockSpec((1, L, ng, hs), lambda i, tab, p, ne: (i, 0, 0, 0))
+        num_prefetch, prefetch = 3, (tables, pos, n_emit.astype(jnp.int32))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=num_prefetch,
+        grid=(B,),
+        in_specs=[a_spec, s_spec, v_spec],
+        out_specs=[a_spec, s_spec],
+    )
+    kwargs = {}
+    if not _interpret():
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",))
+    na = num_prefetch  # arena arg index right after the prefetch operands
+    return pl.pallas_call(
+        functools.partial(_paged_token_write_fused_kernel, qmax=qmax),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(arena.shape, arena.dtype),
+            jax.ShapeDtypeStruct(scale_arena.shape, scale_arena.dtype),
+        ],
+        input_output_aliases={na: 0, na + 1: 1},
+        interpret=_interpret(),
+        **kwargs,
+    )(*prefetch, arena, scale_arena, vals)
+
+
+def _lora_delta_kernel(x_ref, a_ref, b_ref, o_ref, *, scaling):
+    x = x_ref[0]                                       # (T, C)
+    a = a_ref[0].astype(x.dtype)                       # (r, C)
+    b = b_ref[0].astype(x.dtype)                       # (fout, r)
+    d = jax.lax.dot_general(x, a, (((1,), (1,)), ((), ())))
+    o_ref[0] = (jax.lax.dot_general(d, b, (((1,), (1,)), ((), ()))) * scaling
+                ).astype(o_ref.dtype)
+
+
+def lora_delta_fused(x, a, b, scaling):
+    """Fused per-request LoRA delta ``scaling * B(A(x))`` — one kernel call
+    per target instead of two standalone HLO einsums (the Liger fused-
+    epilogue pattern applied to the adapter path).  ``x``: (B, T, fin);
+    ``a``: (B, r, fin); ``b``: (B, fout, r) → (B, T, fout), same dtype flow
+    as ``models.generate._lora_delta`` (factors cast to ``x.dtype``, default
+    accumulation), so the delta is bit-identical to the unfused twin.  Used
+    by the meshless kernel path only — under a mesh the unfused einsums stay
+    (a bare pallas_call has no SPMD rule)."""
+    B, T, C = x.shape
+    _, r, _ = a.shape
+    _, fout, _ = b.shape
+    kwargs = {}
+    if not _interpret():
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel",))
+    return pl.pallas_call(
+        functools.partial(_lora_delta_kernel, scaling=scaling),
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, T, C), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, r, C), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, fout, r), lambda i: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, T, fout), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, T, fout), x.dtype),
+        interpret=_interpret(),
+        **kwargs,
+    )(x, a, b)
 
 
 # install the fast paths so XLA fusion regions and TrainStep trace evaluation

@@ -131,21 +131,25 @@ def _lora_delta(x, a, b, scaling):
 
 
 def _project_qkv(ap, x, cos_t, sin_t, cfg: Config, *, lin=None, lora=None,
-                 lora_scaling=1.0):
+                 lora_scaling=1.0, delta_fn=None):
     """QKV projections + partial rotary for new tokens: x (B, T, C) →
     q (B, nh, T, hs), k/v (B, ng, T, hs) — K/V stay at the grouped head
     count.  Shared by KV-cache decode and sequence-parallel training.
     ``lora``: optional ``{target: (a, b)}`` per-request factors for this
-    layer (see :func:`_lora_delta`)."""
+    layer (see :func:`_lora_delta`); ``delta_fn`` swaps the delta
+    implementation (the serving kernel path passes its fused epilogue —
+    same ``(x, a, b, scaling)`` contract, bit-identical math)."""
     if lin is None:
         lin = _linear
+    if delta_fn is None:
+        delta_fn = _lora_delta
     B, T, C = x.shape
     hs, nh, ng = cfg.head_size, cfg.n_head, cfg.n_query_groups
 
     def proj(name, bias):
         o = lin(x, ap[name], ap.get(bias))
         if lora is not None and name in lora:
-            o = o + _lora_delta(x, *lora[name], lora_scaling)
+            o = o + delta_fn(x, *lora[name], lora_scaling)
         return o
 
     q = proj("wq", "bq").reshape(B, T, nh, hs).transpose(0, 2, 1, 3)

@@ -132,6 +132,13 @@ class GoodputLedger:
         self.violations = 0
         self.waste = dict.fromkeys(WASTE_CAUSES, 0)
         self.per_kind: dict[str, dict] = {}
+        # paged-attention block-walk accounting (the ragged-decode
+        # visibility figure): bucketed vs actually-streamed block counts,
+        # aggregated and per program kind.  Blocks are not token-position
+        # slots, so these NEVER enter the conservation law above.
+        self.blocks_walked = 0
+        self.blocks_real = 0
+        self.blocks_per_kind: dict[str, dict] = {}
         reg = registry()
         self._m_positions = reg.counter("serving.goodput.positions")
         self._m_committed = reg.counter("serving.goodput.committed_positions")
@@ -140,6 +147,9 @@ class GoodputLedger:
         self._m_waste = {
             c: reg.counter(f"serving.goodput.waste.{c}") for c in WASTE_CAUSES
         }
+        self._m_blocks_walked = reg.counter("serving.goodput.blocks_walked")
+        self._m_blocks_real = reg.counter("serving.goodput.blocks_real")
+        self._m_blocks_frac = reg.gauge("serving.goodput.blocks_real_frac")
 
     # -- accumulation -----------------------------------------------------
 
@@ -201,6 +211,31 @@ class GoodputLedger:
             self.committed_tokens += int(n)
             self._m_tokens.inc(int(n))
 
+    def note_blocks(self, kind: str, walked: int, real: int) -> None:
+        """Record one paged-attention dispatch's block-walk widths.
+
+        ``walked`` is the bucketed count the compiled grid iterates
+        (``rows x nbb x steps``); ``real`` is the count the ragged clamp
+        actually streams from the arena (per-row ``ceil(pos / block_size)``,
+        clamped to ``[1, nbb]``).  ``walked - real`` block-loads is exactly
+        what ragged decode saves over bucketed walking — a visibility
+        figure beside the slot ledger, never part of the conservation law.
+        """
+        walked, real = int(walked), int(real)
+        if walked < real:
+            raise ValueError(f"blocks_real={real} exceeds walked={walked}")
+        self.blocks_walked += walked
+        self.blocks_real += real
+        ent = self.blocks_per_kind.setdefault(
+            kind, {"dispatches": 0, "walked": 0, "real": 0})
+        ent["dispatches"] += 1
+        ent["walked"] += walked
+        ent["real"] += real
+        self._m_blocks_walked.inc(walked)
+        self._m_blocks_real.inc(real)
+        if self.blocks_walked:
+            self._m_blocks_frac.set(self.blocks_real / self.blocks_walked)
+
     def note_device_s(self, kind: str, seconds: float) -> None:
         """Attribute dispatch->harvest wall seconds to a program kind."""
         if not self.config.device_time:
@@ -225,6 +260,12 @@ class GoodputLedger:
                                    if self.positions else 0.0),
             "waste": {c: n for c, n in self.waste.items() if n},
             "violations": self.violations,
+            "blocks": {
+                "walked": self.blocks_walked,
+                "real": self.blocks_real,
+                "real_frac": (self.blocks_real / self.blocks_walked
+                              if self.blocks_walked else None),
+            },
         }
 
     def report(self) -> dict:
@@ -248,6 +289,13 @@ class GoodputLedger:
                 row["wasted_device_s"] = ent["device_s"] * (1.0 - frac)
             per_kind[kind] = row
         rep["per_kind"] = per_kind
+        if self.blocks_per_kind:
+            rep["blocks_per_kind"] = {
+                kind: {**ent,
+                       "real_frac": (ent["real"] / ent["walked"]
+                                     if ent["walked"] else None)}
+                for kind, ent in sorted(self.blocks_per_kind.items())
+            }
         if self.config.device_time:
             rep["device_s"] = sum(e["device_s"] for e in self.per_kind.values())
             rep["wasted_device_s"] = sum(
@@ -296,6 +344,8 @@ def fleet_goodput(snaps: list[dict]) -> dict:
     for s in snaps:
         for c, n in s.get("waste", {}).items():
             waste[c] = waste.get(c, 0) + n
+    walked = sum(s.get("blocks", {}).get("walked", 0) for s in snaps)
+    real = sum(s.get("blocks", {}).get("real", 0) for s in snaps)
     positions = sum(s["positions"] for s in snaps)
     committed = sum(s["committed"] for s in snaps)
     per_lane = [s["committed"] for s in snaps]
@@ -311,6 +361,8 @@ def fleet_goodput(snaps: list[dict]) -> dict:
                                / positions if positions else 0.0),
         "waste": waste,
         "violations": sum(s.get("violations", 0) for s in snaps),
+        "blocks": {"walked": walked, "real": real,
+                   "real_frac": (real / walked) if walked else None},
         "committed_per_lane": per_lane,
         "committed_imbalance": ((max(per_lane) - min(per_lane)) / mean
                                 if per_lane and mean else 0.0),

@@ -466,6 +466,42 @@ class ServingEngine:
             self.prefix_sharing = False
             sch.prefill_chunk = None
         self._table_widths = self._table_width_buckets()
+        # chunked prefill resolves its kernel/gather path INDEPENDENTLY of
+        # decode (stats()["attn"]["kinds"] reports both): the paged chunk
+        # writer lands whole (L, ng, bs, hs) block slabs built from the
+        # chunk's fresh K/V alone, so every chunk boundary must fall on a
+        # block edge — the chunk width and every prefill bucket must be
+        # multiples of the pool block size (the FINAL piece runs the
+        # ``prefill`` kind and may stay ragged).  Sliding-window models
+        # keep the gather chunk (the multi-query kernel has no windowed
+        # keep-mask), and speculative engines keep ``spec_prefill_chunk``
+        # (it writes the draft arena too).  Resolution happens ONCE here,
+        # so the program-identity story is unchanged: the paged chunk kind
+        # REPLACES the gather chunk kind 1:1 per engine and the
+        # bucket_bound formula in stats() is untouched.
+        sch = self.scheduler
+        if self.attn != "paged":
+            chunk_why = (self._attn_fallback_reason
+                         if self._attn_requested == "auto"
+                         else "attn='gather' requested")
+        elif self.spec is not None:
+            chunk_why = "speculative prefill writes the draft arena (gather chunk)"
+        elif cfg.sliding_window is not None:
+            chunk_why = "sliding-window keep-mask is decode-only"
+        elif sch.prefill_chunk is not None and sch.prefill_chunk % block_size:
+            chunk_why = (f"prefill_chunk={sch.prefill_chunk} not a multiple "
+                         f"of block_size={block_size}")
+        elif any(t % block_size for t in sch.prefill_buckets):
+            chunk_why = (f"prefill_buckets={tuple(sch.prefill_buckets)} not "
+                         f"all multiples of block_size={block_size}")
+        else:
+            chunk_why = None
+        self.attn_chunk = "paged" if chunk_why is None else "gather"
+        self._attn_chunk_fallback_reason = chunk_why
+        # per-kind [kernel, fallback] step counters beside the decode-only
+        # aggregates (attn_kernel_steps/attn_fallback_steps keep their
+        # pre-existing decode semantics)
+        self._attn_steps = {"decode": [0, 0], "prefill_chunk": [0, 0]}
         # fault tolerance: the chaos plan (None = unarmed — one `is None`
         # check per fault point, compiled programs byte-identical either
         # way), the retry/backoff policy, and the harvest watchdog on the
@@ -526,7 +562,8 @@ class ServingEngine:
         self.step_calls = 0
         self.tokens_generated = 0
         self._occupancy_sum = 0
-        self.compile_counts = {"prefill": 0, "prefill_chunk": 0, "decode": 0,
+        self.compile_counts = {"prefill": 0, "prefill_chunk": 0,
+                               "prefill_chunk_paged": 0, "decode": 0,
                                "decode_paged": 0, "decode_multi": 0,
                                "decode_multi_paged": 0, "spec_prefill": 0,
                                "spec_prefill_chunk": 0, "draft_decode": 0,
@@ -1000,6 +1037,24 @@ class ServingEngine:
                 "fallback_reason": self._attn_fallback_reason,
                 "kernel_steps": self.attn_kernel_steps,
                 "fallback_steps": self.attn_fallback_steps,
+                # per-kind resolution: decode and chunk-prefill resolve
+                # independently (the chunk kernel needs block-aligned
+                # widths and no sliding window), so a single top-level
+                # mode/reason can't tell the whole story
+                "kinds": {
+                    "decode": {
+                        "mode": self.attn,
+                        "fallback_reason": self._attn_fallback_reason,
+                        "kernel_steps": self._attn_steps["decode"][0],
+                        "fallback_steps": self._attn_steps["decode"][1],
+                    },
+                    "prefill_chunk": {
+                        "mode": self.attn_chunk,
+                        "fallback_reason": self._attn_chunk_fallback_reason,
+                        "kernel_steps": self._attn_steps["prefill_chunk"][0],
+                        "fallback_steps": self._attn_steps["prefill_chunk"][1],
+                    },
+                },
             },
             "bucket_bound": kinds * len(self._table_widths),
             "prefix_lookups": self._prefix_lookups,
@@ -1328,6 +1383,26 @@ class ServingEngine:
             self._release_retired()         # token materialized: consumer done
             self._sample_occupancy()
 
+    def _chunk_kind(self) -> str:
+        """The non-speculative chunk program kind this engine dispatches —
+        resolved once at construction (``self.attn_chunk``), so raggedness
+        never changes program identity mid-flight."""
+        return ("prefill_chunk_paged" if self.attn_chunk == "paged"
+                else "prefill_chunk")
+
+    def _note_chunk_attn_step(self) -> None:
+        """Per-kind attn step accounting for one chunk dispatch (the decode
+        aggregates keep their decode-only semantics)."""
+        st = self._attn_steps["prefill_chunk"]
+        if self.attn_chunk == "paged":
+            st[0] += 1
+        else:
+            st[1] += 1
+            if self._attn_requested != "gather":
+                # the user asked for kernels (paged or auto) but the chunk
+                # kind resolved gather: that is a fallback step
+                self._m_attn_fallback.inc()
+
     def _prefill_dispatch(self, req: Request) -> dict:
         """Dispatches the next prefill piece for ``req`` and returns its
         in-flight record.  A piece is either a full ``prefill`` (samples
@@ -1355,7 +1430,7 @@ class ServingEngine:
         if self.spec is not None:
             kind = "spec_prefill" if final else "spec_prefill_chunk"
         else:
-            kind = "prefill" if final else "prefill_chunk"
+            kind = "prefill" if final else self._chunk_kind()
         prog, compiled = self._program(kind, Tb, nbb)
         req.prefill_compiled = req.prefill_compiled or compiled
         # the dispatch phase is named by its dominant cost: a fresh program
@@ -1445,6 +1520,8 @@ class ServingEngine:
         else:
             self.chunk_runs += 1
             reg.counter("serving.steps.prefill_chunk").inc()
+            if self.spec is None:
+                self._note_chunk_attn_step()
         if compiled:
             # cold-compile TTFT outliers must be distinguishable from queue
             # delay: count prefill RUNS that paid a compile (vs
@@ -1456,7 +1533,8 @@ class ServingEngine:
             self._flight.record("prefill" if final else "prefill_chunk",
                                 rid=req.rid, compiled=compiled,
                                 bucket=[Tb, nbb], pos=pos,
-                                shared_blocks=req.n_shared_blocks)
+                                shared_blocks=req.n_shared_blocks,
+                                **({} if final else {"attn": self.attn_chunk}))
         return rec
 
     def _prefill_harvest(self, rec: dict) -> None:
@@ -1658,11 +1736,22 @@ class ServingEngine:
             )
         if self.attn == "paged":
             self.attn_kernel_steps += 1
+            self._attn_steps["decode"][0] += 1
             self._m_attn_kernel.inc()
         elif self._attn_requested == "auto":
             # auto resolved to gather: every decode step is a fallback step
             self.attn_fallback_steps += 1
+            self._attn_steps["decode"][1] += 1
             self._m_attn_fallback.inc()
+        if self._goodput is not None and self.attn == "paged":
+            # ragged-decode visibility: the compiled grid spans Bb x nbb
+            # blocks per step but the ragged clamp streams only each row's
+            # live range — per-row ceil(pos / bs) clamped to [1, nbb]
+            # (padding rows collapse to one block, the sink); host ints
+            # only, the dispatch itself is untouched
+            hp = np.asarray(host_pos, dtype=np.int64)[:, None] + np.arange(N)
+            real = int(np.minimum(np.maximum(-(-hp // bs), 1), nbb).sum())
+            self._goodput.note_blocks(kind, Bb * nbb * N, real)
         tr = self._tracer
         if tr is not None:
             for r in running:
@@ -2336,13 +2425,14 @@ class ServingEngine:
                 )
                 self.draft_pool.set_arenas(darenas)
             else:
-                prog, _compiled = self._program("prefill_chunk", Tb, nbb)
+                prog, _compiled = self._program(self._chunk_kind(), Tb, nbb)
                 arenas, qerr = prog(
                     self.params, jnp.asarray(toks)[None], jnp.int32(pos),
                     pool.arenas, jnp.asarray(table), jnp.asarray(dest),
                     self._lora_arenas(),
                     jnp.asarray([adapter_slot], dtype=jnp.int32),
                 )
+                self._note_chunk_attn_step()
             pool.set_arenas(arenas)
             if req is not None:
                 # every real position of a replay piece is recomputation
@@ -2354,7 +2444,7 @@ class ServingEngine:
                 # replay pieces never stream: real positions are the given
                 # replay cause, except sink-routed (window-expired) slots
                 kind = ("spec_prefill_chunk" if self.spec is not None
-                        else "prefill_chunk")
+                        else self._chunk_kind())
                 sunk = self._sunk_positions(block_table, pos, n_real, bs)
                 waste = {}
                 if Tb > n_real:
@@ -2371,7 +2461,7 @@ class ServingEngine:
             if gp is not None:
                 gp.note_device_s(
                     "spec_prefill_chunk" if self.spec is not None
-                    else "prefill_chunk", time.perf_counter() - t_disp)
+                    else self._chunk_kind(), time.perf_counter() - t_disp)
             self._release_retired()
             self.chunk_runs += 1
             registry().counter("serving.steps.prefill_chunk").inc()
@@ -2486,6 +2576,7 @@ class ServingEngine:
             else:
                 build = {"prefill": self._build_prefill,
                          "prefill_chunk": self._build_prefill_chunk,
+                         "prefill_chunk_paged": self._build_prefill_chunk_paged,
                          "decode": self._build_decode,
                          "decode_paged": self._build_decode_paged,
                          "decode_multi": self._build_decode_multi,
@@ -2652,6 +2743,48 @@ class ServingEngine:
 
         return prefill_chunk
 
+    def _build_prefill_chunk_paged(self, Tb: int, nbb: int) -> Callable:
+        """The kernel twin of :meth:`_build_prefill_chunk`: same signature,
+        same returns — but the chunk's attention runs the multi-query paged
+        kernel straight off the arenas (earlier chunks' KV is read in block
+        granules with the causal intra-chunk mask fused in-kernel) and the
+        chunk's fresh K/V lands via the block-granule chunk writer, so the
+        compiled program contains zero arena gather/scatter primitives (the
+        purity census asserts this with the gather chunk program as positive
+        control).  Quantized pools take the fused absmax quantize-on-write
+        epilogue; LoRA deltas run the fused kernel when meshless.  Only
+        built when the construction-time chunk resolution picked "paged"
+        (block-aligned chunk widths, no sliding window)."""
+        from thunder_tpu.serving.paged_attention import (
+            forward_paged,
+            write_fresh_kv_chunk,
+        )
+
+        cfg = self.cfg
+        qkv = self.pool.quantized_kv
+        cdtype = jnp.dtype(self.pool.dtype)
+        kv_dtype = jnp.dtype(self.pool.kv_dtype) if qkv else None
+        bs = self.pool.block_size
+        cap = self.pool.capacity_tokens(nbb)
+        cos_all, sin_all = build_rope_cache(cfg, cap)
+        mesh = self.mesh
+
+        @partial(jax.jit, donate_argnums=(3,),
+                 **self._jit_kwargs("prefill_chunk_paged"))
+        def prefill_chunk_paged(params, toks, pos, arenas, table, dest, lora,
+                                slot):
+            pv = jnp.reshape(pos, (1,)).astype(jnp.int32)   # (B=1,) vec pos
+            _logits, fresh = forward_paged(
+                params, toks, pv, arenas, table[None, :], cos_all, sin_all,
+                cfg, cdtype=cdtype, mesh=mesh, lora_fused=True,
+                **self._fwd_kwargs(lora, slot),
+            )
+            return write_fresh_kv_chunk(
+                arenas, fresh, dest, pv, block_size=bs,
+                kv_dtype=kv_dtype, mesh=mesh)
+
+        return prefill_chunk_paged
+
     def _build_decode(self, Bb: int, nbb: int) -> Callable:
         cfg, fwd, temp = self.cfg, self._forward, self.temperature
         qkv = self.pool.quantized_kv
@@ -2741,7 +2874,8 @@ class ServingEngine:
                          *cmask):
             logits, fresh = forward_paged(
                 params, toks[:, None], pos, arenas, tables, cos_all, sin_all,
-                cfg, cdtype=cdtype, mesh=mesh, **self._fwd_kwargs(lora, slots),
+                cfg, cdtype=cdtype, mesh=mesh, lora_fused=True,
+                **self._fwd_kwargs(lora, slots),
             )
             sp = jax.vmap(jax.random.split)(keys)          # per-request key chains
             new_keys, subs = sp[:, 0], sp[:, 1]
@@ -2888,7 +3022,8 @@ class ServingEngine:
                 toks, pos, keys, live, arenas = carry
                 logits, fresh = forward_paged(
                     params, toks[:, None], pos, arenas, tables,
-                    cos_all, sin_all, cfg, cdtype=cdtype, mesh=mesh, **kw,
+                    cos_all, sin_all, cfg, cdtype=cdtype, mesh=mesh,
+                    lora_fused=True, **kw,
                 )
                 sp = jax.vmap(jax.random.split)(keys)
                 new_keys = jnp.where(live[:, None], sp[:, 0], keys)

@@ -175,7 +175,10 @@ def program_shardings(kind: str, params, mesh: Mesh, arena_sh: NamedSharding,
             in_shardings=(param_sh, repl, repl, repl, arena_sh, repl, repl, repl, repl, repl),
             out_shardings=(repl, arena_sh, repl, repl),
         )
-    if kind == "prefill_chunk":
+    if kind in ("prefill_chunk", "prefill_chunk_paged"):
+        # the paged chunk kind keeps the exact gather-chunk signature, so
+        # it shares the row (inside the program the kernels run under
+        # shard_map with heads-local specs matching ``arena_sh``)
         return dict(
             in_shardings=(param_sh, repl, repl, arena_sh, repl, repl, repl, repl),
             out_shardings=(arena_sh, repl),

@@ -37,9 +37,13 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from thunder_tpu.executors.pallasex import (
+    lora_delta_fused,
     paged_attn_decode,
     paged_attn_verify,
+    paged_chunk_write,
+    paged_chunk_write_fused,
     paged_token_write,
+    paged_token_write_fused,
     paged_token_write_masked,
     pltpu as _pltpu,
 )
@@ -53,7 +57,7 @@ from thunder_tpu.models.generate import (
 from thunder_tpu.serving.quant import quantize_kv
 
 __all__ = ["forward_paged", "write_fresh_kv", "write_fresh_kv_live",
-           "write_fresh_kv_masked", "paged_supported"]
+           "write_fresh_kv_masked", "write_fresh_kv_chunk", "paged_supported"]
 
 
 def _smap(fn, mesh, in_specs, out_specs):
@@ -158,7 +162,7 @@ def _attn_paged_multi(q, arenas, fresh_k, fresh_v, tables, pos, *, layer, mesh):
 
 def forward_paged(params, idx, pos, arenas, tables, cos_all, sin_all, cfg, *,
                   cdtype, quantized=False, lora=None, lora_scaling=1.0,
-                  mesh=None):
+                  mesh=None, lora_fused=False):
     """Decode/verify forward straight off the KV block arenas.
 
     Mirrors ``forward_with_cache`` (vec-pos) except attention: instead of
@@ -172,8 +176,12 @@ def forward_paged(params, idx, pos, arenas, tables, cos_all, sin_all, cfg, *,
     ``(logits (B, T, V), fresh)`` with ``fresh = {"k"/"v": (B, L, ng, hs)}``
     for T=1 or ``(B, L, ng, T, hs)`` for T>1, at cdtype — the caller
     persists it with :func:`write_fresh_kv` / :func:`write_fresh_kv_masked`
-    (same step, after sampling's logits are taken; order doesn't matter as
-    the kernel already attended it)."""
+    / :func:`write_fresh_kv_chunk` (same step, after sampling's logits are
+    taken; order doesn't matter as the kernel already attended it).
+    ``lora_fused`` routes the per-target adapter deltas through the fused
+    ``lora_delta_fused`` kernel instead of standalone HLO einsums —
+    bit-identical math, meshless only (a bare pallas_call has no SPMD
+    rule)."""
     B, T = idx.shape
     hs, nh = cfg.head_size, cfg.n_head
     window = cfg.sliding_window
@@ -187,6 +195,7 @@ def forward_paged(params, idx, pos, arenas, tables, cos_all, sin_all, cfg, *,
     sin_t = jax.vmap(lambda p: jax.lax.dynamic_slice_in_dim(sin_all, p, T, axis=0))(pos)[:, None]
 
     lin = partial(_linear, quantized=quantized)
+    delta_fn = lora_delta_fused if (lora_fused and mesh is None) else _lora_delta
     fresh_k, fresh_v = [], []
     for l, bp in enumerate(params["blocks"]):
         n1 = _norm(x, bp["norm_1"], cfg, bp.get("norm_1_b"))
@@ -194,7 +203,8 @@ def forward_paged(params, idx, pos, arenas, tables, cos_all, sin_all, cfg, *,
         if lora:
             lora_l = {t: (ab["a"][:, l], ab["b"][:, l]) for t, ab in lora.items()}
         q, k, v = _project_qkv(bp["attn"], n1, cos_t, sin_t, cfg, lin=lin,
-                               lora=lora_l, lora_scaling=lora_scaling)
+                               lora=lora_l, lora_scaling=lora_scaling,
+                               delta_fn=delta_fn)
         # fresh K/V at the cache compute dtype — the exact values the dense
         # path writes before attending
         if T == 1:
@@ -212,7 +222,7 @@ def forward_paged(params, idx, pos, arenas, tables, cos_all, sin_all, cfg, *,
             y = y.transpose(0, 2, 1, 3).reshape(B, T, nh * hs)
         h = lin(y, bp["attn"]["wo"], bp["attn"].get("bo"))
         if lora_l is not None and "wo" in lora_l:
-            h = h + _lora_delta(y, *lora_l["wo"], lora_scaling)
+            h = h + delta_fn(y, *lora_l["wo"], lora_scaling)
         fresh_k.append(fk)
         fresh_v.append(fv)
         if cfg.parallel_residual:
@@ -243,29 +253,57 @@ def _write(arena, vals, tables, pos, *, block_size, mesh):
     )(arena, vals, tables, pos)
 
 
+def _write_fused(arena, scale, vals, tables, pos, *, block_size, mesh,
+                 n_emit=None, offset=0):
+    """Fused quantize-on-write: ``vals`` at the compute dtype go through the
+    in-kernel absmax epilogue (``paged_token_write_fused``), landing value +
+    scale in one aliased pallas_call — no standalone quantize op in the
+    program.  The per-slot-head scale is an absmax over ``hs``, computed
+    per KV group, so under a mesh each shard quantizes its own heads
+    (shard-local, no collective)."""
+    if mesh is None:
+        return paged_token_write_fused(arena, scale, vals, tables, pos,
+                                       block_size=block_size, n_emit=n_emit,
+                                       offset=offset)
+    aspec = P(None, None, "tp", None, None)
+    sspec = P(None, None, "tp", None)
+    vspec = P(None, None, "tp", None)
+    if n_emit is None:
+        return _smap(
+            lambda a, s, v, t, p: paged_token_write_fused(
+                a, s, v, t, p, block_size=block_size),
+            mesh, (aspec, sspec, vspec, P(None, None), P(None)), (aspec, sspec),
+        )(arena, scale, vals, tables, pos)
+    return _smap(
+        lambda a, s, v, t, p, n: paged_token_write_fused(
+            a, s, v, t, p, block_size=block_size, n_emit=n, offset=offset),
+        mesh, (aspec, sspec, vspec, P(None, None), P(None), P(None)),
+        (aspec, sspec),
+    )(arena, scale, vals, tables, pos, n_emit)
+
+
 def write_fresh_kv(arenas, fresh, tables, pos, *, block_size, kv_dtype=None,
                    mesh=None):
     """Lands one decode step's fresh K/V in the arenas, in place.
 
     ``fresh``: ``{"k"/"v": (B, L, ng, hs) at the compute dtype}`` from
     :func:`forward_paged`.  ``kv_dtype``: the storage dtype when the pool is
-    quantized (int8/fp8) — quantization runs *here* with the same
-    ``quantize_kv`` call ``scatter_token_q`` makes, so the stored bytes are
-    bit-identical to the gather path's; the kernels then write precomputed
-    values + scales.  Returns the updated arenas dict (aliased buffers: no
-    scatter primitive, untouched blocks keep their bytes; padding rows land
-    in sink block 0, never attended)."""
-    w = partial(_write, tables=tables, pos=pos, block_size=block_size, mesh=mesh)
+    quantized (int8/fp8) — quantization is **fused into the writer kernel**
+    (``paged_token_write_fused`` runs the exact ``quantize_kv`` absmax math
+    as its epilogue and lands value + scale through two aliased outputs),
+    so the stored bytes stay bit-identical to the gather path's while no
+    standalone quantize op appears in the program.  Returns the updated
+    arenas dict (aliased buffers: no scatter primitive, untouched blocks
+    keep their bytes; padding rows land in sink block 0, never attended)."""
     if kv_dtype is None:
+        w = partial(_write, tables=tables, pos=pos, block_size=block_size,
+                    mesh=mesh)
         return {"k": w(arenas["k"], fresh["k"]), "v": w(arenas["v"], fresh["v"])}
-    kq, ks = quantize_kv(fresh["k"], kv_dtype)
-    vq, vs = quantize_kv(fresh["v"], kv_dtype)
-    return {
-        "k": w(arenas["k"], kq),
-        "v": w(arenas["v"], vq),
-        "k_scale": w(arenas["k_scale"], ks),
-        "v_scale": w(arenas["v_scale"], vs),
-    }
+    ka, ks = _write_fused(arenas["k"], arenas["k_scale"], fresh["k"], tables,
+                          pos, block_size=block_size, mesh=mesh)
+    va, vs = _write_fused(arenas["v"], arenas["v_scale"], fresh["v"], tables,
+                          pos, block_size=block_size, mesh=mesh)
+    return {"k": ka, "v": va, "k_scale": ks, "v_scale": vs}
 
 
 def write_fresh_kv_live(arenas, fresh, tables, pos, live, *, block_size,
@@ -282,20 +320,21 @@ def write_fresh_kv_live(arenas, fresh, tables, pos, live, *, block_size,
     ``n_emit = live`` makes :func:`paged_token_write_masked`'s
     ``offset < n_emit`` predicate the liveness mask itself — so the stored
     bytes for live rows are bit-identical to the single-step kernel's and
-    the program still contains zero scatter primitives."""
+    the program still contains zero scatter primitives.  Quantized pools
+    take the same fused quantize-on-write epilogue as
+    :func:`write_fresh_kv`."""
     n_emit = live.astype(jnp.int32)
-    w = partial(_write_masked, tables=tables, pos=pos, n_emit=n_emit,
-                offset=0, block_size=block_size, mesh=mesh)
     if kv_dtype is None:
+        w = partial(_write_masked, tables=tables, pos=pos, n_emit=n_emit,
+                    offset=0, block_size=block_size, mesh=mesh)
         return {"k": w(arenas["k"], fresh["k"]), "v": w(arenas["v"], fresh["v"])}
-    kq, ks = quantize_kv(fresh["k"], kv_dtype)
-    vq, vs = quantize_kv(fresh["v"], kv_dtype)
-    return {
-        "k": w(arenas["k"], kq),
-        "v": w(arenas["v"], vq),
-        "k_scale": w(arenas["k_scale"], ks),
-        "v_scale": w(arenas["v_scale"], vs),
-    }
+    ka, ks = _write_fused(arenas["k"], arenas["k_scale"], fresh["k"], tables,
+                          pos, block_size=block_size, mesh=mesh,
+                          n_emit=n_emit, offset=0)
+    va, vs = _write_fused(arenas["v"], arenas["v_scale"], fresh["v"], tables,
+                          pos, block_size=block_size, mesh=mesh,
+                          n_emit=n_emit, offset=0)
+    return {"k": ka, "v": va, "k_scale": ks, "v_scale": vs}
 
 
 def _write_masked(arena, vals, tables, pos, n_emit, offset, *, block_size, mesh):
@@ -321,20 +360,90 @@ def write_fresh_kv_masked(arenas, fresh, tables, pos, n_emit, *, block_size,
     each chunk offset ``k`` only rows with ``k < n_emit`` commit at
     ``pos + k``; the rest are sink-routed (block 0, never attended), so
     rejected candidates leave no trace and the next round re-derives them
-    from scratch.  Quantization matches :func:`write_fresh_kv` — per-token
-    ``quantize_kv``, bit-identical bytes to the gather path's commits."""
+    from scratch.  Quantization matches :func:`write_fresh_kv` — the fused
+    in-kernel absmax epilogue per chunk offset, bit-identical bytes to the
+    gather path's commits."""
     T = fresh["k"].shape[3]
-    if kv_dtype is None:
-        pairs = {"k": fresh["k"], "v": fresh["v"]}
-    else:
-        kq, ks = quantize_kv(fresh["k"], kv_dtype)
-        vq, vs = quantize_kv(fresh["v"], kv_dtype)
-        pairs = {"k": kq, "v": vq, "k_scale": ks, "v_scale": vs}
     out = dict(arenas)
-    for name, vals in pairs.items():
-        a = out[name]
+    if kv_dtype is None:
+        for name in ("k", "v"):
+            a = out[name]
+            for k in range(T):
+                a = _write_masked(a, fresh[name][:, :, :, k], tables, pos,
+                                  n_emit, k, block_size=block_size, mesh=mesh)
+            out[name] = a
+        return out
+    for name in ("k", "v"):
+        a, s = out[name], out[name + "_scale"]
         for k in range(T):
-            a = _write_masked(a, vals[:, :, :, k], tables, pos, n_emit, k,
-                              block_size=block_size, mesh=mesh)
-        out[name] = a
+            a, s = _write_fused(a, s, fresh[name][:, :, :, k], tables, pos,
+                                block_size=block_size, mesh=mesh,
+                                n_emit=n_emit, offset=k)
+        out[name], out[name + "_scale"] = a, s
     return out
+
+
+def _chunk_blocks(x, bs):
+    """(1, L, ng, T, hs) chunk-fresh layout → (T // bs, L, ng, bs, hs) block
+    granules for the chunk writer — pure reshape/transpose, no gather."""
+    _, L, ng, T, hs = x.shape
+    return x[0].reshape(L, ng, T // bs, bs, hs).transpose(2, 0, 1, 3, 4)
+
+
+def write_fresh_kv_chunk(arenas, fresh, dest, pos, *, block_size,
+                         kv_dtype=None, mesh=None):
+    """Lands one chunked-prefill piece's K/V in the arenas, block-granule,
+    in place — the ``prefill_chunk_paged`` program's ``scatter_blocks``
+    replacement.
+
+    ``fresh``: ``{"k"/"v": (1, L, ng, T, hs)}`` from a T = chunk-width
+    :func:`forward_paged` call (B=1 prefill layout, T block-aligned);
+    ``dest``: (nbb,) int32 scatter table from ``kv_pool.chunk_tables`` (sink
+    outside the chunk's own block range); ``pos``: (1,) int32 block-aligned
+    chunk start.  Each chunk block lands as one whole (L, ng, bs, hs) slab
+    at ``dest[pos // bs + c]``; quantized pools run the fused absmax
+    epilogue (``paged_chunk_write_fused``) with in-kernel masked error sums.
+    Returns ``(arenas, qerr)`` with ``qerr`` the same
+    ``0.5 * (k_rel + v_rel)`` figure the gather chunk program reports
+    (0.0 unquantized)."""
+    bs = block_size
+
+    def plain(arena, vals):
+        if mesh is None:
+            return paged_chunk_write(arena, vals, dest, pos, block_size=bs)
+        aspec = P(None, None, "tp", None, None)
+        return _smap(
+            lambda a, v, d, p: paged_chunk_write(a, v, d, p, block_size=bs),
+            mesh, (aspec, aspec, P(None), P(None)), aspec,
+        )(arena, vals, dest, pos)
+
+    def fused(arena, scale, vals):
+        if mesh is None:
+            return paged_chunk_write_fused(arena, scale, vals, dest, pos,
+                                           block_size=bs)
+        aspec = P(None, None, "tp", None, None)
+        sspec = P(None, None, "tp", None)
+        return _smap(
+            lambda a, s, v, d, p: paged_chunk_write_fused(
+                a, s, v, d, p, block_size=bs),
+            mesh, (aspec, sspec, aspec, P(None), P(None)),
+            (aspec, sspec, P(None, "tp", None)),
+        )(arena, scale, vals, dest, pos)
+
+    kb = _chunk_blocks(fresh["k"], bs)
+    vb = _chunk_blocks(fresh["v"], bs)
+    if kv_dtype is None:
+        out = {"k": plain(arenas["k"], kb.astype(arenas["k"].dtype)),
+               "v": plain(arenas["v"], vb.astype(arenas["v"].dtype))}
+        return out, jnp.float32(0.0)
+    ka, ks, ke = fused(arenas["k"], arenas["k_scale"], kb)
+    va, vs, ve = fused(arenas["v"], arenas["v_scale"], vb)
+
+    def rel(e):
+        # per-block masked sums ride in last-dim cols 0 (|dq - x|) and 1
+        # (|x|), zeros elsewhere — summing every row keeps the figure exact
+        # under a mesh, where the shards' err slabs concatenate on axis 1
+        return jnp.sum(e[..., 0]) / (jnp.sum(e[..., 1]) + 1e-30)
+
+    qerr = 0.5 * (rel(ke) + rel(ve))
+    return {"k": ka, "v": va, "k_scale": ks, "v_scale": vs}, qerr

@@ -455,7 +455,8 @@ def build_verify_paged(eng, Bb: int, nbb: int):
         chunk = jnp.concatenate([toks[:, None], drafts], axis=1)  # (B, K+1)
         logits, fresh = forward_paged(
             params, chunk, pos, arenas, tables, cos, sin, cfg,
-            cdtype=cdtype, mesh=mesh, **eng._fwd_kwargs(lora, slots),
+            cdtype=cdtype, mesh=mesh, lora_fused=True,
+            **eng._fwd_kwargs(lora, slots),
         )
         emitted, n_emit, y, new_keys = _acceptance(
             logits, drafts, q_rows, keys, temp, K)

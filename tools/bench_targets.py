@@ -40,6 +40,7 @@ __all__ = [
     "check_multistep_targets",
     "check_sessions_targets",
     "check_goodput_targets",
+    "check_ragged_targets",
 ]
 
 # generous: CI hosts jitter, and the gate exists to catch the donate=False
@@ -713,6 +714,81 @@ def check_sessions_targets(artifact: dict | None = None, *,
         f"paid an XLA compile — the TTFT windows are polluted by cold "
         f"starts"
     )
+    return artifact
+
+
+def check_ragged_targets(artifact: dict | None = None, *,
+                         min_blocks_ratio: float = 2.0,
+                         min_chunk_ratio: float = 1.0) -> dict:
+    """Validates the BENCH_RAGGED.json artifact: schema, **exact** token
+    parity for the mixed-cohort ragged decode drive AND the chunked paged
+    prefill drive against their gather twins, the headline claim (the
+    goodput ledger's bucketed blocks-walked at least ``min_blocks_ratio``x
+    the real blocks streamed — the bucket tax the ragged clamp stops
+    paying, a deterministic position-math figure, not a timing one), the
+    paged chunk kind actually resolving and stepping, the analytic chunk
+    arena-traffic ratio > ``min_chunk_ratio``, and program identity:
+    a warm identically-configured engine compiles ZERO new programs and
+    the cold engine's compile count stays inside its own bucket bound.
+    Wall-clock fields are schema-checked but never gated (interpret-mode
+    kernels on CPU).  Returns the artifact for chaining."""
+    if artifact is None:
+        artifact = load_artifact("BENCH_RAGGED.json")
+    assert "backend" in artifact and "results" in artifact, sorted(artifact)
+    r = artifact["results"]
+    for key in (
+        "parity_ok", "tokens_checked", "blocks_walked", "blocks_real",
+        "blocks_ratio_x", "decode_dispatches", "chunk_parity_ok",
+        "chunk_attn_mode", "chunk_kernel_steps",
+        "gather_chunk_bytes_per_piece", "paged_chunk_bytes_per_piece",
+        "chunk_traffic_ratio_x", "warm_engine_new_programs",
+        "warm_parity_ok", "bucket_bound", "compiles_total",
+        "drive_gather_ms", "drive_paged_ms",
+    ):
+        assert key in r, (key, sorted(r))
+    assert r["parity_ok"] is True, (
+        "ragged paged decode tokens diverged from the gather path on the "
+        "mixed cohort — the clamp broke the serving bit-exactness contract"
+    )
+    assert r["chunk_parity_ok"] is True, (
+        "chunked paged-prefill tokens diverged from the gather chunk path "
+        "— prefill_chunk_paged broke the serving bit-exactness contract"
+    )
+    assert r["tokens_checked"] > 0 and r["decode_dispatches"] > 0, r
+    assert r["blocks_walked"] > r["blocks_real"] > 0, (
+        f"the ledger shows no bucket slack (walked={r['blocks_walked']}, "
+        f"real={r['blocks_real']}) — either the cohort is not mixed or "
+        f"the blocks figure stopped recording"
+    )
+    assert r["blocks_ratio_x"] >= min_blocks_ratio, (
+        f"blocks walked only {r['blocks_ratio_x']:.2f}x the real blocks "
+        f"streamed (< {min_blocks_ratio}x) — the mixed cohort is not "
+        f"showing the bucket tax the ragged kernel exists to skip"
+    )
+    assert r["chunk_attn_mode"] == "paged" and r["chunk_kernel_steps"] > 0, (
+        f"the chunk kind resolved to {r['chunk_attn_mode']!r} with "
+        f"{r['chunk_kernel_steps']} kernel steps — prefill_chunk_paged "
+        f"never actually ran, so the chunk parity above proves nothing"
+    )
+    assert r["chunk_traffic_ratio_x"] > min_chunk_ratio, (
+        f"the paged chunk must move fewer arena bytes per piece than the "
+        f"dense round-trip: ratio {r['chunk_traffic_ratio_x']} <= "
+        f"{min_chunk_ratio}"
+    )
+    assert r["warm_engine_new_programs"] == 0, (
+        f"a warm identically-configured engine compiled "
+        f"{r['warm_engine_new_programs']} fresh programs — raggedness or "
+        f"the fused epilogues leaked into program identity"
+    )
+    assert r["warm_parity_ok"] is True, (
+        "the warm engine's tokens diverged from the cold engine's — "
+        "cached programs are not serving the same math"
+    )
+    assert r["compiles_total"] <= r["bucket_bound"], (
+        f"{r['compiles_total']} compiled programs exceed the bucket bound "
+        f"{r['bucket_bound']} — the paged kinds are leaking program shapes"
+    )
+    assert r["drive_gather_ms"] > 0 and r["drive_paged_ms"] > 0, r
     return artifact
 
 

@@ -526,37 +526,57 @@ def blocks_benchmarks(on_tpu: bool, out_path: str = "BENCH_BLOCKS.json"):
     return rows
 
 
-def scaling_table(out_path: str = "BENCH_SCALING.json"):
-    """Distributed scaling table on the virtual CPU mesh: tokens/s at
-    1/2/4/8 devices × ddp/fsdp/tp (the reference's multiprocess distributed
-    benchmark runner analog, benchmarks/__init__.py:584-698 — torchrun
-    spawns there; one process + virtual mesh here).  CPU tokens/s say
-    nothing about ICI — the table's value is the TREND (does throughput
-    scale with the mesh?) and CI-policing the sharded step at every size."""
+def scaling_table(out_path: str = "BENCH_SCALING.json", smoke: bool = False):
+    """Distributed scaling + production-training knob table on the virtual
+    CPU mesh.
+
+    Two halves:
+
+    - ``modes``: tokens/s at 1/2/4/8 devices × ddp/fsdp/tp (the reference's
+      multiprocess distributed benchmark runner analog,
+      benchmarks/__init__.py:584-698 — torchrun spawns there; one process +
+      virtual mesh here).  CPU tokens/s say nothing about ICI — the value is
+      the TREND and CI-policing the sharded step at every size.
+    - the training-knob sweeps (PR 20): remat policy peak-bytes curve at
+      equal loss, accumulation peak curve over k, overlap bucket/fraction
+      curve, overlap grad parity vs plain SPMD, and a mid-run-kill elastic
+      restart whose loss curve must be bit-identical to the undisturbed run.
+      These are DETERMINISTIC (byte/bool facts, not timings), so
+      tools/bench_targets.check_scaling_targets gates them even on CPU.
+    """
+    import tempfile
+
+    import numpy as np
     from jax.sharding import PartitionSpec as P
 
     from thunder_tpu._platform import force_cpu
 
     force_cpu(8)
     from thunder_tpu import distributed as dist
+    from thunder_tpu.serving.faults import FP_TRAIN_STEP, FaultPlan, FaultSpec, RetryPolicy
+    from thunder_tpu.train import AsyncCheckpointer, train_loop
 
     cfg = llama.Config.from_name("tiny-llama-debug")
-    B, T, steps = 16, 64, 4
+    B, T, steps = 16, 64, (2 if smoke else 4)
+    sizes = (1, 2) if smoke else (1, 2, 4, 8)
     idx = jax.random.randint(jax.random.PRNGKey(1), (B, T), 0, cfg.vocab_size)
     tgt = jax.random.randint(jax.random.PRNGKey(2), (B, T), 0, cfg.vocab_size)
     cos, sin = llama.build_rope_cache(cfg, T)
+
+    def loss_fn(p, i, t, c, s):
+        return llama.gpt_loss(p, i, t, c, s, cfg)
+
     table: dict[str, dict[str, float]] = {}
     for mode in ("ddp", "fsdp", "tp"):
         table[mode] = {}
-        for n in (1, 2, 4, 8):
+        for n in sizes:
             axes = {"tp": {"tp": n}, "fsdp": {"fsdp": n}, "ddp": {"dp": n}}[mode]
             bspec = P() if mode == "tp" else P(next(iter(axes)))
             mesh = dist.make_mesh(axes, devices=jax.devices()[:n])
             place = {"ddp": dist.ddp, "fsdp": dist.fsdp, "tp": dist.tp_fsdp}[mode]
             params = place(llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32), mesh)
             step = dist.make_train_step(
-                lambda p, i, t, c, s: llama.gpt_loss(p, i, t, c, s, cfg),
-                optax.adamw(1e-3), mesh, batch_specs=(bspec, bspec, P(), P()),
+                loss_fn, optax.adamw(1e-3), mesh, batch_specs=(bspec, bspec, P(), P()),
             )
             opt = step.init_optimizer_state(params)
             params, opt, loss = step(params, opt, idx, tgt, cos, sin)  # compile
@@ -564,12 +584,133 @@ def scaling_table(out_path: str = "BENCH_SCALING.json"):
             dt_s, _ = time_steps(lambda p, o: step(p, o, idx, tgt, cos, sin), steps, params, opt)
             table[mode][str(n)] = round(B * T * steps / dt_s, 1)
             log(f"scaling {mode} x{n}: {table[mode][str(n)]:,.0f} tokens/s (cpu smoke)")
-    artifact = {"backend": jax.default_backend(), "note": "virtual-mesh CPU smoke; trend only",
-                "shapes": {"B": B, "T": T, "cfg": cfg.name}, "table": table}
+
+    mesh1 = dist.make_mesh({"dp": 1}, devices=jax.devices()[:1])
+
+    def one_step(**kw):
+        params = dist.ddp(llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32), mesh1)
+        ts = dist.make_train_step(loss_fn, optax.adamw(1e-3), mesh1, **kw)
+        opt = ts.init_optimizer_state(params)
+        new_p, _, loss = ts(params, opt, idx, tgt, cos, sin)
+        return new_p, float(loss), ts.profile_stats()
+
+    # remat policy sweep: peak bytes must fall as the policy gets more
+    # aggressive while the loss stays bit-identical (recompute changes
+    # memory, never math)
+    remat = {}
+    for pol in ("none", "attention", "full_block"):
+        _, loss, st = one_step(remat=pol)
+        remat[pol] = {
+            "peak_bytes": int(st["peak_bytes_estimate"]),
+            "residual_bytes": int(st["residual_bytes"]),
+            "loss": loss,
+        }
+        log(f"scaling remat={pol}: peak {remat[pol]['peak_bytes']:,} B loss {loss:.6f}")
+    remat_reduction = 1.0 - remat["full_block"]["peak_bytes"] / remat["none"]["peak_bytes"]
+    remat_loss_delta = max(abs(remat[p]["loss"] - remat["none"]["loss"])
+                           for p in ("attention", "full_block"))
+
+    # accumulation sweep: microbatch activations shrink with B/k, the f32
+    # accumulator adds param-sized bytes — the peak curve must not grow
+    accum = {}
+    for k in (1, 2, 4):
+        p_k, loss, st = one_step(accum_steps=k)
+        accum[str(k)] = {
+            "peak_bytes": int(st["peak_bytes_estimate"]),
+            "accum_buffer_bytes": int(st["accum_buffer_bytes"]),
+            "loss": loss,
+        }
+        if k == 1:
+            p_1 = p_k
+        log(f"scaling accum k={k}: peak {accum[str(k)]['peak_bytes']:,} B loss {loss:.6f}")
+    accum_loss_delta = max(abs(accum[k]["loss"] - accum["1"]["loss"]) for k in accum)
+
+    # overlap sweep: dp=2 mesh, shrinking bucket caps — more buckets, more
+    # of the gradient bytes overlap the backward; grads must match SPMD
+    mesh2 = dist.make_mesh({"dp": 2}, devices=jax.devices()[:2])
+
+    def dp2_step(**kw):
+        params = dist.ddp(llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32), mesh2)
+        ts = dist.make_train_step(loss_fn, optax.adamw(1e-3), mesh2, **kw)
+        opt = ts.init_optimizer_state(params)
+        new_p, _, loss = ts(params, opt, idx, tgt, cos, sin)
+        return new_p, float(loss), ts
+
+    p_plain, loss_plain, _ = dp2_step(overlap=False)
+    overlap = {}
+    p_ov = None
+    for mb in (1.0, 0.25, 0.05):
+        p_o, loss_o, ts_o = dp2_step(overlap=True, overlap_bucket_mb=mb)
+        rep = ts_o.profile_stats()["overlap"]
+        overlap[str(mb)] = {"n_buckets": rep["n_buckets"],
+                            "overlap_frac": round(rep["overlap_frac"], 6)}
+        p_ov = p_o
+        log(f"scaling overlap bucket={mb}MiB: {rep['n_buckets']} buckets "
+            f"frac {rep['overlap_frac']:.3f}")
+    ov_delta = max(float(jnp.max(jnp.abs(a - b))) for a, b in zip(
+        jax.tree_util.tree_leaves(p_plain), jax.tree_util.tree_leaves(p_ov)))
+
+    # elastic restart episode: kill step call #4 with an engine-class fault,
+    # restore the newest committed checkpoint, and require the final loss
+    # curve bit-identical to the undisturbed run
+    loop_steps = 4 if smoke else 6
+    Br, Tr = 4, 32
+    cos_r, sin_r = llama.build_rope_cache(cfg, Tr)
+
+    def batch_for_step(s):
+        k1, k2 = jax.random.split(jax.random.PRNGKey(7000 + s))
+        return (jax.random.randint(k1, (Br, Tr), 0, cfg.vocab_size),
+                jax.random.randint(k2, (Br, Tr), 0, cfg.vocab_size), cos_r, sin_r)
+
+    def fresh_loop():
+        params = dist.ddp(llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32), mesh1)
+        ts = dist.make_train_step(loss_fn, optax.adamw(1e-3), mesh1)
+        return ts, params, ts.init_optimizer_state(params)
+
+    ts_a, p_a, o_a = fresh_loop()
+    base = train_loop(ts_a, p_a, o_a, batch_for_step, steps=loop_steps)
+    base_losses = [float(x) for x in base.losses]
+    with tempfile.TemporaryDirectory() as ckdir:
+        ts_b, p_b, o_b = fresh_loop()
+        plan = FaultPlan([FaultSpec(point=FP_TRAIN_STEP, kind="oom", at=loop_steps - 2)])
+        with AsyncCheckpointer(ckdir, config={"bench": "scaling"}) as ck:
+            faulted = train_loop(
+                ts_b, p_b, o_b, batch_for_step, steps=loop_steps,
+                checkpointer=ck, checkpoint_every=2, fault_plan=plan,
+                retry=RetryPolicy(max_retries=2, sleep=lambda s: None),
+            )
+    faulted_losses = [float(x) for x in faulted.losses]
+    bitident = all(
+        np.float32(a).tobytes() == np.float32(b).tobytes()
+        for a, b in zip(base_losses, faulted_losses)
+    )
+    log(f"scaling restart: {faulted.restarts} restart(s), resumed from "
+        f"{faulted.resumed_from}, loss curve bit-identical: {bitident}")
+
+    results = {
+        "modes": table,
+        "remat": remat,
+        "remat_peak_reduction_frac": round(remat_reduction, 6),
+        "remat_loss_max_delta": float(remat_loss_delta),
+        "accum": accum,
+        "accum_loss_max_delta": float(accum_loss_delta),
+        "overlap": overlap,
+        "overlap_grad_parity": bool(ov_delta <= 1e-5),
+        "overlap_max_param_delta": float(ov_delta),
+        "restart_loss_bitident": bool(bitident),
+        "restart_restarts": int(faulted.restarts),
+        "restart_resumed_from": faulted.resumed_from,
+    }
+    artifact = {"backend": jax.default_backend(),
+                "note": "virtual-mesh CPU smoke; tokens/s = trend only, the "
+                        "knob sweeps (remat/accum/overlap/restart) are "
+                        "deterministic facts gated by tools/bench_targets",
+                "shapes": {"B": B, "T": T, "cfg": cfg.name},
+                "results": results}
     with open(out_path, "w") as f:
         json.dump(artifact, f, indent=1)
     log(f"scaling artifact written to {out_path}")
-    return table
+    return artifact
 
 
 def dist_throughput_smoke():
@@ -682,12 +823,17 @@ def main():
         }))
         return
     if len(sys.argv) > 1 and sys.argv[1] == "scaling":
-        # virtual-mesh scaling table: forces 8 CPU devices itself, no TPU probe
-        t = scaling_table()
-        best = max(v for row in t.values() for v in row.values())
+        # virtual-mesh scaling + training-knob table: forces 8 CPU devices
+        # itself, no TPU probe
+        art = scaling_table()
+        r = art["results"]
+        best = max(v for row in r["modes"].values() for v in row.values())
         print(json.dumps({
             "metric": "dist_scaling_table_cpu_smoke", "value": best,
-            "unit": "tokens/s", "vs_baseline": 1.0, "table": t,
+            "unit": "tokens/s", "vs_baseline": 1.0, "table": r["modes"],
+            "remat_peak_reduction_frac": r["remat_peak_reduction_frac"],
+            "overlap_grad_parity": r["overlap_grad_parity"],
+            "restart_loss_bitident": r["restart_loss_bitident"],
         }))
         return
     if len(sys.argv) > 1 and sys.argv[1] == "dispatch":

@@ -146,15 +146,19 @@ class TestHarnessTargets:
 
     @pytest.mark.slow
     def test_scaling_table_cpu(self, tmp_path):
-        """The distributed scaling table must produce a tokens/s number for
-        every mode × mesh size (reference's distributed benchmark runner
-        analog)."""
+        """The distributed scaling + training-knob table must produce a
+        tokens/s number for every mode × mesh size (reference's distributed
+        benchmark runner analog) plus the deterministic knob sweeps the
+        scaling TargetSpec gates."""
         out = tmp_path / "scaling.json"
-        table = bench.scaling_table(out_path=str(out))
+        art = bench.scaling_table(out_path=str(out))
+        table = art["results"]["modes"]
         assert set(table) == {"ddp", "fsdp", "tp"}
         for mode, row in table.items():
             assert set(row) == {"1", "2", "4", "8"}, (mode, row)
             assert all(v > 0 for v in row.values()), (mode, row)
+        assert art["results"]["restart_loss_bitident"] is True
+        assert json.loads(out.read_text())["results"]["modes"] == table
 
     @pytest.mark.slow
     def test_decode_benchmark_cpu(self):
@@ -471,6 +475,28 @@ def _ragged_committed(art):
     assert r["chunk_attn_mode"] == "paged"
 
 
+def _scaling_committed(art):
+    r = art["results"]
+    assert r["remat_peak_reduction_frac"] >= 0.15
+    assert r["overlap_grad_parity"] is True
+    assert r["restart_loss_bitident"] is True
+    assert r["restart_restarts"] >= 1
+
+
+def _scaling_flatten_remat(r):
+    r["remat"]["full_block"]["peak_bytes"] = r["remat"]["none"]["peak_bytes"] + 1
+
+
+def _scaling_grow_accum(r):
+    ks = sorted(r["accum"], key=int)
+    r["accum"][ks[-1]]["peak_bytes"] = r["accum"][ks[0]]["peak_bytes"] + 1
+
+
+def _scaling_shrink_buckets(r):
+    finest = min(r["overlap"], key=float)
+    r["overlap"][finest]["n_buckets"] = 1
+
+
 def _compiles_over_bound(key="decode_compiles"):
     return lambda r: r.__setitem__(key, r["bucket_bound"] + 1)
 
@@ -554,6 +580,16 @@ def _smoke_ragged():
     return ragged_bench(on_tpu=False, smoke=True)
 
 
+def _smoke_scaling():
+    # scaling_table writes its artifact — the smoke must land in a temp
+    # path, never over the committed BENCH_SCALING.json
+    import os
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        return bench.scaling_table(out_path=os.path.join(d, "scaling.json"), smoke=True)
+
+
 
 # -- live-smoke extra assertions (deterministic facts the relaxed check
 #    kwargs turned off must still hold at smoke shapes) ---------------------
@@ -611,6 +647,12 @@ def _smoke_extra_goodput(r):
 def _smoke_extra_ragged(r):
     assert r["smoke"] is True, r
     assert r["parity_ok"] is True and r["chunk_parity_ok"] is True, r
+
+
+def _smoke_extra_scaling(r):
+    assert r["overlap_grad_parity"] is True, r
+    assert r["restart_loss_bitident"] is True, r
+    assert r["remat_loss_max_delta"] == 0.0, r
 
 
 TARGETS = [
@@ -844,6 +886,28 @@ TARGETS = [
         ),
         smoke=_smoke_ragged, smoke_check_kwargs={"min_blocks_ratio": 1.2},
         smoke_extra=_smoke_extra_ragged,
+    ),
+    TargetSpec(
+        # production-training knob table: remat peak curve monotone with a
+        # >= 15% full_block reduction at bit-stable loss, accum peak curve
+        # nonincreasing over k, overlap bucket monotonicity + grad parity
+        # vs plain SPMD, and the mid-run-kill elastic restart bit-identical
+        # (all deterministic facts — the full gate applies at smoke shapes)
+        name="scaling", artifact="BENCH_SCALING.json",
+        check="check_scaling_targets", committed=_scaling_committed,
+        regressions=(
+            (_set("remat_peak_reduction_frac", 0.05), "pruning residuals"),
+            (_scaling_flatten_remat, "monotone"),
+            (_set("remat_loss_max_delta", 1.0), "math transform"),
+            (_scaling_grow_accum, "trade steps for memory"),
+            (_set("accum_loss_max_delta", 1.0), "reassociation"),
+            (_scaling_shrink_buckets, "smaller buckets"),
+            (_set("overlap_grad_parity", False), "ordering optimization"),
+            (_set("restart_loss_bitident", False), "bit-identical"),
+            (_del("remat"), None),
+        ),
+        smoke=_smoke_scaling,
+        smoke_extra=_smoke_extra_scaling,
     ),
 ]
 

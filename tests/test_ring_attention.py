@@ -264,12 +264,13 @@ class TestUlysses:
         k = jax.random.normal(ks[1], (B, H, T, hs))
         v = jax.random.normal(ks[2], (B, H, T, hs))
         mesh = dist.make_mesh({"sp": 4}, devices=jax.devices()[:4])
-        out = jax.jit(jax.shard_map(
+        from thunder_tpu.distributed.prims import shard_map_compat
+
+        out = jax.jit(shard_map_compat(
             lambda q, k, v: dist.ulysses_attend_shard(q, k, v, axis="sp", sp=4),
             mesh=mesh,
             in_specs=(P(None, None, "sp"),) * 3,
             out_specs=P(None, None, "sp"),
-            check_vma=False,
         ))(q, k, v)
         s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) / (hs ** 0.5)
         s = jnp.where(jnp.tril(jnp.ones((T, T), bool)), s, -jnp.inf)

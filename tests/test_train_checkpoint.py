@@ -1,0 +1,150 @@
+"""Async atomic checkpointing + torn-file tolerance
+(thunder_tpu.train.checkpoint).
+
+Write hygiene contract: temp dir → per-leaf fsync → manifest committed
+LAST → atomic rename → parent fsync.  A kill at any instant leaves either
+a complete checkpoint or none; restore skips torn ones with a structured
+``CheckpointWarning`` and never crashes the resume."""
+import json
+import os
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from thunder_tpu.observability.metrics import registry
+from thunder_tpu.serving.faults import FP_CKPT_SAVE, FaultPlan, FaultSpec
+from thunder_tpu.train.checkpoint import (
+    AsyncCheckpointer,
+    CheckpointWarning,
+    committed_steps,
+    config_fingerprint,
+    restore_latest,
+    save_checkpoint_atomic,
+)
+
+STATE = {"w": jnp.arange(32, dtype=jnp.float32).reshape(4, 8), "b": jnp.ones((8,))}
+
+
+class TestAtomicSave:
+    def test_layout_and_manifest(self, tmp_path):
+        path = save_checkpoint_atomic(tmp_path, STATE, step=3, config={"lr": 1e-3})
+        assert path == str(tmp_path / "step_3")
+        manifest = json.loads((tmp_path / "step_3" / "manifest.json").read_text())
+        assert manifest["step"] == 3 and manifest["n_leaves"] == 2
+        assert manifest["config_fingerprint"] == config_fingerprint({"lr": 1e-3})
+        for entry in manifest["leaves"]:
+            assert (tmp_path / "step_3" / entry["file"]).exists()
+            assert entry["crc32"] >= 0 and entry["shape"] and entry["dtype"]
+
+    def test_no_temp_dirs_survive_commit(self, tmp_path):
+        save_checkpoint_atomic(tmp_path, STATE, step=1)
+        assert [p.name for p in tmp_path.iterdir()] == ["step_1"]
+        assert committed_steps(tmp_path) == [1]
+
+    def test_replayed_step_overwrites(self, tmp_path):
+        save_checkpoint_atomic(tmp_path, {"w": jnp.zeros(4)}, step=2)
+        save_checkpoint_atomic(tmp_path, {"w": jnp.ones(4)}, step=2)
+        got = restore_latest(tmp_path, {"w": jnp.zeros(4)})
+        assert got[0] == 2
+        np.testing.assert_array_equal(np.asarray(got[1]["w"]), np.ones(4))
+
+    def test_fingerprint_is_order_insensitive(self):
+        assert config_fingerprint({"a": 1, "b": 2}) == config_fingerprint({"b": 2, "a": 1})
+        assert config_fingerprint({"a": 1}) != config_fingerprint({"a": 2})
+
+
+class TestRestore:
+    def test_roundtrip_restores_values_and_structure(self, tmp_path):
+        save_checkpoint_atomic(tmp_path, STATE, step=5)
+        step, state = restore_latest(tmp_path, STATE)
+        assert step == 5 and set(state) == {"w", "b"}
+        np.testing.assert_array_equal(np.asarray(state["w"]), np.asarray(STATE["w"]))
+        assert isinstance(state["w"], jax.Array)  # device_put to template sharding
+
+    def test_empty_dir_returns_none(self, tmp_path):
+        assert restore_latest(tmp_path, STATE) is None
+
+    def test_torn_checkpoint_skipped_with_structured_warning(self, tmp_path):
+        save_checkpoint_atomic(tmp_path, STATE, step=2)
+        save_checkpoint_atomic(tmp_path, STATE, step=4)
+        # corrupt the newest commit's first leaf: a torn write past the
+        # rename can only come from media corruption, but the CRC must
+        # still catch it
+        with open(tmp_path / "step_4" / "leaf_00000.npy", "r+b") as f:
+            f.seek(128)
+            f.write(b"\xff" * 8)
+        before = registry().counter("train.checkpoint.torn_skipped").value
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            step, _ = restore_latest(tmp_path, STATE)
+        assert step == 2  # newest VALID wins
+        cw = [x.message for x in w if isinstance(x.message, CheckpointWarning)]
+        assert len(cw) == 1 and cw[0].info["reason"] == "checksum_mismatch"
+        assert cw[0].info["step"] == 4 and "step_4" in cw[0].info["path"]
+        assert registry().counter("train.checkpoint.torn_skipped").value == before + 1
+
+    def test_missing_manifest_means_torn(self, tmp_path):
+        save_checkpoint_atomic(tmp_path, STATE, step=1)
+        os.remove(tmp_path / "step_1" / "manifest.json")
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            assert restore_latest(tmp_path, STATE) is None
+        assert any(isinstance(x.message, CheckpointWarning)
+                   and x.message.info["reason"] == "missing_manifest" for x in w)
+
+    def test_strict_config_mismatch_skips(self, tmp_path):
+        save_checkpoint_atomic(tmp_path, STATE, step=1, config={"lr": 1e-3})
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            got = restore_latest(tmp_path, STATE, config={"lr": 3e-4}, strict_config=True)
+        assert got is None
+        assert any(isinstance(x.message, CheckpointWarning)
+                   and x.message.info["reason"] == "config_fingerprint_mismatch" for x in w)
+
+    def test_template_shape_mismatch_skips(self, tmp_path):
+        save_checkpoint_atomic(tmp_path, {"w": jnp.zeros(4), "extra": jnp.zeros(2)}, step=1)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            assert restore_latest(tmp_path, {"w": jnp.zeros(4)}) is None
+        assert any(isinstance(x.message, CheckpointWarning)
+                   and x.message.info["reason"] == "template_leaf_count_mismatch" for x in w)
+
+
+class TestAsyncCheckpointer:
+    def test_dispatch_harvest_commits_off_step_path(self, tmp_path):
+        with AsyncCheckpointer(tmp_path) as ck:
+            ck.dispatch(2, STATE)
+            ck.dispatch(4, STATE)
+            recs = ck.wait()
+        assert [r["step"] for r in recs] == [2, 4]
+        assert all("path" in r for r in recs)
+        assert committed_steps(tmp_path) == [2, 4]
+
+    def test_dispatch_snapshots_before_returning(self, tmp_path):
+        """The device_get in dispatch() is the donation-safety contract: the
+        caller's next donated step consumes these buffers, so deleting the
+        device array right after dispatch must not break the save."""
+        x = jnp.zeros(4, jnp.float32) + 7.0
+        with AsyncCheckpointer(tmp_path) as ck:
+            ck.dispatch(1, {"w": x})
+            x.delete()  # simulate donation consuming the buffer
+            recs = ck.wait()
+        assert recs and "error" not in recs[0]
+        _, got = restore_latest(tmp_path, {"w": jnp.zeros(4)})
+        np.testing.assert_array_equal(np.asarray(got["w"]), np.full(4, 7.0))
+
+    def test_injected_save_fault_surfaces_as_record(self, tmp_path):
+        """A FaultPlan armed at checkpoint.save makes save failures
+        reproducible; they surface as harvest records (and the failed
+        counter), never as exceptions on the step path."""
+        plan = FaultPlan([FaultSpec(point=FP_CKPT_SAVE, kind="fail", at=1)])
+        before = registry().counter("train.checkpoint.failed").value
+        with AsyncCheckpointer(tmp_path, fault_plan=plan) as ck:
+            ck.dispatch(2, STATE)
+            recs = ck.wait()
+        assert len(recs) == 1 and "error" in recs[0]
+        assert registry().counter("train.checkpoint.failed").value == before + 1
+        assert committed_steps(tmp_path) == []  # nothing partial published

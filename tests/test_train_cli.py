@@ -93,3 +93,54 @@ def test_cli_telemetry_jsonl(tmp_path):
         assert s["tokens"] == 4 * 32 and s["tokens_per_sec"] > 0
         assert s["peak_bytes"] > 0
         assert s["grad_norm"] > 0
+
+def test_cli_elastic_checkpoint_resume_bit_identity(tmp_path):
+    """Satellite PR-20 flags: --checkpoint-every writes committed atomic
+    checkpoints during the run; --resume restores the newest one and the
+    continued loss curve is bit-identical to an undisturbed run (the CLI
+    batch is a pure function of the step, so replay is exact).  run_start
+    telemetry must carry the full train config."""
+    ckdir_a, ckdir_b = tmp_path / "a", tmp_path / "b"
+    tel_a, tel_b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    base = [sys.executable, "train_cli.py", "--mode", "none", "--devices", "1",
+            "--virtual-cpu", "--batch", "2", "--seq", "16",
+            "--config", "tiny-llama-debug", "--checkpoint-every", "2"]
+
+    # undisturbed: 6 steps straight through
+    out = subprocess.run(
+        [*base, "--steps", "6", "--checkpoint-dir", str(ckdir_a),
+         "--telemetry", str(tel_a)],
+        capture_output=True, text=True, timeout=900, cwd="/root/repo")
+    assert out.returncode == 0, out.stderr[-2000:]
+    report = json.loads(out.stdout.strip().splitlines()[-1])
+    assert report["checkpoint_every"] == 2 and report["restarts"] == 0
+    assert sorted(p.name for p in ckdir_a.iterdir() if not p.name.startswith(".")) == [
+        "step_2", "step_4", "step_6"]
+
+    # interrupted: 4 steps, "kill", then --resume to 6
+    out = subprocess.run(
+        [*base, "--steps", "4", "--checkpoint-dir", str(ckdir_b)],
+        capture_output=True, text=True, timeout=900, cwd="/root/repo")
+    assert out.returncode == 0, out.stderr[-2000:]
+    out = subprocess.run(
+        [*base, "--steps", "6", "--checkpoint-dir", str(ckdir_b), "--resume",
+         "--telemetry", str(tel_b)],
+        capture_output=True, text=True, timeout=900, cwd="/root/repo")
+    assert out.returncode == 0, out.stderr[-2000:]
+    report = json.loads(out.stdout.strip().splitlines()[-1])
+    assert report["resumed_from"] == 4
+
+    lines_a = [json.loads(l) for l in tel_a.read_text().splitlines()]
+    lines_b = [json.loads(l) for l in tel_b.read_text().splitlines()]
+    # run_start carries the full train config (elastic fields included)
+    start = lines_b[0]
+    assert start["event"] == "run_start"
+    assert start["checkpoint_every"] == 2 and start["resume"] is True
+    assert start["accum_steps"] == 1 and start["overlap"] is False
+    assert start["remat"] in ("on", "off", "auto", "none", "attention", "full_block")
+    # resumed steps 4..5 match the undisturbed run's EXACTLY (json floats
+    # round-trip via repr, so == here is bit-identity)
+    loss_a = {l["step"]: l["loss"] for l in lines_a if l["event"] == "step"}
+    loss_b = {l["step"]: l["loss"] for l in lines_b if l["event"] == "step"}
+    assert sorted(loss_b) == [4, 5]
+    assert loss_b == {s: loss_a[s] for s in (4, 5)}

@@ -1,0 +1,170 @@
+"""Elastic training loop (thunder_tpu.train.loop): classify, restore,
+replay, converge.
+
+Most tests drive a FAKE step_fn — the loop's recovery grammar (transient
+retry, engine-class elastic restart, escalation, budgets) is host logic
+and needs no compiler.  One test runs a real tiny TrainStep to pin the
+headline guarantee: a mid-run kill + restart yields a loss curve
+bit-identical to the undisturbed run."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from thunder_tpu import distributed as dist
+from thunder_tpu.models import llama
+from thunder_tpu.serving.faults import (
+    FP_CKPT_SAVE,
+    FP_TRAIN_STEP,
+    FaultPlan,
+    FaultSpec,
+    RecoveryError,
+    RequestAnomalyFault,
+    RetryPolicy,
+)
+from thunder_tpu.train.checkpoint import AsyncCheckpointer
+from thunder_tpu.train.loop import train_loop
+
+NO_SLEEP = lambda: RetryPolicy(max_retries=3, sleep=lambda s: None)  # noqa: E731
+
+
+def _fake_step(params, opt_state, s):
+    """Pure fake: params counts completed steps, loss encodes the step."""
+    return {"w": params["w"] + 1.0}, opt_state, 100.0 + s
+
+
+def _jax_step(params, opt_state, s):
+    return {"w": params["w"] + 1.0}, opt_state, float(100 + s)
+
+
+BATCH = lambda s: (s,)  # noqa: E731 — pure function of the step index
+
+
+class TestLoopLogic:
+    def test_clean_run(self):
+        res = train_loop(_fake_step, {"w": 0.0}, {}, BATCH, steps=3)
+        assert res.losses == [100.0, 101.0, 102.0]
+        assert res.steps_run == 3 and res.restarts == 0 and res.retries == 0
+        assert res.params["w"] == 3.0
+
+    def test_transient_fault_retries_same_step(self):
+        slept = []
+        plan = FaultPlan([FaultSpec(point=FP_TRAIN_STEP, kind="fail", at=2)])
+        retry = RetryPolicy(max_retries=3, backoff_s=0.05, sleep=slept.append)
+        res = train_loop(_fake_step, {"w": 0.0}, {}, BATCH, steps=3,
+                         fault_plan=plan, retry=retry)
+        assert res.losses == [100.0, 101.0, 102.0]  # step 1 retried, not skipped
+        assert res.retries == 1 and res.restarts == 0
+        assert res.steps_run == 3 and res.params["w"] == 3.0
+        assert slept == [0.05]  # first backoff tier
+        assert res.faults[0]["kind"] == "fail" and res.faults[0]["point"] == FP_TRAIN_STEP
+
+    def test_transient_exhaustion_raises_recovery_error(self):
+        plan = FaultPlan([FaultSpec(point=FP_TRAIN_STEP, kind="fail", at=1, count=5)])
+        retry = RetryPolicy(max_retries=1, sleep=lambda s: None)
+        with pytest.raises(RecoveryError, match="persisted past"):
+            train_loop(_fake_step, {"w": 0.0}, {}, BATCH, steps=3,
+                       fault_plan=plan, retry=retry)
+
+    def test_engine_fault_restarts_from_seed_without_checkpointer(self):
+        """No committed checkpoint → the host seed-state snapshot replays
+        from start_step; donation makes the copy mandatory."""
+        plan = FaultPlan([FaultSpec(point=FP_TRAIN_STEP, kind="oom", at=3)])
+        res = train_loop(_fake_step, {"w": 0.0}, {}, BATCH, steps=4,
+                         fault_plan=plan, retry=NO_SLEEP())
+        assert res.restarts == 1 and res.resumed_from == 0
+        assert res.losses == [100.0, 101.0, 102.0, 103.0]
+        assert res.params["w"] == 4.0  # replayed from scratch, not doubled
+        assert res.steps_run == 6  # 2 before the fault + 4 replayed
+
+    def test_engine_fault_restores_newest_checkpoint(self, tmp_path):
+        plan = FaultPlan([FaultSpec(point=FP_TRAIN_STEP, kind="oom", at=5)])
+        with AsyncCheckpointer(tmp_path) as ck:
+            res = train_loop(_jax_step, {"w": jnp.zeros(())}, {"m": jnp.zeros(())},
+                             BATCH, steps=6, checkpointer=ck, checkpoint_every=2,
+                             fault_plan=plan, retry=NO_SLEEP())
+        assert res.restarts == 1 and res.resumed_from == 4
+        assert res.steps_run == 4 + 2  # steps 0-3, then 4-5 replayed from step_4
+        assert float(res.params["w"]) == 6.0
+        assert res.losses == [100.0, 101.0, 102.0, 103.0, 104.0, 105.0]
+        assert res.checkpoint_failures == []
+
+    def test_restart_budget_exhausted(self):
+        plan = FaultPlan([FaultSpec(point=FP_TRAIN_STEP, kind="oom", at=1, count=99)],
+                         max_faults=99)
+        with pytest.raises(RecoveryError, match="restart budget"):
+            train_loop(_fake_step, {"w": 0.0}, {}, BATCH, steps=3,
+                       fault_plan=plan, retry=NO_SLEEP(), max_restarts=2)
+
+    def test_request_class_escalates(self):
+        """nan-class faults blame a request; training has no request to
+        quarantine, so they escalate like programming errors."""
+        plan = FaultPlan([FaultSpec(point=FP_TRAIN_STEP, kind="nan", at=2, rid=None)])
+        with pytest.raises(RequestAnomalyFault):
+            train_loop(_fake_step, {"w": 0.0}, {}, BATCH, steps=3, fault_plan=plan)
+
+    def test_unclassified_exception_reraises(self):
+        def bad_step(params, opt_state, s):
+            raise KeyError("programming error")
+
+        with pytest.raises(KeyError):
+            train_loop(bad_step, {"w": 0.0}, {}, BATCH, steps=2)
+
+    def test_failed_save_recorded_not_raised(self, tmp_path):
+        ck_plan = FaultPlan([FaultSpec(point=FP_CKPT_SAVE, kind="fail", at=1)])
+        with AsyncCheckpointer(tmp_path, fault_plan=ck_plan) as ck:
+            res = train_loop(_jax_step, {"w": jnp.zeros(())}, {}, BATCH, steps=4,
+                             checkpointer=ck, checkpoint_every=2)
+        assert res.losses == [100.0, 101.0, 102.0, 103.0]  # step path undisturbed
+        assert len(res.checkpoint_failures) == 1
+        assert res.checkpoint_failures[0]["step"] == 2
+
+    def test_on_step_sees_every_final_step_once(self):
+        seen = []
+        plan = FaultPlan([FaultSpec(point=FP_TRAIN_STEP, kind="fail", at=2)])
+        train_loop(_fake_step, {"w": 0.0}, {}, BATCH, steps=3,
+                   fault_plan=plan, retry=NO_SLEEP(),
+                   on_step=lambda s, loss: seen.append(s))
+        assert seen == [0, 1, 2]
+
+
+class TestRealStepBitIdentity:
+    def test_kill_and_restart_loss_curve_bit_identical(self, tmp_path):
+        """The acceptance gate, in-process: run a real TrainStep loop clean,
+        then the SAME built step under an injected engine fault + async
+        checkpoints, and compare loss curves byte-for-byte."""
+        cfg = llama.Config.from_name("tiny-llama-debug")
+        B, T = 2, 16
+        mesh = dist.make_mesh({"dp": 1}, devices=jax.devices()[:1])
+        cos, sin = llama.build_rope_cache(cfg, T)
+        ts = dist.make_train_step(
+            lambda p, i, t, c, s: llama.gpt_loss(p, i, t, c, s, cfg),
+            optax.adamw(1e-3), mesh,
+        )
+
+        def batch_for_step(s):
+            idx = jax.random.randint(jax.random.PRNGKey(2 * s), (B, T), 0, cfg.vocab_size)
+            tgt = jax.random.randint(jax.random.PRNGKey(2 * s + 1), (B, T), 0, cfg.vocab_size)
+            return idx, tgt, cos, sin
+
+        def fresh():
+            params = dist.ddp(llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32), mesh)
+            return params, ts.init_optimizer_state(params)
+
+        steps = 5
+        p, o = fresh()
+        clean = train_loop(ts, p, o, batch_for_step, steps=steps)
+        clean_bytes = [np.float32(x).tobytes() for x in clean.losses]
+
+        plan = FaultPlan([FaultSpec(point=FP_TRAIN_STEP, kind="oom", at=4)])
+        p, o = fresh()
+        with AsyncCheckpointer(tmp_path) as ck:
+            faulted = train_loop(ts, p, o, batch_for_step, steps=steps,
+                                 checkpointer=ck, checkpoint_every=2,
+                                 fault_plan=plan, retry=NO_SLEEP())
+        assert faulted.restarts == 1 and faulted.resumed_from == 2
+        assert [np.float32(x).tobytes() for x in faulted.losses] == clean_bytes
+        for a, b in zip(jax.tree_util.tree_leaves(clean.params),
+                        jax.tree_util.tree_leaves(faulted.params)):
+            assert np.asarray(a).tobytes() == np.asarray(b).tobytes()

@@ -1,0 +1,137 @@
+"""Bucketed-psum gradient overlap (thunder_tpu.train.overlap +
+TrainStep(overlap=True)).
+
+The torch-DDP bucket_cap_mb design on a TPU mesh: grads bucketed in
+reverse leaf order, one variadic psum per bucket inside shard_map over
+``dp``.  Overlap is an ORDERING optimization — the resulting params must
+be bit-identical to the plain SPMD grad sync."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from thunder_tpu import distributed as dist
+from thunder_tpu.models import llama
+from thunder_tpu.train.overlap import (
+    assign_buckets,
+    bucket_cap_suggestion,
+    expected_all_reduces,
+    overlap_fraction,
+    validate_overlap_mesh,
+)
+
+CFG = llama.Config.from_name("tiny-llama-debug")
+B, T = 4, 16
+
+
+class TestBuckets:
+    # leaves of 1 MiB / 1 MiB / 2 MiB / 0.5 MiB (f32)
+    LEAVES = [jnp.zeros(262144), jnp.zeros(262144), jnp.zeros(524288), jnp.zeros(131072)]
+
+    def test_reverse_order_fill(self):
+        buckets = assign_buckets(self.LEAVES, bucket_mb=2.5)
+        # reverse order: [3(0.5M), 2(2M)] fills to 2.5M, then [1, 0] (2M)
+        assert buckets == [[3, 2], [1, 0]]
+        flat = [i for b in buckets for i in b]
+        assert sorted(flat) == [0, 1, 2, 3]  # every leaf exactly once
+
+    def test_oversized_leaf_gets_own_bucket(self):
+        buckets = assign_buckets(self.LEAVES, bucket_mb=1.0)
+        assert [2] in buckets  # the 2 MiB leaf is never split or merged
+        assert all(len(b) >= 1 for b in buckets)
+
+    def test_huge_cap_means_one_bucket(self):
+        assert assign_buckets(self.LEAVES, bucket_mb=1e6) == [[3, 2, 1, 0]]
+
+    def test_smaller_cap_never_fewer_buckets(self):
+        caps = [8.0, 2.0, 1.0, 0.25]
+        counts = [len(assign_buckets(self.LEAVES, c)) for c in caps]
+        assert counts == sorted(counts)
+
+    def test_overlap_fraction_analytic(self):
+        buckets = assign_buckets(self.LEAVES, bucket_mb=2.5)
+        # last bucket holds leaves 1+0 = 2 MiB of 4.5 MiB total
+        assert overlap_fraction(self.LEAVES, buckets) == pytest.approx(1 - 2 / 4.5)
+        # one bucket == no overlap: nothing left to hide the reduction behind
+        assert overlap_fraction(self.LEAVES, [[3, 2, 1, 0]]) == 0.0
+        assert overlap_fraction([], []) == 0.0
+
+    def test_expected_all_reduces_counts_loss_mean(self):
+        assert expected_all_reduces([[0], [1]]) == 3
+
+    def test_bucket_cap_suggestion(self):
+        # 8 MiB of grads at 4 target buckets -> ~2 MiB caps
+        assert bucket_cap_suggestion(8 * 2**20, 4) == pytest.approx(2.0)
+        assert bucket_cap_suggestion(0) == 25.0
+
+
+class TestMeshValidation:
+    def test_pure_dp_ok(self):
+        validate_overlap_mesh(dist.make_mesh({"dp": 2}, devices=jax.devices()[:2]))
+
+    def test_missing_dp_axis_rejected(self):
+        mesh = dist.make_mesh({"tp": 2}, devices=jax.devices()[:2])
+        with pytest.raises(ValueError, match="needs a 'dp' mesh axis"):
+            validate_overlap_mesh(mesh)
+
+    def test_nontrivial_extra_axis_rejected(self):
+        mesh = dist.make_mesh({"dp": 2, "tp": 2}, devices=jax.devices()[:4])
+        with pytest.raises(ValueError, match="pure data-parallel"):
+            validate_overlap_mesh(mesh)
+
+    def test_train_step_validates_at_init(self):
+        mesh = dist.make_mesh({"dp": 2, "tp": 2}, devices=jax.devices()[:4])
+        with pytest.raises(ValueError, match="pure data-parallel"):
+            dist.make_train_step(
+                lambda p, i, t, c, s: llama.gpt_loss(p, i, t, c, s, CFG),
+                optax.adamw(1e-3), mesh, overlap=True,
+            )
+
+
+class TestOverlapParity:
+    def _run(self, overlap, bucket_mb=0.05):
+        mesh = dist.make_mesh({"dp": 2}, devices=jax.devices()[:2])
+        idx = jax.random.randint(jax.random.PRNGKey(1), (B, T), 0, CFG.vocab_size)
+        tgt = jax.random.randint(jax.random.PRNGKey(2), (B, T), 0, CFG.vocab_size)
+        cos, sin = llama.build_rope_cache(CFG, T)
+        params = dist.ddp(llama.init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32), mesh)
+        ts = dist.make_train_step(
+            lambda p, i, t, c, s: llama.gpt_loss(p, i, t, c, s, CFG),
+            optax.adamw(1e-3), mesh, overlap=overlap, overlap_bucket_mb=bucket_mb,
+        )
+        opt = ts.init_optimizer_state(params)
+        p, _, loss = ts(params, opt, idx, tgt, cos, sin)
+        return p, float(loss), ts
+
+    def test_overlap_params_bit_identical_to_spmd(self):
+        """2-device mesh: bucketed psum vs XLA's own sharding-derived
+        reduction.  Both compute sum/n in f32 — the params must match
+        bit-for-bit, or overlap silently changed the math."""
+        p_plain, l_plain, _ = self._run(False)
+        p_ov, l_ov, ts = self._run(True)
+        assert np.float32(l_plain).tobytes() == np.float32(l_ov).tobytes()
+        for a, b in zip(jax.tree_util.tree_leaves(p_plain), jax.tree_util.tree_leaves(p_ov)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        rep = ts.profile_stats()["overlap"]
+        assert rep["n_buckets"] > 1 and 0.0 < rep["overlap_frac"] < 1.0
+        assert sum(rep["bucket_bytes"]) == rep["total_grad_bytes"]
+
+    def test_single_bucket_reports_zero_overlap(self):
+        _, _, ts = self._run(True, bucket_mb=1e4)
+        rep = ts.profile_stats()["overlap"]
+        assert rep["n_buckets"] == 1 and rep["overlap_frac"] == 0.0
+
+    def test_overlap_rejects_indivisible_batch(self):
+        mesh = dist.make_mesh({"dp": 2}, devices=jax.devices()[:2])
+        idx = jax.random.randint(jax.random.PRNGKey(1), (3, T), 0, CFG.vocab_size)
+        tgt = jax.random.randint(jax.random.PRNGKey(2), (3, T), 0, CFG.vocab_size)
+        cos, sin = llama.build_rope_cache(CFG, T)
+        params = dist.ddp(llama.init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32), mesh)
+        ts = dist.make_train_step(
+            lambda p, i, t, c, s: llama.gpt_loss(p, i, t, c, s, CFG),
+            optax.adamw(1e-3), mesh, overlap=True,
+        )
+        opt = ts.init_optimizer_state(params)
+        with pytest.raises(ValueError, match="divisible by the dp axis"):
+            ts(params, opt, idx, tgt, cos, sin)

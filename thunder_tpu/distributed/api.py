@@ -199,14 +199,18 @@ class TrainStep:
         batch_specs: Sequence[P] | None = None,
         donate: bool = True,
         donate_batch: bool = False,
-        remat: bool = True,
+        remat: bool | str = True,
         zero3: bool = False,
+        accum_steps: int = 1,
+        overlap: bool = False,
+        overlap_bucket_mb: float = 4.0,
         executors=None,
         quant: str | None = None,
         comm_combine_threshold_mb: float | None = None,
         bucketer: Callable | None = None,
     ):
         from thunder_tpu.core import compile_cache
+        from thunder_tpu.train.remat import validate_remat
 
         compile_cache.ensure_enabled()  # warm-start repeat processes
         self.loss_fn = loss_fn
@@ -223,12 +227,30 @@ class TrainStep:
         #: summaries + donated-aware peak estimates); None until built or
         #: when donate=False
         self.donation_report = None
-        if not (isinstance(remat, bool) or remat == "auto"):
-            raise ValueError(f"remat must be True, False, or 'auto', got {remat!r}")
+        validate_remat(remat)
         self.remat = remat
         #: the resolved decision of the last _build (introspection/tests)
         self.last_remat_applied: bool | None = None
+        #: the resolved policy name of the last _build (train.remat.REMAT_POLICIES)
+        self.last_remat_policy: str | None = None
         self.zero3 = zero3
+        if not isinstance(accum_steps, int) or accum_steps < 1:
+            raise ValueError(f"accum_steps must be an int >= 1, got {accum_steps!r}")
+        # in-program gradient accumulation: k microsteps inside ONE donated
+        # program (lax.scan over (k, B/k, ...) microbatches, float32
+        # accumulator in fixed order); k=1 is byte-identical to the plain path
+        self.accum_steps = accum_steps
+        # bucketed-psum gradient collectives during backward (torch DDP
+        # bucket_cap_mb design, train.overlap) — pure-dp meshes only
+        self.overlap = overlap
+        self.overlap_bucket_mb = overlap_bucket_mb
+        #: analytic bucket/overlap accounting of the last _build (None until
+        #: built or when overlap=False)
+        self.overlap_report = None
+        if overlap:
+            from thunder_tpu.train.overlap import validate_overlap_mesh
+
+            validate_overlap_mesh(mesh)
         self.executors = executors
         if quant not in (None, "int8", "fp8"):
             raise ValueError(f"quant must be None, 'int8', or 'fp8', got {quant!r}")
@@ -305,7 +327,47 @@ class TrainStep:
         from thunder_tpu.core.transforms import forward_and_backward_from_trace
         from thunder_tpu.functional import trace_from_fn
 
-        trace_results = trace_from_fn(self.loss_fn, (params, *batch), {}, grad_argnums=(0,))
+        # accum_steps=k: the fw/bw traces are built at MICROBATCH shapes
+        # (B/k per microstep) — the accumulation scan feeds them k slices
+        # inside one program.  Trace shapes bake into bound symbols
+        # (reshape dims etc.), so tracing at B and evaluating at B/k is not
+        # an option.
+        k = self.accum_steps
+        accum_mask: tuple = ()
+        if k > 1:
+            from thunder_tpu.train.accum import split_for_accum
+
+            split_template, accum_mask = split_for_accum(batch, k)
+            trace_batch = tuple(
+                b[0] if m else b for b, m in zip(split_template, accum_mask)
+            )
+        else:
+            trace_batch = batch
+
+        # overlap: the grad body runs INSIDE shard_map over dp, so each
+        # device evaluates the trace on its LOCAL shard — trace at B/dp
+        # (on top of any B/k microbatching above), same shape-baking rule.
+        # The "grads" entry still takes GLOBAL microbatches, so its
+        # shardings prune against the pre-slicing shapes.
+        micro_template = trace_batch
+        if self.overlap:
+            from thunder_tpu.train.accum import microbatch_mask as _mb_mask
+
+            dp = int(self.mesh.shape["dp"])
+            if dp > 1:
+                ov_mask = _mb_mask(trace_batch)
+                b0 = int(trace_batch[0].shape[0])
+                if b0 % dp != 0:
+                    raise ValueError(
+                        f"overlap=True needs the per-step batch ({b0}) divisible "
+                        f"by the dp axis ({dp})"
+                    )
+                trace_batch = tuple(
+                    b[: b.shape[0] // dp] if m else b
+                    for b, m in zip(trace_batch, ov_mask)
+                )
+
+        trace_results = trace_from_fn(self.loss_fn, (params, *trace_batch), {}, grad_argnums=(0,))
         comp = dce(trace_results.computation_trace)
         comp = cse(comp)
         # before the fw/bw split so the backward rule sees the half-precision
@@ -313,19 +375,27 @@ class TrainStep:
         comp = absorb_ce_widening_converts(comp)
         comp.args = trace_results.computation_trace.args
         fw_trace, bw_trace = forward_and_backward_from_trace(comp)
-        do_remat = self.remat if isinstance(self.remat, bool) else self._auto_remat(
-            fw_trace, params, opt_state, batch
+        from thunder_tpu.core.rematerialization import saved_bytes
+        from thunder_tpu.train.remat import resolve_remat
+
+        residual_bytes_no_remat = saved_bytes(fw_trace)
+        decision = resolve_remat(
+            self.remat, zero3=self.zero3,
+            auto=lambda: self._auto_remat(fw_trace, params, opt_state, trace_batch),
         )
-        self.last_remat_applied = bool(do_remat or self.zero3)
-        if do_remat or self.zero3:
+        self.last_remat_applied = decision.apply
+        self.last_remat_policy = decision.policy
+        if decision.apply:
             from thunder_tpu.core.rematerialization import rematerialize_forward_and_backward
 
-            # zero3: aggressive remat — residuals shrink toward the inputs,
-            # and XLA re-gathers sharded params inside the recompute cones
-            # (regather-in-backward, reference rematerialization.py:389)
+            # full_block (and zero3, which forces it): aggressive remat —
+            # residuals shrink toward the inputs, and XLA re-gathers sharded
+            # params inside the recompute cones (regather-in-backward,
+            # reference rematerialization.py:389)
             fw_trace, bw_trace = rematerialize_forward_and_backward(
-                fw_trace, bw_trace, max_cone=256 if self.zero3 else 64, aggressive=self.zero3
+                fw_trace, bw_trace, max_cone=decision.max_cone, aggressive=decision.aggressive
             )
+        residual_bytes = saved_bytes(fw_trace)
         # one execution pipeline: the same claiming pass the jit path uses, so
         # operator executors (pallas flash attention, int8) claim symbols here
         # too instead of relying on jaxex fast-path hooks alone
@@ -369,12 +439,33 @@ class TrainStep:
             bw_deld, bw_donation = annotate_donations(
                 del_last_used(bw_trace), which="trainstep_backward"
             )
+            from thunder_tpu.train.accum import accum_buffer_bytes
+
+            fw_peak = memory_timeline(fw_deld)["peak_bytes_estimate"]
+            bw_peak = memory_timeline(bw_deld)["peak_bytes_estimate"]
+            # accum_steps=k carries a float32 grad accumulator across the
+            # scan — real memory the donated-aware estimate must include
+            # (the per-microstep activation peaks above already shrank to
+            # B/k because the traces are microbatch-shaped)
+            acc_bytes = accum_buffer_bytes(params) if k > 1 else 0
             self.donation_report = {
                 "forward": donation_summary(fw_donation),
                 "backward": donation_summary(bw_donation),
-                "fw_peak_bytes_estimate": memory_timeline(fw_deld)["peak_bytes_estimate"],
-                "bw_peak_bytes_estimate": memory_timeline(bw_deld)["peak_bytes_estimate"],
+                "fw_peak_bytes_estimate": fw_peak,
+                "bw_peak_bytes_estimate": bw_peak,
+                "remat_policy": decision.policy,
+                "residual_bytes_no_remat": residual_bytes_no_remat,
+                "residual_bytes": residual_bytes,
+                "accum_steps": k,
+                "accum_buffer_bytes": acc_bytes,
+                "peak_bytes_estimate": max(fw_peak, bw_peak) + acc_bytes,
             }
+            from thunder_tpu.observability.metrics import registry as _registry
+
+            _registry().gauge("train.step.peak_bytes_estimate").set(
+                self.donation_report["peak_bytes_estimate"]
+            )
+            _registry().gauge("train.step.residual_bytes").set(residual_bytes)
 
         # map runtime leaves → computation inputs (flatten order, tensors only).
         # MUST use the same tensor predicate as the frontend so the env order
@@ -414,17 +505,92 @@ class TrainStep:
         # shardings: params/opt from their current placement; batch from specs
         param_sh = jax.tree_util.tree_map(lambda x: x.sharding, params)
 
-        def step(params, opt_state, *batch):
-            loss, grads = value_and_grad_fn(params, *batch)
-            # pin each grad to its param's sharding HERE: SPMD then resolves
-            # the data-axes partial-sum straight into the param layout (one
-            # reduce-scatter/all-reduce) instead of propagating a layout the
-            # optimizer update can't transition from without a full
-            # rematerialization (spmd_partitioner.cc:652 warnings on the GQA
-            # kv grads under a dp×fsdp×tp mesh)
-            grads = jax.lax.with_sharding_constraint(grads, param_sh)
-            new_params, new_opt_state = apply_gradients(params, opt_state, grads)
-            return new_params, new_opt_state, loss
+        # overlap: wrap the grad computation in a shard_map over dp and
+        # issue the data-parallel mean as one psum PER BUCKET (reverse leaf
+        # order) so XLA's scheduler can hoist early buckets into the
+        # backward — the torch-DDP bucket_cap_mb design (train.overlap)
+        grad_fn = value_and_grad_fn
+        if self.overlap:
+            from thunder_tpu.train.accum import microbatch_mask
+            from thunder_tpu.train.overlap import (
+                assign_buckets,
+                bucketed_grad_sync,
+                overlap_report,
+            )
+
+            buckets = assign_buckets(params_flat, self.overlap_bucket_mb)
+            self.overlap_report = overlap_report(params_flat, buckets, self.overlap_bucket_mb)
+            sm_mask = microbatch_mask(trace_batch)
+
+            def _local_vg(params, *mb):
+                loss, grads = value_and_grad_fn(params, *mb)
+                grads = bucketed_grad_sync(grads, axis="dp", buckets=buckets)
+                return jax.lax.pmean(loss, "dp"), grads
+
+            from thunder_tpu.distributed.prims import shard_map_compat
+
+            in_specs = (P(),) + tuple(P("dp") if m else P() for m in sm_mask)
+            grad_fn = shard_map_compat(
+                _local_vg, mesh=self.mesh, in_specs=in_specs,
+                out_specs=(P(), P()),
+            )
+
+        if k > 1:
+            # ONE donated program: lax.scan over the (k, B/k, ...) microbatch
+            # axis with a float32 accumulator in fixed summation order
+            # (microstep 0 first, always) — deterministic, and equal to the
+            # k×-batch step up to float reassociation
+            def _shift(sh, shape):
+                # (B, ...) spec -> (k, B/k, ...): batch axes move to dim 1
+                return NamedSharding(self.mesh, P(None, *sh.spec))
+
+            def step(params, opt_state, *batch):
+                split = []
+                for b, m, sh in zip(batch, accum_mask, batch_sh):
+                    if m:
+                        shp = jnp.shape(b)
+                        mb = jnp.reshape(b, (k, shp[0] // k) + tuple(shp[1:]))
+                        split.append(jax.lax.with_sharding_constraint(mb, _shift(sh, shp)))
+                    else:
+                        split.append(b)
+                scanned = tuple(b for b, m in zip(split, accum_mask) if m)
+                acc0 = jax.tree_util.tree_map(
+                    lambda x: jnp.zeros(jnp.shape(x), jnp.float32), params
+                )
+
+                def body(carry, mbs):
+                    acc, loss_sum = carry
+                    it = iter(mbs)
+                    args = tuple(next(it) if m else b for b, m in zip(split, accum_mask))
+                    loss, grads = grad_fn(params, *args)
+                    acc = jax.tree_util.tree_map(
+                        lambda a, g: a + g.astype(jnp.float32), acc, grads
+                    )
+                    return (acc, loss_sum + loss.astype(jnp.float32)), None
+
+                (acc, loss_sum), _ = jax.lax.scan(
+                    body, (acc0, jnp.zeros((), jnp.float32)), scanned
+                )
+                grads = jax.tree_util.tree_map(
+                    lambda a, p: (a / k).astype(jnp.asarray(p).dtype), acc, params
+                )
+                loss = loss_sum / k  # mean of microbatch means == batch mean
+                grads = jax.lax.with_sharding_constraint(grads, param_sh)
+                new_params, new_opt_state = apply_gradients(params, opt_state, grads)
+                return new_params, new_opt_state, loss
+        else:
+            def step(params, opt_state, *batch):
+                loss, grads = grad_fn(params, *batch)
+                # pin each grad to its param's sharding HERE: SPMD then
+                # resolves the data-axes partial-sum straight into the param
+                # layout (one reduce-scatter/all-reduce) instead of
+                # propagating a layout the optimizer update can't transition
+                # from without a full rematerialization
+                # (spmd_partitioner.cc:652 warnings on the GQA kv grads
+                # under a dp×fsdp×tp mesh)
+                grads = jax.lax.with_sharding_constraint(grads, param_sh)
+                new_params, new_opt_state = apply_gradients(params, opt_state, grads)
+                return new_params, new_opt_state, loss
         opt_sh = jax.tree_util.tree_map(
             lambda x: x.sharding if isinstance(x, jax.Array) else None, opt_state
         )
@@ -435,6 +601,18 @@ class TrainStep:
                 NamedSharding(self.mesh, _prune_spec(s, jnp.shape(b), self.mesh))
                 for s, b in zip(self.batch_specs, batch)
             )
+        # the "grads" micro-step entry is shaped like ONE microbatch (B/k):
+        # its shardings prune against the micro shapes, not the full batch
+        if k > 1:
+            if self.batch_specs is None:
+                micro_batch_sh = default_batch_shardings(self.mesh, micro_template)
+            else:
+                micro_batch_sh = tuple(
+                    NamedSharding(self.mesh, _prune_spec(s, jnp.shape(b), self.mesh))
+                    for s, b in zip(self.batch_specs, micro_template)
+                )
+        else:
+            micro_batch_sh = batch_sh
 
         copts = combine_threshold_options(self.comm_combine_threshold_mb)
         self.compiler_options = copts
@@ -481,7 +659,7 @@ class TrainStep:
             # in_shardings expect param_sh)
             "grads": jax.jit(
                 value_and_grad_fn,
-                in_shardings=(param_sh,) + batch_sh,
+                in_shardings=(param_sh,) + micro_batch_sh,
                 out_shardings=(None, param_sh),
                 donate_argnums=grads_donate,
                 **jit_kw,
@@ -563,6 +741,31 @@ class TrainStep:
             entry = self._get_entry(params, opt_state, batch_template)
             return entry["apply"](params, opt_state, grads)
 
+    def profile_stats(self) -> dict:
+        """Peak-bytes / policy accounting of the last build (the
+        training-plane sibling of ``thunder_tpu.profile_stats``): the
+        resolved remat policy with its residual-bytes delta, the
+        donated-aware fw/bw peak estimates, the float32 accumulator bytes
+        ``accum_steps=k`` adds, and the bucketed-overlap accounting when
+        ``overlap=True``.  Needs a built step (call the TrainStep once)."""
+        if self.last_remat_policy is None:
+            raise RuntimeError(
+                "profile_stats() needs a built step — run the TrainStep once first"
+            )
+        out: dict = {"remat_policy": self.last_remat_policy,
+                     "accum_steps": self.accum_steps}
+        if self.donation_report is not None:
+            out.update({k: v for k, v in self.donation_report.items()
+                        if k not in ("forward", "backward")})
+            if self.donation_report["residual_bytes_no_remat"]:
+                out["remat_residual_reduction_frac"] = 1.0 - (
+                    self.donation_report["residual_bytes"]
+                    / self.donation_report["residual_bytes_no_remat"]
+                )
+        if self.overlap_report is not None:
+            out["overlap"] = dict(self.overlap_report)
+        return out
+
     def no_sync(self):
         """Reference-compat alias (``thunder/distributed/__init__.py:200``):
         a context yielding the micro-step ``grads`` entry — (loss, grads)
@@ -623,8 +826,11 @@ def make_train_step(
     batch_specs: Sequence[P] | None = None,
     donate: bool = True,
     donate_batch: bool = False,
-    remat: bool = True,
+    remat: bool | str = True,
     zero3: bool = False,
+    accum_steps: int = 1,
+    overlap: bool = False,
+    overlap_bucket_mb: float = 4.0,
     executors=None,
     quant: str | None = None,
     comm_combine_threshold_mb: float | None = None,
@@ -633,6 +839,7 @@ def make_train_step(
     return TrainStep(
         loss_fn, optimizer, mesh, batch_specs=batch_specs, donate=donate,
         donate_batch=donate_batch, remat=remat,
-        zero3=zero3, executors=executors, quant=quant,
+        zero3=zero3, accum_steps=accum_steps, overlap=overlap,
+        overlap_bucket_mb=overlap_bucket_mb, executors=executors, quant=quant,
         comm_combine_threshold_mb=comm_combine_threshold_mb, bucketer=bucketer,
     )

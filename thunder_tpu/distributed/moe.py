@@ -122,12 +122,13 @@ def ep_moe_mlp(
         )
         return y.reshape(xb.shape)
 
-    fn = jax.shard_map(
+    from thunder_tpu.distributed.prims import shard_map_compat
+
+    fn = shard_map_compat(
         body,
         mesh=mesh,
         in_specs=(P(axis), P(), P(axis), P(axis), P(axis)),
         out_specs=P(axis),
-        check_vma=False,
     )
     return fn(x, mp["gate"], mp["fc_1"], mp["fc_2"], mp["proj"])
 

@@ -119,12 +119,13 @@ def gpipe(
 
     blocks_spec = jax.tree_util.tree_map(lambda _: P(axis), blocks)
     repl = P()
-    fn = jax.shard_map(
+    from thunder_tpu.distributed.prims import shard_map_compat
+
+    fn = shard_map_compat(
         body,
         mesh=mesh,
         in_specs=(blocks_spec, repl) + tuple(repl for _ in extras),
         out_specs=repl,
-        check_vma=False,
     )
     return fn(blocks, microbatches, *extras)
 

@@ -93,8 +93,10 @@ def ring_attention(
         return ring_attend_shard(qb, kb, vb, axis=axis, sp=sp, causal=causal, scale=scale,
                                  window=window)
 
+    from thunder_tpu.distributed.prims import shard_map_compat
+
     spec = P(None, None, axis, None)
-    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
+    fn = shard_map_compat(body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)
 
 

@@ -83,12 +83,13 @@ def seq_parallel_gpt_loss(params, idx, targets, cos, sin, cfg, *, mesh: Mesh,
         local = -jnp.take_along_axis(logp, tgt_b.reshape(-1, 1), axis=1).sum()
         return jax.lax.psum(local, axis) / (B * T)
 
-    fn = jax.shard_map(
+    from thunder_tpu.distributed.prims import shard_map_compat
+
+    fn = shard_map_compat(
         body,
         mesh=mesh,
         in_specs=(P(), P(None, axis), P(None, axis), P(axis), P(axis)),
         out_specs=P(),
-        check_vma=False,
     )
     return fn(params, idx, targets, cos, sin)
 

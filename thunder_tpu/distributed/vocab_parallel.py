@@ -72,12 +72,13 @@ def tp_fused_linear_ce(
         losses = jnp.where(tgt != ignore_index, lse - tl, 0.0)
         return losses
 
-    losses = jax.shard_map(
+    from thunder_tpu.distributed.prims import shard_map_compat
+
+    losses = shard_map_compat(
         local,
         mesh=mesh,
         in_specs=(P(), P(axis), P()),
         out_specs=P(),
-        check_vma=False,
     )(h, w, target)
 
     if reduction == "none":

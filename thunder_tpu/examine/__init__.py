@@ -17,6 +17,7 @@ __all__ = [
     "get_fusion_symbols",
     "memory_estimate",
     "memory_timeline",
+    "train_memory_report",
     "cost_analysis",
 ]
 
@@ -163,6 +164,17 @@ def memory_timeline(trace) -> dict:
     from thunder_tpu.observability.memory import memory_timeline as _mt
 
     return _mt(trace)
+
+
+def train_memory_report(train_step) -> dict:
+    """Memory accounting for a built distributed ``TrainStep``: the
+    donation-aware fw/bw peaks, the remat policy + residual-bytes delta it
+    bought, the accumulation buffer the scan carries, and the overlap
+    bucket layout (``TrainStep.profile_stats()``, surfaced here so the
+    examine toolkit covers training-step memory the way
+    ``memory_estimate`` covers a single trace).  Requires the step to have
+    run (built) at least once."""
+    return dict(train_step.profile_stats())
 
 
 # hardware peaks (bf16 FLOP/s, HBM bytes/s) keyed by jax backend — the ONE

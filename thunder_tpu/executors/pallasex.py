@@ -680,8 +680,10 @@ def _dispatch(local_fn, operands, specs):
         if not any(s is not None for spec in in_specs for s in tuple(spec)):
             return None
         stats["sharded"] += 1
-        return jax.shard_map(
-            local_fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+        from thunder_tpu.distributed.prims import shard_map_compat
+
+        return shard_map_compat(
+            local_fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs
         )(*operands)
     if any(_concrete_multi_device(x) for x in operands):
         return None

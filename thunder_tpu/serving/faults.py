@@ -62,6 +62,8 @@ __all__ = [
     "FP_VERIFY",
     "FP_HARVEST",
     "FP_SCATTER",
+    "FP_TRAIN_STEP",
+    "FP_CKPT_SAVE",
     "FAULT_POINTS",
     "FAULT_KINDS",
     "FaultSpec",
@@ -89,7 +91,15 @@ FP_DRAFT = "draft.dispatch"
 FP_VERIFY = "verify.dispatch"
 FP_HARVEST = "harvest"
 FP_SCATTER = "scatter"
-FAULT_POINTS = (FP_PREFILL, FP_DECODE, FP_DRAFT, FP_VERIFY, FP_HARVEST, FP_SCATTER)
+# training-plane fault points (thunder_tpu.train.loop / train.checkpoint):
+# FP_TRAIN_STEP fires before the train-step dispatch (params/opt state
+# intact, so transient faults retry the same step) and FP_CKPT_SAVE inside
+# the async checkpoint worker (a failed save surfaces as a harvest record,
+# never into the step path)
+FP_TRAIN_STEP = "train.step"
+FP_CKPT_SAVE = "checkpoint.save"
+FAULT_POINTS = (FP_PREFILL, FP_DECODE, FP_DRAFT, FP_VERIFY, FP_HARVEST, FP_SCATTER,
+                FP_TRAIN_STEP, FP_CKPT_SAVE)
 
 FAULT_KINDS = ("fail", "nan", "oom", "hang")
 

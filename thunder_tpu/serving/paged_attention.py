@@ -61,15 +61,10 @@ __all__ = ["forward_paged", "write_fresh_kv", "write_fresh_kv_live",
 
 
 def _smap(fn, mesh, in_specs, out_specs):
-    """shard_map across jax versions: ``jax.shard_map`` (check_vma) when
-    present, else ``jax.experimental.shard_map`` (check_rep)."""
-    if hasattr(jax, "shard_map"):
-        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_vma=False)
-    from jax.experimental.shard_map import shard_map
+    """shard_map across jax versions — the shared compat shim."""
+    from thunder_tpu.distributed.prims import shard_map_compat
 
-    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                     check_rep=False)
+    return shard_map_compat(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
 
 
 def paged_supported(cfg, model_fn_is_default: bool, mesh=None) -> tuple[bool, str]:

@@ -1,0 +1,65 @@
+"""thunder_tpu.train: production training orchestration layered on TrainStep.
+
+The source paper is a *training* compiler; this package closes the training
+loop at production scale (ROADMAP item 4) with the pieces the pjit
+pretraining playbook (PAPERS.md "Scalable Training of Language Models using
+JAX pjit and TPUv4", TorchTitan) prescribes:
+
+- :mod:`thunder_tpu.train.accum` — in-program gradient accumulation:
+  ``TrainStep(..., accum_steps=k)`` runs k microsteps inside ONE donated XLA
+  program (a ``lax.scan`` over microbatches with a fixed-dtype float32
+  accumulator, fixed summation order), so the donation pass and the
+  peak-bytes estimates see the accumulation buffers.
+- :mod:`thunder_tpu.train.remat` — the trace-layer rematerialization pass as
+  selectable policies: ``remat="none" | "attention" | "full_block"`` with
+  per-policy residual/peak-bytes deltas surfaced via
+  ``TrainStep.profile_stats()``.
+- :mod:`thunder_tpu.train.checkpoint` — async distributed checkpointing
+  (dispatch/harvest off the step path, write-to-temp + fsync + atomic
+  rename, manifest committed last) and torn-checkpoint-tolerant restore.
+- :mod:`thunder_tpu.train.overlap` — bucketed-psum gradient collectives
+  (the torch-DDP ``bucket_cap_mb`` design) issued during backward so XLA's
+  scheduler overlaps them with remaining compute.
+- :mod:`thunder_tpu.train.loop` — the elastic training loop: classifies
+  step/checkpoint failures through the serving fault taxonomy
+  (:mod:`thunder_tpu.serving.faults`) and resumes bit-identically from the
+  last committed checkpoint.
+"""
+from thunder_tpu.train.accum import (
+    accum_buffer_bytes,
+    microbatch_mask,
+    pp_microbatches,
+    split_for_accum,
+)
+from thunder_tpu.train.checkpoint import (
+    AsyncCheckpointer,
+    CheckpointWarning,
+    committed_steps,
+    config_fingerprint,
+    restore_latest,
+    save_checkpoint_atomic,
+)
+from thunder_tpu.train.loop import TrainLoopResult, train_loop
+from thunder_tpu.train.overlap import assign_buckets, bucketed_grad_sync, overlap_fraction
+from thunder_tpu.train.remat import REMAT_POLICIES, RematDecision, resolve_remat
+
+__all__ = [
+    "accum_buffer_bytes",
+    "microbatch_mask",
+    "pp_microbatches",
+    "split_for_accum",
+    "AsyncCheckpointer",
+    "CheckpointWarning",
+    "committed_steps",
+    "config_fingerprint",
+    "restore_latest",
+    "save_checkpoint_atomic",
+    "TrainLoopResult",
+    "train_loop",
+    "assign_buckets",
+    "bucketed_grad_sync",
+    "overlap_fraction",
+    "REMAT_POLICIES",
+    "RematDecision",
+    "resolve_remat",
+]

@@ -1,0 +1,101 @@
+"""In-program gradient accumulation: the microbatch split for
+``TrainStep(..., accum_steps=k)``.
+
+The reference accumulates across *optimizer-skipping host steps*
+(``no_sync``, ``thunder/distributed/__init__.py:200-242``) — k dispatches, k
+grad pytrees alive on the host, and the data-parallel all-reduce paid per
+microstep.  The TPU-native design runs the whole accumulation inside ONE
+compiled, donated program: a ``lax.scan`` over the microbatch axis with a
+float32 accumulator in fixed summation order (microstep 0 first, always), so
+
+- the accumulator buffers are part of the program and therefore visible to
+  the donation pass and the peak-bytes estimates
+  (:func:`accum_buffer_bytes` feeds ``TrainStep.donation_report`` /
+  ``profile_stats``);
+- per-microstep activations are sized ``B/k`` — the activation peak *drops*
+  as k grows (the reason accumulation exists);
+- numerics are deterministic: fixed dtype (float32), fixed order, so the
+  same inputs always produce bit-identical grads, and the result matches a
+  single k×-batch step up to float reassociation.
+
+Only the helpers live here (pure shape logic, unit-testable without a
+mesh); the scan itself is built inside ``TrainStep._build`` where the
+traced fw/bw functions and shardings exist.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["microbatch_mask", "split_for_accum", "accum_buffer_bytes", "pp_microbatches"]
+
+
+def microbatch_mask(batch: Sequence) -> tuple[bool, ...]:
+    """Which batch args carry the batch dim (and therefore split into
+    microbatches).  Same rule as ``default_batch_shardings``: leading dim
+    equals ``batch[0]``'s, and the arg is integer-typed (token ids/targets)
+    or shares the leading-shape prefix.  Replicated side inputs (rope
+    caches) are passed whole to every microstep."""
+    b0_shape = tuple(jnp.shape(batch[0]))
+    bsz = b0_shape[0] if b0_shape else None
+
+    def _split(b) -> bool:
+        shp = tuple(jnp.shape(b))
+        if not shp or shp[0] != bsz:
+            return False
+        dt = getattr(b, "dtype", None)
+        if dt is not None and jnp.issubdtype(dt, jnp.integer):
+            return True
+        k = min(len(shp), len(b0_shape))
+        return shp[:k] == b0_shape[:k]
+
+    return tuple(_split(b) for b in batch)
+
+
+def split_for_accum(batch: Sequence, accum_steps: int, mask: Sequence[bool] | None = None):
+    """Reshapes each batch-dim arg ``(B, ...) -> (k, B//k, ...)``; replicated
+    args pass through.  Raises ``ValueError`` when the batch size does not
+    divide ``accum_steps`` (a silent drop would change the loss)."""
+    if mask is None:
+        mask = microbatch_mask(batch)
+    k = int(accum_steps)
+    out = []
+    for b, m in zip(batch, mask):
+        if not m:
+            out.append(b)
+            continue
+        B = jnp.shape(b)[0]
+        if B % k != 0:
+            raise ValueError(
+                f"accum_steps={k} must divide the batch size {B} "
+                f"(arg shape {tuple(jnp.shape(b))})"
+            )
+        out.append(jnp.reshape(b, (k, B // k) + tuple(jnp.shape(b))[1:]))
+    return tuple(out), tuple(mask)
+
+
+def accum_buffer_bytes(params) -> int:
+    """Bytes of the float32 gradient accumulator the in-program scan carries
+    (one f32 buffer per inexact param leaf) — added to the donated-aware
+    peak estimate so ``accum_steps=k`` memory accounting is honest."""
+    total = 0
+    for x in jax.tree_util.tree_leaves(params):
+        if hasattr(x, "dtype") and jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact):
+            total += int(jnp.size(x)) * 4
+    return total
+
+
+def pp_microbatches(accum_steps: int, batch_size: int) -> int:
+    """Microbatch count for the GPipe schedule, riding the accumulation
+    knob: ``accum_steps`` when it divides the batch (pipeline microbatching
+    and gradient accumulation are the same split, so one knob drives both),
+    else the largest divisor of ``batch_size`` not exceeding it."""
+    k = max(int(accum_steps), 1)
+    if batch_size % k == 0:
+        return k
+    for n in range(min(k, batch_size), 0, -1):
+        if batch_size % n == 0:
+            return n
+    return 1

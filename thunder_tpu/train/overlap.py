@@ -1,0 +1,131 @@
+"""Bucketed-psum gradient collectives: overlap grad sync with backward.
+
+Under plain SPMD the data-parallel gradient reduction is one property of
+the sharding — XLA emits whatever all-reduces it likes, usually after the
+whole backward.  Production DDP stacks instead *bucket* gradients
+(torch DDP ``bucket_cap_mb``, reference ``distributed/transforms/ddp.py``)
+and issue one collective per bucket as soon as its gradients are produced,
+so the reductions for early buckets overlap the rest of the backward.
+
+TPU-native realization: the train step body runs inside ``jax.shard_map``
+over the ``dp`` axis (params replicated, batch sharded), computes the
+*local* grads with the framework-traced fw/bw functions, then issues ONE
+``jax.lax.psum`` per bucket — a variadic all-reduce XLA's latency-hiding
+scheduler is free to hoist into the backward.  Buckets are filled in
+*reverse* leaf order (backward produces late-layer grads first), capped at
+``bucket_mb``.
+
+The overlap fraction is analytic: every bucket except the last can overlap
+remaining backward compute, so ``overlap_frac = 1 - last_bucket_bytes /
+total_bytes`` — measured into the metrics registry as
+``train.step.overlap_frac`` (the training-plane sibling of
+``serving.step.overlap_frac``).
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from thunder_tpu.observability.metrics import registry
+
+__all__ = ["assign_buckets", "overlap_fraction", "bucketed_grad_sync", "validate_overlap_mesh"]
+
+
+def _leaf_bytes(x) -> int:
+    return int(jnp.size(x)) * jnp.asarray(x).dtype.itemsize if hasattr(x, "dtype") else 0
+
+
+def assign_buckets(leaves: Sequence, bucket_mb: float) -> list[list[int]]:
+    """Groups leaf *indices* into buckets of at most ``bucket_mb`` MiB, in
+    reverse leaf order (the order backward produces them).  A single leaf
+    larger than the cap gets its own bucket — never split, never dropped."""
+    cap = max(float(bucket_mb), 0.0) * 2**20
+    buckets: list[list[int]] = []
+    cur: list[int] = []
+    cur_bytes = 0
+    for i in reversed(range(len(leaves))):
+        nb = _leaf_bytes(leaves[i])
+        if cur and cur_bytes + nb > cap:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += nb
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def overlap_fraction(leaves: Sequence, buckets: list[list[int]]) -> float:
+    """Fraction of gradient bytes whose reduction can overlap backward
+    compute: everything except the final bucket (which has no compute left
+    to hide behind)."""
+    total = sum(_leaf_bytes(x) for x in leaves)
+    if total == 0 or not buckets:
+        return 0.0
+    last = sum(_leaf_bytes(leaves[i]) for i in buckets[-1])
+    return 1.0 - last / total
+
+
+def validate_overlap_mesh(mesh, axis: str = "dp") -> None:
+    """Bucketed grad sync is the DDP design: it needs a pure data-parallel
+    mesh (params replicated over ``axis``; any other axis must be trivial).
+    FSDP/TP meshes keep the SPMD path — their reductions are layout
+    transitions, not plain all-reduces."""
+    if axis not in mesh.shape:
+        raise ValueError(f"overlap=True needs a {axis!r} mesh axis, mesh has {dict(mesh.shape)}")
+    extra = {a: s for a, s in mesh.shape.items() if a != axis and s > 1}
+    if extra:
+        raise ValueError(
+            f"overlap=True supports pure data-parallel ({axis!r}) meshes; "
+            f"non-trivial axes {extra} keep the SPMD grad-sync path"
+        )
+
+
+def bucketed_grad_sync(grads, *, axis: str, buckets: list[list[int]]):
+    """Inside ``shard_map``: mean-reduces ``grads`` over ``axis``, one
+    variadic ``psum`` per bucket.  Returns the synced pytree (same
+    structure/dtypes)."""
+    flat, treedef = jax.tree_util.tree_flatten(grads)
+    n = jax.lax.psum(1, axis)
+    out = list(flat)
+    for bucket in buckets:
+        vals = jax.lax.psum(tuple(flat[i] for i in bucket), axis)
+        for i, v in zip(bucket, vals):
+            out[i] = v / n
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def overlap_report(grad_leaves: Sequence, buckets: list[list[int]], bucket_mb: float) -> dict:
+    """The analytic overlap accounting TrainStep exposes and mirrors into
+    the registry (``train.step.overlap_frac`` / ``train.step.grad_buckets``)."""
+    total = sum(_leaf_bytes(x) for x in grad_leaves)
+    frac = overlap_fraction(grad_leaves, buckets)
+    reg = registry()
+    reg.gauge("train.step.overlap_frac").set(frac)
+    reg.gauge("train.step.grad_buckets").set(len(buckets))
+    return {
+        "bucket_mb": float(bucket_mb),
+        "n_buckets": len(buckets),
+        "total_grad_bytes": int(total),
+        "bucket_bytes": [int(sum(_leaf_bytes(grad_leaves[i]) for i in b)) for b in buckets],
+        "overlap_frac": float(frac),
+    }
+
+
+def bucket_cap_suggestion(total_bytes: int, target_buckets: int = 4) -> float:
+    """A starting ``bucket_mb`` that yields roughly ``target_buckets``
+    buckets (tuning helper; torch's default 25 MiB is sized for NCCL rings,
+    not ICI)."""
+    if total_bytes <= 0 or target_buckets <= 0:
+        return 25.0
+    return max(total_bytes / target_buckets / 2**20, 1e-3)
+
+
+def expected_all_reduces(buckets: list[list[int]]) -> int:
+    """All-reduce count the bucketed program should show in compiled HLO:
+    one per bucket plus one for the scalar loss mean.  XLA may still merge
+    adjacent ones past a combine threshold — census checks should treat
+    this as an upper bound."""
+    return len(buckets) + 1

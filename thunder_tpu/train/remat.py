@@ -1,0 +1,74 @@
+"""Selectable rematerialization policies for TrainStep.
+
+The trace-layer pass (:mod:`thunder_tpu.core.rematerialization`) was wired
+to a boolean; production training wants *policies* (TorchTitan's
+``activation_checkpoint.mode = none | selective | full``):
+
+- ``"none"``        — save every residual; fastest backward, largest peak.
+- ``"attention"``   — the default selective policy: recompute cheap-op
+  producer cones (elementwise/norm/rope chains) behind the anchor ops
+  (matmul/reduction/RNG/embedding stay saved), ``max_cone=64``.  This is
+  what ``remat=True`` always meant; attention score chains are the bulk of
+  what it drops.
+- ``"full_block"``  — aggressive: anchors (matmuls included) are recomputed
+  too, residuals shrink toward the layer inputs (``max_cone=256``,
+  ``aggressive=True``) — the full-activation-checkpoint / ZeRO-3 regather
+  regime.
+
+Booleans and ``"auto"`` stay accepted (``True`` ≡ ``"attention"``,
+``False`` ≡ ``"none"``; ``"auto"`` resolves by the memory-budget probe).
+``zero3=True`` forces ``"full_block"`` regardless, as before.
+"""
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+__all__ = ["REMAT_POLICIES", "RematDecision", "resolve_remat"]
+
+#: the selectable policy names, weakest to strongest
+REMAT_POLICIES = ("none", "attention", "full_block")
+
+
+class RematDecision(NamedTuple):
+    """Resolved policy: whether the pass runs and with which knobs."""
+
+    policy: str          # one of REMAT_POLICIES
+    apply: bool          # run rematerialize_forward_and_backward at all
+    max_cone: int        # recompute-cone size cap
+    aggressive: bool     # recompute anchor ops (matmuls) too
+
+
+_BY_POLICY = {
+    "none": RematDecision("none", False, 0, False),
+    "attention": RematDecision("attention", True, 64, False),
+    "full_block": RematDecision("full_block", True, 256, True),
+}
+
+
+def validate_remat(remat) -> None:
+    """Raises ``ValueError`` for anything outside the accepted vocabulary
+    (bool, ``"auto"``, or a :data:`REMAT_POLICIES` name)."""
+    if isinstance(remat, bool) or remat == "auto" or remat in REMAT_POLICIES:
+        return
+    raise ValueError(
+        f"remat must be True, False, 'auto', or one of {REMAT_POLICIES}, got {remat!r}"
+    )
+
+
+def resolve_remat(remat, *, zero3: bool = False, auto: Callable[[], bool] | None = None) -> RematDecision:
+    """Maps the user-facing ``remat=`` value to a :class:`RematDecision`.
+
+    ``auto`` is the deferred memory-budget probe (``TrainStep._auto_remat``)
+    — called only when ``remat="auto"`` and ``zero3`` is off."""
+    validate_remat(remat)
+    if zero3:
+        # ZeRO-3 is the aggressive regime by construction: residuals shrink
+        # toward the inputs so XLA re-gathers sharded params in the
+        # recompute cones (reference rematerialization.py:389)
+        return _BY_POLICY["full_block"]
+    if remat == "auto":
+        want = bool(auto()) if auto is not None else True
+        return _BY_POLICY["attention" if want else "none"]
+    if isinstance(remat, bool):
+        return _BY_POLICY["attention" if remat else "none"]
+    return _BY_POLICY[remat]

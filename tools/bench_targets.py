@@ -41,6 +41,7 @@ __all__ = [
     "check_sessions_targets",
     "check_goodput_targets",
     "check_ragged_targets",
+    "check_scaling_targets",
 ]
 
 # generous: CI hosts jitter, and the gate exists to catch the donate=False
@@ -837,3 +838,105 @@ def check_goodput_targets(artifact: dict | None = None, *,
     )
     assert 0.0 <= r["token_goodput_frac"] <= r["goodput_frac"] <= 1.0, r
     return artifact
+
+
+def check_scaling_targets(artifact: dict | None = None, *,
+                          min_remat_reduction: float = 0.15,
+                          min_overlap_frac: float = 0.5,
+                          loss_tol: float = 1e-4) -> dict:
+    """Validates the BENCH_SCALING.json artifact: the distributed tokens/s
+    table (every mode x mesh size measured) plus the production-training
+    knob sweeps, which are deterministic facts rather than timings:
+
+    - remat: peak bytes monotone nonincreasing none -> attention ->
+      full_block, full_block at least ``min_remat_reduction`` below none,
+      and the loss bit-stable across policies (recompute changes memory,
+      never math);
+    - accum: the peak curve over k must not grow (microbatch activations
+      shrink faster than the f32 accumulator adds), losses within float
+      reassociation of k=1;
+    - overlap: shrinking the bucket cap must never DEcrease the bucket
+      count or the analytic overlap fraction, and the bucketed-psum step
+      must reproduce plain SPMD grads (parity flag);
+    - restart: the mid-run-kill elastic-restart episode's loss curve must
+      be bit-identical to the undisturbed run.
+
+    Returns the artifact for chaining."""
+    if artifact is None:
+        artifact = load_artifact("BENCH_SCALING.json")
+    assert "backend" in artifact and "results" in artifact, sorted(artifact)
+    r = artifact["results"]
+    for key in (
+        "modes", "remat", "remat_peak_reduction_frac", "remat_loss_max_delta",
+        "accum", "accum_loss_max_delta", "overlap", "overlap_grad_parity",
+        "restart_loss_bitident",
+    ):
+        assert key in r, (key, sorted(r))
+    for mode in ("ddp", "fsdp", "tp"):
+        assert mode in r["modes"], (mode, sorted(r["modes"]))
+        for n, tps in r["modes"][mode].items():
+            assert tps > 0, (mode, n, tps)
+
+    peaks = [r["remat"][p]["peak_bytes"] for p in ("none", "attention", "full_block")]
+    assert peaks[0] >= peaks[1] >= peaks[2], (
+        f"remat peak-bytes curve is not monotone nonincreasing over "
+        f"none/attention/full_block: {peaks} — a more aggressive policy "
+        f"must never save MORE residuals"
+    )
+    assert r["remat_peak_reduction_frac"] >= min_remat_reduction, (
+        f"remat full_block cut peak bytes by only "
+        f"{r['remat_peak_reduction_frac']:.1%} < {min_remat_reduction:.0%} — "
+        f"the rematerialization pass stopped pruning residuals"
+    )
+    assert r["remat_loss_max_delta"] <= loss_tol, (
+        f"remat changed the loss by {r['remat_loss_max_delta']} — "
+        f"recompute must be a memory transform, not a math transform"
+    )
+
+    ks = sorted(r["accum"], key=int)
+    acc_peaks = [r["accum"][k]["peak_bytes"] for k in ks]
+    assert all(a >= b for a, b in zip(acc_peaks, acc_peaks[1:])), (
+        f"accum peak-bytes curve grew with k: {dict(zip(ks, acc_peaks))} — "
+        f"microbatching is supposed to trade steps for memory"
+    )
+    assert r["accum_loss_max_delta"] <= loss_tol, (
+        f"accum loss drifted {r['accum_loss_max_delta']} from the k=1 step — "
+        f"beyond float reassociation, the microstep sum is wrong"
+    )
+
+    caps = sorted((float(c) for c in r["overlap"]), reverse=True)
+    buckets = [r["overlap"][_cap_key(r["overlap"], c)]["n_buckets"] for c in caps]
+    fracs = [r["overlap"][_cap_key(r["overlap"], c)]["overlap_frac"] for c in caps]
+    assert all(a <= b for a, b in zip(buckets, buckets[1:])), (
+        f"bucket count fell as the cap shrank: {dict(zip(caps, buckets))} — "
+        f"smaller buckets must mean more of them"
+    )
+    # the fraction itself is NOT monotone in the cap (the LAST bucket's
+    # relative size is what it measures) — the invariants are the identity
+    # frac==0 <-> one bucket, and real overlap once the cap bites
+    for c, nb, fr in zip(caps, buckets, fracs):
+        assert 0.0 <= fr < 1.0, (c, fr)
+        assert (nb == 1) == (fr == 0.0), (
+            f"overlap_frac {fr} with {nb} bucket(s) at cap {c} MiB breaks "
+            f"the 1 - last_bucket/total identity"
+        )
+    assert max(fracs) >= min_overlap_frac, (
+        f"best overlap fraction {max(fracs):.2f} < {min_overlap_frac} — "
+        f"bucketing never exposed meaningful reduction/backward overlap"
+    )
+    assert r["overlap_grad_parity"] is True, (
+        "bucketed-psum gradients diverged from the plain SPMD step — "
+        "overlap is an ordering optimization, the math must be identical"
+    )
+    assert r["restart_loss_bitident"] is True, (
+        "the elastic-restart episode's loss curve is not bit-identical to "
+        "the undisturbed run — resume replayed different math"
+    )
+    return artifact
+
+
+def _cap_key(overlap: dict, cap: float) -> str:
+    for k in overlap:
+        if float(k) == cap:
+            return k
+    raise KeyError(cap)

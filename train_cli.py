@@ -58,14 +58,37 @@ def main(argv=None):
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--seq", type=int, default=None, help="sequence length (default: min(block_size, 128))")
     ap.add_argument("--steps", type=int, default=10)
-    ap.add_argument("--accum", type=int, default=1, help="gradient-accumulation micro steps")
+    ap.add_argument("--accum", type=int, default=1,
+                    help="host-loop gradient accumulation (k calls to the grads/apply "
+                         "entries per optimizer step)")
+    ap.add_argument("--accum-steps", type=int, default=1,
+                    help="IN-PROGRAM gradient accumulation: one donated program scans k "
+                         "microbatches with a float32 accumulator (TrainStep modes; in pp "
+                         "mode k rides the GPipe microbatch schedule instead)")
     ap.add_argument("--dtype", default="float32", choices=["float32", "bfloat16"])
     ap.add_argument("--lr", type=float, default=1e-3)
     ap.add_argument("--no-remat", action="store_true")
-    ap.add_argument("--remat", default=None, choices=["on", "off", "auto"],
-                    help="activation rematerialization; 'auto' pays recompute only "
-                         "when residuals would not fit device memory (overrides --no-remat)")
-    ap.add_argument("--checkpoint-dir", default=None, help="save a checkpoint at the end (orbax)")
+    ap.add_argument("--remat", default=None,
+                    choices=["on", "off", "auto", "none", "attention", "full_block"],
+                    help="activation rematerialization: on/off/auto (legacy) or a policy — "
+                         "none, attention (recompute attention internals), full_block "
+                         "(aggressive, residuals shrink toward the inputs; what zero3 forces)")
+    ap.add_argument("--overlap", action="store_true",
+                    help="bucketed-psum gradient collectives overlapping the backward "
+                         "(pure-dp meshes; the torch-DDP bucket_cap_mb design)")
+    ap.add_argument("--overlap-bucket-mb", type=float, default=4.0,
+                    help="gradient bucket cap in MiB for --overlap")
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="checkpoint directory: with --checkpoint-every the async atomic "
+                         "checkpointer writes here during the run; otherwise one final "
+                         "save (orbax) lands here")
+    ap.add_argument("--checkpoint-every", type=int, default=0,
+                    help="dispatch an async atomic checkpoint every N optimizer steps "
+                         "(train.checkpoint; 0 = off)")
+    ap.add_argument("--resume", action="store_true",
+                    help="resume from the newest committed checkpoint in --checkpoint-dir "
+                         "(torn checkpoints are skipped with a structured warning); the "
+                         "replayed loss curve is bit-identical to an undisturbed run")
     ap.add_argument("--telemetry", default=None,
                     help="per-step JSONL telemetry path (StepLogger: loss, step time, "
                          "tokens/sec, peak-bytes estimate; mirrored into the metrics registry)")
@@ -125,7 +148,14 @@ def main(argv=None):
         elif args.mode == "pp":
             pp = args.devices
             assert cfg.n_layer % pp == 0, f"n_layer {cfg.n_layer} must divide over pp={pp}"
-            n_micro = 2 if args.batch % 2 == 0 else 1
+            # --accum-steps rides the GPipe schedule: more microbatches
+            # per step IS pipeline-parallel gradient accumulation (the
+            # bubble shrinks as k grows); clamped to a divisor of the batch
+            from thunder_tpu.train import pp_microbatches
+
+            n_micro = pp_microbatches(
+                args.accum_steps if args.accum_steps > 1 else 2, args.batch
+            )
             mesh = dist.make_mesh({"pp": pp}, devices=devices)
             train_params = dist.place_pipeline_params(dist.stack_blocks(params), mesh)
 
@@ -174,29 +204,47 @@ def main(argv=None):
         def loss_fn(p, i, t, c, s):
             return llama.gpt_loss(p, i, t, c, s, cfg)
 
+        remat_arg = (
+            {"on": True, "off": False, "auto": "auto"}.get(args.remat, args.remat)
+            if args.remat else not args.no_remat
+        )
         train_step = dist.make_train_step(
             loss_fn, optimizer, mesh,
-            remat=({"on": True, "off": False, "auto": "auto"}[args.remat]
-                   if args.remat else not args.no_remat),
+            remat=remat_arg,
             zero3=(args.mode == "zero3"),
             quant=args.quant, comm_combine_threshold_mb=args.comm_combine_mb,
             bucketer=llama.batch_bucketer(cfg) if args.bucket else None,
+            accum_steps=args.accum_steps,
+            overlap=args.overlap, overlap_bucket_mb=args.overlap_bucket_mb,
         )
         opt_state = train_step.init_optimizer_state(params)
         step = train_step
         accumulate = train_step.accumulate
         train_step_obj = train_step
 
+    elastic = args.checkpoint_every > 0 or args.resume
+    if elastic:
+        assert args.checkpoint_dir, "--checkpoint-every/--resume need --checkpoint-dir"
+        assert train_step_obj is not None, (
+            "--checkpoint-every/--resume need a TrainStep mode (not sp/pp/ep)")
+        assert args.accum == 1, "--checkpoint-every composes with --accum-steps, not --accum"
+
     t0 = time.perf_counter()
-    if args.accum > 1:
+    if elastic:
+        # the elastic loop is step-indexed: every step (including the first)
+        # runs inside train_loop so a resumed run replays the exact same
+        # step sequence — no out-of-band warmup step to desync the curve
+        loss = None
+    elif args.accum > 1:
         assert accumulate is not None, "--accum needs a TrainStep mode (not sp/pp/ep)"
         mb = args.batch // args.accum
         micro = [(idx[k * mb:(k + 1) * mb], tgt[k * mb:(k + 1) * mb], cos, sin) for k in range(args.accum)]
         params, opt_state, loss = accumulate(params, opt_state, micro)
     else:
         params, opt_state, loss = step(params, opt_state, idx, tgt, cos, sin)
-    jax.block_until_ready(loss)
-    log(f"compile+first step: {time.perf_counter()-t0:.1f}s loss={float(loss):.4f}")
+    if loss is not None:
+        jax.block_until_ready(loss)
+        log(f"compile+first step: {time.perf_counter()-t0:.1f}s loss={float(loss):.4f}")
 
     # per-step telemetry (observability.telemetry.StepLogger): one JSONL
     # record per optimizer step, mirrored into the metrics registry.  The
@@ -208,10 +256,21 @@ def main(argv=None):
     if args.telemetry:
         from thunder_tpu.observability.telemetry import StepLogger, trace_peak_bytes
 
+        # run_start carries the FULL training config: a resumed run (or a
+        # postmortem) must be able to reconstruct every knob from record 0
         telemetry = StepLogger(args.telemetry, meta={
             "config": cfg.name, "mode": args.mode, "devices": args.devices,
             "batch": args.batch, "seq": T, "dtype": args.dtype,
             "accum": args.accum, "quant": args.quant,
+            "accum_steps": args.accum_steps,
+            "remat": (args.remat or ("off" if args.no_remat else "on")),
+            "overlap": bool(args.overlap),
+            "overlap_bucket_mb": args.overlap_bucket_mb,
+            "checkpoint_dir": args.checkpoint_dir,
+            "checkpoint_every": args.checkpoint_every,
+            "resume": bool(args.resume),
+            "mesh_axes": dict(mesh.shape),
+            "lr": args.lr,
         })
         if getattr(train_step_obj, "fw_trace", None) is not None:
             peak_bytes = max(
@@ -222,36 +281,90 @@ def main(argv=None):
             + (f" (peak_bytes_estimate={peak_bytes})" if peak_bytes else ""))
 
     t0 = time.perf_counter()
-    last = loss
-    for k in range(args.steps):
-        t_step = time.perf_counter()
-        if args.accum > 1:
-            params, opt_state, last = accumulate(params, opt_state, micro)
-        else:
-            params, opt_state, last = step(params, opt_state, idx, tgt, cos, sin)
-        if telemetry is not None:
-            jax.block_until_ready(last)
-            gn = None
-            if args.telemetry_grad_norm and train_step_obj is not None and args.accum == 1:
-                import optax as _optax
+    restarts = resumed_from = None
+    if elastic:
+        from thunder_tpu.observability.telemetry import trace_peak_bytes as _tpb
+        from thunder_tpu.train import AsyncCheckpointer, restore_latest, train_loop
 
-                _, g = train_step_obj.grads(params, opt_state, idx, tgt, cos, sin)
-                gn = float(_optax.global_norm(g))
-            telemetry.log_step(
-                k,
-                loss=float(last),
-                grad_norm=gn,
-                step_time_s=time.perf_counter() - t_step,
-                tokens=args.batch * T,
-                peak_bytes=peak_bytes,
+        # the config fingerprint in each manifest: resuming under silently
+        # different knobs is a divergence, not a resume
+        train_config = {"config": cfg.name, "mode": args.mode,
+                        "devices": args.devices, "batch": args.batch, "seq": T,
+                        "dtype": args.dtype, "accum_steps": args.accum_steps,
+                        "lr": args.lr}
+        start_step = 0
+        if args.resume:
+            got = restore_latest(args.checkpoint_dir,
+                                 {"params": params, "opt_state": opt_state},
+                                 config=train_config)
+            if got is not None:
+                start_step, state = got
+                params, opt_state = state["params"], state["opt_state"]
+                log(f"resumed from committed checkpoint step {start_step}")
+            else:
+                log("no committed checkpoint found; starting from scratch")
+        resumed_from = start_step if args.resume else None
+
+        t_prev = [time.perf_counter()]
+        peak_holder = [peak_bytes]
+
+        def on_step(s, loss_s):
+            now = time.perf_counter()
+            if telemetry is not None:
+                if peak_holder[0] is None and getattr(train_step_obj, "fw_trace", None) is not None:
+                    peak_holder[0] = max(_tpb(train_step_obj.fw_trace),
+                                         _tpb(train_step_obj.bw_trace))
+                telemetry.log_step(
+                    s, loss=float(loss_s), step_time_s=now - t_prev[0],
+                    tokens=args.batch * T, peak_bytes=peak_holder[0],
+                )
+            t_prev[0] = now
+
+        with AsyncCheckpointer(args.checkpoint_dir, config=train_config) as ck:
+            res = train_loop(
+                step, params, opt_state, lambda s: (idx, tgt, cos, sin),
+                steps=args.steps, start_step=start_step,
+                checkpointer=ck, checkpoint_every=args.checkpoint_every,
+                on_step=on_step,
             )
-    jax.block_until_ready(last)
-    dt = time.perf_counter() - t0
+        params, opt_state = res.params, res.opt_state
+        last = res.losses[-1] if res.losses and res.losses[-1] is not None else float("nan")
+        restarts = res.restarts
+        steps_done = max(args.steps - start_step, 1)
+        jax.block_until_ready(last)
+        dt = time.perf_counter() - t0
+    else:
+        last = loss
+        for k in range(args.steps):
+            t_step = time.perf_counter()
+            if args.accum > 1:
+                params, opt_state, last = accumulate(params, opt_state, micro)
+            else:
+                params, opt_state, last = step(params, opt_state, idx, tgt, cos, sin)
+            if telemetry is not None:
+                jax.block_until_ready(last)
+                gn = None
+                if args.telemetry_grad_norm and train_step_obj is not None and args.accum == 1:
+                    import optax as _optax
+
+                    _, g = train_step_obj.grads(params, opt_state, idx, tgt, cos, sin)
+                    gn = float(_optax.global_norm(g))
+                telemetry.log_step(
+                    k,
+                    loss=float(last),
+                    grad_norm=gn,
+                    step_time_s=time.perf_counter() - t_step,
+                    tokens=args.batch * T,
+                    peak_bytes=peak_bytes,
+                )
+        jax.block_until_ready(last)
+        dt = time.perf_counter() - t0
+        steps_done = args.steps
     if telemetry is not None:
         telemetry.close()
-    tps = args.batch * T * args.steps / dt
+    tps = args.batch * T * steps_done / dt
 
-    if args.checkpoint_dir:
+    if args.checkpoint_dir and not elastic:
         from thunder_tpu.distributed import save_checkpoint
 
         save_checkpoint(args.checkpoint_dir, {"params": params, "opt_state": opt_state}, step=args.steps)
@@ -261,7 +374,12 @@ def main(argv=None):
         "config": cfg.name, "mode": args.mode, "devices": args.devices,
         "quant": args.quant,
         "fused_ce": bool(args.fused_ce),
-        "tokens_per_sec": round(tps, 1), "ms_per_step": round(dt / args.steps * 1e3, 2),
+        "accum_steps": args.accum_steps,
+        "remat": (args.remat or ("off" if args.no_remat else "on")),
+        "overlap": bool(args.overlap),
+        "checkpoint_every": args.checkpoint_every,
+        "resumed_from": resumed_from, "restarts": restarts,
+        "tokens_per_sec": round(tps, 1), "ms_per_step": round(dt / steps_done * 1e3, 2),
         "final_loss": round(float(last), 4),
     }))
 
